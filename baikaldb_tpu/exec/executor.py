@@ -1,0 +1,223 @@
+"""Plan executor: lower the plan IR to one jit-compiled kernel pipeline.
+
+This replaces BOTH of the reference's execution modes: the volcano
+open/get_next interpreter (include/exec/exec_node.h:140-145) and the Acero
+declaration path (GlobalArrowExecutor::execute,
+src/runtime/arrow_io_excutor.cpp:265).  The whole query — scan filters,
+projections, group-by, joins, sort — traces into a single XLA program, so
+operator boundaries cost nothing: XLA fuses scan+filter+aggregate into a few
+HBM passes (the fusion the reference hopes Acero's pipelining approximates).
+
+Static-shape discipline: join/limit caps are compile-time constants; join
+overflow is detected via returned flags and retried with doubled caps
+(recompile), the analog of the reference re-fetching on region-version change
+(fetcher_store.cpp handle_version_old).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dreplace
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..column.batch import Column, ColumnBatch
+from ..expr.ast import ColRef, Lit
+from ..expr.compile import eval_expr, eval_output, eval_predicate, infer_type
+from ..ops import join as join_ops
+from ..ops.compact import compact, head
+from ..ops.hashagg import (AggSpec, group_aggregate_dense,
+                           group_aggregate_sorted, scalar_aggregate)
+from ..ops.sort import SortKey, sort_batch, top_k
+from ..plan.nodes import (AggNode, DistinctNode, FilterNode, JoinNode,
+                          LimitNode, PlanNode, ProjectNode, ScanNode, SortNode,
+                          UnionNode, ValuesNode)
+from ..column.batch import concat_batches
+from ..types import LType
+
+
+class ExecError(RuntimeError):
+    pass
+
+
+def compile_plan(plan: PlanNode) -> Callable:
+    """-> fn(table_batches: dict) -> (ColumnBatch, overflow_flags list).
+
+    The returned fn is pure/traceable; wrap in jax.jit by the session.  Join
+    caps live on the plan nodes (mutated by the retry loop, forcing re-trace).
+    """
+
+    join_order: list = []
+
+    def run(batches: dict):
+        overflows: list = []
+        out = _eval(plan, batches, overflows)
+        # nodes are host objects: expose them on the closure (filled at trace
+        # time), return only the traced flags
+        join_order.clear()
+        join_order.extend(n for n, _ in overflows)
+        return out, tuple(f for _, f in overflows)
+
+    run.join_order = join_order
+    return run
+
+
+def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
+    if isinstance(node, ScanNode):
+        b = batches[node.table_key]
+        names = tuple(f"{node.label}.{c}" for c in node.columns)
+        cols = [b.column(c) for c in node.columns]
+        out = ColumnBatch(names, cols, b.sel, b.num_rows)
+        if node.pushed_filter is not None:
+            out = out.and_sel(eval_predicate(node.pushed_filter, out))
+        return out
+
+    if isinstance(node, FilterNode):
+        child = _eval(node.child(), batches, overflows)
+        return child.and_sel(eval_predicate(node.pred, child))
+
+    if isinstance(node, ProjectNode):
+        child = _eval(node.child(), batches, overflows)
+        n = len(child)
+        cols = []
+        for e in node.exprs:
+            c = eval_output(e, child)
+            cols.append(_broadcast(c, n))
+        return ColumnBatch(tuple(node.names), cols, child.sel, child.num_rows)
+
+    if isinstance(node, JoinNode):
+        left = _eval(node.children[0], batches, overflows)
+        right = _eval(node.children[1], batches, overflows)
+        if node.how == "cross":
+            if node.cap is None:
+                node.cap = max(1, len(left) * len(right))
+            out, ovf = join_ops.cross_join(left, right, cap=node.cap)
+        else:
+            if node.cap is None:
+                node.cap = max(1, len(left))
+            out, ovf = join_ops.join(left, node.left_keys, right,
+                                     node.right_keys, how=node.how, cap=node.cap)
+        overflows.append((node, ovf))
+        # label-qualified names are globally unique, no suffixing occurs
+        return out
+
+    if isinstance(node, AggNode):
+        child = _eval(node.child(), batches, overflows)
+        if not node.key_names:
+            return scalar_aggregate(child, node.specs)
+        shift = getattr(node, "key_shift", {}) or {}
+        if node.strategy == "dense":
+            work = child
+            if shift:
+                cols = list(work.columns)
+                for kn, mn in shift.items():
+                    i = work.names.index(kn)
+                    c = cols[i]
+                    cols[i] = dreplace(c, data=c.data - jnp.asarray(mn, c.data.dtype))
+                work = ColumnBatch(work.names, cols, work.sel, work.num_rows)
+            out = group_aggregate_dense(work, node.key_names, node.domains,
+                                        node.specs)
+            if shift:
+                cols = list(out.columns)
+                for kn, mn in shift.items():
+                    i = out.names.index(kn)
+                    c = cols[i]
+                    cols[i] = dreplace(c, data=c.data + jnp.asarray(mn, c.data.dtype))
+                out = ColumnBatch(out.names, cols, out.sel, out.num_rows)
+            return out
+        mg = node.max_groups or max(1, len(child))
+        return group_aggregate_sorted(child, node.key_names, node.specs, mg)
+
+    if isinstance(node, DistinctNode):
+        child = _eval(node.child(), batches, overflows)
+        mg = max(1, len(child))
+        return group_aggregate_sorted(child, list(child.names), [], mg)
+
+    if isinstance(node, SortNode):
+        child = _eval(node.child(), batches, overflows)
+        keys = [SortKey(k, asc) for k, asc in node.keys]
+        if node.limit is not None:
+            out = top_k(child, keys, node.limit + node.offset)
+            if node.offset:
+                out = head(out, node.limit, node.offset)
+            return out
+        return sort_batch(child, keys)
+
+    if isinstance(node, LimitNode):
+        child = _eval(node.child(), batches, overflows)
+        return head(child, node.limit, node.offset)
+
+    if isinstance(node, UnionNode):
+        parts = [compact(_eval(c, batches, overflows)) for c in node.children]
+        names = [f.name for f in node.schema.fields]
+        parts = [p.rename(names) for p in parts]
+        parts = [_harmonize(p, node.schema) for p in parts]
+        parts = _align_string_dicts(parts)
+        return concat_batches(parts)
+
+    if isinstance(node, ValuesNode):
+        cols = []
+        empty = ColumnBatch((), [], None, None)
+        for i, e in enumerate(node.exprs[0]):
+            c = eval_output(e, empty)
+            cols.append(_broadcast(c, 1))
+        return ColumnBatch(tuple(node.names), cols)
+
+    raise ExecError(f"unknown plan node {type(node).__name__}")
+
+
+def _broadcast(c: Column, n: int) -> Column:
+    data = jnp.asarray(c.data)
+    if data.ndim == 0:
+        data = jnp.broadcast_to(data, (n,))
+    v = c.validity
+    if v is not None and jnp.ndim(v) == 0:
+        v = jnp.broadcast_to(v, (n,))
+    return dreplace(c, data=data, validity=v)
+
+
+def _align_string_dicts(parts: list[ColumnBatch]) -> list[ColumnBatch]:
+    """Remap string columns of UNION arms onto shared dictionaries."""
+    from ..column.dictionary import NULL_CODE, Dictionary
+    import numpy as np
+
+    if len(parts) < 2:
+        return parts
+    out = [list(p.columns) for p in parts]
+    for i, c0 in enumerate(parts[0].columns):
+        if c0.ltype is not LType.STRING:
+            continue
+        dicts = [p.columns[i].dictionary for p in parts]
+        if any(d is None for d in dicts):
+            raise ExecError("UNION string column lacks a dictionary")
+        if all(d._id == dicts[0]._id for d in dicts):
+            continue
+        values = dicts[0].values
+        for d in dicts[1:]:
+            values = np.union1d(values, d.values)
+        merged = Dictionary(values)
+        for pi, p in enumerate(parts):
+            c = p.columns[i]
+            remap = jnp.asarray(np.searchsorted(values, c.dictionary.values)
+                                .astype(np.int32))
+            data = jnp.where(c.data >= 0,
+                             jnp.take(remap, jnp.clip(c.data, 0, None), mode="clip"),
+                             NULL_CODE)
+            out[pi][i] = dreplace(c, data=data, dictionary=merged)
+    return [ColumnBatch(p.names, cols, p.sel, p.num_rows)
+            for p, cols in zip(parts, out)]
+
+
+def _harmonize(p: ColumnBatch, schema) -> ColumnBatch:
+    """Cast union arms to the unified schema's types."""
+    from ..expr.compile import cast_column
+
+    cols = []
+    for c, f in zip(p.columns, schema.fields):
+        if c.ltype != f.ltype:
+            if c.ltype is LType.STRING or f.ltype is LType.STRING:
+                raise ExecError("UNION of string and non-string columns")
+            c = cast_column(c, f.ltype)
+        cols.append(c)
+    return ColumnBatch(p.names, cols, p.sel, p.num_rows)

@@ -1,0 +1,149 @@
+"""Expression AST — the analog of the reference's ExprNode tree.
+
+The reference builds ``ExprNode`` trees (literal / slot-ref / fn-call,
+``src/expr/expr_node.cpp``) from the parser AST, infers types, const-folds, and
+then either interprets row-wise (``get_value(MemRow)``) or translates to
+``arrow::compute::Expression`` (``include/expr/arrow_function.h:48``).  Here the
+tree is a small immutable Python structure; expr/compile.py lowers it straight
+to jax ops inside the jitted query pipeline (the expr->XLA lowering SURVEY.md
+§2.6 calls out as the replacement for the Arrow translation table).
+
+Aggregate calls (AggCall) never reach the scalar compiler — the planner hoists
+them into aggregation operators, mirroring how the reference splits AggFnCall
+(src/expr/agg_fn_call.cpp) from scalar ScalarFnCall.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..types import LType
+
+__all__ = ["Expr", "ColRef", "Lit", "Call", "AggCall", "col", "lit", "call"]
+
+
+class Expr:
+    """Base expression node."""
+
+    def children(self) -> tuple["Expr", ...]:
+        return ()
+
+    # -- sugar for hand-built plans and tests ---------------------------
+    def __add__(self, o): return call("add", self, _wrap(o))
+    def __radd__(self, o): return call("add", _wrap(o), self)
+    def __sub__(self, o): return call("sub", self, _wrap(o))
+    def __rsub__(self, o): return call("sub", _wrap(o), self)
+    def __mul__(self, o): return call("mul", self, _wrap(o))
+    def __rmul__(self, o): return call("mul", _wrap(o), self)
+    def __truediv__(self, o): return call("div", self, _wrap(o))
+    def __mod__(self, o): return call("mod", self, _wrap(o))
+    def __neg__(self): return call("neg", self)
+    def __eq__(self, o): return call("eq", self, _wrap(o))  # type: ignore[override]
+    def __ne__(self, o): return call("ne", self, _wrap(o))  # type: ignore[override]
+    def __lt__(self, o): return call("lt", self, _wrap(o))
+    def __le__(self, o): return call("le", self, _wrap(o))
+    def __gt__(self, o): return call("gt", self, _wrap(o))
+    def __ge__(self, o): return call("ge", self, _wrap(o))
+    def __and__(self, o): return call("and", self, _wrap(o))
+    def __or__(self, o): return call("or", self, _wrap(o))
+    def __invert__(self): return call("not", self)
+    def __hash__(self):
+        return hash(self.key())
+
+    def key(self) -> tuple:
+        raise NotImplementedError
+
+    def equals(self, other: "Expr") -> bool:
+        return isinstance(other, Expr) and self.key() == other.key()
+
+
+@dataclass(frozen=True, eq=False)
+class ColRef(Expr):
+    name: str
+    # resolved by the planner: index of source column; None until bound
+    table: Optional[str] = None
+
+    def key(self):
+        return ("col", self.table, self.name)
+
+    def __repr__(self):
+        return f"{self.table}.{self.name}" if self.table else self.name
+
+
+@dataclass(frozen=True, eq=False)
+class Lit(Expr):
+    value: Any
+    ltype: Optional[LType] = None  # inferred if None
+
+    def key(self):
+        return ("lit", self.value, self.ltype)
+
+    def __repr__(self):
+        return repr(self.value)
+
+
+@dataclass(frozen=True, eq=False)
+class Call(Expr):
+    op: str
+    args: tuple
+
+    def children(self):
+        return self.args
+
+    def key(self):
+        return ("call", self.op) + tuple(a.key() for a in self.args)
+
+    def __repr__(self):
+        return f"{self.op}({', '.join(map(repr, self.args))})"
+
+
+@dataclass(frozen=True, eq=False)
+class AggCall(Expr):
+    """Aggregate function call: COUNT/SUM/AVG/MIN/MAX/... (+DISTINCT flag).
+
+    Mirrors pb::ExprNode agg nodes handled by src/expr/agg_fn_call.cpp."""
+
+    op: str
+    args: tuple
+    distinct: bool = False
+
+    def children(self):
+        return self.args
+
+    def key(self):
+        return ("agg", self.op, self.distinct) + tuple(a.key() for a in self.args)
+
+    def __repr__(self):
+        d = "distinct " if self.distinct else ""
+        return f"{self.op}({d}{', '.join(map(repr, self.args))})"
+
+
+def _wrap(v) -> Expr:
+    return v if isinstance(v, Expr) else Lit(v)
+
+
+def col(name: str, table: str | None = None) -> ColRef:
+    return ColRef(name, table)
+
+
+def lit(v, ltype: LType | None = None) -> Lit:
+    return Lit(v, ltype)
+
+
+def call(op: str, *args) -> Call:
+    return Call(op, tuple(_wrap(a) for a in args))
+
+
+def walk(e: Expr):
+    yield e
+    for c in e.children():
+        yield from walk(c)
+
+
+def contains_agg(e: Expr) -> bool:
+    return any(isinstance(x, AggCall) for x in walk(e))
+
+
+def referenced_columns(e: Expr) -> list[ColRef]:
+    return [x for x in walk(e) if isinstance(x, ColRef)]

@@ -1,0 +1,158 @@
+"""Schema catalog — the in-process round-1 analog of the reference's meta
+service + SchemaFactory.
+
+The reference keeps all schema in a Raft-replicated meta server
+(src/meta_server: NamespaceManager -> DatabaseManager -> TableManager,
+meta.interface.proto SchemaInfo) and caches it on every node in SchemaFactory
+(include/common/schema_factory.h:1082) with double-buffered wait-free reads.
+Round 1 collapses that to a process-local Catalog with the same
+namespace -> database -> table hierarchy and versioned TableInfo records; the
+RPC/Raft layers land with the distributed meta service (SURVEY.md §7 step 6).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..types import Field, LType, Schema
+
+_TYPE_ALIASES = {
+    "tinyint": LType.INT8, "smallint": LType.INT16, "int": LType.INT32,
+    "integer": LType.INT32, "bigint": LType.INT64, "float": LType.FLOAT32,
+    "double": LType.FLOAT64, "real": LType.FLOAT64, "decimal": LType.DECIMAL,
+    "numeric": LType.DECIMAL, "bool": LType.BOOL, "boolean": LType.BOOL,
+    "date": LType.DATE, "datetime": LType.DATETIME, "timestamp": LType.TIMESTAMP,
+    "varchar": LType.STRING, "char": LType.STRING, "text": LType.STRING,
+    "string": LType.STRING, "int64": LType.INT64, "int32": LType.INT32,
+    "float64": LType.FLOAT64, "float32": LType.FLOAT32,
+    "unsigned": LType.UINT64, "uint64": LType.UINT64, "uint32": LType.UINT32,
+}
+
+
+def parse_type(name: str) -> LType:
+    base = name.strip().lower().split("(")[0].strip()
+    if base in _TYPE_ALIASES:
+        return _TYPE_ALIASES[base]
+    raise ValueError(f"unknown SQL type {name!r}")
+
+
+@dataclass
+class IndexInfo:
+    """Secondary index metadata (reference: pb::IndexInfo,
+    schema_factory.h; primary/unique/key/fulltext/vector/rollup)."""
+    name: str
+    kind: str              # primary | unique | key | fulltext | vector
+    columns: list[str] = field(default_factory=list)
+    params: dict = field(default_factory=dict)
+
+
+@dataclass
+class TableInfo:
+    """One table's schema + options (reference: SchemaInfo,
+    meta.interface.proto:206)."""
+    table_id: int
+    namespace: str
+    database: str
+    name: str
+    schema: Schema
+    version: int = 1
+    indexes: list[IndexInfo] = field(default_factory=list)
+    # partitioning over the row axis -> regions (reference: RegionInfo ranges)
+    options: dict = field(default_factory=dict)
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.database}.{self.name}"
+
+    def primary_key(self) -> Optional[IndexInfo]:
+        for ix in self.indexes:
+            if ix.kind == "primary":
+                return ix
+        return None
+
+
+class Catalog:
+    """namespace -> database -> table registry with versioned schemas."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._ids = itertools.count(1)
+        self._namespaces: set[str] = {"default"}
+        self._databases: dict[str, set[str]] = {"default": set()}
+        self._tables: dict[str, TableInfo] = {}  # "db.table" -> info
+
+    # -- namespaces / databases ----------------------------------------
+    def create_database(self, name: str, namespace: str = "default",
+                        if_not_exists: bool = False):
+        with self._lock:
+            if name in self._databases:
+                if if_not_exists:
+                    return
+                raise ValueError(f"database {name!r} exists")
+            self._databases[name] = set()
+            self._namespaces.add(namespace)
+
+    def drop_database(self, name: str, if_exists: bool = False):
+        with self._lock:
+            if name not in self._databases:
+                if if_exists:
+                    return
+                raise ValueError(f"database {name!r} does not exist")
+            for t in list(self._databases[name]):
+                self._tables.pop(f"{name}.{t}", None)
+            del self._databases[name]
+
+    def databases(self) -> list[str]:
+        with self._lock:
+            return sorted(self._databases)
+
+    # -- tables ---------------------------------------------------------
+    def create_table(self, database: str, name: str, schema: Schema,
+                     indexes: list[IndexInfo] | None = None,
+                     options: dict | None = None,
+                     if_not_exists: bool = False) -> TableInfo:
+        with self._lock:
+            if database not in self._databases:
+                raise ValueError(f"database {database!r} does not exist")
+            key = f"{database}.{name}"
+            if key in self._tables:
+                if if_not_exists:
+                    return self._tables[key]
+                raise ValueError(f"table {key!r} exists")
+            info = TableInfo(next(self._ids), "default", database, name, schema,
+                             indexes=indexes or [], options=options or {})
+            self._tables[key] = info
+            self._databases[database].add(name)
+            return info
+
+    def drop_table(self, database: str, name: str, if_exists: bool = False):
+        with self._lock:
+            key = f"{database}.{name}"
+            if key not in self._tables:
+                if if_exists:
+                    return
+                raise ValueError(f"table {key!r} does not exist")
+            del self._tables[key]
+            self._databases[database].discard(name)
+
+    def get_table(self, database: str, name: str) -> TableInfo:
+        with self._lock:
+            key = f"{database}.{name}"
+            if key not in self._tables:
+                raise ValueError(f"table {key!r} does not exist")
+            return self._tables[key]
+
+    def has_table(self, database: str, name: str) -> bool:
+        with self._lock:
+            return f"{database}.{name}" in self._tables
+
+    def tables(self, database: str) -> list[str]:
+        with self._lock:
+            return sorted(self._databases.get(database, ()))
+
+    def bump_version(self, database: str, name: str):
+        with self._lock:
+            self.get_table(database, name).version += 1

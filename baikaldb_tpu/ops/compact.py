@@ -1,0 +1,40 @@
+"""Selection-mask materialization (mask -> dense prefix).
+
+XLA requires static shapes, so filters refine a bool ``sel`` mask instead of
+shrinking batches (SURVEY.md §7 hard part #3: dynamic result cardinality).
+``compact`` stable-partitions live rows to the front and returns the same-
+capacity batch plus a traced live count — the pattern the reference never
+needs (Acero emits variable-length batches) but which keeps every downstream
+kernel shape-static on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..column.batch import ColumnBatch
+
+
+def compact(batch: ColumnBatch) -> ColumnBatch:
+    """Move live rows to the front (stable); sets num_rows, clears sel."""
+    if batch.sel is None and batch.num_rows is None:
+        return batch
+    if batch.sel is None:
+        return batch
+    sel = batch.sel
+    n = jnp.sum(sel).astype(jnp.int32)
+    order = jnp.argsort(~sel, stable=True)
+    out = batch.gather(order)
+    out.num_rows = n
+    # rows past n keep stale data; mark them dead for any mask-aware consumer
+    out.sel = jnp.arange(len(batch)) < n
+    return out
+
+
+def head(batch: ColumnBatch, limit: int, offset: int = 0) -> ColumnBatch:
+    """LIMIT/OFFSET over live rows (reference: src/exec/limit_node.cpp)."""
+    b = compact(batch)
+    n = b.live_count()
+    idx = jnp.arange(len(b))
+    keep = (idx >= offset) & (idx < jnp.minimum(n, offset + limit))
+    return ColumnBatch(b.names, b.columns, keep, None)

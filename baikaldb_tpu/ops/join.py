@@ -1,0 +1,201 @@
+"""Equi-join kernels (reference: src/exec/join_node.cpp + joiner.cpp — hash
+join build/probe, index nested-loop join; Acero hashjoin declaration).
+
+A chasing hash table is hostile to the VPU, so the TPU design is a *sort
+join*: sort the build side by key once, then probe with vectorized binary
+search (``jnp.searchsorted``) — O(log n) fully-unrolled compare ladders that
+XLA vectorizes across all probe rows.  Duplicate build keys are handled by
+[lo, hi) match ranges plus an offset-inversion expansion (the static-shape
+analog of emitting one output row per match).
+
+Join keys: one column of any fixed-width type, or two int32-ish columns packed
+into one int64.  String keys join on dictionary codes: ``join`` aligns the two
+sides' dictionaries host-side (column/dictionary.merge) at trace time before
+comparing codes.
+
+NULL keys never match (SQL semantics); dead rows (sel=False) never match.
+Output cardinality is static: ``cap`` rows (planner-estimated); an overflow
+flag is returned so the executor can retry with a larger cap.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import jax.numpy as jnp
+
+from ..column.batch import Column, ColumnBatch
+from ..column.dictionary import NULL_CODE, merge as dict_merge
+from ..types import LType
+
+
+def _align_string_keys(probe: ColumnBatch, probe_keys: list[str],
+                       build: ColumnBatch, build_keys: list[str]):
+    """Remap string key columns of both sides onto merged dictionaries so code
+    equality == string equality.  Host work is O(|dict|), done at trace time."""
+
+    def retag(batch, name, col):
+        cols = list(batch.columns)
+        cols[batch.names.index(name)] = col
+        return ColumnBatch(batch.names, cols, batch.sel, batch.num_rows)
+
+    for pk, bk in zip(probe_keys, build_keys):
+        pc, bc = probe.column(pk), build.column(bk)
+        if pc.ltype is not LType.STRING and bc.ltype is not LType.STRING:
+            continue
+        if pc.dictionary is None or bc.dictionary is None:
+            raise ValueError(f"string join key {pk}/{bk} lacks a dictionary")
+        if pc.dictionary is bc.dictionary or pc.dictionary._id == bc.dictionary._id:
+            continue
+        m, ra, rb = dict_merge(pc.dictionary, bc.dictionary)
+        ta, tb = jnp.asarray(ra), jnp.asarray(rb)
+        pd = jnp.where(pc.data >= 0, jnp.take(ta, jnp.clip(pc.data, 0, None), mode="clip"),
+                       NULL_CODE)
+        bd = jnp.where(bc.data >= 0, jnp.take(tb, jnp.clip(bc.data, 0, None), mode="clip"),
+                       NULL_CODE)
+        probe = retag(probe, pk, replace(pc, data=pd, dictionary=m))
+        build = retag(build, bk, replace(bc, data=bd, dictionary=m))
+    return probe, build
+
+
+def _key_array(batch: ColumnBatch, names: list[str]):
+    """Pack 1-2 key columns into a single sortable array + validity."""
+    cols = [batch.column(n) for n in names]
+    valid = None
+    for c in cols:
+        if c.validity is not None:
+            valid = c.validity if valid is None else (valid & c.validity)
+    if len(cols) == 1:
+        d = cols[0].data
+        if d.dtype == jnp.bool_:
+            d = d.astype(jnp.int32)
+        return d, valid
+    if len(cols) == 2:
+        for c in cols:
+            if c.ltype not in (LType.BOOL, LType.INT8, LType.INT16, LType.INT32,
+                               LType.UINT32, LType.DATE, LType.STRING):
+                raise ValueError("2-key sort-join requires 32-bit-safe key "
+                                 "types; planner must demote wider keys to "
+                                 "residual equality")
+        a = cols[0].data.astype(jnp.int64)
+        b = cols[1].data.astype(jnp.int64)
+        return (a << 32) | (b & jnp.int64(0xFFFFFFFF)), valid
+    raise ValueError(">2 join key columns: planner must demote extras to "
+                     "residual equality")
+
+
+def _sentinel_max(dtype):
+    return (jnp.iinfo if dtype.kind in "iu" else jnp.finfo)(dtype).max
+
+
+def join(probe: ColumnBatch, probe_keys: list[str],
+         build: ColumnBatch, build_keys: list[str],
+         how: str = "inner", cap: int | None = None,
+         suffix: str = "_r"):
+    """Returns (out_batch, overflow_flag).
+
+    how: inner | left | semi | anti.
+    - semi/anti keep probe's capacity and just refine sel (no expansion).
+    - inner/left emit up to ``cap`` rows (default: probe capacity), pairing
+      each probe row with every matching build row.
+    Column names: probe names keep their own; clashing build names get suffix.
+    """
+    probe, build = _align_string_keys(probe, probe_keys, build, build_keys)
+    pk, pvalid = _key_array(probe, probe_keys)
+    bk, bvalid = _key_array(build, build_keys)
+
+    # build side: dead/null-key rows -> +inf sentinel, sorted to the end
+    bdead = jnp.zeros(len(build), bool)
+    if build.sel is not None:
+        bdead = bdead | ~build.sel
+    if bvalid is not None:
+        bdead = bdead | ~bvalid
+    bk_s_key = jnp.where(bdead, _sentinel_max(bk.dtype), bk)
+    order = jnp.argsort(bk_s_key, stable=True)
+    bk_sorted = bk_s_key[order]
+    blive_sorted = ~bdead[order]
+
+    lo = jnp.searchsorted(bk_sorted, pk, side="left")
+    hi = jnp.searchsorted(bk_sorted, pk, side="right")
+    # guard sentinel collision: a probe key equal to the sentinel must verify
+    # against build liveness below (gathered per match), so just clamp counts
+    psel_dead = jnp.zeros(len(probe), bool)
+    if probe.sel is not None:
+        psel_dead = psel_dead | ~probe.sel
+    pdead = psel_dead
+    if pvalid is not None:
+        pdead = pdead | ~pvalid
+    counts = jnp.where(pdead, 0, hi - lo)
+    # drop matches that land on dead build rows (only possible at the sentinel
+    # run, which is contiguous at the tail)
+    first_dead = jnp.sum(blive_sorted).astype(lo.dtype)
+    counts = jnp.where(lo >= first_dead, 0, jnp.minimum(counts, first_dead - lo))
+
+    if how == "semi":
+        return probe.and_sel(counts > 0), jnp.asarray(False)
+    if how == "anti":
+        return probe.and_sel(counts == 0), jnp.asarray(False)
+
+    if how == "left":
+        # NULL-key probe rows still survive a LEFT JOIN (with NULL build side);
+        # only sel-dead rows are dropped
+        out_counts = jnp.maximum(counts, jnp.where(psel_dead, 0, 1))
+    elif how == "inner":
+        out_counts = counts
+    else:
+        raise ValueError(f"unknown join type {how}")
+
+    if cap is None:
+        cap = len(probe)
+    offsets = jnp.cumsum(out_counts)
+    total = offsets[-1] if len(probe) else jnp.int32(0)
+    overflow = total > cap
+    starts = offsets - out_counts
+    # output row j -> probe row i = searchsorted(offsets, j, 'right')
+    j = jnp.arange(cap)
+    pi = jnp.searchsorted(offsets, j, side="right")
+    pi_c = jnp.clip(pi, 0, len(probe) - 1)
+    k = j - starts[pi_c]                      # match ordinal within probe row
+    live_out = j < total
+    bpos = lo[pi_c] + k                        # index into sorted build
+    matched = k < counts[pi_c]
+    bidx = order[jnp.clip(bpos, 0, len(build) - 1)]
+
+    out_p = probe.gather(pi_c, valid=None)
+    bvalid_out = jnp.where(matched, True, False) & live_out
+    out_b = build.gather(bidx, valid=None)
+
+    names = list(out_p.names)
+    cols = list(out_p.columns)
+    for n, c in zip(out_b.names, out_b.columns):
+        if how == "left":
+            v = c.validity & bvalid_out if c.validity is not None else bvalid_out
+            c = replace(c, validity=v)
+        name = n if n not in names else n + suffix
+        names.append(name)
+        cols.append(c)
+    out = ColumnBatch(tuple(names), cols, live_out, None)
+    return out, overflow
+
+
+def cross_join(probe: ColumnBatch, build: ColumnBatch, cap: int | None = None,
+               suffix: str = "_r"):
+    """Cartesian product with static cap (reference: JoinNode without
+    equality conditions falls back to nested loop)."""
+    np_, nb = len(probe), len(build)
+    if cap is None:
+        cap = np_ * nb
+    j = jnp.arange(cap)
+    pi = j // nb
+    bi = j % nb
+    live = (j < np_ * nb)
+    live = live & probe.sel_mask()[jnp.clip(pi, 0, np_ - 1)] & build.sel_mask()[jnp.clip(bi, 0, nb - 1)]
+    out_p = probe.gather(jnp.clip(pi, 0, np_ - 1))
+    out_b = build.gather(jnp.clip(bi, 0, nb - 1))
+    names = list(out_p.names)
+    cols = list(out_p.columns)
+    for n, c in zip(out_b.names, out_b.columns):
+        names.append(n if n not in names else n + suffix)
+        cols.append(c)
+    overflow = jnp.asarray(np_ * nb > cap)
+    return ColumnBatch(tuple(names), cols, live, None), overflow

@@ -1,0 +1,77 @@
+"""Sort / Top-K kernels (reference: src/exec/sort_node.cpp,
+src/runtime/sorter.cpp, topn_sorter.cpp, Acero order_by declarations in
+src/exec/select_manager_node.cpp:259-265).
+
+Multi-key ORDER BY is a composition of stable single-key argsorts from the
+least-significant key to the most-significant one (classic LSD radix-style
+composition).  NULL ordering follows MySQL: NULLs first under ASC, last under
+DESC.  Dead rows (sel=False) always sort to the end, so LIMIT after ORDER BY
+is a static slice.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..column.batch import Column, ColumnBatch
+from ..types import LType
+
+
+@dataclass(frozen=True)
+class SortKey:
+    name: str
+    asc: bool = True
+
+
+def _orderable(c: Column):
+    d = c.data
+    if d.dtype == jnp.bool_:
+        d = d.astype(jnp.int32)
+    return d
+
+
+def sort_permutation(batch: ColumnBatch, keys: list[SortKey]):
+    """Permutation putting rows in ORDER BY order, dead rows last."""
+    n = len(batch)
+    perm = jnp.arange(n)
+    for k in reversed(keys):
+        c = batch.column(k.name)
+        d = _orderable(c)[perm]
+        # descending argsort (not negation: negation breaks for unsigned 0
+        # wraparound and INT_MIN overflow)
+        perm = perm[jnp.argsort(d, stable=True, descending=not k.asc)]
+        if c.validity is not None:
+            v = c.validity[perm]
+            # ASC: nulls first -> sort by validity ascending=False first
+            keyv = v if k.asc else ~v
+            perm = perm[jnp.argsort(keyv, stable=True)]
+    if batch.sel is not None:
+        dead = ~batch.sel[perm]
+        perm = perm[jnp.argsort(dead, stable=True)]
+    return perm
+
+
+def sort_batch(batch: ColumnBatch, keys: list[SortKey]) -> ColumnBatch:
+    perm = sort_permutation(batch, keys)
+    out = batch.gather(perm)
+    if batch.sel is not None:
+        n = jnp.sum(batch.sel).astype(jnp.int32)
+        out.sel = jnp.arange(len(batch)) < n
+        out.num_rows = n
+    return out
+
+
+def top_k(batch: ColumnBatch, keys: list[SortKey], k: int) -> ColumnBatch:
+    """ORDER BY + LIMIT k (reference: TopNSorter).  Full sort then static
+    slice; the gather after slicing touches only k rows per column, so for
+    k << N the HBM traffic is the sort keys, not the payload."""
+    perm = sort_permutation(batch, keys)
+    k = min(k, len(batch))
+    perm_k = perm[:k]
+    live = jnp.arange(k) < batch.live_count() if (batch.sel is not None) else None
+    out = batch.gather(perm_k)
+    if live is not None:
+        out.sel = live
+    return out

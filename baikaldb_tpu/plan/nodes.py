@@ -1,0 +1,145 @@
+"""Plan IR — the analog of the reference's ExecNode tree (include/exec/
+exec_node.h:79) plus the pb::PlanNode serialized form (proto/plan.proto).
+
+One IR serves as both logical and physical plan; the planner's passes
+(plan/planner.py) annotate it (pushed-down predicates, pruned columns, join
+keys, group-by strategy) the way the reference's PhysicalPlanner pass pipeline
+rewrites its tree (src/physical_plan/physical_planner.cpp:27-120).  The
+executor (exec/executor.py) lowers this IR to jax kernels inside one jit —
+the replacement for the volcano open/get_next loop and the Acero Declaration
+path (exec_node.h:411-414).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..expr.ast import Expr
+from ..ops.hashagg import AggSpec
+from ..types import Schema
+
+
+@dataclass
+class PlanNode:
+    children: list["PlanNode"] = field(default_factory=list)
+    # output schema, filled by the binder/planner
+    schema: Optional[Schema] = None
+
+    def child(self) -> "PlanNode":
+        return self.children[0]
+
+    def tree_repr(self, indent: int = 0) -> str:
+        pad = "  " * indent
+        lines = [pad + self._label()]
+        for c in self.children:
+            lines.append(c.tree_repr(indent + 1))
+        return "\n".join(lines)
+
+    def _label(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class ScanNode(PlanNode):
+    """Table scan (reference: RocksdbScanNode / the column-store reader).
+    Emits columns under qualified names ``label.col``."""
+    table_key: str = ""        # "db.table"
+    label: str = ""            # alias in the query
+    columns: list[str] = field(default_factory=list)   # pruned physical columns
+    pushed_filter: Optional[Expr] = None               # PredicatePushDown result
+
+    def _label(self):
+        f = f" filter={self.pushed_filter!r}" if self.pushed_filter else ""
+        return f"Scan({self.table_key} as {self.label} cols={self.columns}{f})"
+
+
+@dataclass
+class FilterNode(PlanNode):
+    pred: Optional[Expr] = None
+
+    def _label(self):
+        return f"Filter({self.pred!r})"
+
+
+@dataclass
+class ProjectNode(PlanNode):
+    exprs: list[Expr] = field(default_factory=list)
+    names: list[str] = field(default_factory=list)
+
+    def _label(self):
+        return f"Project({', '.join(f'{n}={e!r}' for n, e in zip(self.names, self.exprs))})"
+
+
+@dataclass
+class JoinNode(PlanNode):
+    how: str = "inner"                      # inner|left|semi|anti|cross
+    left_keys: list[str] = field(default_factory=list)   # resolved column names
+    right_keys: list[str] = field(default_factory=list)
+    residual: Optional[Expr] = None         # non-equi conjuncts, post-filter
+    cap: Optional[int] = None               # static output capacity
+
+    def _label(self):
+        return (f"Join({self.how} on {list(zip(self.left_keys, self.right_keys))}"
+                + (f" residual={self.residual!r}" if self.residual else "") + ")")
+
+
+@dataclass
+class AggNode(PlanNode):
+    """GROUP BY + aggregates (reference: AggNode partial/merge,
+    src/exec/agg_node.cpp).  Key exprs are precomputed into columns named
+    key_names by a child ProjectNode."""
+    key_names: list[str] = field(default_factory=list)
+    specs: list[AggSpec] = field(default_factory=list)
+    strategy: str = "sorted"                 # dense | sorted
+    domains: list[int] = field(default_factory=list)     # dense: per-key domain
+    max_groups: int = 0                      # sorted: static group cap
+
+    def _label(self):
+        s = f"dense{self.domains}" if self.strategy == "dense" else f"sorted<= {self.max_groups}"
+        return f"Agg(keys={self.key_names} {s} aggs={[sp.out_name for sp in self.specs]})"
+
+
+@dataclass
+class SortNode(PlanNode):
+    keys: list[tuple[str, bool]] = field(default_factory=list)  # (col, asc)
+    limit: Optional[int] = None              # fused top-k
+    offset: int = 0
+
+    def _label(self):
+        lim = f" limit={self.limit}+{self.offset}" if self.limit is not None else ""
+        return f"Sort({self.keys}{lim})"
+
+
+@dataclass
+class LimitNode(PlanNode):
+    limit: int = 0
+    offset: int = 0
+
+    def _label(self):
+        return f"Limit({self.limit} offset {self.offset})"
+
+
+@dataclass
+class UnionNode(PlanNode):
+    all: bool = True
+
+    def _label(self):
+        return f"Union({'all' if self.all else 'distinct'})"
+
+
+@dataclass
+class DistinctNode(PlanNode):
+    def _label(self):
+        return "Distinct"
+
+
+@dataclass
+class ValuesNode(PlanNode):
+    """Literal rows (SELECT without FROM)."""
+    rows: list[list] = field(default_factory=list)
+    names: list[str] = field(default_factory=list)
+    exprs: list[list] = field(default_factory=list)
+
+    def _label(self):
+        return f"Values({len(self.rows)} rows)"

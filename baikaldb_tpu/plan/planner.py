@@ -1,0 +1,776 @@
+"""Query planner: bind SELECT AST -> plan IR -> optimization passes.
+
+Mirrors the reference's two stages, collapsed: logical planning
+(src/logical_plan/select_planner.cpp — Packet->Sort->Agg->Filter->Join/Scan
+tree) and the physical pass pipeline
+(src/physical_plan/physical_planner.cpp:27-120 — ColumnsPrune,
+PredicatePushDown, ExprOptimize, JoinTypeAnalyzer, ...).  The passes kept for
+round 1 are the ones that matter on TPU:
+
+- **predicate pushdown** into scans (filters fuse into the scan kernel),
+- **column pruning** (HBM traffic is the bottleneck; never move dead columns),
+- **aggregate extraction** with the dense-vs-sorted group-by strategy choice
+  (dictionary/small-int keys -> segment_sum over a dense domain),
+- **join key extraction** (equi conjuncts -> sort-join keys, rest residual),
+- **sort+limit fusion** into top-k.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import replace as dreplace
+from typing import Optional
+
+import numpy as np
+
+from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, walk
+from ..expr.compile import infer_type
+from ..meta.catalog import Catalog
+from ..ops.hashagg import AggSpec, agg_result_type
+from ..sql.lexer import SqlError
+from ..sql.stmt import JoinClause, SelectStmt, TableRef
+from ..types import Field, LType, Schema
+from .nodes import (AggNode, DistinctNode, FilterNode, JoinNode, LimitNode,
+                    PlanNode, ProjectNode, ScanNode, SortNode, UnionNode,
+                    ValuesNode)
+
+MAX_DENSE_GROUPS = 1 << 20
+
+
+class PlanError(SqlError):
+    pass
+
+
+class Scope:
+    """Name resolution for one SELECT level: label -> (table schema, columns)."""
+
+    def __init__(self):
+        self.tables: dict[str, Schema] = {}   # label -> schema (plain col names)
+        self.order: list[str] = []
+
+    def add(self, label: str, schema: Schema):
+        if label in self.tables:
+            raise PlanError(f"duplicate table alias {label!r}")
+        self.tables[label] = schema
+        self.order.append(label)
+
+    def resolve(self, name: str, table: Optional[str]) -> tuple[str, LType]:
+        """-> (qualified unique column name, type)."""
+        if table is not None:
+            if table not in self.tables:
+                raise PlanError(f"unknown table {table!r}")
+            sch = self.tables[table]
+            if name not in sch:
+                raise PlanError(f"unknown column {table}.{name}")
+            return f"{table}.{name}", sch.field(name).ltype
+        hits = [(lbl, self.tables[lbl]) for lbl in self.order if name in self.tables[lbl]]
+        if not hits:
+            raise PlanError(f"unknown column {name!r}")
+        if len(hits) > 1:
+            raise PlanError(f"ambiguous column {name!r}")
+        lbl, sch = hits[0]
+        return f"{lbl}.{name}", sch.field(name).ltype
+
+    def flat_schema(self) -> Schema:
+        fields = []
+        for lbl in self.order:
+            for f in self.tables[lbl].fields:
+                fields.append(Field(f"{lbl}.{f.name}", f.ltype, f.nullable))
+        return Schema(tuple(fields))
+
+
+class Planner:
+    def __init__(self, catalog: Catalog, stores: dict, default_db: str,
+                 stats_fn=None):
+        self.catalog = catalog
+        self.stores = stores          # "db.table" -> TableStore
+        self.default_db = default_db
+        self.stats_fn = stats_fn      # (table_key, col) -> dict | None
+        self._ids = itertools.count()
+
+    def _tmp(self, prefix: str) -> str:
+        return f"__{prefix}{next(self._ids)}"
+
+    # ------------------------------------------------------------------
+    def plan_select(self, stmt: SelectStmt) -> PlanNode:
+        plan = self._plan_query(stmt)
+        self._prune_columns(plan)
+        return plan
+
+    def _plan_query(self, stmt: SelectStmt) -> PlanNode:
+        if stmt.union is None:
+            return self._plan_single(stmt)
+        # union chain: plan every arm bare, then ORDER BY/LIMIT of the head
+        # stmt apply to the WHOLE union (MySQL semantics)
+        mode, rhs = stmt.union
+        left = self._plan_single(dreplace_union(stmt))
+        right = self._plan_union_arm(rhs)
+        plan = self._merge_union(left, right, mode)
+        if rhs.union is not None:
+            # chain continues: fold remaining arms left-associatively
+            node = rhs.union
+            while node is not None:
+                m, arm = node
+                plan = self._merge_union(plan, self._plan_single(
+                    dreplace_union(arm)), m)
+                node = arm.union
+        return self._apply_union_tail(plan, stmt)
+
+    def _plan_union_arm(self, stmt: SelectStmt) -> PlanNode:
+        return self._plan_single(dreplace_union(stmt))
+
+    def _merge_union(self, left: PlanNode, right: PlanNode, mode: str) -> PlanNode:
+        if len(left.schema.fields) != len(right.schema.fields):
+            raise PlanError("UNION arms have different column counts")
+        right = ProjectNode(children=[right],
+                            exprs=[ColRef(f.name) for f in right.schema.fields],
+                            names=[f.name for f in left.schema.fields],
+                            schema=left.schema)
+        u = UnionNode(children=[left, right], all=(mode == "all"),
+                      schema=left.schema)
+        if mode != "all":
+            return DistinctNode(children=[u], schema=left.schema)
+        return u
+
+    def _apply_union_tail(self, plan: PlanNode, stmt: SelectStmt) -> PlanNode:
+        """ORDER BY (output names/ordinals only) + LIMIT over a union result."""
+        names = [f.name for f in plan.schema.fields]
+        keys: list[tuple[str, bool]] = []
+        for o in stmt.order_by:
+            e = o.expr
+            if isinstance(e, Lit) and isinstance(e.value, int):
+                idx = e.value - 1
+                if not 0 <= idx < len(names):
+                    raise PlanError(f"ORDER BY position {e.value} out of range")
+                keys.append((names[idx], o.asc))
+            elif isinstance(e, ColRef) and e.table is None and e.name in names:
+                keys.append((e.name, o.asc))
+            else:
+                raise PlanError("ORDER BY over a UNION must use output column "
+                                "names or ordinals")
+        if keys:
+            plan = SortNode(children=[plan], keys=keys, limit=stmt.limit,
+                            offset=stmt.offset if stmt.limit is not None else 0,
+                            schema=plan.schema)
+        elif stmt.limit is not None:
+            plan = LimitNode(children=[plan], limit=stmt.limit,
+                             offset=stmt.offset, schema=plan.schema)
+        return plan
+
+    # ------------------------------------------------------------------
+    def _plan_single(self, stmt: SelectStmt) -> PlanNode:
+        scope = Scope()
+        plan: Optional[PlanNode] = None
+
+        # FROM clause
+        if stmt.table is not None:
+            plan = self._plan_table_ref(stmt.table, scope)
+            for j in stmt.joins:
+                plan = self._plan_join(plan, j, scope, stmt)
+        flat = scope.flat_schema() if plan is not None else Schema(())
+
+        if plan is None:
+            # SELECT without FROM: single-row values
+            names, exprs = [], []
+            for i, item in enumerate(stmt.items):
+                if item.expr is None:
+                    raise PlanError("SELECT * without FROM")
+                names.append(item.alias or f"_c{i}")
+                exprs.append(item.expr)
+            sch = Schema(tuple(Field(n, infer_type(e, Schema(())))
+                               for n, e in zip(names, exprs)))
+            return ValuesNode(rows=[[None]], names=names, exprs=[exprs], schema=sch)
+
+        resolve = _Resolver(scope)
+
+        # WHERE
+        where = resolve(stmt.where) if stmt.where is not None else None
+        if where is not None:
+            plan = self._push_predicates(plan, where, stmt)
+            flatf = plan.schema or flat
+
+        # expand select items
+        items: list[tuple[str, Expr]] = []
+        for i, item in enumerate(stmt.items):
+            if item.expr is None:
+                labels = [item.star_table] if item.star_table else scope.order
+                for lbl in labels:
+                    if lbl not in scope.tables:
+                        raise PlanError(f"unknown table {lbl!r} in {lbl}.*")
+                    for f in scope.tables[lbl].fields:
+                        # multi-table *: qualify clashing display names
+                        items.append((f.name if len(labels) == 1 else f"{lbl}.{f.name}",
+                                      ColRef(f"{lbl}.{f.name}")))
+            else:
+                e = resolve(item.expr)
+                items.append((item.alias or _display_name(item.expr), e))
+        # de-duplicate display names
+        seen: dict[str, int] = {}
+        named_items = []
+        for n, e in items:
+            if n in seen:
+                seen[n] += 1
+                n = f"{n}_{seen[n]}"
+            else:
+                seen[n] = 0
+            named_items.append((n, e))
+
+        # MySQL scoping: GROUP BY / HAVING / ORDER BY may reference select
+        # aliases (reference: logical_planner name resolution)
+        alias_map = {item.alias: item.expr for item in stmt.items
+                     if item.alias and item.expr is not None}
+
+        def subst_alias(e: Optional[Expr]) -> Optional[Expr]:
+            if e is None:
+                return None
+            if isinstance(e, ColRef) and e.table is None and e.name in alias_map:
+                # real columns shadow aliases (MySQL resolution order)
+                try:
+                    scope.resolve(e.name, None)
+                    return e
+                except PlanError:
+                    return alias_map[e.name]
+            if isinstance(e, AggCall):
+                return AggCall(e.op, tuple(subst_alias(a) for a in e.args), e.distinct)
+            if isinstance(e, Call):
+                return Call(e.op, tuple(subst_alias(a) for a in e.args))
+            return e
+
+        group_exprs = [resolve(subst_alias(g)) for g in stmt.group_by]
+        # GROUP BY ordinal / alias support
+        for gi, g in enumerate(group_exprs):
+            if isinstance(g, Lit) and isinstance(g.value, int):
+                idx = g.value - 1
+                if not 0 <= idx < len(named_items):
+                    raise PlanError(f"GROUP BY position {g.value} out of range")
+                group_exprs[gi] = named_items[idx][1]
+        having = resolve(subst_alias(stmt.having)) if stmt.having is not None else None
+        order_items = [(resolve(subst_alias(o.expr)), o.asc) for o in stmt.order_by]
+
+        has_agg = (any(_contains_agg(e) for _, e in named_items)
+                   or group_exprs or (having is not None and _contains_agg(having))
+                   or any(_contains_agg(e) for e, _ in order_items))
+
+        if has_agg:
+            plan, named_items, having, order_items = self._plan_aggregate(
+                plan, flat, named_items, group_exprs, having, order_items, stmt)
+        else:
+            if having is not None:
+                raise PlanError("HAVING without aggregation")
+
+        # final projection (+ hidden sort columns)
+        sch = plan.schema
+        proj_names = [n for n, _ in named_items]
+        proj_exprs = [e for _, e in named_items]
+        sort_keys: list[tuple[str, bool]] = []
+        for oe, asc in order_items:
+            # ORDER BY ordinal
+            if isinstance(oe, Lit) and isinstance(oe.value, int):
+                idx = oe.value - 1
+                if not 0 <= idx < len(proj_names):
+                    raise PlanError(f"ORDER BY position {oe.value} out of range")
+                sort_keys.append((proj_names[idx], asc))
+                continue
+            # alias / identical expr match
+            hit = None
+            for n, e in zip(proj_names, proj_exprs):
+                if e.equals(oe) or (isinstance(oe, ColRef) and oe.table is None
+                                    and oe.name == n):
+                    hit = n
+                    break
+            if hit is None:
+                hit = self._tmp("s")
+                proj_names.append(hit)
+                proj_exprs.append(oe)
+            sort_keys.append((hit, asc))
+
+        out_schema = Schema(tuple(Field(n, infer_type(e, sch))
+                                  for n, e in zip(proj_names, proj_exprs)))
+        plan = ProjectNode(children=[plan], exprs=proj_exprs, names=proj_names,
+                           schema=out_schema)
+
+        if stmt.distinct:
+            plan = DistinctNode(children=[plan], schema=plan.schema)
+
+        n_display = len(named_items)
+        if sort_keys:
+            plan = SortNode(children=[plan], keys=sort_keys,
+                            limit=stmt.limit, offset=stmt.offset if stmt.limit is not None else 0,
+                            schema=plan.schema)
+        elif stmt.limit is not None:
+            plan = LimitNode(children=[plan], limit=stmt.limit, offset=stmt.offset,
+                             schema=plan.schema)
+
+        if len(proj_names) != n_display:
+            # drop hidden sort columns
+            vis = proj_names[:n_display]
+            plan = ProjectNode(children=[plan], exprs=[ColRef(n) for n in vis],
+                               names=vis,
+                               schema=Schema(tuple(out_schema.fields[:n_display])))
+        return plan
+
+    # ------------------------------------------------------------------
+    def _plan_table_ref(self, ref: TableRef, scope: Scope) -> PlanNode:
+        if ref.subquery is not None:
+            sub = self._plan_query(ref.subquery)
+            label = ref.label
+            scope.add(label, Schema(tuple(Field(f.name, f.ltype, f.nullable)
+                                          for f in sub.schema.fields)))
+            # re-qualify subquery outputs under the derived-table label
+            exprs = [ColRef(f.name) for f in sub.schema.fields]
+            names = [f"{label}.{f.name}" for f in sub.schema.fields]
+            return ProjectNode(children=[sub], exprs=exprs, names=names,
+                               schema=Schema(tuple(Field(n, f.ltype, f.nullable)
+                                                   for n, f in zip(names, sub.schema.fields))))
+        db = ref.database or self.default_db
+        info = self.catalog.get_table(db, ref.name)
+        label = ref.label
+        scope.add(label, info.schema)
+        sch = Schema(tuple(Field(f"{label}.{f.name}", f.ltype, f.nullable)
+                           for f in info.schema.fields))
+        return ScanNode(table_key=f"{db}.{ref.name}", label=label,
+                        columns=[f.name for f in info.schema.fields], schema=sch)
+
+    def _plan_join(self, left: PlanNode, j: JoinClause, scope: Scope,
+                   stmt: SelectStmt) -> PlanNode:
+        how = j.kind
+        right = self._plan_table_ref(j.table, scope)
+        rlabel = j.table.label
+        if how == "right":
+            # RIGHT JOIN -> LEFT JOIN with swapped children
+            left, right = right, left
+            how = "left"
+        resolve = _Resolver(scope)
+        on = resolve(j.on) if j.on is not None else None
+        if j.using:
+            conj = None
+            llabels = [n for n in scope.order if n != rlabel]
+            for c in j.using:
+                lq = None
+                for lbl in llabels:
+                    if c in scope.tables[lbl]:
+                        lq = f"{lbl}.{c}"
+                        break
+                if lq is None:
+                    raise PlanError(f"USING column {c!r} not found on left side")
+                eq = Call("eq", (ColRef(lq), ColRef(f"{rlabel}.{c}")))
+                conj = eq if conj is None else Call("and", (conj, eq))
+            on = conj if on is None else Call("and", (on, conj))
+        if how == "cross" or on is None:
+            if how in ("semi", "anti"):
+                raise PlanError("SEMI/ANTI join requires ON")
+            node = JoinNode(children=[left, right], how="cross",
+                            schema=_join_schema(left, right, "cross"))
+            if on is not None:
+                node = FilterNode(children=[node], pred=on, schema=node.schema)
+            return node
+        lcols = {f.name for f in left.schema.fields}
+        rcols = {f.name for f in right.schema.fields}
+        lkeys, rkeys, residual = [], [], None
+        for c in _conjuncts(on):
+            pair = _equi_pair(c, lcols, rcols)
+            if pair is not None:
+                lkeys.append(pair[0])
+                rkeys.append(pair[1])
+            else:
+                residual = c if residual is None else Call("and", (residual, c))
+        if not lkeys:
+            node = JoinNode(children=[left, right], how="cross",
+                            schema=_join_schema(left, right, "cross"))
+            return FilterNode(children=[node], pred=on, schema=node.schema)
+        # the sort-join packs at most TWO keys, each into 32 bits: wider/more
+        # keys join on the first key exactly and verify the rest as residual
+        # equality (superset of matches -> post-filter)
+        safe32 = {LType.BOOL, LType.INT8, LType.INT16, LType.INT32,
+                  LType.UINT32, LType.DATE, LType.STRING}
+
+        def pair_is_32bit(i: int) -> bool:
+            return (left.schema.field(lkeys[i]).ltype in safe32 and
+                    right.schema.field(rkeys[i]).ltype in safe32)
+
+        if len(lkeys) > 1 and not (len(lkeys) == 2 and pair_is_32bit(0)
+                                   and pair_is_32bit(1)):
+            for l, r in zip(lkeys[1:], rkeys[1:]):
+                eq = Call("eq", (ColRef(l), ColRef(r)))
+                residual = eq if residual is None else Call("and", (residual, eq))
+            lkeys, rkeys = lkeys[:1], rkeys[:1]
+        if residual is not None and how in ("left", "semi", "anti"):
+            raise PlanError(f"non-equi residual not supported for {how} join (round 1)")
+        node = JoinNode(children=[left, right], how=how, left_keys=lkeys,
+                        right_keys=rkeys, residual=residual,
+                        schema=_join_schema(left, right, how))
+        if residual is not None:
+            node2 = FilterNode(children=[node], pred=residual, schema=node.schema)
+            node.residual = None
+            return node2
+        return node
+
+    # ------------------------------------------------------------------
+    def _push_predicates(self, plan: PlanNode, where: Expr,
+                         stmt: SelectStmt) -> PlanNode:
+        """Split WHERE conjuncts; push single-table ones into their Scan
+        (reference: PredicatePushDown pass, src/physical_plan).  Right sides
+        of LEFT joins and either side of SEMI/ANTI are not safe targets."""
+        unsafe = set()
+        for j in stmt.joins:
+            if j.kind in ("left",):
+                unsafe.add(j.table.label)
+            if j.kind == "right":
+                # after swap the *other* tables became the right side; keep
+                # it simple: disable pushdown entirely when RIGHT JOIN present
+                return FilterNode(children=[plan], pred=where, schema=plan.schema)
+        scan_labels = set()
+
+        def scan_label_walk(n: PlanNode):
+            if isinstance(n, ScanNode):
+                scan_labels.add(n.label)
+            for c in n.children:
+                scan_label_walk(c)
+
+        scan_label_walk(plan)
+        remaining = None
+        pushed: dict[str, Expr] = {}
+        for c in _conjuncts(where):
+            labels = {r.name.split(".", 1)[0] for r in walk(c)
+                      if isinstance(r, ColRef)}
+            # derived tables have no ScanNode: their conjuncts must stay above
+            if len(labels) == 1:
+                lbl = next(iter(labels))
+                if lbl not in unsafe and lbl in scan_labels:
+                    pushed[lbl] = c if lbl not in pushed else Call("and", (pushed[lbl], c))
+                    continue
+            remaining = c if remaining is None else Call("and", (remaining, c))
+        if pushed:
+            _push_into_scans(plan, pushed)
+        if remaining is not None:
+            plan = FilterNode(children=[plan], pred=remaining, schema=plan.schema)
+        return plan
+
+    # ------------------------------------------------------------------
+    def _plan_aggregate(self, plan, flat, named_items, group_exprs, having,
+                        order_items, stmt):
+        sch = plan.schema
+        # pre-agg projection: group keys + aggregate inputs
+        pre_names: list[str] = []
+        pre_exprs: list[Expr] = []
+        key_names: list[str] = []
+        for g in group_exprs:
+            if isinstance(g, ColRef):
+                key_names.append(g.name)
+                continue
+            kn = self._tmp("k")
+            key_names.append(kn)
+            pre_names.append(kn)
+            pre_exprs.append(g)
+
+        aggs: list[AggCall] = []
+
+        def note_aggs(e: Optional[Expr]):
+            if e is None:
+                return
+            for x in walk(e):
+                if isinstance(x, AggCall) and not any(x.equals(a) for a in aggs):
+                    aggs.append(x)
+
+        for _, e in named_items:
+            note_aggs(e)
+        note_aggs(having)
+        for e, _ in order_items:
+            note_aggs(e)
+
+        specs: list[AggSpec] = []
+        agg_out: list[tuple[AggCall, str]] = []
+        for a in aggs:
+            out = self._tmp("a")
+            if a.op == "count_star" or not a.args:
+                specs.append(AggSpec("count_star", None, out))
+            else:
+                arg = a.args[0]
+                if isinstance(arg, ColRef):
+                    inp = arg.name
+                else:
+                    inp = self._tmp("ai")
+                    pre_names.append(inp)
+                    pre_exprs.append(arg)
+                op = a.op
+                if op == "count" and len(a.args) > 1:
+                    raise PlanError("multi-arg COUNT not supported (round 1)")
+                specs.append(AggSpec(op, inp, out, distinct=a.distinct))
+            agg_out.append((a, out))
+
+        if pre_exprs:
+            # keep existing columns + computed ones
+            keep = [f.name for f in sch.fields]
+            exprs = [ColRef(n) for n in keep] + pre_exprs
+            names = keep + pre_names
+            psch = Schema(tuple(list(sch.fields) +
+                                [Field(n, infer_type(e, sch)) for n, e in
+                                 zip(pre_names, pre_exprs)]))
+            plan = ProjectNode(children=[plan], exprs=exprs, names=names, schema=psch)
+            sch = psch
+
+        strategy, domains, max_groups, key_shift = self._group_strategy(plan, sch, key_names)
+        out_fields = []
+        for kn in key_names:
+            f = sch.field(kn)
+            out_fields.append(Field(kn, f.ltype, f.nullable))
+        for (a, out), s in zip(agg_out, specs):
+            at = infer_type(a.args[0], sch) if a.args else LType.INT64
+            out_fields.append(Field(out, agg_result_type(s.op if s.op != "count_star"
+                                                         else "count", at)))
+        agg = AggNode(children=[plan], key_names=key_names, specs=specs,
+                      strategy=strategy, domains=domains, max_groups=max_groups,
+                      schema=Schema(tuple(out_fields)))
+        agg.key_shift = key_shift
+        plan = agg
+
+        # rewrite post-agg expressions: AggCall -> its out column; group-key
+        # exprs -> key column
+        mapping: list[tuple[Expr, Expr]] = []
+        for a, out in agg_out:
+            mapping.append((a, ColRef(out)))
+        for g, kn in zip(group_exprs, key_names):
+            mapping.append((g, ColRef(kn)))
+
+        def rewrite(e: Optional[Expr]) -> Optional[Expr]:
+            if e is None:
+                return None
+            for src, dst in mapping:
+                if e.equals(src):
+                    return dst
+            if isinstance(e, (Call, AggCall)):
+                new_args = tuple(rewrite(x) for x in e.args)
+                if isinstance(e, AggCall):
+                    raise PlanError(f"nested aggregate {e!r}")
+                return Call(e.op, new_args)
+            if isinstance(e, ColRef):
+                if e.name in key_names:
+                    return e
+                raise PlanError(f"column {e.name!r} must appear in GROUP BY "
+                                "or inside an aggregate")
+            return e
+
+        named_items = [(n, rewrite(e)) for n, e in named_items]
+        order_items = [(rewrite(e), asc) for e, asc in order_items]
+        if having is not None:
+            having = rewrite(having)
+            plan = FilterNode(children=[plan], pred=having, schema=plan.schema)
+        return plan, named_items, None, order_items
+
+    def _group_strategy(self, plan, sch: Schema, key_names: list[str]):
+        """dense (segment_sum over known domains) vs sorted fallback.
+
+        Dense applies when every key is a dictionary column (dense codes by
+        construction) or an integer with host statistics showing a small
+        min..max span; mirrors how the reference picks hash-agg layouts from
+        statistics (ExecTypeAnalyzer + statistics adjust,
+        src/physical_plan/exec_type_analyzer.cpp:42-51)."""
+        if not key_names:
+            return "scalar", [], 0, {}
+        domains: list[int] = []
+        key_shift: dict[str, int] = {}
+        total = 1
+        for kn in key_names:
+            f = sch.field(kn)
+            st = self._key_stats(plan, kn)
+            if f.ltype is LType.STRING and st is not None and "dict_size" in st:
+                domains.append(st["dict_size"])
+            elif f.ltype.is_integer and st is not None and st.get("min") is not None:
+                span = int(st["max"]) - int(st["min"]) + 1
+                if span <= 0 or span > MAX_DENSE_GROUPS:
+                    return self._sorted_strategy(plan, key_names)
+                domains.append(span)
+                if int(st["min"]) != 0:
+                    key_shift[kn] = int(st["min"])
+            else:
+                return self._sorted_strategy(plan, key_names)
+            total *= domains[-1] + 1
+            if total > MAX_DENSE_GROUPS:
+                return self._sorted_strategy(plan, key_names)
+        return "dense", domains, 0, key_shift
+
+    def _sorted_strategy(self, plan, key_names):
+        return "sorted", [], 0, {}   # max_groups resolved at exec from batch size
+
+    def _key_stats(self, plan: PlanNode, qualified: str) -> Optional[dict]:
+        """Host-side column stats for group keys, traced back to the scan."""
+        node = plan
+        # only look through simple chains (Project/Filter) to a single scan
+        while True:
+            if isinstance(node, ScanNode):
+                if "." not in qualified:
+                    return None
+                lbl, col = qualified.split(".", 1)
+                if lbl != node.label:
+                    return None
+                if self.stats_fn is not None:
+                    return self.stats_fn(node.table_key, col)
+                return None
+            if isinstance(node, (FilterNode,)) and node.children:
+                node = node.children[0]
+                continue
+            if isinstance(node, ProjectNode) and node.children:
+                # pass through identity projections of the column
+                for n, e in zip(node.names, node.exprs):
+                    if n == qualified and isinstance(e, ColRef):
+                        qualified = e.name
+                        break
+                else:
+                    if qualified not in node.names:
+                        node = node.children[0]
+                        continue
+                    return None
+                node = node.children[0]
+                continue
+            return None
+
+    # ------------------------------------------------------------------
+    def _prune_columns(self, plan: PlanNode):
+        """ColumnsPrune analog: restrict every Scan to columns referenced
+        above it."""
+        used: set[str] = set()
+
+        def collect(node: PlanNode):
+            if isinstance(node, ScanNode):
+                if node.pushed_filter is not None:
+                    used.update(r.name for r in walk(node.pushed_filter)
+                                if isinstance(r, ColRef))
+                return
+            if isinstance(node, FilterNode) and node.pred is not None:
+                used.update(r.name for r in walk(node.pred) if isinstance(r, ColRef))
+            elif isinstance(node, ProjectNode):
+                for e in node.exprs:
+                    used.update(r.name for r in walk(e) if isinstance(r, ColRef))
+            elif isinstance(node, JoinNode):
+                used.update(node.left_keys)
+                used.update(node.right_keys)
+                if node.residual is not None:
+                    used.update(r.name for r in walk(node.residual)
+                                if isinstance(r, ColRef))
+            elif isinstance(node, AggNode):
+                used.update(node.key_names)
+                used.update(s.input for s in node.specs if s.input)
+            elif isinstance(node, SortNode):
+                used.update(k for k, _ in node.keys)
+            for c in node.children:
+                collect(c)
+
+        collect(plan)
+
+        def apply(node: PlanNode, required: set[str]):
+            if isinstance(node, ScanNode):
+                if node.pushed_filter is not None:
+                    required = required | {r.name for r in walk(node.pushed_filter)
+                                           if isinstance(r, ColRef)}
+                keep = [c for c in node.columns
+                        if f"{node.label}.{c}" in required]
+                if not keep and node.columns:
+                    # COUNT(*)-style scans still need row extent: keep the
+                    # narrowest column
+                    keep = [min(node.columns,
+                                key=lambda c: node.schema.field(f"{node.label}.{c}")
+                                .ltype.np_dtype.itemsize)]
+                node.columns = keep
+                keep_q = {f"{node.label}.{c}" for c in keep}
+                node.schema = Schema(tuple(f for f in node.schema.fields
+                                           if f.name in keep_q))
+                return
+            if isinstance(node, ProjectNode):
+                for c in node.children:
+                    sub = set()
+                    for e in node.exprs:
+                        sub.update(r.name for r in walk(e) if isinstance(r, ColRef))
+                    apply(c, sub)
+                return
+            for c in node.children:
+                apply(c, required | used)
+
+        # required at the top = everything referenced anywhere (conservative,
+        # Project nodes narrow it on the way down)
+        apply(plan, set(used))
+
+
+# ----------------------------------------------------------------------
+
+
+class _Resolver:
+    def __init__(self, scope: Scope):
+        self.scope = scope
+
+    def __call__(self, e: Optional[Expr]) -> Optional[Expr]:
+        if e is None:
+            return None
+        if isinstance(e, ColRef):
+            q, _ = self.scope.resolve(e.name, e.table)
+            return ColRef(q)
+        if isinstance(e, AggCall):
+            return AggCall(e.op, tuple(self(a) for a in e.args), e.distinct)
+        if isinstance(e, Call):
+            return Call(e.op, tuple(self(a) for a in e.args))
+        return e
+
+
+def _conjuncts(e: Expr) -> list[Expr]:
+    if isinstance(e, Call) and e.op == "and":
+        return _conjuncts(e.args[0]) + _conjuncts(e.args[1])
+    return [e]
+
+
+def _equi_pair(e: Expr, lcols: set, rcols: set) -> Optional[tuple[str, str]]:
+    if not (isinstance(e, Call) and e.op == "eq"):
+        return None
+    a, b = e.args
+    if not (isinstance(a, ColRef) and isinstance(b, ColRef)):
+        return None
+    if a.name in lcols and b.name in rcols:
+        return a.name, b.name
+    if b.name in lcols and a.name in rcols:
+        return b.name, a.name
+    return None
+
+
+def _join_schema(left: PlanNode, right: PlanNode, how: str) -> Schema:
+    if how in ("semi", "anti"):
+        return left.schema
+    fields = list(left.schema.fields)
+    names = {f.name for f in fields}
+    for f in right.schema.fields:
+        name = f.name if f.name not in names else f.name + "_r"
+        nullable = True if how == "left" else f.nullable
+        fields.append(Field(name, f.ltype, nullable))
+    return Schema(tuple(fields))
+
+
+def _push_into_scans(node: PlanNode, pushed: dict[str, Expr]):
+    if isinstance(node, ScanNode):
+        if node.label in pushed:
+            p = pushed[node.label]
+            node.pushed_filter = p if node.pushed_filter is None else \
+                Call("and", (node.pushed_filter, p))
+        return
+    # do not push through joins' right side for left joins: planner already
+    # excluded those labels
+    for c in node.children:
+        _push_into_scans(c, pushed)
+
+
+def _contains_agg(e: Expr) -> bool:
+    return any(isinstance(x, AggCall) for x in walk(e))
+
+
+def _display_name(e: Expr) -> str:
+    if isinstance(e, ColRef):
+        return e.name.split(".")[-1] if e.table is None else e.name
+    return repr(e)
+
+
+def dreplace_union(stmt: SelectStmt) -> SelectStmt:
+    """Bare-arm copy: no union link, no ORDER BY/LIMIT (those bind to the
+    union result, not the arm)."""
+    import copy
+    s = copy.copy(stmt)
+    s.union = None
+    s.order_by = []
+    s.limit = None
+    s.offset = 0
+    return s

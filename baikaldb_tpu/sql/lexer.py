@@ -1,0 +1,131 @@
+"""SQL lexer (reference: include/sqlparser/sql_lex.l — flex; here a compact
+hand-rolled tokenizer for the MySQL dialect subset)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+class SqlError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str    # KW | IDENT | NUM | STR | OP | END
+    value: str
+    pos: int
+
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "asc", "desc", "as", "and", "or", "not", "xor", "in", "is",
+    "null", "like", "between", "distinct", "all", "union", "join", "inner",
+    "left", "right", "full", "outer", "cross", "on", "using", "case", "when",
+    "then", "else", "end", "cast", "true", "false", "exists", "any",
+    "insert", "into", "values", "replace", "update", "set", "delete",
+    "create", "table", "database", "drop", "truncate", "alter", "add",
+    "primary", "key", "unique", "index", "fulltext", "if", "show", "tables",
+    "databases", "describe", "desc", "explain", "use", "begin", "commit",
+    "rollback", "div", "mod", "interval", "semi", "anti",
+    "count", "sum", "avg", "min", "max",
+}
+
+_TWO_CHAR = {"<=", ">=", "<>", "!=", ":=", "<<", ">>", "||", "&&"}
+
+
+def tokenize(sql: str) -> list[Token]:
+    out: list[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if c == "-" and sql[i:i + 2] == "--":
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if c == "/" and sql[i:i + 2] == "/*":
+            j = sql.find("*/", i)
+            if j < 0:
+                raise SqlError(f"unterminated comment at {i}")
+            i = j + 2
+            continue
+        if c == "#":
+            j = sql.find("\n", i)
+            i = n if j < 0 else j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = seen_e = False
+            while j < n:
+                ch = sql[j]
+                if ch.isdigit():
+                    j += 1
+                elif ch == "." and not seen_dot and not seen_e:
+                    seen_dot = True
+                    j += 1
+                elif ch in "eE" and not seen_e and j > i:
+                    seen_e = True
+                    j += 1
+                    if j < n and sql[j] in "+-":
+                        j += 1
+                else:
+                    break
+            out.append(Token("NUM", sql[i:j], i))
+            i = j
+            continue
+        if c in "'\"":
+            q = c
+            j = i + 1
+            buf = []
+            while j < n:
+                if sql[j] == "\\" and j + 1 < n:
+                    esc = sql[j + 1]
+                    buf.append({"n": "\n", "t": "\t", "0": "\0"}.get(esc, esc))
+                    j += 2
+                elif sql[j] == q:
+                    if j + 1 < n and sql[j + 1] == q:  # '' escape
+                        buf.append(q)
+                        j += 2
+                    else:
+                        break
+                else:
+                    buf.append(sql[j])
+                    j += 1
+            if j >= n:
+                raise SqlError(f"unterminated string at {i}")
+            out.append(Token("STR", "".join(buf), i))
+            i = j + 1
+            continue
+        if c == "`":
+            j = sql.find("`", i + 1)
+            if j < 0:
+                raise SqlError(f"unterminated identifier at {i}")
+            out.append(Token("IDENT", sql[i + 1:j], i))
+            i = j + 1
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            word = sql[i:j]
+            if word.lower() in KEYWORDS:
+                out.append(Token("KW", word.lower(), i))
+            else:
+                out.append(Token("IDENT", word, i))
+            i = j
+            continue
+        two = sql[i:i + 2]
+        if two in _TWO_CHAR:
+            out.append(Token("OP", two, i))
+            i += 2
+            continue
+        if c in "+-*/%(),.;=<>!@":
+            out.append(Token("OP", c, i))
+            i += 1
+            continue
+        raise SqlError(f"unexpected character {c!r} at {i}")
+    out.append(Token("END", "", n))
+    return out

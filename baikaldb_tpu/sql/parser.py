@@ -1,0 +1,691 @@
+"""Recursive-descent SQL parser for the MySQL dialect subset.
+
+The reference uses a 6941-line bison grammar (include/sqlparser/sql_parse.y)
+generated at build time; statement dispatch mirrors
+src/logical_plan/logical_planner.cpp:427-471.  This parser covers the round-1
+surface: SELECT (joins, group/having, order/limit, union, derived tables),
+INSERT/REPLACE/UPDATE/DELETE, CREATE/DROP TABLE|DATABASE, TRUNCATE, USE,
+SHOW, DESCRIBE, EXPLAIN, and the expression grammar with MySQL operator
+precedence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..expr.ast import AggCall, Call, ColRef, Expr, Lit
+from .lexer import SqlError, Token, tokenize
+from .stmt import (ColumnDef, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
+                   DescribeStmt, DropDatabaseStmt, DropTableStmt, ExplainStmt,
+                   InsertStmt, JoinClause, OrderItem, SelectItem, SelectStmt,
+                   ShowStmt, TableRef, TruncateStmt, UpdateStmt, UseStmt)
+
+_AGG_FUNCS = {"count", "sum", "avg", "min", "max", "stddev", "std",
+              "stddev_samp", "variance", "var_samp", "group_concat"}
+
+_FN_ALIASES = {
+    "substring": "substr", "mid": "substr", "ucase": "upper", "lcase": "lower",
+    "ceiling": "ceil", "power": "pow", "log": "ln", "character_length":
+    "char_length", "curdate": "curdate", "now": "now", "std": "stddev",
+    "datediff": "datediff", "adddate": "date_add_days", "subdate": "date_sub_days",
+}
+
+_CMP_OPS = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le",
+            ">": "gt", ">=": "ge"}
+
+
+def parse_sql(sql: str):
+    """Parse one or more ;-separated statements -> list of stmt nodes."""
+    p = Parser(tokenize(sql))
+    stmts = []
+    while not p.at_end():
+        if p.try_op(";"):
+            continue
+        stmts.append(p.statement())
+        if not p.at_end() and not p.try_op(";"):
+            raise SqlError(f"unexpected token {p.peek().value!r} at {p.peek().pos}")
+    return stmts
+
+
+class Parser:
+    def __init__(self, tokens: list[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # -- token helpers ---------------------------------------------------
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def at_end(self) -> bool:
+        return self.peek().kind == "END"
+
+    def advance(self) -> Token:
+        t = self.toks[self.i]
+        if t.kind != "END":
+            self.i += 1
+        return t
+
+    def try_kw(self, *kws: str) -> Optional[str]:
+        t = self.peek()
+        if t.kind == "KW" and t.value in kws:
+            self.advance()
+            return t.value
+        return None
+
+    def expect_kw(self, kw: str):
+        if not self.try_kw(kw):
+            t = self.peek()
+            raise SqlError(f"expected {kw.upper()!r}, got {t.value!r} at {t.pos}")
+
+    def try_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "OP" and t.value == op:
+            self.advance()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.try_op(op):
+            t = self.peek()
+            raise SqlError(f"expected {op!r}, got {t.value!r} at {t.pos}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind == "IDENT" or (t.kind == "KW" and t.value in
+                                 ("key", "tables", "databases", "index", "count",
+                                  "sum", "avg", "min", "max")):
+            self.advance()
+            return t.value
+        raise SqlError(f"expected identifier, got {t.value!r} at {t.pos}")
+
+    # -- statements ------------------------------------------------------
+    def statement(self):
+        t = self.peek()
+        if t.kind != "KW":
+            raise SqlError(f"expected statement, got {t.value!r} at {t.pos}")
+        if t.value == "select":
+            return self.select_stmt()
+        if t.value in ("insert", "replace"):
+            return self.insert_stmt()
+        if t.value == "update":
+            return self.update_stmt()
+        if t.value == "delete":
+            return self.delete_stmt()
+        if t.value == "create":
+            return self.create_stmt()
+        if t.value == "drop":
+            return self.drop_stmt()
+        if t.value == "truncate":
+            self.advance()
+            self.try_kw("table")
+            return TruncateStmt(self.table_name())
+        if t.value == "use":
+            self.advance()
+            return UseStmt(self.ident())
+        if t.value == "show":
+            return self.show_stmt()
+        if t.value in ("describe", "desc"):
+            self.advance()
+            return DescribeStmt(self.table_name())
+        if t.value == "explain":
+            self.advance()
+            fmt = None
+            sel = self.select_stmt()
+            return ExplainStmt(sel, fmt)
+        raise SqlError(f"unsupported statement {t.value!r} at {t.pos}")
+
+    def table_name(self) -> TableRef:
+        a = self.ident()
+        if self.try_op("."):
+            return TableRef(a, self.ident())
+        return TableRef(None, a)
+
+    # -- SELECT ----------------------------------------------------------
+    def select_stmt(self) -> SelectStmt:
+        """select_core (UNION [ALL] select_core)* [ORDER BY ...] [LIMIT ...]
+
+        ORDER BY / LIMIT after a UNION bind to the WHOLE union (MySQL), so
+        they are parsed once here, after the union chain."""
+        stmt = self._select_core()
+        tail = stmt
+        while self.try_kw("union"):
+            mode = "all" if self.try_kw("all") else "distinct"
+            self.try_kw("distinct")
+            rhs = self._select_core()
+            tail.union = (mode, rhs)
+            tail = rhs
+        if self.try_kw("order"):
+            self.expect_kw("by")
+            stmt.order_by.append(self.order_item())
+            while self.try_op(","):
+                stmt.order_by.append(self.order_item())
+        if self.try_kw("limit"):
+            a = self._int_lit()
+            if self.try_op(","):            # LIMIT offset, count
+                stmt.offset = a
+                stmt.limit = self._int_lit()
+            else:
+                stmt.limit = a
+                if self.try_kw("offset"):
+                    stmt.offset = self._int_lit()
+        return stmt
+
+    def _select_core(self) -> SelectStmt:
+        self.expect_kw("select")
+        distinct = bool(self.try_kw("distinct"))
+        self.try_kw("all")
+        items = [self.select_item()]
+        while self.try_op(","):
+            items.append(self.select_item())
+        stmt = SelectStmt(items=items, distinct=distinct)
+        if self.try_kw("from"):
+            stmt.table = self.table_ref()
+            while True:
+                j = self.join_clause()
+                if j is None:
+                    break
+                stmt.joins.append(j)
+        if self.try_kw("where"):
+            stmt.where = self.expr()
+        if self.try_kw("group"):
+            self.expect_kw("by")
+            stmt.group_by.append(self.expr())
+            while self.try_op(","):
+                stmt.group_by.append(self.expr())
+        if self.try_kw("having"):
+            stmt.having = self.expr()
+        return stmt
+
+    def _int_lit(self) -> int:
+        t = self.peek()
+        if t.kind != "NUM":
+            raise SqlError(f"expected integer, got {t.value!r} at {t.pos}")
+        self.advance()
+        return int(t.value)
+
+    def select_item(self) -> SelectItem:
+        if self.try_op("*"):
+            return SelectItem(None)
+        # t.* form
+        t = self.peek()
+        if t.kind == "IDENT" and self.peek(1).value == "." and self.peek(2).value == "*":
+            self.advance(); self.advance(); self.advance()
+            return SelectItem(None, star_table=t.value)
+        e = self.expr()
+        alias = None
+        if self.try_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "IDENT":
+            alias = self.ident()
+        elif self.peek().kind == "STR":
+            alias = self.advance().value
+        return SelectItem(e, alias)
+
+    def order_item(self) -> OrderItem:
+        e = self.expr()
+        asc = True
+        if self.try_kw("desc"):
+            asc = False
+        else:
+            self.try_kw("asc")
+        return OrderItem(e, asc)
+
+    def table_ref(self) -> TableRef:
+        if self.try_op("("):
+            sub = self.select_stmt()
+            self.expect_op(")")
+            self.try_kw("as")
+            alias = self.ident()
+            return TableRef(None, alias, alias, subquery=sub)
+        ref = self.table_name()
+        if self.try_kw("as"):
+            ref.alias = self.ident()
+        elif self.peek().kind == "IDENT":
+            ref.alias = self.ident()
+        return ref
+
+    def join_clause(self) -> Optional[JoinClause]:
+        kind = None
+        if self.try_kw("join") or self.try_op(","):
+            kind = "inner"
+        elif self.try_kw("inner"):
+            self.expect_kw("join")
+            kind = "inner"
+        elif self.try_kw("cross"):
+            self.expect_kw("join")
+            kind = "cross"
+        elif self.try_kw("left"):
+            self.try_kw("outer")
+            if self.try_kw("semi"):
+                kind = "semi"
+            elif self.try_kw("anti"):
+                kind = "anti"
+            else:
+                kind = "left"
+            self.expect_kw("join")
+        elif self.try_kw("right"):
+            self.try_kw("outer")
+            self.expect_kw("join")
+            kind = "right"
+        else:
+            return None
+        table = self.table_ref()
+        on = None
+        using: list[str] = []
+        if self.try_kw("on"):
+            on = self.expr()
+        elif self.try_kw("using"):
+            self.expect_op("(")
+            using.append(self.ident())
+            while self.try_op(","):
+                using.append(self.ident())
+            self.expect_op(")")
+        return JoinClause(kind, table, on, using)
+
+    # -- DML -------------------------------------------------------------
+    def insert_stmt(self) -> InsertStmt:
+        replace = bool(self.try_kw("replace"))
+        if not replace:
+            self.expect_kw("insert")
+        self.try_kw("into")
+        table = self.table_name()
+        columns: list[str] = []
+        if self.try_op("("):
+            columns.append(self.ident())
+            while self.try_op(","):
+                columns.append(self.ident())
+            self.expect_op(")")
+        if self.try_kw("values"):
+            rows = []
+            while True:
+                self.expect_op("(")
+                row = [self.literal_value()]
+                while self.try_op(","):
+                    row.append(self.literal_value())
+                self.expect_op(")")
+                rows.append(row)
+                if not self.try_op(","):
+                    break
+            return InsertStmt(table, columns, rows, replace=replace)
+        sel = self.select_stmt()
+        return InsertStmt(table, columns, [], select=sel, replace=replace)
+
+    def literal_value(self):
+        """A literal (or signed literal / NULL) inside VALUES(...)."""
+        t = self.peek()
+        if t.kind == "NUM":
+            self.advance()
+            return _num(t.value)
+        if t.kind == "STR":
+            self.advance()
+            return t.value
+        if t.kind == "KW" and t.value == "null":
+            self.advance()
+            return None
+        if t.kind == "KW" and t.value in ("true", "false"):
+            self.advance()
+            return t.value == "true"
+        if t.kind == "OP" and t.value == "-":
+            self.advance()
+            return -self.literal_value()
+        raise SqlError(f"expected literal in VALUES, got {t.value!r} at {t.pos}")
+
+    def update_stmt(self) -> UpdateStmt:
+        self.expect_kw("update")
+        table = self.table_name()
+        self.expect_kw("set")
+        assigns = [self._assignment()]
+        while self.try_op(","):
+            assigns.append(self._assignment())
+        where = self.expr() if self.try_kw("where") else None
+        return UpdateStmt(table, assigns, where)
+
+    def _assignment(self) -> tuple[str, Expr]:
+        name = self.ident()
+        self.expect_op("=")
+        return name, self.expr()
+
+    def delete_stmt(self) -> DeleteStmt:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.table_name()
+        where = self.expr() if self.try_kw("where") else None
+        return DeleteStmt(table, where)
+
+    # -- DDL -------------------------------------------------------------
+    def create_stmt(self):
+        self.expect_kw("create")
+        if self.try_kw("database"):
+            ine = self._if_not_exists()
+            return CreateDatabaseStmt(self.ident(), ine)
+        self.expect_kw("table")
+        ine = self._if_not_exists()
+        table = self.table_name()
+        self.expect_op("(")
+        cols: list[ColumnDef] = []
+        pk: list[str] = []
+        indexes: list[tuple[str, str, list[str]]] = []
+        while True:
+            if self.try_kw("primary"):
+                self.expect_kw("key")
+                pk = self._paren_name_list()
+            elif self.try_kw("unique"):
+                self.try_kw("key") or self.try_kw("index")
+                name = self.ident() if self.peek().kind == "IDENT" else ""
+                indexes.append(("unique", name, self._paren_name_list()))
+            elif self.try_kw("fulltext"):
+                self.try_kw("key") or self.try_kw("index")
+                name = self.ident() if self.peek().kind == "IDENT" else ""
+                indexes.append(("fulltext", name, self._paren_name_list()))
+            elif self.try_kw("key") or self.try_kw("index"):
+                name = self.ident() if self.peek().kind == "IDENT" else ""
+                indexes.append(("key", name, self._paren_name_list()))
+            else:
+                cname = self.ident()
+                tname = self._type_name()
+                nullable = True
+                primary = False
+                while True:
+                    if self.try_kw("not"):
+                        self.expect_kw("null")
+                        nullable = False
+                    elif self.try_kw("null"):
+                        pass
+                    elif self.try_kw("primary"):
+                        self.expect_kw("key")
+                        primary = True
+                    elif self.peek().kind == "IDENT" and \
+                            self.peek().value.lower() in ("default", "comment",
+                                                          "auto_increment"):
+                        self.advance()
+                        if self.peek().kind in ("NUM", "STR") or \
+                                (self.peek().kind == "KW" and self.peek().value == "null"):
+                            self.advance()
+                    else:
+                        break
+                cols.append(ColumnDef(cname, tname, nullable, primary))
+                if primary:
+                    pk = [cname]
+            if not self.try_op(","):
+                break
+        self.expect_op(")")
+        # swallow table options (ENGINE=..., etc.)
+        while not self.at_end() and self.peek().value != ";":
+            self.advance()
+        return CreateTableStmt(table, cols, pk, indexes, ine)
+
+    def _type_name(self) -> str:
+        base = self.ident()
+        if self.try_op("("):
+            depth = 1
+            while depth:
+                v = self.advance().value
+                if v == "(":
+                    depth += 1
+                elif v == ")":
+                    depth -= 1
+        if self.peek().kind == "IDENT" and self.peek().value.lower() == "unsigned":
+            self.advance()
+            return base + " unsigned"
+        return base
+
+    def _paren_name_list(self) -> list[str]:
+        self.expect_op("(")
+        names = [self.ident()]
+        while self.try_op(","):
+            names.append(self.ident())
+        self.expect_op(")")
+        return names
+
+    def _if_not_exists(self) -> bool:
+        if self.try_kw("if"):
+            self.expect_kw("not")
+            if self.peek().value.lower() == "exists":
+                self.advance()
+            return True
+        return False
+
+    def drop_stmt(self):
+        self.expect_kw("drop")
+        if self.try_kw("database"):
+            ie = self._if_exists()
+            return DropDatabaseStmt(self.ident(), ie)
+        self.expect_kw("table")
+        ie = self._if_exists()
+        return DropTableStmt(self.table_name(), ie)
+
+    def _if_exists(self) -> bool:
+        if self.try_kw("if"):
+            if self.peek().value.lower() == "exists":
+                self.advance()
+            return True
+        return False
+
+    def show_stmt(self) -> ShowStmt:
+        self.expect_kw("show")
+        if self.try_kw("tables"):
+            db = None
+            if self.try_kw("from"):
+                db = self.ident()
+            return ShowStmt("tables", db)
+        if self.try_kw("databases"):
+            return ShowStmt("databases")
+        t = self.peek()
+        raise SqlError(f"unsupported SHOW {t.value!r} at {t.pos}")
+
+    # -- expressions (MySQL precedence) ----------------------------------
+    def expr(self) -> Expr:
+        return self._or_expr()
+
+    def _or_expr(self) -> Expr:
+        e = self._xor_expr()
+        while self.try_kw("or") or self.try_op("||"):
+            e = Call("or", (e, self._xor_expr()))
+        return e
+
+    def _xor_expr(self) -> Expr:
+        e = self._and_expr()
+        while self.try_kw("xor"):
+            e = Call("xor", (e, self._and_expr()))
+        return e
+
+    def _and_expr(self) -> Expr:
+        e = self._not_expr()
+        while self.try_kw("and") or self.try_op("&&"):
+            e = Call("and", (e, self._not_expr()))
+        return e
+
+    def _not_expr(self) -> Expr:
+        if self.try_kw("not"):
+            return Call("not", (self._not_expr(),))
+        return self._cmp_expr()
+
+    def _cmp_expr(self) -> Expr:
+        e = self._add_expr()
+        while True:
+            t = self.peek()
+            if t.kind == "OP" and t.value in _CMP_OPS:
+                self.advance()
+                e = Call(_CMP_OPS[t.value], (e, self._add_expr()))
+                continue
+            if t.kind == "KW" and t.value == "is":
+                self.advance()
+                neg = bool(self.try_kw("not"))
+                self.expect_kw("null")
+                e = Call("is_not_null" if neg else "is_null", (e,))
+                continue
+            neg = False
+            save = self.i
+            if self.try_kw("not"):
+                neg = True
+            if self.try_kw("like"):
+                pat = self._add_expr()
+                e = Call("not_like" if neg else "like", (e, pat))
+                continue
+            if self.try_kw("in"):
+                self.expect_op("(")
+                args = [e, self._in_item()]
+                while self.try_op(","):
+                    args.append(self._in_item())
+                self.expect_op(")")
+                e = Call("not_in" if neg else "in", tuple(args))
+                continue
+            if self.try_kw("between"):
+                lo = self._add_expr()
+                self.expect_kw("and")
+                hi = self._add_expr()
+                b = Call("between", (e, lo, hi))
+                e = Call("not", (b,)) if neg else b
+                continue
+            if neg:
+                self.i = save
+            break
+        return e
+
+    def _in_item(self) -> Expr:
+        t = self.peek()
+        if t.kind == "OP" and t.value == "-":
+            self.advance()
+            v = self.literal_value()
+            return Lit(-v if isinstance(v, (int, float)) else v)
+        if t.kind in ("NUM", "STR") or (t.kind == "KW" and t.value in
+                                        ("null", "true", "false")):
+            return Lit(self.literal_value())
+        return self.expr()
+
+    def _add_expr(self) -> Expr:
+        e = self._mul_expr()
+        while True:
+            if self.try_op("+"):
+                e = Call("add", (e, self._mul_expr()))
+            elif self.try_op("-"):
+                e = Call("sub", (e, self._mul_expr()))
+            else:
+                return e
+
+    def _mul_expr(self) -> Expr:
+        e = self._unary_expr()
+        while True:
+            if self.try_op("*"):
+                e = Call("mul", (e, self._unary_expr()))
+            elif self.try_op("/"):
+                e = Call("div", (e, self._unary_expr()))
+            elif self.try_op("%") or self.try_kw("mod"):
+                e = Call("mod", (e, self._unary_expr()))
+            elif self.try_kw("div"):
+                e = Call("int_div", (e, self._unary_expr()))
+            else:
+                return e
+
+    def _unary_expr(self) -> Expr:
+        if self.try_op("-"):
+            inner = self._unary_expr()
+            if isinstance(inner, Lit) and isinstance(inner.value, (int, float)):
+                return Lit(-inner.value)
+            return Call("neg", (inner,))
+        if self.try_op("+"):
+            return self._unary_expr()
+        return self._primary()
+
+    def _primary(self) -> Expr:
+        t = self.peek()
+        if t.kind == "NUM":
+            self.advance()
+            return Lit(_num(t.value))
+        if t.kind == "STR":
+            self.advance()
+            return Lit(t.value)
+        if t.kind == "KW":
+            if t.value == "null":
+                self.advance()
+                return Lit(None)
+            if t.value in ("true", "false"):
+                self.advance()
+                return Lit(t.value == "true")
+            if t.value == "case":
+                return self._case_expr()
+            if t.value == "cast":
+                self.advance()
+                self.expect_op("(")
+                e = self.expr()
+                self.expect_kw("as")
+                from ..meta.catalog import parse_type
+                tname = self._type_name()
+                self.expect_op(")")
+                return Call("cast", (e, Lit(parse_type(tname))))
+            if t.value in _AGG_FUNCS:
+                return self._call_or_ident()
+            if t.value == "interval":
+                raise SqlError("INTERVAL only valid inside DATE_ADD/DATE_SUB")
+            if t.value == "if":
+                return self._call_or_ident()
+        if self.try_op("("):
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "IDENT" or (t.kind == "KW" and t.value in _AGG_FUNCS | {"if"}):
+            return self._call_or_ident()
+        raise SqlError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def _case_expr(self) -> Expr:
+        self.expect_kw("case")
+        operand = None
+        if not (self.peek().kind == "KW" and self.peek().value == "when"):
+            operand = self.expr()
+        args: list[Expr] = []
+        while self.try_kw("when"):
+            cond = self.expr()
+            if operand is not None:
+                cond = Call("eq", (operand, cond))
+            self.expect_kw("then")
+            args.extend([cond, self.expr()])
+        if self.try_kw("else"):
+            args.append(self.expr())
+        self.expect_kw("end")
+        return Call("case_when", tuple(args))
+
+    def _call_or_ident(self) -> Expr:
+        name = self.advance().value
+        # qualified column t.c
+        if self.try_op("."):
+            return ColRef(self.ident(), table=name)
+        if not self.try_op("("):
+            return ColRef(name)
+        lname = name.lower()
+        # COUNT(*) / COUNT(DISTINCT x) / aggregates
+        if lname in _AGG_FUNCS:
+            distinct = bool(self.try_kw("distinct"))
+            if self.try_op("*"):
+                self.expect_op(")")
+                return AggCall("count_star" if lname == "count" else lname, ())
+            args = [self.expr()]
+            while self.try_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+            op = _FN_ALIASES.get(lname, lname)
+            return AggCall(op, tuple(args), distinct=distinct)
+        # DATE_ADD(x, INTERVAL n DAY)
+        if lname in ("date_add", "date_sub"):
+            x = self.expr()
+            self.expect_op(",")
+            self.expect_kw("interval")
+            n = self.expr()
+            unit = self.ident().lower()
+            self.expect_op(")")
+            if unit not in ("day", "days"):
+                raise SqlError(f"unsupported INTERVAL unit {unit!r} (round 1)")
+            return Call("date_add_days" if lname == "date_add" else "date_sub_days",
+                        (x, n))
+        args = []
+        if not self.try_op(")"):
+            args.append(self.expr())
+            while self.try_op(","):
+                args.append(self.expr())
+            self.expect_op(")")
+        return Call(_FN_ALIASES.get(lname, lname), tuple(args))
+
+
+def _num(s: str):
+    if "." in s or "e" in s.lower():
+        return float(s)
+    return int(s)

@@ -1,0 +1,141 @@
+"""Statement AST nodes (reference: include/sqlparser/{dml,ddl}.h arena AST;
+here plain dataclasses the planners consume)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..expr.ast import Expr
+
+
+@dataclass
+class TableRef:
+    database: Optional[str]
+    name: str
+    alias: Optional[str] = None
+    subquery: Optional["SelectStmt"] = None  # derived table
+
+    @property
+    def label(self) -> str:
+        return self.alias or self.name
+
+
+@dataclass
+class JoinClause:
+    kind: str          # inner | left | right | cross | semi | anti
+    table: TableRef
+    on: Optional[Expr] = None
+    using: list[str] = field(default_factory=list)
+
+
+@dataclass
+class SelectItem:
+    expr: Optional[Expr]   # None for plain *
+    alias: Optional[str] = None
+    star_table: Optional[str] = None  # "t.*"
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    asc: bool = True
+
+
+@dataclass
+class SelectStmt:
+    items: list[SelectItem]
+    table: Optional[TableRef] = None
+    joins: list[JoinClause] = field(default_factory=list)
+    where: Optional[Expr] = None
+    group_by: list[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: list[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    distinct: bool = False
+    union: Optional[tuple[str, "SelectStmt"]] = None  # ("all"|"distinct", rhs)
+
+
+@dataclass
+class InsertStmt:
+    table: TableRef
+    columns: list[str]
+    rows: list[list]              # literal rows
+    select: Optional[SelectStmt] = None
+    replace: bool = False
+
+
+@dataclass
+class UpdateStmt:
+    table: TableRef
+    assignments: list[tuple[str, Expr]]
+    where: Optional[Expr] = None
+
+
+@dataclass
+class DeleteStmt:
+    table: TableRef
+    where: Optional[Expr] = None
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str
+    nullable: bool = True
+    primary: bool = False
+
+
+@dataclass
+class CreateTableStmt:
+    table: TableRef
+    columns: list[ColumnDef]
+    primary_key: list[str] = field(default_factory=list)
+    indexes: list[tuple[str, str, list[str]]] = field(default_factory=list)  # (kind,name,cols)
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTableStmt:
+    table: TableRef
+    if_exists: bool = False
+
+
+@dataclass
+class TruncateStmt:
+    table: TableRef
+
+
+@dataclass
+class CreateDatabaseStmt:
+    name: str
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropDatabaseStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class UseStmt:
+    database: str
+
+
+@dataclass
+class ShowStmt:
+    what: str                     # tables | databases
+    database: Optional[str] = None
+
+
+@dataclass
+class DescribeStmt:
+    table: TableRef
+
+
+@dataclass
+class ExplainStmt:
+    stmt: SelectStmt
+    fmt: Optional[str] = None

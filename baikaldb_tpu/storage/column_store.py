@@ -1,0 +1,252 @@
+"""Columnar table storage: host Arrow tier + device-resident region cache.
+
+The reference's OLAP tier stores rows as Parquet column files managed by
+ColumnFileManager (src/column, include/column/file_manager.h:272) and converts
+row data to columns via row2column readers; scans produce Arrow RecordBatches.
+Here the host tier is a pyarrow Table per region (persistable to Parquet), and
+the *device tier* is a lazily-built, cached ColumnBatch per region — the
+TPU-resident column cache that scans read from (the ParquetCache analog,
+include/column/parquet_cache.h:168).
+
+Regions partition the row axis (the reference's key-range Region shards,
+include/store/region.h:445); round 1 splits by fixed row-count ranges and the
+parallel layer shards regions across mesh devices.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..column.batch import ColumnBatch
+from ..meta.catalog import TableInfo
+from ..types import LType, Schema
+
+DEFAULT_REGION_ROWS = 1 << 20  # split threshold on the row axis
+
+
+def schema_to_arrow(schema: Schema) -> pa.Schema:
+    m = {
+        LType.BOOL: pa.bool_(), LType.INT8: pa.int8(), LType.INT16: pa.int16(),
+        LType.INT32: pa.int32(), LType.INT64: pa.int64(),
+        LType.UINT32: pa.uint32(), LType.UINT64: pa.uint64(),
+        LType.FLOAT32: pa.float32(), LType.FLOAT64: pa.float64(),
+        LType.DECIMAL: pa.float64(), LType.DATE: pa.date32(),
+        LType.DATETIME: pa.timestamp("us"), LType.TIMESTAMP: pa.timestamp("us"),
+        LType.STRING: pa.string(),
+    }
+    return pa.schema([pa.field(f.name, m[f.ltype], nullable=f.nullable)
+                      for f in schema.fields])
+
+
+@dataclass
+class Region:
+    """One row-range shard of a table (reference Region minus Raft, which
+    arrives with the distributed store tier)."""
+    region_id: int
+    data: pa.Table
+    version: int = 1
+    _device: Optional[ColumnBatch] = None
+    _device_version: int = -1
+
+    @property
+    def num_rows(self) -> int:
+        return self.data.num_rows
+
+    def device_batch(self) -> ColumnBatch:
+        """Device-resident batch, rebuilt only when the region mutates."""
+        if self._device is None or self._device_version != self.version:
+            self._device = ColumnBatch.from_arrow(self.data)
+            self._device_version = self.version
+        return self._device
+
+
+class TableStore:
+    """All regions of one table + DML on the host tier.
+
+    OLTP writes (insert/delete/update) mutate the host Arrow data and bump
+    versions; the device cache refreshes lazily.  This mirrors the reference's
+    hot row store feeding the cold column tier (region_olap.cpp), collapsed to
+    one tier for round 1."""
+
+    def __init__(self, info: TableInfo, region_rows: int = DEFAULT_REGION_ROWS):
+        self.info = info
+        self.region_rows = region_rows
+        self.arrow_schema = schema_to_arrow(info.schema)
+        self._lock = threading.RLock()
+        self._next_region = 1
+        self.regions: list[Region] = [Region(self._alloc_region_id(),
+                                             self.arrow_schema.empty_table())]
+
+    def _alloc_region_id(self) -> int:
+        rid = self._next_region
+        self._next_region += 1
+        return rid
+
+    # -- reads ----------------------------------------------------------
+    @property
+    def num_rows(self) -> int:
+        with self._lock:
+            return sum(r.num_rows for r in self.regions)
+
+    def snapshot(self) -> pa.Table:
+        with self._lock:
+            return pa.concat_tables([r.data for r in self.regions]) \
+                if self.regions else self.arrow_schema.empty_table()
+
+    def device_batches(self) -> list[ColumnBatch]:
+        with self._lock:
+            return [r.device_batch() for r in self.regions if r.num_rows]
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return sum(r.version for r in self.regions) + len(self.regions)
+
+    def device_table_batch(self) -> ColumnBatch:
+        """Whole-table device batch with table-wide string dictionaries.
+
+        Built from the concatenated snapshot so every string column has ONE
+        dictionary (regions sharing dictionaries is what lets per-region
+        partial aggregates merge by code).  Cached until any region mutates."""
+        with self._lock:
+            v = self.version
+            if getattr(self, "_table_device", None) is not None and \
+                    getattr(self, "_table_device_version", -1) == v:
+                return self._table_device
+            self._table_device = ColumnBatch.from_arrow(self.snapshot())
+            self._table_device_version = v
+            return self._table_device
+
+    def column_stats(self, column: str) -> dict:
+        """Host-side column statistics for planner decisions (the analog of
+        the reference's statistics.proto CM-sketch/histogram feed)."""
+        import pyarrow.compute as pc
+
+        with self._lock:
+            v = self.version
+            cache = getattr(self, "_stats_cache", None)
+            if cache is None or cache[0] != v:
+                cache = (v, {})
+                self._stats_cache = cache
+            if column in cache[1]:
+                return cache[1][column]
+            snap = self.snapshot()
+            col = snap.column(column)
+            st: dict = {}
+            f = self.info.schema.field(column)
+            if f.ltype is LType.STRING:
+                batch = self.device_table_batch()
+                d = batch.column(column).dictionary
+                st["dict_size"] = 0 if d is None else len(d)
+            elif snap.num_rows:
+                try:
+                    mm = pc.min_max(col).as_py()
+                    mn, mx = mm["min"], mm["max"]
+                    if hasattr(mn, "toordinal") and not hasattr(mn, "hour"):
+                        import datetime
+                        epoch = datetime.date(1970, 1, 1)
+                        mn = (mn - epoch).days
+                        mx = (mx - epoch).days
+                    if isinstance(mn, (int,)) or f.ltype.is_integer or f.ltype is LType.DATE:
+                        st["min"], st["max"] = mn, mx
+                except Exception:
+                    pass
+            cache[1][column] = st
+            return st
+
+    # -- writes ---------------------------------------------------------
+    def insert_arrow(self, table: pa.Table):
+        """Append rows (column order/type coerced to the table schema)."""
+        table = _coerce(table, self.arrow_schema)
+        with self._lock:
+            last = self.regions[-1]
+            last.data = pa.concat_tables([last.data, table]).combine_chunks()
+            last.version += 1
+            self._maybe_split(last)
+
+    def insert_rows(self, rows: list[dict]):
+        cols = {f.name: [r.get(f.name) for r in rows] for f in self.arrow_schema}
+        self.insert_arrow(pa.table(cols, schema=self.arrow_schema))
+
+    def delete_where(self, host_mask_fn) -> int:
+        """Delete rows where host_mask_fn(pa.Table) -> bool np.ndarray."""
+        deleted = 0
+        with self._lock:
+            for r in self.regions:
+                if not r.num_rows:
+                    continue
+                mask = np.asarray(host_mask_fn(r.data), dtype=bool)
+                if mask.any():
+                    r.data = r.data.filter(pa.array(~mask))
+                    r.version += 1
+                    deleted += int(mask.sum())
+        return deleted
+
+    def update_where(self, host_mask_fn, assign_fn) -> int:
+        """Update rows in place: assign_fn(pa.Table, mask) -> pa.Table."""
+        updated = 0
+        with self._lock:
+            for r in self.regions:
+                if not r.num_rows:
+                    continue
+                mask = np.asarray(host_mask_fn(r.data), dtype=bool)
+                if mask.any():
+                    r.data = _coerce(assign_fn(r.data, mask), self.arrow_schema)
+                    r.version += 1
+                    updated += int(mask.sum())
+        return updated
+
+    def truncate(self):
+        with self._lock:
+            self.regions = [Region(self._alloc_region_id(),
+                                   self.arrow_schema.empty_table())]
+
+    def _maybe_split(self, region: Region):
+        """Row-count split (the reference splits oversized regions,
+        region.cpp:4472; here a plain row-range cut, no raft catch-up)."""
+        while region.num_rows > self.region_rows:
+            keep = region.data.slice(0, self.region_rows)
+            rest = region.data.slice(self.region_rows)
+            region.data = keep.combine_chunks()
+            region.version += 1
+            new = Region(self._alloc_region_id(), rest.combine_chunks())
+            self.regions.append(new)
+            region = new
+
+    # -- persistence ----------------------------------------------------
+    def save_parquet(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        with self._lock:
+            for r in self.regions:
+                pq.write_table(r.data, os.path.join(directory, f"region_{r.region_id}.parquet"))
+
+    def load_parquet(self, directory: str):
+        files = sorted(f for f in os.listdir(directory) if f.endswith(".parquet"))
+        with self._lock:
+            self.regions = []
+            for f in files:
+                t = pq.read_table(os.path.join(directory, f))
+                self.regions.append(Region(self._alloc_region_id(),
+                                           _coerce(t, self.arrow_schema)))
+            if not self.regions:
+                self.regions = [Region(self._alloc_region_id(),
+                                       self.arrow_schema.empty_table())]
+
+
+def _coerce(table: pa.Table, schema: pa.Schema) -> pa.Table:
+    if table.schema == schema:
+        return table
+    cols = []
+    for f in schema:
+        if f.name not in table.column_names:
+            cols.append(pa.nulls(table.num_rows, f.type))
+        else:
+            cols.append(table.column(f.name).cast(f.type))
+    return pa.table(cols, schema=schema)

@@ -1,0 +1,102 @@
+"""Branch-free calendar math, jittable on TPU.
+
+The reference implements datetime builtins row-wise in C++
+(``src/expr/internal_functions.cpp``, e.g. year/month/day/hour) and a second
+time for Arrow (``src/expr/arrow_time_function.cpp``).  Here every datetime
+scalar function is pure integer arithmetic over epoch days (DATE: int32) or
+epoch microseconds (DATETIME: int64), so it vectorizes on the VPU with no
+lookup tables.  Civil-calendar conversion uses Howard Hinnant's public-domain
+algorithms (days_from_civil / civil_from_days).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+US_PER_SEC = 1_000_000
+US_PER_MIN = 60 * US_PER_SEC
+US_PER_HOUR = 60 * US_PER_MIN
+US_PER_DAY = 24 * US_PER_HOUR
+
+
+def _fdiv(a, b):
+    """Floor division (jnp // already floors for ints of same sign mix)."""
+    return jnp.floor_divide(a, b)
+
+
+def civil_from_days(z):
+    """Epoch days -> (year, month, day), vectorized."""
+    z = z.astype(jnp.int32) + 719468
+    era = _fdiv(z, 146097)
+    doe = z - era * 146097                                    # [0, 146096]
+    yoe = _fdiv(doe - _fdiv(doe, 1460) + _fdiv(doe, 36524) - _fdiv(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + _fdiv(yoe, 4) - _fdiv(yoe, 100))  # [0, 365]
+    mp = _fdiv(5 * doy + 2, 153)                               # [0, 11]
+    d = doy - _fdiv(153 * mp + 2, 5) + 1                       # [1, 31]
+    m = mp + jnp.where(mp < 10, 3, -9)                         # [1, 12]
+    y = y + (m <= 2)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y, m, d):
+    """(year, month, day) -> epoch days, vectorized."""
+    y = jnp.asarray(y, jnp.int32) - (jnp.asarray(m, jnp.int32) <= 2)
+    m = jnp.asarray(m, jnp.int32)
+    d = jnp.asarray(d, jnp.int32)
+    era = _fdiv(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = _fdiv(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + _fdiv(yoe, 4) - _fdiv(yoe, 100) + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def dt_days(us):
+    """DATETIME micros -> epoch days (floored, handles pre-1970)."""
+    return jnp.floor_divide(us, US_PER_DAY).astype(jnp.int32)
+
+
+def dt_time_of_day_us(us):
+    return us - jnp.floor_divide(us, US_PER_DAY) * US_PER_DAY
+
+
+def year_of_days(days):
+    return civil_from_days(days)[0]
+
+
+def month_of_days(days):
+    return civil_from_days(days)[1]
+
+
+def day_of_days(days):
+    return civil_from_days(days)[2]
+
+
+def quarter_of_days(days):
+    m = civil_from_days(days)[1]
+    return ((m - 1) // 3 + 1).astype(jnp.int32)
+
+
+def day_of_week(days):
+    """MySQL DAYOFWEEK: 1=Sunday .. 7=Saturday; epoch day 0 was a Thursday."""
+    return (jnp.mod(days.astype(jnp.int32) + 4, 7) + 1).astype(jnp.int32)
+
+
+def weekday(days):
+    """MySQL WEEKDAY: 0=Monday .. 6=Sunday."""
+    return jnp.mod(days.astype(jnp.int32) + 3, 7).astype(jnp.int32)
+
+
+def day_of_year(days):
+    y, _, _ = civil_from_days(days)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return (days.astype(jnp.int32) - jan1 + 1).astype(jnp.int32)
+
+
+def last_day(days):
+    """Epoch days -> epoch days of the last day of that month."""
+    y, m, _ = civil_from_days(days)
+    ny = jnp.where(m == 12, y + 1, y)
+    nm = jnp.where(m == 12, 1, m + 1)
+    return days_from_civil(ny, nm, jnp.ones_like(ny)) - 1

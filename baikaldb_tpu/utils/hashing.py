@@ -1,0 +1,57 @@
+"""Vectorized integer hashing for join/shuffle/group keys.
+
+The reference hashes join keys row-wise via ExprValue::hash (byte-wise
+MurmurHash, include/common/expr_value.h) and partitions MPP exchange batches by
+``hash(key) % partition_num`` (src/exec/exchange_sender_node.cpp).  Here keys
+are already fixed-width lanes, so we use a murmur3-finalizer — a few int ops
+per lane, fully vectorized on the VPU — and combine multiple key columns with
+an xor-mix fold.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _as_u32(x):
+    """Reduce any fixed-width lane to uint32 (canonicalizing -0.0 and widths)."""
+    x = jnp.asarray(x)
+    if x.dtype == jnp.bool_:
+        return x.astype(jnp.uint32)
+    if x.dtype.kind == "f":
+        x = jnp.where(x == 0, jnp.zeros_like(x), x)  # -0.0 == 0.0
+        if x.dtype.itemsize == 8:
+            u = x.view(jnp.uint64)
+            return (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) ^ \
+                   (u >> jnp.uint64(32)).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+        return x.view(jnp.uint32)
+    if x.dtype.itemsize == 8:
+        u = x.view(jnp.uint64) if x.dtype.kind == "u" else x.astype(jnp.int64).view(jnp.uint64)
+        return (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) ^ \
+               (u >> jnp.uint64(32)).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+    return x.astype(jnp.uint32)
+
+
+def mix32(x):
+    """murmur3 fmix32: bijective avalanche on uint32 lanes."""
+    x = jnp.asarray(x, jnp.uint32)
+    x ^= x >> 16
+    x = x * jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x = x * jnp.uint32(0xC2B2AE35)
+    x ^= x >> 16
+    return x
+
+
+def hash_columns(arrays, seed: int = 0x12345678):
+    """Combine N key arrays -> uint32 hash per row."""
+    h = jnp.broadcast_to(jnp.uint32(seed & 0xFFFFFFFF), jnp.shape(arrays[0]))
+    for a in arrays:
+        h = mix32(h ^ mix32(_as_u32(a)))
+    return h
+
+
+def partition_ids(arrays, num_partitions: int):
+    """Row -> partition id in [0, num_partitions), for MPP-style shuffle."""
+    h = hash_columns(arrays)
+    return (h % jnp.uint32(num_partitions)).astype(jnp.int32)

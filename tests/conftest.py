@@ -1,0 +1,15 @@
+"""Test harness: force an 8-virtual-device CPU mesh so multi-chip sharding
+paths are exercised without TPU hardware (SURVEY.md §4 implication: simulated
+N-device mesh via JAX's multi-device CPU backend).
+
+Hard-override JAX_PLATFORMS: this environment pins it to the axon TPU tunnel,
+and unit tests must never compete for the single real chip (a stray SIGKILL
+mid-op can wedge the tunnel for every process).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

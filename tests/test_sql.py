@@ -1,0 +1,220 @@
+"""End-to-end SQL tests (reference: test/fun/*.sql ordered functional scripts
++ test_sqlparser*.cpp).  Each test drives Session.execute the way a MySQL
+client would drive the reference's frontend."""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Session
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session()
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, g VARCHAR(16), v DOUBLE, d DATE)")
+    s.execute("INSERT INTO t (id, g, v, d) VALUES "
+              "(1,'a',10.0,'2024-01-01'),(2,'b',20.0,'2024-01-02'),"
+              "(3,'a',30.0,'2024-02-01'),(4,NULL,40.0,'2024-03-05'),"
+              "(5,'b',NULL,'2024-01-01')")
+    s.execute("CREATE TABLE r (g VARCHAR(16), label VARCHAR(32))")
+    s.execute("INSERT INTO r VALUES ('a','alpha'),('b','beta')")
+    return s
+
+
+def test_count_star(sess):
+    assert sess.execute("SELECT COUNT(*) FROM t").scalar() == 5
+
+
+def test_projection_filter(sess):
+    assert sess.query("SELECT id, v*2 AS dv FROM t WHERE v > 15 AND g = 'a'") == \
+        [{"id": 3, "dv": 60.0}]
+
+
+def test_group_by_with_nulls(sess):
+    rows = sess.query("SELECT g, SUM(v) AS s, COUNT(*) n FROM t GROUP BY g ORDER BY s DESC, g")
+    # NULL sorts first under ASC tie-break on g
+    assert rows == [{"g": None, "s": 40.0, "n": 1},
+                    {"g": "a", "s": 40.0, "n": 2},
+                    {"g": "b", "s": 20.0, "n": 2}]
+
+
+def test_group_by_expression(sess):
+    rows = sess.query("SELECT MONTH(d) m, COUNT(*) c FROM t GROUP BY m ORDER BY m")
+    assert rows == [{"m": 1, "c": 3}, {"m": 2, "c": 1}, {"m": 3, "c": 1}]
+
+
+def test_inner_and_left_join(sess):
+    rows = sess.query("SELECT t.id, r.label FROM t JOIN r ON t.g = r.g ORDER BY t.id")
+    assert [r["label"] for r in rows] == ["alpha", "beta", "alpha", "beta"]
+    rows = sess.query("SELECT t.id, r.label FROM t LEFT JOIN r ON t.g = r.g ORDER BY t.id")
+    assert [r["label"] for r in rows] == ["alpha", "beta", "alpha", None, "beta"]
+
+
+def test_having_alias(sess):
+    assert sess.query("SELECT g, COUNT(*) c FROM t GROUP BY g HAVING c >= 2 "
+                      "ORDER BY g") == \
+        [{"g": "a", "c": 2}, {"g": "b", "c": 2}]
+
+
+def test_order_limit_offset(sess):
+    assert [r["id"] for r in sess.query("SELECT id FROM t ORDER BY id DESC LIMIT 2")] == [5, 4]
+    assert [r["id"] for r in sess.query("SELECT id FROM t ORDER BY id LIMIT 2 OFFSET 1")] == [2, 3]
+    assert [r["id"] for r in sess.query("SELECT id FROM t ORDER BY id LIMIT 1, 2")] == [2, 3]
+
+
+def test_union(sess):
+    rows = sess.query("SELECT id FROM t WHERE id = 1 UNION ALL "
+                      "SELECT id FROM t WHERE id > 3 ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 4, 5]
+    rows = sess.query("SELECT g FROM t WHERE g IS NOT NULL UNION SELECT g FROM r ORDER BY g")
+    assert [r["g"] for r in rows] == ["a", "b"]
+
+
+def test_derived_table(sess):
+    rows = sess.query("SELECT g, s FROM (SELECT g, SUM(v) s FROM t GROUP BY g) x "
+                      "WHERE s > 25 ORDER BY s, g")
+    assert sorted([r["g"] for r in rows], key=lambda x: (x is not None, x)) == [None, "a"]
+    assert all(r["s"] > 25 for r in rows)
+
+
+def test_select_no_from(sess):
+    assert sess.query("SELECT 1+2 AS x, 'a' IS NULL AS y") == [{"x": 3, "y": False}]
+
+
+def test_distinct(sess):
+    rows = sess.query("SELECT DISTINCT g FROM t ORDER BY g")
+    assert [r["g"] for r in rows] == [None, "a", "b"]
+
+
+def test_scalar_funcs_in_sql(sess):
+    rows = sess.query("SELECT UPPER(g) u FROM t WHERE id = 1")
+    assert rows == [{"u": "A"}]
+    rows = sess.query("SELECT id FROM t WHERE g LIKE 'a%' ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 3]
+    rows = sess.query("SELECT CASE WHEN v > 25 THEN 'hi' ELSE 'lo' END c, COUNT(*) n "
+                      "FROM t WHERE v IS NOT NULL GROUP BY c ORDER BY c")
+    assert rows == [{"c": "hi", "n": 2}, {"c": "lo", "n": 2}]
+
+
+def test_agg_distinct_sql(sess):
+    assert sess.execute("SELECT COUNT(DISTINCT g) FROM t").scalar() == 2
+
+
+def test_min_max_avg(sess):
+    row = sess.query("SELECT MIN(v) mn, MAX(v) mx, AVG(v) a FROM t")[0]
+    assert row["mn"] == 10.0 and row["mx"] == 40.0 and abs(row["a"] - 25.0) < 1e-9
+
+
+def test_semi_anti_join_sql(sess):
+    rows = sess.query("SELECT id FROM t LEFT SEMI JOIN r ON t.g = r.g ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 2, 3, 5]
+    rows = sess.query("SELECT id FROM t LEFT ANTI JOIN r ON t.g = r.g ORDER BY id")
+    assert [r["id"] for r in rows] == [4]
+
+
+def test_explain(sess):
+    txt = sess.execute("EXPLAIN SELECT g, SUM(v) FROM t WHERE v > 0 GROUP BY g").plan_text
+    assert "Scan" in txt and "Agg" in txt and "filter=" in txt
+
+
+def test_show_and_describe(sess):
+    names = [r[0] for r in sess.execute("SHOW TABLES").rows]
+    assert "t" in names and "r" in names
+    fields = [r[0] for r in sess.execute("DESCRIBE t").rows]
+    assert fields == ["id", "g", "v", "d"]
+
+
+def test_dml_roundtrip():
+    s = Session()
+    s.execute("CREATE TABLE w (id BIGINT, x DOUBLE)")
+    s.execute("INSERT INTO w VALUES (1, 1.5), (2, 2.5), (3, 3.5)")
+    assert s.execute("UPDATE w SET x = x * 2 WHERE id >= 2").affected_rows == 2
+    assert s.query("SELECT x FROM w ORDER BY id") == \
+        [{"x": 1.5}, {"x": 5.0}, {"x": 7.0}]
+    assert s.execute("DELETE FROM w WHERE x > 6").affected_rows == 1
+    assert s.execute("SELECT COUNT(*) FROM w").scalar() == 2
+    s.execute("TRUNCATE TABLE w")
+    assert s.execute("SELECT COUNT(*) FROM w").scalar() == 0
+
+
+def test_insert_select():
+    s = Session()
+    s.execute("CREATE TABLE src (a BIGINT)")
+    s.execute("INSERT INTO src VALUES (1),(2),(3)")
+    s.execute("CREATE TABLE dst (a BIGINT)")
+    r = s.execute("INSERT INTO dst SELECT a FROM src WHERE a > 1")
+    assert r.affected_rows == 2
+    assert s.execute("SELECT COUNT(*) FROM dst").scalar() == 2
+
+
+def test_plan_cache():
+    s = Session()
+    s.execute("CREATE TABLE pc (a BIGINT)")
+    s.execute("INSERT INTO pc VALUES (1),(2)")
+    q = "SELECT COUNT(*) FROM pc"
+    assert s.execute(q).scalar() == 2
+    key = (q, "default")
+    assert key in s._plan_cache
+    compiled_before = dict(s._plan_cache[key]["compiled"])
+    assert s.execute(q).scalar() == 2          # same shapes: cache hit
+    assert s._plan_cache[key]["compiled"].keys() == compiled_before.keys()
+    s.execute("INSERT INTO pc VALUES (3)")     # shape changes: new entry
+    assert s.execute(q).scalar() == 3
+
+
+def test_errors():
+    s = Session()
+    s.execute("CREATE TABLE e (a BIGINT)")
+    with pytest.raises(Exception):
+        s.execute("SELECT nope FROM e")
+    with pytest.raises(Exception):
+        s.execute("SELECT a FROM missing_table")
+    with pytest.raises(Exception):
+        s.execute("SELECT a, COUNT(*) FROM e")  # a not in GROUP BY
+
+
+def test_union_order_limit_applies_to_whole():
+    """Regression: ORDER BY/LIMIT after UNION bind to the union result, not
+    the last arm (caught in round-1 code review)."""
+    s = Session()
+    s.execute("CREATE TABLE ua (x BIGINT)")
+    s.execute("CREATE TABLE ub (x BIGINT)")
+    s.execute("INSERT INTO ua VALUES (5),(1)")
+    s.execute("INSERT INTO ub VALUES (4),(2)")
+    rows = s.query("SELECT x FROM ua UNION ALL SELECT x FROM ub ORDER BY x LIMIT 3")
+    assert [r["x"] for r in rows] == [1, 2, 4]
+
+
+def test_multikey_join_int64_residual():
+    """Wide (int64) multi-key joins go through residual equality, exactly."""
+    s = Session()
+    s.execute("CREATE TABLE ja (a BIGINT, b BIGINT, pv BIGINT)")
+    s.execute("CREATE TABLE jb (a BIGINT, b BIGINT, bv BIGINT)")
+    big = 2**32
+    s.execute(f"INSERT INTO ja VALUES (1,{big + 1},10),(1,1,20)")
+    s.execute(f"INSERT INTO jb VALUES (1,1,100),(1,{big + 1},200)")
+    rows = s.query("SELECT pv, bv FROM ja JOIN jb ON ja.a = jb.a AND ja.b = jb.b "
+                   "ORDER BY pv")
+    assert rows == [{"pv": 10, "bv": 200}, {"pv": 20, "bv": 100}]
+
+
+def test_select_string_literal():
+    s = Session()
+    s.execute("CREATE TABLE sl (x BIGINT)")
+    s.execute("INSERT INTO sl VALUES (1),(2)")
+    assert s.query("SELECT 'tag' t, x FROM sl ORDER BY x") == \
+        [{"t": "tag", "x": 1}, {"t": "tag", "x": 2}]
+    assert s.query("SELECT 'hello' h") == [{"h": "hello"}]
+
+
+def test_plan_cache_invalidation_dense_domain():
+    """Regression: cached dense group-by domains must refresh when new key
+    values appear (caught in round-1 code review)."""
+    s = Session()
+    s.execute("CREATE TABLE pcd (k INT, v BIGINT)")
+    s.execute("INSERT INTO pcd VALUES (1,10),(2,20)")
+    q = "SELECT k, SUM(v) s FROM pcd GROUP BY k ORDER BY k"
+    assert [r["k"] for r in s.query(q)] == [1, 2]
+    s.execute("INSERT INTO pcd VALUES (99,30)")   # outside old min..max span
+    rows = s.query(q)
+    assert [r["k"] for r in rows] == [1, 2, 99]
+    assert rows[-1]["s"] == 30
