@@ -276,7 +276,8 @@ def _segment_distinct(c: Column, gid, ng: int, s: AggSpec) -> Column:
 
 
 def group_aggregate_sorted(batch: ColumnBatch, key_names: list[str],
-                           specs: list[AggSpec], max_groups: int) -> ColumnBatch:
+                           specs: list[AggSpec], max_groups: int,
+                           with_overflow: bool = False):
     """General GROUP BY: lexicographic stable sort, boundary cumsum group ids,
     segment reductions into a static max_groups-slot table.
 
@@ -336,7 +337,10 @@ def group_aggregate_sorted(batch: ColumnBatch, key_names: list[str],
         out_names.append(s.out_name)
         out_cols.append(_segment_one(sorted_batch, s, gid, max_groups, sel_s))
     present = jnp.arange(max_groups) < ngroups
-    return ColumnBatch(tuple(out_names), out_cols, present, ngroups)
+    out = ColumnBatch(tuple(out_names), out_cols, present, ngroups)
+    if with_overflow:
+        return out, jnp.sum(flags) > max_groups
+    return out
 
 
 # ----------------------------------------------------------------------

@@ -1,0 +1,112 @@
+"""Distributed aggregation: per-shard partials + mesh collectives.
+
+The reference pushes partial AggNodes to every region and merges on the
+coordinator (MERGE_AGG_NODE, plan.proto:14-16; src/exec/agg_node.cpp), moving
+partial states over brpc.  Here each mesh shard computes the SAME fixed-size
+partial table (dense group domain), and the merge is a single XLA collective
+over ICI: psum for sum/count partials, pmin/pmax for min/max — the
+BASELINE.json north-star config #2 ("per-region partial agg + psum").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..column.batch import Column, ColumnBatch
+from ..ops.hashagg import (AggSpec, MERGE_OP, finalize_partials,
+                           group_aggregate_dense, partial_specs)
+from .mesh import AXIS, shard_map
+
+
+def _merge_collective(op: str, x, axis_name: str):
+    if op == "sum":
+        return jax.lax.psum(x, axis_name)
+    if op == "min":
+        return jax.lax.pmin(x, axis_name)
+    if op == "max":
+        return jax.lax.pmax(x, axis_name)
+    raise ValueError(f"no collective merge for {op}")
+
+
+def dist_group_aggregate_dense(batch: ColumnBatch, key_names: list[str],
+                               domains: list[int], specs: list[AggSpec],
+                               mesh) -> ColumnBatch:
+    """GROUP BY over a row-sharded batch; dense key domains.
+
+    Inside shard_map every device reduces its local rows into the
+    [prod(domains+1)] partial table, then the tables merge in-network
+    (psum/pmin/pmax over ICI).  Output is replicated (small)."""
+    parts, fin = partial_specs(specs)
+    for s in parts:
+        if s.distinct:
+            raise ValueError("DISTINCT aggregates need a shuffle "
+                             "(use dist_group_aggregate_shuffled)")
+
+    in_specs = jax.tree.map(lambda _: P(AXIS), batch)
+
+    def local(b: ColumnBatch) -> ColumnBatch:
+        part = group_aggregate_dense(b, key_names, domains, parts)
+        cols = []
+        for name, c in zip(part.names, part.columns):
+            if name in key_names:
+                cols.append(c)
+                continue
+            spec = next(s for s in parts if s.out_name == name)
+            merged = _merge_collective(MERGE_OP[spec.op], c.data, AXIS)
+            validity = c.validity
+            if validity is not None:
+                validity = jax.lax.psum(validity.astype(jnp.int32), AXIS) > 0
+            cols.append(Column(merged, validity, c.ltype, c.dictionary))
+        present = jax.lax.psum(part.sel_mask().astype(jnp.int32), AXIS) > 0
+        return ColumnBatch(part.names, cols, present, None)
+
+    out_specs = jax.tree.map(lambda _: P(), _shape_probe(batch, key_names,
+                                                         domains, parts))
+    fn = shard_map(local, mesh=mesh, in_specs=(in_specs,),
+                   out_specs=out_specs, check_vma=False)
+    merged = fn(batch)
+    return finalize_partials(merged, fin, key_names)
+
+
+def _shape_probe(batch, key_names, domains, parts):
+    """Eval-shape the local fn output to build a matching out_specs pytree."""
+    import jax
+
+    def probe(b):
+        return group_aggregate_dense(b, key_names, domains, parts)
+
+    out = jax.eval_shape(probe, batch)
+    return out
+
+
+def dist_scalar_aggregate(batch: ColumnBatch, specs: list[AggSpec],
+                          mesh) -> ColumnBatch:
+    """Global aggregates (no GROUP BY) over a row-sharded batch."""
+    from ..ops.hashagg import scalar_aggregate
+
+    parts, fin = partial_specs(specs)
+    for s in parts:
+        if s.distinct:
+            raise ValueError("DISTINCT scalar aggregates need a gather")
+    in_specs = jax.tree.map(lambda _: P(AXIS), batch)
+
+    def local(b: ColumnBatch) -> ColumnBatch:
+        part = scalar_aggregate(b, parts)
+        cols = []
+        for name, c in zip(part.names, part.columns):
+            spec = next(s for s in parts if s.out_name == name)
+            merged = _merge_collective(MERGE_OP[spec.op], c.data, AXIS)
+            validity = c.validity
+            if validity is not None:
+                validity = jax.lax.psum(validity.astype(jnp.int32), AXIS) > 0
+            cols.append(Column(merged, validity, c.ltype, c.dictionary))
+        return ColumnBatch(part.names, cols, None, None)
+
+    out_probe = jax.eval_shape(lambda b: scalar_aggregate(b, parts), batch)
+    out_specs = jax.tree.map(lambda _: P(), out_probe)
+    fn = shard_map(local, mesh=mesh, in_specs=(in_specs,),
+                   out_specs=out_specs, check_vma=False)
+    merged = fn(batch)
+    return finalize_partials(merged, fin, [])

@@ -1,0 +1,71 @@
+"""Device mesh + row-axis sharding of column batches.
+
+The reference scales by hash/range-partitioning rows into Regions across
+store nodes and scatter-gathering per-region plans over brpc
+(SURVEY.md §2.14).  The TPU-native analog: one `jax.sharding.Mesh` whose
+"shard" axis plays the role of the store fleet; tables shard on the row axis
+with `NamedSharding`, and per-shard kernels + XLA collectives (psum /
+all_to_all over ICI) replace the RPC fan-out + coordinator merge.
+
+Padding discipline: every shard must hold the same row count (SPMD), so
+sharded batches are padded up to a multiple of the mesh size with dead rows
+(sel=False) — the moral equivalent of the reference's uneven region sizes,
+handled by masks instead of variable-length RPC payloads.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax>=0.6 promotes shard_map out of experimental
+    shard_map = jax.shard_map  # type: ignore[attr-defined]
+except (ImportError, AttributeError):  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ..column.batch import Column, ColumnBatch
+
+AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.asarray(devs[:n]), (AXIS,))
+
+
+def pad_rows(batch: ColumnBatch, multiple: int) -> ColumnBatch:
+    """Pad to a row-count multiple with dead rows (sel=False)."""
+    n = len(batch)
+    target = max(multiple, math.ceil(n / multiple) * multiple)
+    if target == n:
+        return batch if batch.sel is not None else batch.with_sel(
+            jnp.ones(n, dtype=bool))
+    pad = target - n
+    cols = []
+    for c in batch.columns:
+        data = jnp.concatenate([c.data, jnp.zeros((pad,), c.data.dtype)])
+        validity = None
+        if c.validity is not None:
+            validity = jnp.concatenate([c.validity, jnp.zeros((pad,), bool)])
+        cols.append(Column(data, validity, c.ltype, c.dictionary))
+    sel = jnp.concatenate([batch.sel_mask(), jnp.zeros((pad,), bool)])
+    return ColumnBatch(batch.names, cols, sel, None)
+
+
+def shard_batch(batch: ColumnBatch, mesh: Mesh) -> ColumnBatch:
+    """Row-shard a batch across the mesh (device_put with NamedSharding)."""
+    n = mesh.devices.size
+    b = pad_rows(batch, n)
+    sharding = NamedSharding(mesh, P(AXIS))
+    cols = [Column(jax.device_put(c.data, sharding),
+                   None if c.validity is None else jax.device_put(c.validity, sharding),
+                   c.ltype, c.dictionary) for c in b.columns]
+    sel = jax.device_put(b.sel_mask(), sharding)
+    return ColumnBatch(b.names, cols, sel, None)

@@ -1,0 +1,194 @@
+"""MPP shuffle as in-program collectives: hash repartition + distributed join.
+
+The reference's MPP plane shuffles Arrow RecordBatches between worker dbs over
+brpc (`ExchangeSenderNode` hash-partitions batches into per-channel
+`transmit_data` RPCs, src/exec/exchange_sender_node.cpp; receivers queue them
+in DataStreamManager).  On a TPU mesh the entire exchange is ONE
+`lax.all_to_all` over ICI inside the jitted program:
+
+  1. each shard computes dest = hash(key) % n for its rows,
+  2. sorts rows by dest and scatters them into an [n, cap] padded send
+     buffer (cap = per-destination capacity, static),
+  3. all_to_all swaps the leading axis, giving every shard the [n, cap] rows
+     hashed to it,
+  4. rows flatten back into a local batch with a validity sel mask.
+
+Per-destination overflow (a skewed key exceeding cap) sets a flag the caller
+retries on with a larger cap — the analog of exchange backpressure.
+After repartition, keys are disjoint across shards, so joins and group-bys
+complete locally with no further communication (the reference's reason for
+hash repartition, mpp_analyzer.cpp).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dreplace
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..column.batch import Column, ColumnBatch
+from ..ops import join as join_ops
+from ..ops.hashagg import AggSpec, group_aggregate_sorted
+from ..utils.hashing import partition_ids
+from .mesh import AXIS, shard_map
+
+
+def _local_repartition(b: ColumnBatch, key_names: list[str], n: int, cap: int):
+    """Shard-local: -> ([n, cap]-shaped batch pytree, valid [n, cap], overflow)."""
+    # canonicalize NULL key lanes to 0 before hashing so every NULL-key row
+    # routes to the same shard (the local sort path canonicalizes the same
+    # way; validity still separates NULL from key 0 in the local group-by)
+    keys = []
+    for k in key_names:
+        c = b.column(k)
+        d = c.data
+        if c.validity is not None:
+            d = jnp.where(c.validity, d, jnp.zeros((), d.dtype))
+        keys.append(d)
+    dest = partition_ids(keys, n)
+    sel = b.sel_mask()
+    dest = jnp.where(sel, dest, n)                    # dead rows -> bucket n
+    order = jnp.argsort(dest, stable=True)
+    dest_s = dest[order]
+    # rank within destination bucket
+    idx = jnp.arange(dest_s.shape[0])
+    start = jnp.searchsorted(dest_s, jnp.arange(n + 1))
+    rank = idx - start[jnp.clip(dest_s, 0, n)]
+    counts = start[1:] - start[:-1]                   # per-dest counts [n]
+    overflow = jnp.any(counts > cap)
+    # scatter into [n, cap] send buffer (dest-major)
+    slot = jnp.where((dest_s < n) & (rank < cap), dest_s * cap + rank, n * cap)
+    valid = jnp.zeros((n * cap + 1,), bool).at[slot].set(True)[:n * cap]
+
+    def scatter_col(data):
+        buf = jnp.zeros((n * cap + 1,), data.dtype).at[slot].set(data[order])
+        return buf[:n * cap].reshape(n, cap)
+
+    cols = []
+    for c in b.columns:
+        data = scatter_col(c.data)
+        validity = None if c.validity is None else scatter_col(c.validity)
+        cols.append(Column(data, validity, c.ltype, c.dictionary))
+    return cols, valid.reshape(n, cap), overflow
+
+
+def _all_to_all(x):
+    return jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True)
+
+
+def repartition_fn(names, key_names: list[str], n: int, cap: int):
+    """Build the shard-local repartition function (for use inside shard_map)."""
+
+    def fn(b: ColumnBatch):
+        cols, valid, overflow = _local_repartition(b, key_names, n, cap)
+        out_cols = []
+        for c in cols:
+            data = _all_to_all(c.data).reshape(n * cap)
+            validity = None if c.validity is None else \
+                _all_to_all(c.validity).reshape(n * cap)
+            out_cols.append(Column(data, validity, c.ltype, c.dictionary))
+        sel = _all_to_all(valid).reshape(n * cap)
+        any_overflow = jax.lax.psum(overflow.astype(jnp.int32), AXIS) > 0
+        return ColumnBatch(names, out_cols, sel, None), any_overflow
+
+    return fn
+
+
+def dist_hash_repartition(batch: ColumnBatch, key_names: list[str], mesh,
+                          cap: int | None = None):
+    """Repartition a row-sharded batch so equal keys land on one shard.
+
+    Returns (sharded batch [rows = n*cap per shard], overflow flag)."""
+    n = mesh.devices.size
+    per_shard = len(batch) // n
+    if cap is None:
+        cap = max(1, 2 * per_shard // n)
+    in_specs = jax.tree.map(lambda _: P(AXIS), batch)
+    local = repartition_fn(batch.names, key_names, n, cap)
+
+    # output pytree structure == input batch structure (cols+sel), so reuse it
+    # as the out_specs template (eval_shape can't trace the collectives)
+    out_specs = (jax.tree.map(lambda _: P(AXIS), batch), P())
+    fn = shard_map(local, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs,
+                   check_vma=False)
+    return fn(batch)
+
+
+def _local_view(batch: ColumnBatch, n: int) -> ColumnBatch:
+    """Shape-only view of one shard's slice (for eval_shape)."""
+    import numpy as np
+
+    def slc(x):
+        return jax.ShapeDtypeStruct((x.shape[0] // n,) + x.shape[1:], x.dtype)
+
+    return jax.tree.map(slc, batch)
+
+
+def dist_join(probe: ColumnBatch, probe_keys: list[str],
+              build: ColumnBatch, build_keys: list[str], mesh,
+              how: str = "inner", cap: int | None = None,
+              shuffle_cap: int | None = None):
+    """Distributed equi-join: all_to_all both sides on the key hash, then one
+    local sort-join per shard (BASELINE config #3: 'all-to-all shuffle on the
+    join key')."""
+    n = mesh.devices.size
+    pshard, ovf_p = dist_hash_repartition(probe, probe_keys, mesh, shuffle_cap)
+    bshard, ovf_b = dist_hash_repartition(build, build_keys, mesh, shuffle_cap)
+
+    local_cap = cap or len(pshard) // n
+    in_p = jax.tree.map(lambda _: P(AXIS), pshard)
+    in_b = jax.tree.map(lambda _: P(AXIS), bshard)
+
+    def local(pb: ColumnBatch, bb: ColumnBatch):
+        out, ovf = join_ops.join(pb, probe_keys, bb, build_keys, how=how,
+                                 cap=local_cap)
+        any_ovf = jax.lax.psum(ovf.astype(jnp.int32), AXIS) > 0
+        return out, any_ovf
+
+    probe_local = _local_view(pshard, n)
+    build_local = _local_view(bshard, n)
+    # probe shapes via the collective-free join kernel only
+    out_probe = jax.eval_shape(
+        lambda a, b: join_ops.join(a, probe_keys, b, build_keys, how=how,
+                                   cap=local_cap)[0],
+        probe_local, build_local)
+    out_specs = (jax.tree.map(lambda _: P(AXIS), out_probe), P())
+    fn = shard_map(local, mesh=mesh, in_specs=(in_p, in_b),
+                   out_specs=out_specs, check_vma=False)
+    out, ovf_j = fn(pshard, bshard)
+    return out, (ovf_p, ovf_b, ovf_j)
+
+
+def dist_group_aggregate_shuffled(batch: ColumnBatch, key_names: list[str],
+                                  specs: list[AggSpec], mesh,
+                                  max_groups_per_shard: int,
+                                  shuffle_cap: int | None = None):
+    """High-cardinality GROUP BY: repartition rows by key hash, then one local
+    sort-based group-by per shard (keys disjoint across shards — the MPP
+    hash-agg plan the reference picks for big group counts)."""
+    n = mesh.devices.size
+    shard, ovf = dist_hash_repartition(batch, key_names, mesh, shuffle_cap)
+    in_specs = jax.tree.map(lambda _: P(AXIS), shard)
+
+    def local(b: ColumnBatch):
+        out, g_ovf = group_aggregate_sorted(b, key_names, specs,
+                                            max_groups_per_shard,
+                                            with_overflow=True)
+        any_ovf = jax.lax.psum(g_ovf.astype(jnp.int32), AXIS) > 0
+        # num_rows is a per-shard scalar: drop it (sel carries liveness) so
+        # every output leaf shards over AXIS uniformly
+        return ColumnBatch(out.names, out.columns, out.sel, None), any_ovf
+
+    # probe shapes via the collective-free kernel only
+    probe = jax.eval_shape(
+        lambda b: group_aggregate_sorted(b, key_names, specs,
+                                         max_groups_per_shard),
+        _local_view(shard, n))
+    probe = ColumnBatch(probe.names, probe.columns, probe.sel, None)
+    out_specs = (jax.tree.map(lambda _: P(AXIS), probe), P())
+    fn = shard_map(local, mesh=mesh, in_specs=(in_specs,), out_specs=out_specs,
+                   check_vma=False)
+    out, group_ovf = fn(shard)
+    return out, (ovf, group_ovf)

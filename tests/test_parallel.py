@@ -1,0 +1,135 @@
+"""Distributed-execution tests on the 8-virtual-device CPU mesh — the analog
+of the reference's in-process fake-topology tests (test_fetcher_store.cpp
+builds 12 fake instances; test_exchange.cpp drives the shuffle in one
+process, SURVEY.md §4)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+
+from baikaldb_tpu import ColumnBatch
+from baikaldb_tpu.ops.hashagg import AggSpec
+from baikaldb_tpu.parallel.mesh import make_mesh, shard_batch
+from baikaldb_tpu.parallel.agg import (dist_group_aggregate_dense,
+                                       dist_scalar_aggregate)
+from baikaldb_tpu.parallel.shuffle import (dist_group_aggregate_shuffled,
+                                           dist_hash_repartition, dist_join)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(8)
+
+
+def test_dist_scalar_agg(mesh):
+    rng = np.random.default_rng(0)
+    v = rng.normal(size=1000)
+    b = shard_batch(ColumnBatch.from_arrow(pa.table({"v": v})), mesh)
+    out = dist_scalar_aggregate(b, [AggSpec("sum", "v", "s"),
+                                    AggSpec("count_star", None, "n"),
+                                    AggSpec("avg", "v", "a"),
+                                    AggSpec("min", "v", "mn"),
+                                    AggSpec("max", "v", "mx")], mesh)
+    row = out.to_arrow().to_pylist()[0]
+    assert row["n"] == 1000
+    assert abs(row["s"] - v.sum()) < 1e-6
+    assert abs(row["a"] - v.mean()) < 1e-9
+    assert row["mn"] == pytest.approx(v.min()) and row["mx"] == pytest.approx(v.max())
+
+
+def test_dist_dense_groupby_matches_local(mesh):
+    rng = np.random.default_rng(1)
+    g = rng.integers(0, 5, 977)   # deliberately not divisible by 8
+    v = rng.normal(size=977)
+    b = shard_batch(ColumnBatch.from_arrow(pa.table({"g": g, "v": v})), mesh)
+    out = dist_group_aggregate_dense(b, ["g"], [5],
+                                     [AggSpec("sum", "v", "s"),
+                                      AggSpec("count_star", None, "n"),
+                                      AggSpec("avg", "v", "a"),
+                                      AggSpec("min", "v", "mn")], mesh)
+    rows = {r["g"]: r for r in out.to_arrow().to_pylist()}
+    for gi in range(5):
+        vs = v[g == gi]
+        assert rows[gi]["n"] == len(vs)
+        assert abs(rows[gi]["s"] - vs.sum()) < 1e-6
+        assert abs(rows[gi]["a"] - vs.mean()) < 1e-9
+        assert rows[gi]["mn"] == pytest.approx(vs.min())
+
+
+def test_dist_repartition_places_equal_keys_together(mesh):
+    rng = np.random.default_rng(2)
+    k = rng.integers(0, 100, 800)
+    b = shard_batch(ColumnBatch.from_arrow(pa.table({"k": k})), mesh)
+    out, ovf = dist_hash_repartition(b, ["k"], mesh, cap=64)
+    assert not bool(ovf)
+    # all rows survive, each key on exactly one shard
+    arr = np.asarray(out.column("k").data)
+    sel = np.asarray(out.sel)
+    n_shards = 8
+    per_shard = arr.shape[0] // n_shards
+    keys_by_shard = []
+    for i in range(n_shards):
+        sl = slice(i * per_shard, (i + 1) * per_shard)
+        keys_by_shard.append(set(arr[sl][sel[sl]].tolist()))
+    assert sum(len(s & t) for i, s in enumerate(keys_by_shard)
+               for t in keys_by_shard[i + 1:]) == 0
+    assert sorted(np.concatenate([arr[i * per_shard:(i + 1) * per_shard]
+                                  [sel[i * per_shard:(i + 1) * per_shard]]
+                                  for i in range(n_shards)]).tolist()) == \
+        sorted(k.tolist())
+
+
+def test_dist_join_matches_local(mesh):
+    rng = np.random.default_rng(3)
+    pk = rng.integers(0, 50, 400)
+    pv = rng.integers(0, 1000, 400)
+    bk = np.arange(50)
+    bv = bk * 10
+    probe = shard_batch(ColumnBatch.from_arrow(pa.table({"k": pk, "pv": pv})), mesh)
+    build = shard_batch(ColumnBatch.from_arrow(pa.table({"k": bk, "bv": bv})), mesh)
+    out, (o1, o2, o3) = dist_join(probe, ["k"], build, ["k"], mesh,
+                                  shuffle_cap=256)
+    assert not (bool(o1) or bool(o2) or bool(o3))
+    rows = out.to_arrow().to_pylist()
+    got = sorted((r["k"], r["pv"], r["bv"]) for r in rows)
+    want = sorted((int(k), int(v), int(k) * 10) for k, v in zip(pk, pv))
+    assert got == want
+
+
+def test_dist_groupby_shuffled_high_cardinality(mesh):
+    rng = np.random.default_rng(4)
+    g = rng.integers(0, 300, 2000)
+    v = rng.normal(size=2000)
+    b = shard_batch(ColumnBatch.from_arrow(pa.table({"g": g, "v": v})), mesh)
+    out, flags = dist_group_aggregate_shuffled(
+        b, ["g"], [AggSpec("sum", "v", "s"), AggSpec("count_star", None, "n")],
+        mesh, max_groups_per_shard=300, shuffle_cap=256)
+    assert not any(bool(f) for f in flags)
+    rows = {r["g"]: r for r in out.to_arrow().to_pylist()}
+    assert len(rows) == len(np.unique(g))
+    for gi in np.unique(g):
+        vs = v[g == gi]
+        assert rows[int(gi)]["n"] == len(vs)
+        assert abs(rows[int(gi)]["s"] - vs.sum()) < 1e-6
+
+
+def test_shuffled_groupby_overflow_flag(mesh):
+    """max_groups_per_shard too small must raise the group-overflow flag
+    instead of silently dropping groups (caught in round-1 code review)."""
+    g = np.arange(512)
+    b = shard_batch(ColumnBatch.from_arrow(pa.table({"g": g, "v": g * 1.0})), mesh)
+    out, (shuffle_ovf, group_ovf) = dist_group_aggregate_shuffled(
+        b, ["g"], [AggSpec("count_star", None, "n")], mesh,
+        max_groups_per_shard=8, shuffle_cap=512)
+    assert bool(group_ovf)
+
+
+def test_repartition_overflow_flag(mesh):
+    # all rows share one key -> one destination bucket must overflow tiny cap
+    k = np.zeros(800, dtype=np.int64)
+    b = shard_batch(ColumnBatch.from_arrow(pa.table({"k": k})), mesh)
+    out, ovf = dist_hash_repartition(b, ["k"], mesh, cap=4)
+    assert bool(ovf)
