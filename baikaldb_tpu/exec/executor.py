@@ -32,7 +32,7 @@ from ..ops.hashagg import (AggSpec, group_aggregate_dense,
 from ..ops.sort import SortKey, sort_batch, top_k
 from ..plan.nodes import (AggNode, DistinctNode, FilterNode, JoinNode,
                           LimitNode, PlanNode, ProjectNode, ScanNode, SortNode,
-                          UnionNode, ValuesNode)
+                          UnionNode, ValuesNode, WindowNode)
 from ..column.batch import concat_batches
 from ..types import LType
 
@@ -155,6 +155,13 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         parts = [_harmonize(p, node.schema) for p in parts]
         parts = _align_string_dicts(parts)
         return concat_batches(parts)
+
+    if isinstance(node, WindowNode):
+        from ..ops.window import window_compute
+
+        child = _eval(node.child(), batches, overflows)
+        keys = [SortKey(k, asc) for k, asc in node.order_keys]
+        return window_compute(child, node.partition_names, keys, node.specs)
 
     if isinstance(node, ValuesNode):
         cols = []

@@ -184,6 +184,17 @@ class Session:
             self.db.stores[key] = TableStore(info)
         return self.db.stores[key]
 
+    def load_arrow(self, table_name: str, table: pa.Table,
+                   database: str | None = None) -> int:
+        """Bulk ingest (the importer/fast_importer analog, src/tools/importer):
+        appends an Arrow table straight into the column store, bypassing SQL
+        row parsing."""
+        from ..sql.stmt import TableRef
+
+        store = self._store(TableRef(database, table_name))
+        store.insert_arrow(table)
+        return table.num_rows
+
     # -- DDL --------------------------------------------------------------
     def _create_table(self, s: CreateTableStmt) -> Result:
         db = s.table.database or self.current_db

@@ -119,6 +119,31 @@ class AggCall(Expr):
         return f"{self.op}({d}{', '.join(map(repr, self.args))})"
 
 
+@dataclass(frozen=True, eq=False)
+class WindowCall(Expr):
+    """fn(...) OVER (PARTITION BY ... ORDER BY ... [frame]) — reference:
+    window functions in sql_parse.y / window_fn_call.cpp."""
+
+    op: str
+    args: tuple
+    partition_by: tuple = ()
+    order_by: tuple = ()        # ((expr, asc), ...)
+    running: bool = False       # ROWS/RANGE UNBOUNDED PRECEDING..CURRENT ROW
+
+    def children(self):
+        return self.args + self.partition_by + tuple(e for e, _ in self.order_by)
+
+    def key(self):
+        return (("win", self.op, self.running)
+                + tuple(a.key() for a in self.args)
+                + tuple(p.key() for p in self.partition_by)
+                + tuple((e.key(), asc) for e, asc in self.order_by))
+
+    def __repr__(self):
+        return (f"{self.op}({', '.join(map(repr, self.args))}) over("
+                f"partition {list(self.partition_by)} order {list(self.order_by)})")
+
+
 def _wrap(v) -> Expr:
     return v if isinstance(v, Expr) else Lit(v)
 

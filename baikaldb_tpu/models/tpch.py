@@ -1,0 +1,232 @@
+"""TPC-H schema, data generator, and query texts.
+
+The "model family" of an HTAP engine is its benchmark workloads; TPC-H is the
+standard OLAP suite (BASELINE config #5).  This module carries:
+
+- the 8-table TPC-H schema (CREATE TABLE statements),
+- a self-contained columnar data generator (a numpy dbgen stand-in: uniform
+  keys/dates/prices with the spec's categorical domains — not the official
+  dbgen streams, but the same shapes/selectivities for engine benchmarking),
+- the query texts this engine currently supports, adapted to the round-1 SQL
+  surface (date literals resolved, no views).
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+RETURNFLAGS = ["R", "A", "N"]
+LINESTATUS = ["O", "F"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _d(iso: str) -> int:
+    y, m, d = map(int, iso.split("-"))
+    return (datetime.date(y, m, d) - _EPOCH).days
+
+
+DDL = {
+    "region": "CREATE TABLE region (r_regionkey INT PRIMARY KEY, r_name VARCHAR(25))",
+    "nation": "CREATE TABLE nation (n_nationkey INT PRIMARY KEY, "
+              "n_name VARCHAR(25), n_regionkey INT)",
+    "supplier": "CREATE TABLE supplier (s_suppkey INT PRIMARY KEY, "
+                "s_nationkey INT, s_acctbal DOUBLE)",
+    "customer": "CREATE TABLE customer (c_custkey INT PRIMARY KEY, "
+                "c_mktsegment VARCHAR(10), c_nationkey INT, c_acctbal DOUBLE)",
+    "orders": "CREATE TABLE orders (o_orderkey INT PRIMARY KEY, o_custkey INT, "
+              "o_orderstatus VARCHAR(1), o_totalprice DOUBLE, o_orderdate DATE, "
+              "o_orderpriority VARCHAR(15), o_shippriority INT)",
+    "lineitem": "CREATE TABLE lineitem (l_orderkey INT, l_linenumber INT, "
+                "l_suppkey INT, l_quantity DOUBLE, l_extendedprice DOUBLE, "
+                "l_discount DOUBLE, l_tax DOUBLE, l_returnflag VARCHAR(1), "
+                "l_linestatus VARCHAR(1), l_shipdate DATE, l_commitdate DATE, "
+                "l_receiptdate DATE, l_shipmode VARCHAR(10))",
+}
+
+
+def generate(scale: float = 0.01, seed: int = 0) -> dict[str, pa.Table]:
+    """-> table name -> pa.Table; row counts scale like dbgen (SF1 = 6M
+    lineitem)."""
+    rng = np.random.default_rng(seed)
+    n_orders = max(100, int(1_500_000 * scale))
+    n_cust = max(30, int(150_000 * scale))
+    n_supp = max(10, int(10_000 * scale))
+
+    region = pa.table({
+        "r_regionkey": np.arange(5, dtype=np.int32),
+        "r_name": REGIONS,
+    })
+    nation = pa.table({
+        "n_nationkey": np.arange(len(NATIONS), dtype=np.int32),
+        "n_name": [n for n, _ in NATIONS],
+        "n_regionkey": np.asarray([r for _, r in NATIONS], np.int32),
+    })
+    supplier = pa.table({
+        "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int32),
+        "s_nationkey": rng.integers(0, len(NATIONS), n_supp).astype(np.int32),
+        "s_acctbal": np.round(rng.uniform(-999, 9999, n_supp), 2),
+    })
+    customer = pa.table({
+        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int32),
+        "c_mktsegment": np.asarray(SEGMENTS)[rng.integers(0, 5, n_cust)],
+        "c_nationkey": rng.integers(0, len(NATIONS), n_cust).astype(np.int32),
+        "c_acctbal": np.round(rng.uniform(-999, 9999, n_cust), 2),
+    })
+    o_dates = rng.integers(_d("1992-01-01"), _d("1998-08-02"), n_orders)
+    orders = pa.table({
+        "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int32),
+        "o_custkey": rng.integers(1, n_cust + 1, n_orders).astype(np.int32),
+        "o_orderstatus": np.asarray(["O", "F", "P"])[rng.integers(0, 3, n_orders)],
+        "o_totalprice": np.round(rng.uniform(1000, 500000, n_orders), 2),
+        "o_orderdate": pa.array(o_dates.astype(np.int32), pa.int32()).cast(pa.date32()),
+        "o_orderpriority": np.asarray(PRIORITIES)[rng.integers(0, 5, n_orders)],
+        "o_shippriority": np.zeros(n_orders, np.int32),
+    })
+    # ~4 lineitems per order
+    per = rng.integers(1, 8, n_orders)
+    l_order = np.repeat(np.arange(1, n_orders + 1, dtype=np.int32), per)
+    n_li = len(l_order)
+    linenum = np.concatenate([np.arange(1, p + 1, dtype=np.int32) for p in per])
+    ship = np.repeat(o_dates, per) + rng.integers(1, 122, n_li)
+    commit = np.repeat(o_dates, per) + rng.integers(30, 91, n_li)
+    receipt = ship + rng.integers(1, 31, n_li)
+    lineitem = pa.table({
+        "l_orderkey": l_order,
+        "l_linenumber": linenum,
+        "l_suppkey": rng.integers(1, n_supp + 1, n_li).astype(np.int32),
+        "l_quantity": rng.integers(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": np.round(rng.uniform(900, 105000, n_li), 2),
+        "l_discount": np.round(rng.integers(0, 11, n_li) / 100.0, 2),
+        "l_tax": np.round(rng.integers(0, 9, n_li) / 100.0, 2),
+        "l_returnflag": np.asarray(RETURNFLAGS)[rng.integers(0, 3, n_li)],
+        "l_linestatus": np.asarray(LINESTATUS)[rng.integers(0, 2, n_li)],
+        "l_shipdate": pa.array(ship.astype(np.int32), pa.int32()).cast(pa.date32()),
+        "l_commitdate": pa.array(commit.astype(np.int32), pa.int32()).cast(pa.date32()),
+        "l_receiptdate": pa.array(receipt.astype(np.int32), pa.int32()).cast(pa.date32()),
+        "l_shipmode": np.asarray(SHIPMODES)[rng.integers(0, 7, n_li)],
+    })
+    return {"region": region, "nation": nation, "supplier": supplier,
+            "customer": customer, "orders": orders, "lineitem": lineitem}
+
+
+def load_into(session, scale: float = 0.01, seed: int = 0):
+    tables = generate(scale, seed)
+    for name, ddl in DDL.items():
+        session.execute(ddl)
+        session.load_arrow(name, tables[name])
+    return tables
+
+
+QUERIES = {
+    # Q1: pricing summary report (date resolved: 1998-12-01 - 90 days)
+    "q1": """
+        SELECT l_returnflag, l_linestatus,
+               SUM(l_quantity) AS sum_qty,
+               SUM(l_extendedprice) AS sum_base_price,
+               SUM(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+               SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+               AVG(l_quantity) AS avg_qty,
+               AVG(l_extendedprice) AS avg_price,
+               AVG(l_discount) AS avg_disc,
+               COUNT(*) AS count_order
+        FROM lineitem
+        WHERE l_shipdate <= '1998-09-02'
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+    """,
+    # Q3: shipping priority
+    "q3": """
+        SELECT l_orderkey,
+               SUM(l_extendedprice * (1 - l_discount)) AS revenue,
+               o_orderdate, o_shippriority
+        FROM customer
+        JOIN orders ON c_custkey = o_custkey
+        JOIN lineitem ON l_orderkey = o_orderkey
+        WHERE c_mktsegment = 'BUILDING'
+          AND o_orderdate < '1995-03-15'
+          AND l_shipdate > '1995-03-15'
+        GROUP BY l_orderkey, o_orderdate, o_shippriority
+        ORDER BY revenue DESC, o_orderdate
+        LIMIT 10
+    """,
+    # Q5: local supplier volume
+    "q5": """
+        SELECT n_name,
+               SUM(l_extendedprice * (1 - l_discount)) AS revenue
+        FROM customer
+        JOIN orders ON c_custkey = o_custkey
+        JOIN lineitem ON l_orderkey = o_orderkey
+        JOIN supplier ON l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+        JOIN nation ON s_nationkey = n_nationkey
+        JOIN region ON n_regionkey = r_regionkey
+        WHERE r_name = 'ASIA'
+          AND o_orderdate >= '1994-01-01' AND o_orderdate < '1995-01-01'
+        GROUP BY n_name
+        ORDER BY revenue DESC
+    """,
+    # Q6: forecasting revenue change
+    "q6": """
+        SELECT SUM(l_extendedprice * l_discount) AS revenue
+        FROM lineitem
+        WHERE l_shipdate >= '1994-01-01' AND l_shipdate < '1995-01-01'
+          AND l_discount BETWEEN 0.05 AND 0.07
+          AND l_quantity < 24
+    """,
+    # Q12: shipping modes and order priority
+    "q12": """
+        SELECT l_shipmode,
+               SUM(CASE WHEN o_orderpriority = '1-URGENT'
+                         OR o_orderpriority = '2-HIGH' THEN 1 ELSE 0 END) AS high_line_count,
+               SUM(CASE WHEN o_orderpriority <> '1-URGENT'
+                        AND o_orderpriority <> '2-HIGH' THEN 1 ELSE 0 END) AS low_line_count
+        FROM orders
+        JOIN lineitem ON o_orderkey = l_orderkey
+        WHERE l_shipmode IN ('MAIL', 'SHIP')
+          AND l_commitdate < l_receiptdate
+          AND l_shipdate < l_commitdate
+          AND l_receiptdate >= '1994-01-01' AND l_receiptdate < '1995-01-01'
+        GROUP BY l_shipmode
+        ORDER BY l_shipmode
+    """,
+    # Q10: returned item reporting (top customers)
+    "q10": """
+        SELECT c_custkey, SUM(l_extendedprice * (1 - l_discount)) AS revenue,
+               c_acctbal, n_name
+        FROM customer
+        JOIN orders ON c_custkey = o_custkey
+        JOIN lineitem ON l_orderkey = o_orderkey
+        JOIN nation ON c_nationkey = n_nationkey
+        WHERE o_orderdate >= '1993-10-01' AND o_orderdate < '1994-01-01'
+          AND l_returnflag = 'R'
+        GROUP BY c_custkey, c_acctbal, n_name
+        ORDER BY revenue DESC
+        LIMIT 20
+    """,
+    # Q14: promo effect simplified (no part table in mini-gen: ratio of
+    # discounted revenue) — engine-exercise variant
+    "q14_lite": """
+        SELECT 100.00 * SUM(CASE WHEN l_discount > 0.05
+                                 THEN l_extendedprice * (1 - l_discount)
+                                 ELSE 0 END) / SUM(l_extendedprice * (1 - l_discount))
+               AS promo_revenue
+        FROM lineitem
+        WHERE l_shipdate >= '1995-09-01' AND l_shipdate < '1995-10-01'
+    """,
+}

@@ -1,0 +1,180 @@
+"""Vector ANN search on the MXU (BASELINE config #4).
+
+The reference wraps faiss (IVF-Flat / HNSW) per region with a RocksDB scalar
+payload + delete bitmap (src/vector_index/vector_index.cpp:2341,
+include/vector_index/vector_index.h:42).  On TPU the hardware answer is
+different: a brute-force scan IS a matmul — [q, d] x [d, n] on the systolic
+array at bf16 — so exact search saturates the MXU up to millions of vectors,
+and an IVF-style two-stage search (coarse centroids then probed clusters)
+covers the rest.  Deleted rows are a validity mask, MVCC-style, like the
+reference's delete bitmap.
+
+Distances: L2 and inner-product/cosine, matching the reference's
+faiss metric choices.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _scores(queries, base, metric: str, precision: str):
+    q = queries
+    b = base
+    if precision == "bf16":
+        q = q.astype(jnp.bfloat16)
+        b = b.astype(jnp.bfloat16)
+    dots = jnp.matmul(q, b.T, preferred_element_type=jnp.float32)
+    if metric == "ip":
+        return dots
+    if metric == "cosine":
+        qn = jnp.linalg.norm(queries, axis=1, keepdims=True).astype(jnp.float32)
+        bn = jnp.linalg.norm(base, axis=1, keepdims=True).astype(jnp.float32)
+        return dots / jnp.maximum(qn * bn.T, 1e-30)
+    if metric == "l2":
+        # ||q-b||^2 = ||q||^2 - 2qb + ||b||^2; score = -distance
+        q2 = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+        b2 = jnp.sum(base.astype(jnp.float32) ** 2, axis=1)
+        return -(q2 - 2.0 * dots + b2[None, :])
+    raise ValueError(f"unknown metric {metric}")
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "precision"))
+def brute_force_topk(queries, base, valid, k: int, metric: str = "l2",
+                     precision: str = "bf16"):
+    """Exact top-k: [q, d] queries against [n, d] base -> (scores, indices).
+
+    ``valid`` is the live-row mask (deletes / MVCC visibility — the analog of
+    the reference's faiss delete bitmap merged at search time)."""
+    s = _scores(queries, base, metric, precision)
+    if valid is not None:
+        s = jnp.where(valid[None, :], s, -jnp.inf)
+    return jax.lax.top_k(s, k)
+
+
+@partial(jax.jit, static_argnames=("k", "nprobe", "metric", "precision"))
+def ivf_topk(queries, base, valid, centroids, assign, k: int, nprobe: int,
+             metric: str = "l2", precision: str = "bf16"):
+    """IVF-Flat: probe the nprobe nearest centroid clusters only.
+
+    assign: [n] centroid id per base vector.  Scores for rows outside probed
+    clusters are masked.  Static shapes: full scores computed then masked —
+    on TPU the matmul is usually cheaper than a gather for n <= a few M; for
+    larger n a pallas gather kernel takes over (later round)."""
+    cs = _scores(queries, centroids, metric, precision)
+    _, probe = jax.lax.top_k(cs, nprobe)              # [q, nprobe]
+    s = _scores(queries, base, metric, precision)      # [q, n]
+    in_probe = jnp.any(assign[None, :, None] == probe[:, None, :], axis=-1)
+    if valid is not None:
+        in_probe = in_probe & valid[None, :]
+    s = jnp.where(in_probe, s, -jnp.inf)
+    return jax.lax.top_k(s, k)
+
+
+def kmeans(vectors: np.ndarray, n_clusters: int, iters: int = 10,
+           seed: int = 0):
+    """Lloyd's k-means on device (for IVF training — the faiss train analog).
+
+    Returns (centroids [c, d], assign [n])."""
+    rng = np.random.default_rng(seed)
+    idx = rng.choice(len(vectors), size=n_clusters, replace=False)
+    centroids = jnp.asarray(vectors[idx], jnp.float32)
+    x = jnp.asarray(vectors, jnp.float32)
+
+    @jax.jit
+    def step(c):
+        d = _scores(x, c, "l2", "f32")                # [n, cclusters] (neg dist)
+        a = jnp.argmax(d, axis=1)
+        sums = jax.ops.segment_sum(x, a, num_segments=n_clusters)
+        cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],)), a,
+                                  num_segments=n_clusters)
+        newc = sums / jnp.maximum(cnt[:, None], 1.0)
+        # keep old centroid for empty clusters
+        newc = jnp.where(cnt[:, None] > 0, newc, c)
+        return newc, a
+
+    assign = None
+    for _ in range(iters):
+        centroids, assign = step(centroids)
+    return np.asarray(centroids), np.asarray(assign)
+
+
+class VectorIndex:
+    """Per-table vector index: exact by default, IVF above a size threshold.
+
+    API mirrors the reference's VectorIndex surface (insert/delete/search with
+    payload ids + visibility) minus the RocksDB persistence, which the storage
+    tier provides."""
+
+    def __init__(self, dim: int, metric: str = "l2", ivf_threshold: int = 65536,
+                 n_clusters: int | None = None, nprobe: int = 8):
+        self.dim = dim
+        self.metric = metric
+        self.ivf_threshold = ivf_threshold
+        self.n_clusters = n_clusters
+        self.nprobe = nprobe
+        self._vecs = np.zeros((0, dim), np.float32)
+        self._ids = np.zeros((0,), np.int64)
+        self._live = np.zeros((0,), bool)
+        self._device = None           # (base, valid, centroids, assign) | None
+
+    def __len__(self):
+        return int(self._live.sum())
+
+    def add(self, ids: np.ndarray, vectors: np.ndarray):
+        vectors = np.asarray(vectors, np.float32).reshape(-1, self.dim)
+        ids = np.asarray(ids, np.int64)
+        self._vecs = np.concatenate([self._vecs, vectors])
+        self._ids = np.concatenate([self._ids, ids])
+        self._live = np.concatenate([self._live, np.ones(len(ids), bool)])
+        self._device = None
+
+    def delete(self, ids) -> int:
+        mask = np.isin(self._ids, np.asarray(list(ids), np.int64)) & self._live
+        self._live[mask] = False
+        if self._device is not None:
+            # deletes only flip visibility: refresh the mask, keep the base
+            # matrix and IVF centroids/assignments (no retrain)
+            base, _, cent, assign = self._device
+            self._device = (base, jnp.asarray(self._live), cent, assign)
+        return int(mask.sum())
+
+    def _prepare(self):
+        if self._device is not None:
+            return self._device
+        base = jnp.asarray(self._vecs)
+        valid = jnp.asarray(self._live)
+        cent = assign = None
+        if len(self._vecs) >= self.ivf_threshold:
+            nc = self.n_clusters or max(16, int(np.sqrt(len(self._vecs))))
+            c, a = kmeans(self._vecs, nc)
+            cent, assign = jnp.asarray(c), jnp.asarray(a)
+        self._device = (base, valid, cent, assign)
+        return self._device
+
+    def search(self, queries: np.ndarray, k: int):
+        """-> (ids [q, k], scores [q, k]); dead slots get id -1."""
+        if len(self._vecs) == 0:
+            q = np.atleast_2d(queries).shape[0]
+            return np.full((q, k), -1, np.int64), np.full((q, k), -np.inf)
+        base, valid, cent, assign = self._prepare()
+        q = jnp.atleast_2d(jnp.asarray(queries, jnp.float32))
+        kk = min(k, base.shape[0])
+        if cent is None:
+            scores, idx = brute_force_topk(q, base, valid, kk, self.metric)
+        else:
+            scores, idx = ivf_topk(q, base, valid, cent, assign, kk,
+                                   min(self.nprobe, cent.shape[0]), self.metric)
+        scores = np.asarray(scores, np.float64)
+        idx = np.asarray(idx)
+        ids = self._ids[idx]
+        ids = np.where(np.isfinite(scores), ids, -1)
+        if kk < k:
+            pad = k - kk
+            ids = np.pad(ids, ((0, 0), (0, pad)), constant_values=-1)
+            scores = np.pad(scores, ((0, 0), (0, pad)), constant_values=-np.inf)
+        return ids, scores

@@ -1,0 +1,255 @@
+"""Window function kernels (reference: src/expr/window_fn_call.cpp — rank /
+row_number / ntile / lead / lag / aggregates; src/exec/window_node.cpp runs
+them over sorted partitions).
+
+TPU re-design: one stable multi-key sort puts rows in (partition, order)
+order; every window function is then O(n) vectorized prefix math —
+``cumsum`` + segment-start gathers — and results scatter back to the original
+row order through the inverse permutation.  No per-partition loops: a million
+tiny partitions cost the same as one big one.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..column.batch import Column, ColumnBatch
+from ..types import LType
+from .sort import SortKey
+
+
+@dataclass(frozen=True)
+class WinSpec:
+    op: str                      # row_number | rank | dense_rank | ntile |
+    #                              lead | lag | first_value | last_value |
+    #                              sum | count | avg | min | max (partition or
+    #                              running)
+    input: Optional[str] = None
+    out_name: str = ""
+    offset: int = 1              # lead/lag
+    default: Optional[float] = None
+    n: int = 1                   # ntile buckets
+    running: bool = False        # ROWS UNBOUNDED PRECEDING .. CURRENT ROW
+
+
+def window_compute(batch: ColumnBatch, partition_names: list[str],
+                   order_keys: list[SortKey], specs: list[WinSpec]) -> ColumnBatch:
+    """Append window-function columns (aligned to the batch's row order)."""
+    n = len(batch)
+    sel = batch.sel_mask()
+
+    # ---- sort rows: partition keys (primary) then order keys; dead rows
+    # last — one stable multi-key sort, shared with ORDER BY (ops/sort.py)
+    from .sort import sort_permutation
+
+    perm = sort_permutation(batch, [SortKey(p, True) for p in partition_names]
+                            + list(order_keys))
+    pkey_data = []
+    for pn in partition_names:
+        c = batch.column(pn)
+        d = c.data
+        if d.dtype == jnp.bool_:
+            d = d.astype(jnp.int32)
+        if c.validity is not None:
+            d = jnp.where(c.validity, d, jnp.zeros((), d.dtype))
+        pkey_data.append((c, d))
+
+    inv = jnp.zeros(n, perm.dtype).at[perm].set(jnp.arange(n))
+    sel_s = sel[perm]
+    idx = jnp.arange(n)
+
+    # partition boundaries (NULL keys canonicalized above)
+    flags = idx == 0
+    for c, d in pkey_data:
+        ds = d[perm]
+        flags = flags | (ds != jnp.roll(ds, 1))
+        if c.validity is not None:
+            v = c.validity[perm]
+            flags = flags | (v != jnp.roll(v, 1))
+    flags = flags | (sel_s != jnp.roll(sel_s, 1))
+
+    # order-key tie boundaries (for rank/dense_rank)
+    tie = flags
+    for k in order_keys:
+        c = batch.column(k.name)
+        ds = c.data[perm]
+        tie = tie | (ds != jnp.roll(ds, 1))
+        if c.validity is not None:
+            v = c.validity[perm]
+            tie = tie | (v != jnp.roll(v, 1))
+
+    start_idx = jnp.maximum.accumulate(jnp.where(flags, idx, 0))
+    row_number = idx - start_idx + 1
+    sid = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    nseg = n + 1
+    import jax
+
+    seg_size = jax.ops.segment_sum(sel_s.astype(jnp.int64),
+                                   jnp.where(sel_s, sid, n),
+                                   num_segments=nseg)[:n]
+    size_here = jnp.take(seg_size, jnp.clip(sid, 0, n - 1))
+    end_idx = start_idx + jnp.maximum(size_here, 1) - 1
+
+    names = list(batch.names)
+    cols = list(batch.columns)
+    for s in specs:
+        res = _one(s, batch, perm, idx, sel_s, flags, tie, sid, start_idx,
+                   end_idx, row_number, size_here, nseg)
+        if len(res) == 4:
+            out_sorted, validity_sorted, lt, dct = res
+        else:
+            out_sorted, validity_sorted, lt = res
+            dct = None
+            if s.input is not None and lt is LType.STRING:
+                dct = batch.column(s.input).dictionary
+        data = jnp.take(out_sorted, inv)
+        validity = None if validity_sorted is None else jnp.take(validity_sorted, inv)
+        names.append(s.out_name)
+        cols.append(Column(data, validity, lt, dct))
+    return ColumnBatch(tuple(names), cols, batch.sel, batch.num_rows)
+
+
+def _one(s: WinSpec, batch, perm, idx, sel_s, flags, tie, sid, start_idx,
+         end_idx, row_number, size_here, nseg):
+    import jax
+
+    n = idx.shape[0]
+    if s.op == "row_number":
+        return row_number.astype(jnp.int64), None, LType.INT64
+    if s.op == "rank":
+        tstart = jnp.maximum.accumulate(jnp.where(tie, idx, 0))
+        return (tstart - start_idx + 1).astype(jnp.int64), None, LType.INT64
+    if s.op == "dense_rank":
+        c = jnp.cumsum(tie.astype(jnp.int64))
+        c_start = jnp.take(c, start_idx)
+        return c - c_start + 1, None, LType.INT64
+    if s.op == "ntile":
+        t = ((row_number - 1) * s.n) // jnp.maximum(size_here, 1) + 1
+        return t.astype(jnp.int64), None, LType.INT64
+    if s.op == "count" and s.input is None:
+        # COUNT(*) OVER: all live rows count
+        if s.running:
+            return row_number.astype(jnp.int64), None, LType.INT64
+        return size_here.astype(jnp.int64), None, LType.INT64
+
+    c = batch.column(s.input)
+    x = c.data[perm]
+    xv = (c.valid_mask()[perm]) & sel_s
+
+    if s.op in ("lead", "lag"):
+        off = s.offset if s.op == "lead" else -s.offset
+        src = idx + off
+        in_range = (src >= 0) & (src < n)
+        src_c = jnp.clip(src, 0, n - 1)
+        same = jnp.take(sid, src_c) == sid
+        ok = in_range & same & sel_s
+        data = jnp.take(x, src_c)
+        validity = jnp.take(xv, src_c) & ok
+        if s.default is not None:
+            if c.ltype is LType.STRING:
+                if not isinstance(s.default, str):
+                    raise ValueError("lead/lag default on a string column "
+                                     "must be a string")
+                # default becomes a code in an extended dictionary
+                import numpy as np
+                from ..column.dictionary import Dictionary
+                values = np.union1d(c.dictionary.values,
+                                    np.asarray([s.default], dtype=str))
+                remap = jnp.asarray(np.searchsorted(values, c.dictionary.values)
+                                    .astype(np.int32))
+                data = jnp.where(data >= 0,
+                                 jnp.take(remap, jnp.clip(data, 0, None),
+                                          mode="clip"), data)
+                dcode = int(np.searchsorted(values, s.default))
+                data = jnp.where(ok, data, jnp.int32(dcode))
+                validity = jnp.where(ok, validity, True)
+                return data, validity, c.ltype, Dictionary(values)
+            if isinstance(s.default, str):
+                raise ValueError("string default on a non-string column")
+            if isinstance(s.default, float) and not float(s.default).is_integer() \
+                    and x.dtype.kind in "iu":
+                # float default on int column: widen output to f64
+                data = data.astype(jnp.float64)
+                data = jnp.where(ok, data, jnp.float64(s.default))
+                validity = jnp.where(ok, validity, True)
+                return data, validity, LType.FLOAT64, None
+            data = jnp.where(ok, data, jnp.asarray(s.default, x.dtype))
+            validity = jnp.where(ok, validity, True)
+        return data, validity, c.ltype
+    if s.op == "first_value":
+        return jnp.take(x, start_idx), jnp.take(xv, start_idx), c.ltype
+    if s.op == "last_value":
+        if s.running:
+            # default ordered frame (UNBOUNDED PRECEDING..CURRENT ROW):
+            # LAST_VALUE is the current row's value
+            return x, xv, c.ltype
+        return jnp.take(x, end_idx), jnp.take(xv, end_idx), c.ltype
+
+    # aggregates (partition-wide or running)
+    dt = jnp.int64 if c.ltype.is_integer else jnp.float64
+    xa = jnp.where(xv, x.astype(dt), 0)
+    ones = xv.astype(jnp.int64)
+    if s.running:
+        cs = jnp.cumsum(xa)
+        cs0 = cs - xa
+        run_sum = cs - jnp.take(cs0, start_idx)
+        cn = jnp.cumsum(ones)
+        run_cnt = cn - jnp.take(cn - ones, start_idx)
+        if s.op == "sum":
+            return run_sum, run_cnt > 0, LType.INT64 if dt == jnp.int64 else LType.FLOAT64
+        if s.op == "count":
+            return run_cnt, None, LType.INT64
+        if s.op == "avg":
+            return (run_sum.astype(jnp.float64) /
+                    jnp.maximum(run_cnt, 1)), run_cnt > 0, LType.FLOAT64
+        if s.op in ("min", "max"):
+            # segmented running min/max: associative scan that resets at
+            # partition boundaries (carries (segment id, running extreme))
+            big = (jnp.iinfo if x.dtype.kind in "iu" else jnp.finfo)(x.dtype)
+            ident = big.max if s.op == "min" else big.min
+            xm = jnp.where(xv, x, ident)
+            import jax.lax as lax
+
+            def combine(a, b):
+                asid, aval = a
+                bsid, bval = b
+                take_b = bsid != asid
+                val = jnp.where(take_b, bval,
+                                jnp.minimum(aval, bval) if s.op == "min"
+                                else jnp.maximum(aval, bval))
+                return (bsid, val)
+
+            _, vals = lax.associative_scan(combine, (sid, xm))
+            return vals, run_cnt > 0, c.ltype
+        raise ValueError(f"unsupported running window aggregate {s.op}")
+    # partition-wide
+    gid = jnp.where(sel_s, sid, n)
+    if s.op == "count":
+        t = jax.ops.segment_sum(ones, gid, num_segments=nseg)[:n]
+        return jnp.take(t, jnp.clip(sid, 0, n - 1)), None, LType.INT64
+    if s.op == "sum":
+        t = jax.ops.segment_sum(xa, gid, num_segments=nseg)[:n]
+        tc = jax.ops.segment_sum(ones, gid, num_segments=nseg)[:n]
+        sd = jnp.take(t, jnp.clip(sid, 0, n - 1))
+        vc = jnp.take(tc, jnp.clip(sid, 0, n - 1)) > 0
+        return sd, vc, LType.INT64 if dt == jnp.int64 else LType.FLOAT64
+    if s.op == "avg":
+        t = jax.ops.segment_sum(xa.astype(jnp.float64), gid, num_segments=nseg)[:n]
+        tc = jax.ops.segment_sum(ones, gid, num_segments=nseg)[:n]
+        sd = jnp.take(t, jnp.clip(sid, 0, n - 1))
+        cd = jnp.take(tc, jnp.clip(sid, 0, n - 1))
+        return sd / jnp.maximum(cd, 1), cd > 0, LType.FLOAT64
+    if s.op in ("min", "max"):
+        big = (jnp.iinfo if x.dtype.kind in "iu" else jnp.finfo)(x.dtype)
+        ident = big.max if s.op == "min" else big.min
+        xm = jnp.where(xv, x, ident)
+        f = jax.ops.segment_min if s.op == "min" else jax.ops.segment_max
+        t = f(xm, gid, num_segments=nseg)[:n]
+        tc = jax.ops.segment_sum(ones, gid, num_segments=nseg)[:n]
+        sd = jnp.take(t, jnp.clip(sid, 0, n - 1))
+        vc = jnp.take(tc, jnp.clip(sid, 0, n - 1)) > 0
+        return sd, vc, c.ltype
+    raise ValueError(f"unsupported window op {s.op}")

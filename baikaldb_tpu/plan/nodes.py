@@ -135,6 +135,19 @@ class DistinctNode(PlanNode):
 
 
 @dataclass
+class WindowNode(PlanNode):
+    """Window functions over one (partition, order) spec (reference:
+    src/exec/window_node.cpp)."""
+    partition_names: list[str] = field(default_factory=list)
+    order_keys: list[tuple[str, bool]] = field(default_factory=list)
+    specs: list = field(default_factory=list)   # list[ops.window.WinSpec]
+
+    def _label(self):
+        return (f"Window(partition={self.partition_names} order={self.order_keys} "
+                f"fns={[s.out_name for s in self.specs]})")
+
+
+@dataclass
 class ValuesNode(PlanNode):
     """Literal rows (SELECT without FROM)."""
     rows: list[list] = field(default_factory=list)

@@ -23,7 +23,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, walk
+from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, WindowCall, walk
 from ..expr.compile import infer_type
 from ..meta.catalog import Catalog
 from ..ops.hashagg import AggSpec, agg_result_type
@@ -32,7 +32,7 @@ from ..sql.stmt import JoinClause, SelectStmt, TableRef
 from ..types import Field, LType, Schema
 from .nodes import (AggNode, DistinctNode, FilterNode, JoinNode, LimitNode,
                     PlanNode, ProjectNode, ScanNode, SortNode, UnionNode,
-                    ValuesNode)
+                    ValuesNode, WindowNode)
 
 MAX_DENSE_GROUPS = 1 << 20
 
@@ -232,6 +232,11 @@ class Planner:
                     return alias_map[e.name]
             if isinstance(e, AggCall):
                 return AggCall(e.op, tuple(subst_alias(a) for a in e.args), e.distinct)
+            if isinstance(e, WindowCall):
+                return WindowCall(e.op, tuple(subst_alias(a) for a in e.args),
+                                  tuple(subst_alias(a) for a in e.partition_by),
+                                  tuple((subst_alias(x), asc) for x, asc in e.order_by),
+                                  e.running)
             if isinstance(e, Call):
                 return Call(e.op, tuple(subst_alias(a) for a in e.args))
             return e
@@ -257,6 +262,13 @@ class Planner:
         else:
             if having is not None:
                 raise PlanError("HAVING without aggregation")
+
+        # window functions (computed after WHERE/GROUP/HAVING, before
+        # DISTINCT/ORDER BY — SQL evaluation order)
+        if any(any(isinstance(x, WindowCall) for x in walk(e))
+               for e in [e for _, e in named_items] + [e for e, _ in order_items]):
+            plan, named_items, order_items = self._plan_windows(
+                plan, named_items, order_items)
 
         # final projection (+ hidden sort columns)
         sch = plan.schema
@@ -538,6 +550,11 @@ class Planner:
             for src, dst in mapping:
                 if e.equals(src):
                     return dst
+            if isinstance(e, WindowCall):
+                return WindowCall(e.op, tuple(rewrite(x) for x in e.args),
+                                  tuple(rewrite(x) for x in e.partition_by),
+                                  tuple((rewrite(x), asc) for x, asc in e.order_by),
+                                  e.running)
             if isinstance(e, (Call, AggCall)):
                 new_args = tuple(rewrite(x) for x in e.args)
                 if isinstance(e, AggCall):
@@ -556,6 +573,137 @@ class Planner:
             having = rewrite(having)
             plan = FilterNode(children=[plan], pred=having, schema=plan.schema)
         return plan, named_items, None, order_items
+
+    def _plan_windows(self, plan, named_items, order_items):
+        """Extract WindowCalls -> WindowNode(s), one per (partition, order)
+        signature; window inputs become hidden projected columns."""
+        from ..ops.window import WinSpec
+
+        sch = plan.schema
+        wins: list[WindowCall] = []
+
+        def note(e):
+            for x in walk(e):
+                if isinstance(x, WindowCall) and not any(x.equals(w) for w in wins):
+                    wins.append(x)
+
+        for _, e in named_items:
+            note(e)
+        for e, _ in order_items:
+            note(e)
+
+        pre_names: list[str] = []
+        pre_exprs: list[Expr] = []
+
+        def as_col(e: Expr) -> str:
+            if isinstance(e, ColRef):
+                return e.name
+            for n2, e2 in zip(pre_names, pre_exprs):
+                if e2.equals(e):
+                    return n2
+            n2 = self._tmp("w")
+            pre_names.append(n2)
+            pre_exprs.append(e)
+            return n2
+
+        groups: dict[tuple, list[tuple[WindowCall, WinSpec]]] = {}
+        group_meta: dict[tuple, tuple[list[str], list[tuple[str, bool]]]] = {}
+        out_map: list[tuple[WindowCall, str]] = []
+        for w in wins:
+            pnames = [as_col(p) for p in w.partition_by]
+            okeys = [(as_col(x), asc) for x, asc in w.order_by]
+            sig = (tuple(pnames), tuple(okeys))
+            out = self._tmp("wf")
+            spec = self._win_spec(w, out, as_col)
+            groups.setdefault(sig, []).append((w, spec))
+            group_meta[sig] = (pnames, okeys)
+            out_map.append((w, out))
+
+        if pre_exprs:
+            keep = [f.name for f in sch.fields]
+            exprs = [ColRef(n) for n in keep] + pre_exprs
+            names = keep + pre_names
+            psch = Schema(tuple(list(sch.fields) +
+                                [Field(n, infer_type(e, sch))
+                                 for n, e in zip(pre_names, pre_exprs)]))
+            plan = ProjectNode(children=[plan], exprs=exprs, names=names,
+                               schema=psch)
+            sch = psch
+
+        for sig, pairs in groups.items():
+            pnames, okeys = group_meta[sig]
+            specs = [sp for _, sp in pairs]
+            new_fields = list(sch.fields)
+            for w, sp in pairs:
+                lt = self._win_result_type(w, sch)
+                new_fields.append(Field(sp.out_name, lt))
+            sch = Schema(tuple(new_fields))
+            plan = WindowNode(children=[plan], partition_names=pnames,
+                              order_keys=okeys, specs=specs, schema=sch)
+
+        def rewrite(e: Expr) -> Expr:
+            for w, out in out_map:
+                if e.equals(w):
+                    return ColRef(out)
+            if isinstance(e, Call):
+                return Call(e.op, tuple(rewrite(x) for x in e.args))
+            if isinstance(e, AggCall):
+                return AggCall(e.op, tuple(rewrite(x) for x in e.args), e.distinct)
+            return e
+
+        named_items = [(n, rewrite(e)) for n, e in named_items]
+        order_items = [(rewrite(e), asc) for e, asc in order_items]
+        return plan, named_items, order_items
+
+    def _win_spec(self, w: WindowCall, out: str, as_col):
+        from ..ops.window import WinSpec
+
+        op = w.op
+        if op in ("row_number", "rank", "dense_rank"):
+            return WinSpec(op, None, out)
+        if op == "ntile":
+            if not (w.args and isinstance(w.args[0], Lit)):
+                raise PlanError("NTILE requires a literal bucket count")
+            return WinSpec(op, None, out, n=int(w.args[0].value))
+        if op in ("lead", "lag"):
+            if not 1 <= len(w.args) <= 3:
+                raise PlanError(f"{op} takes 1-3 arguments")
+            inp = as_col(w.args[0])
+            offset = 1
+            default = None
+            if len(w.args) > 1:
+                if not isinstance(w.args[1], Lit):
+                    raise PlanError(f"{op} offset must be a literal")
+                offset = int(w.args[1].value)
+            if len(w.args) > 2:
+                if not isinstance(w.args[2], Lit):
+                    raise PlanError(f"{op} default must be a literal")
+                default = w.args[2].value
+            return WinSpec(op, inp, out, offset=offset, default=default)
+        if op in ("first_value", "last_value"):
+            if len(w.args) != 1:
+                raise PlanError(f"{op} takes exactly one argument")
+            return WinSpec(op, as_col(w.args[0]), out, running=w.running)
+        if op in ("sum", "avg", "min", "max"):
+            if len(w.args) != 1:
+                raise PlanError(f"window {op} takes exactly one argument")
+            return WinSpec(op, as_col(w.args[0]), out, running=w.running)
+        if op == "count":
+            inp = as_col(w.args[0]) if w.args else None
+            return WinSpec("count", inp, out, running=w.running)
+        raise PlanError(f"unsupported window function {op!r}")
+
+    def _win_result_type(self, w: WindowCall, sch: Schema) -> LType:
+        if w.op in ("row_number", "rank", "dense_rank", "ntile", "count"):
+            return LType.INT64
+        if w.op in ("lead", "lag", "first_value", "last_value", "min", "max"):
+            return infer_type(w.args[0], sch)
+        if w.op == "avg":
+            return LType.FLOAT64
+        if w.op == "sum":
+            at = infer_type(w.args[0], sch)
+            return LType.INT64 if at.is_integer else LType.FLOAT64
+        return LType.FLOAT64
 
     def _group_strategy(self, plan, sch: Schema, key_names: list[str]):
         """dense (segment_sum over known domains) vs sorted fallback.
@@ -650,6 +798,10 @@ class Planner:
             elif isinstance(node, AggNode):
                 used.update(node.key_names)
                 used.update(s.input for s in node.specs if s.input)
+            elif isinstance(node, WindowNode):
+                used.update(node.partition_names)
+                used.update(k for k, _ in node.order_keys)
+                used.update(s.input for s in node.specs if s.input)
             elif isinstance(node, SortNode):
                 used.update(k for k, _ in node.keys)
             for c in node.children:
@@ -705,6 +857,11 @@ class _Resolver:
             return ColRef(q)
         if isinstance(e, AggCall):
             return AggCall(e.op, tuple(self(a) for a in e.args), e.distinct)
+        if isinstance(e, WindowCall):
+            return WindowCall(e.op, tuple(self(a) for a in e.args),
+                              tuple(self(a) for a in e.partition_by),
+                              tuple((self(x), asc) for x, asc in e.order_by),
+                              e.running)
         if isinstance(e, Call):
             return Call(e.op, tuple(self(a) for a in e.args))
         return e

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..expr.ast import AggCall, Call, ColRef, Expr, Lit
+from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, WindowCall
 from .lexer import SqlError, Token, tokenize
 from .stmt import (ColumnDef, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
                    DescribeStmt, DropDatabaseStmt, DropTableStmt, ExplainStmt,
@@ -22,6 +22,9 @@ from .stmt import (ColumnDef, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
 
 _AGG_FUNCS = {"count", "sum", "avg", "min", "max", "stddev", "std",
               "stddev_samp", "variance", "var_samp", "group_concat"}
+
+_WINDOW_ONLY = {"row_number", "rank", "dense_rank", "ntile", "lead", "lag",
+                "first_value", "last_value"}
 
 _FN_ALIASES = {
     "substring": "substr", "mid": "substr", "ucase": "upper", "lcase": "lower",
@@ -657,13 +660,32 @@ class Parser:
             distinct = bool(self.try_kw("distinct"))
             if self.try_op("*"):
                 self.expect_op(")")
+                w = self._maybe_over("count" if lname == "count" else lname, ())
+                if w is not None:
+                    return w
                 return AggCall("count_star" if lname == "count" else lname, ())
             args = [self.expr()]
             while self.try_op(","):
                 args.append(self.expr())
             self.expect_op(")")
             op = _FN_ALIASES.get(lname, lname)
+            w = self._maybe_over(op, tuple(args))
+            if w is not None:
+                if distinct:
+                    raise SqlError("DISTINCT not supported in window functions")
+                return w
             return AggCall(op, tuple(args), distinct=distinct)
+        if lname in _WINDOW_ONLY:
+            args = []
+            if not self.try_op(")"):
+                args.append(self.expr())
+                while self.try_op(","):
+                    args.append(self.expr())
+                self.expect_op(")")
+            w = self._maybe_over(lname, tuple(args))
+            if w is None:
+                raise SqlError(f"{lname} requires an OVER clause")
+            return w
         # DATE_ADD(x, INTERVAL n DAY)
         if lname in ("date_add", "date_sub"):
             x = self.expr()
@@ -683,6 +705,63 @@ class Parser:
                 args.append(self.expr())
             self.expect_op(")")
         return Call(_FN_ALIASES.get(lname, lname), tuple(args))
+
+    def _try_ctx(self, word: str) -> bool:
+        """Contextual (non-reserved) keyword: matches an IDENT case-
+        insensitively.  Keeps OVER/PARTITION/ROWS/... usable as column
+        names (they are not reserved in MySQL)."""
+        t = self.peek()
+        if t.kind == "IDENT" and t.value.lower() == word:
+            self.advance()
+            return True
+        return False
+
+    def _expect_ctx(self, word: str):
+        if not self._try_ctx(word):
+            t = self.peek()
+            raise SqlError(f"expected {word.upper()!r}, got {t.value!r} at {t.pos}")
+
+    def _maybe_over(self, op: str, args: tuple):
+        """Parse an optional OVER(...) clause -> WindowCall or None.
+
+        OVER is contextual: only treated as a window clause when directly
+        followed by '(' (otherwise it parses as an alias/identifier)."""
+        t = self.peek()
+        if not (t.kind == "IDENT" and t.value.lower() == "over"
+                and self.peek(1).kind == "OP" and self.peek(1).value == "("):
+            return None
+        self.advance()
+        self.expect_op("(")
+        partition: list[Expr] = []
+        order: list[tuple[Expr, bool]] = []
+        running = None
+        if self._try_ctx("partition"):
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.try_op(","):
+                partition.append(self.expr())
+        if self.try_kw("order"):
+            self.expect_kw("by")
+            o = self.order_item()
+            order.append((o.expr, o.asc))
+            while self.try_op(","):
+                o = self.order_item()
+                order.append((o.expr, o.asc))
+        if self._try_ctx("rows") or self._try_ctx("range"):
+            self.expect_kw("between")
+            self._expect_ctx("unbounded")
+            self._expect_ctx("preceding")
+            self.expect_kw("and")
+            self._expect_ctx("current")
+            self._expect_ctx("row")
+            running = True
+        self.expect_op(")")
+        if running is None:
+            # MySQL default frame with ORDER BY is RANGE UNBOUNDED
+            # PRECEDING..CURRENT ROW (running) for frame-aware functions
+            running = bool(order) and op in ("sum", "count", "avg", "min",
+                                             "max", "first_value", "last_value")
+        return WindowCall(op, args, tuple(partition), tuple(order), running)
 
 
 def _num(s: str):

@@ -218,3 +218,43 @@ def test_plan_cache_invalidation_dense_domain():
     rows = s.query(q)
     assert [r["k"] for r in rows] == [1, 2, 99]
     assert rows[-1]["s"] == 30
+
+
+def test_window_functions_sql(sess):
+    rows = sess.query(
+        "SELECT id, v, ROW_NUMBER() OVER (PARTITION BY g ORDER BY v) rn, "
+        "SUM(v) OVER (PARTITION BY g) tot "
+        "FROM t WHERE v IS NOT NULL AND g IS NOT NULL ORDER BY id")
+    by_id = {r["id"]: r for r in rows}
+    assert by_id[1]["rn"] == 1 and by_id[3]["rn"] == 2       # g='a': v=10,30
+    assert by_id[1]["tot"] == 40.0 and by_id[3]["tot"] == 40.0
+    assert by_id[2]["rn"] == 1 and by_id[2]["tot"] == 20.0   # g='b' live row
+
+
+def test_window_running_and_rank_sql(sess):
+    rows = sess.query(
+        "SELECT id, SUM(v) OVER (PARTITION BY g ORDER BY id) run, "
+        "RANK() OVER (ORDER BY v DESC) rk "
+        "FROM t WHERE v IS NOT NULL ORDER BY id")
+    by_id = {r["id"]: r for r in rows}
+    assert by_id[1]["run"] == 10.0 and by_id[3]["run"] == 40.0
+    assert by_id[4]["rk"] == 1   # v=40 highest
+
+
+def test_window_words_usable_as_identifiers():
+    """Regression: OVER/PARTITION/ROW/etc are contextual, not reserved
+    (caught in round-1 code review)."""
+    s = Session()
+    s.execute("CREATE TABLE kwids (current BIGINT, row BIGINT, range BIGINT, "
+              "partition BIGINT, over BIGINT)")
+    s.execute("INSERT INTO kwids VALUES (1, 2, 3, 4, 5)")
+    r = s.query("SELECT current, row, range, partition, over FROM kwids")
+    assert r == [{"current": 1, "row": 2, "range": 3, "partition": 4, "over": 5}]
+
+
+def test_window_arity_errors():
+    s = Session()
+    s.execute("CREATE TABLE wa (x BIGINT)")
+    s.execute("INSERT INTO wa VALUES (1)")
+    with pytest.raises(Exception):
+        s.query("SELECT FIRST_VALUE() OVER () FROM wa")

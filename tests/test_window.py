@@ -1,0 +1,139 @@
+"""Window kernel tests (reference: window_fn_call.cpp coverage), golden-
+checked against hand-computed partitions."""
+
+import numpy as np
+import pyarrow as pa
+
+from baikaldb_tpu import ColumnBatch
+from baikaldb_tpu.ops.sort import SortKey
+from baikaldb_tpu.ops.window import WinSpec, window_compute
+
+
+def make():
+    return ColumnBatch.from_arrow(pa.table({
+        "p": pa.array([1, 2, 1, 2, 1, 1], type=pa.int64()),
+        "o": pa.array([10, 5, 20, 5, 20, 30], type=pa.int64()),
+        "v": pa.array([1.0, 2.0, None, 4.0, 5.0, 6.0], type=pa.float64()),
+    }))
+
+
+def run(specs, order=None):
+    b = make()
+    out = window_compute(b, ["p"], order or [SortKey("o", True)], specs)
+    return out.to_arrow().to_pylist()
+
+
+def test_row_number_rank_dense():
+    rows = run([WinSpec("row_number", None, "rn"),
+                WinSpec("rank", None, "rk"),
+                WinSpec("dense_rank", None, "dr")])
+    # partition p=1 ordered by o: rows (o=10,20,20,30); p=2: (5,5)
+    by = {(r["p"], r["o"], r["v"]): r for r in rows}
+    assert by[(1, 10, 1.0)]["rn"] == 1 and by[(1, 10, 1.0)]["rk"] == 1
+    p1_20 = [r for r in rows if r["p"] == 1 and r["o"] == 20]
+    assert sorted(r["rn"] for r in p1_20) == [2, 3]
+    assert all(r["rk"] == 2 for r in p1_20)
+    assert all(r["dr"] == 2 for r in p1_20)
+    assert by[(1, 30, 6.0)]["rk"] == 4 and by[(1, 30, 6.0)]["dr"] == 3
+    p2 = [r for r in rows if r["p"] == 2]
+    assert sorted(r["rn"] for r in p2) == [1, 2]
+    assert all(r["rk"] == 1 for r in p2)
+
+
+def test_partition_aggregates():
+    rows = run([WinSpec("sum", "v", "s"), WinSpec("count", "v", "c"),
+                WinSpec("avg", "v", "a"), WinSpec("min", "v", "mn"),
+                WinSpec("max", "v", "mx")])
+    for r in rows:
+        if r["p"] == 1:
+            assert r["s"] == 12.0 and r["c"] == 3      # NULL skipped
+            assert abs(r["a"] - 4.0) < 1e-9
+            assert r["mn"] == 1.0 and r["mx"] == 6.0
+        else:
+            assert r["s"] == 6.0 and r["c"] == 2
+
+
+def test_running_sum_count():
+    rows = run([WinSpec("sum", "v", "rs", running=True),
+                WinSpec("count", "v", "rc", running=True)])
+    p1 = sorted([r for r in rows if r["p"] == 1], key=lambda r: (r["o"], r["rc"]))
+    # o=10 (v=1), o=20 (v=NULL), o=20 (v=5) [insertion order], o=30 (v=6)
+    assert p1[0]["rs"] == 1.0
+    assert p1[-1]["rs"] == 12.0 and p1[-1]["rc"] == 3
+
+
+def test_running_min():
+    rows = run([WinSpec("min", "v", "rm", running=True)])
+    p1 = sorted([r for r in rows if r["p"] == 1], key=lambda r: r["o"])
+    assert p1[0]["rm"] == 1.0 and p1[-1]["rm"] == 1.0
+    p2 = [r for r in rows if r["p"] == 2]
+    assert all(r["rm"] == 2.0 or r["rm"] == 2.0 for r in p2)
+
+
+def test_lead_lag():
+    rows = run([WinSpec("lag", "o", "lg", offset=1),
+                WinSpec("lead", "o", "ld", offset=1),
+                WinSpec("lag", "o", "lgd", offset=1, default=-1)])
+    by_rn = {}
+    out = window_compute(make(), ["p"], [SortKey("o", True)],
+                         [WinSpec("row_number", None, "rn"),
+                          WinSpec("lag", "o", "lg", offset=1)])
+    rows2 = out.to_arrow().to_pylist()
+    p1 = sorted([r for r in rows2 if r["p"] == 1], key=lambda r: r["rn"])
+    assert p1[0]["lg"] is None and p1[1]["lg"] == 10
+    # defaults fill out-of-partition lags
+    for r in rows:
+        if r["p"] == 2 and r["lg"] is None:
+            assert r["lgd"] == -1
+
+
+def test_first_last_value():
+    rows = run([WinSpec("first_value", "o", "fv"),
+                WinSpec("last_value", "o", "lv")])
+    for r in rows:
+        if r["p"] == 1:
+            assert r["fv"] == 10 and r["lv"] == 30
+        else:
+            assert r["fv"] == 5 and r["lv"] == 5
+
+
+def test_ntile():
+    b = ColumnBatch.from_arrow(pa.table({
+        "p": [1] * 5, "o": [1, 2, 3, 4, 5]}))
+    out = window_compute(b, ["p"], [SortKey("o", True)],
+                         [WinSpec("ntile", None, "t", n=2)])
+    rows = sorted(out.to_arrow().to_pylist(), key=lambda r: r["o"])
+    assert [r["t"] for r in rows] == [1, 1, 1, 2, 2]
+
+
+def test_window_respects_sel():
+    import jax.numpy as jnp
+    b = make().and_sel(jnp.asarray([True, True, False, True, True, True]))
+    out = window_compute(b, ["p"], [SortKey("o", True)],
+                         [WinSpec("count", "v", "c")])
+    rows = out.to_arrow().to_pylist()
+    for r in rows:
+        if r["p"] == 1:
+            assert r["c"] == 3  # v NULL row was the filtered one; 1,5,6 remain
+
+
+def test_last_value_default_frame_is_current_row():
+    """Regression: ordered LAST_VALUE uses the default running frame (current
+    row), not the partition end (caught in round-1 code review)."""
+    b = ColumnBatch.from_arrow(pa.table({"p": [1, 1, 1], "o": [1, 2, 3],
+                                         "v": [10, 20, 30]}))
+    out = window_compute(b, ["p"], [SortKey("o", True)],
+                         [WinSpec("last_value", "v", "lv", running=True),
+                          WinSpec("last_value", "v", "lvf", running=False)])
+    rows = sorted(out.to_arrow().to_pylist(), key=lambda r: r["o"])
+    assert [r["lv"] for r in rows] == [10, 20, 30]
+    assert [r["lvf"] for r in rows] == [30, 30, 30]
+
+
+def test_lag_string_default():
+    b = ColumnBatch.from_arrow(pa.table({"p": [1, 1], "o": [1, 2],
+                                         "s": ["x", "y"]}))
+    out = window_compute(b, ["p"], [SortKey("o", True)],
+                         [WinSpec("lag", "s", "lg", offset=1, default="none")])
+    rows = sorted(out.to_arrow().to_pylist(), key=lambda r: r["o"])
+    assert [r["lg"] for r in rows] == ["none", "x"]
