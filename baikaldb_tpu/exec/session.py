@@ -30,7 +30,7 @@ from ..sql.parser import parse_sql
 from ..sql.stmt import (CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
                         DescribeStmt, DropDatabaseStmt, DropTableStmt,
                         ExplainStmt, InsertStmt, SelectStmt, ShowStmt,
-                        TruncateStmt, UpdateStmt, UseStmt)
+                        TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
 from ..storage.column_store import TableStore
 from ..types import Field, LType, Schema
 from .executor import compile_plan
@@ -90,6 +90,10 @@ class Session:
         self.db = db or Database()
         self.current_db = database
         self._plan_cache: dict = {}
+        # active SQL transaction: table_key -> pre-txn snapshot (copy-on-write
+        # at the column tier; the row tier has its own Txn machinery —
+        # storage/rowstore.py)
+        self._txn_backup: Optional[dict] = None
 
     # -- public API -------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -106,6 +110,11 @@ class Session:
 
     # -- dispatch -----------------------------------------------------------
     def _execute_stmt(self, s) -> Result:
+        # DDL implicitly commits any open transaction (MySQL semantics);
+        # rolling back across a schema change is not supported
+        if isinstance(s, (CreateTableStmt, DropTableStmt, CreateDatabaseStmt,
+                          DropDatabaseStmt, TruncateStmt)):
+            self._txn_backup = None
         if isinstance(s, SelectStmt):
             return self._select(s)
         if isinstance(s, ExplainStmt):
@@ -141,6 +150,8 @@ class Session:
                 raise PlanError(f"unknown database {s.database!r}")
             self.current_db = s.database
             return Result()
+        if isinstance(s, TxnStmt):
+            return self._txn_stmt(s)
         if isinstance(s, ShowStmt):
             if s.what == "databases":
                 names = self.db.catalog.databases()
@@ -184,6 +195,35 @@ class Session:
             self.db.stores[key] = TableStore(info)
         return self.db.stores[key]
 
+    # -- transactions ------------------------------------------------------
+    def _txn_stmt(self, s: TxnStmt) -> Result:
+        """BEGIN/COMMIT/ROLLBACK (reference: transaction_planner.cpp +
+        TransactionNode fan-out).  Single-node semantics: copy-on-write
+        snapshots of touched tables, restored on ROLLBACK."""
+        if s.kind == "begin":
+            # a new BEGIN implicitly commits any previous txn (MySQL behavior)
+            self._txn_backup = {}
+            return Result()
+        if self._txn_backup is None:
+            return Result()      # COMMIT/ROLLBACK outside txn: no-op
+        if s.kind == "rollback":
+            for key, snap in self._txn_backup.items():
+                store = self.db.stores.get(key)
+                if store is not None:
+                    store.truncate()
+                    if snap.num_rows:
+                        store.insert_arrow(snap)
+        self._txn_backup = None
+        return Result()
+
+    def _txn_touch(self, store: TableStore):
+        """Record a pre-image before the first mutation inside a txn."""
+        if self._txn_backup is None:
+            return
+        key = f"{store.info.database}.{store.info.name}"
+        if key not in self._txn_backup:
+            self._txn_backup[key] = store.snapshot()
+
     def load_arrow(self, table_name: str, table: pa.Table,
                    database: str | None = None) -> int:
         """Bulk ingest (the importer/fast_importer analog, src/tools/importer):
@@ -192,6 +232,7 @@ class Session:
         from ..sql.stmt import TableRef
 
         store = self._store(TableRef(database, table_name))
+        self._txn_touch(store)
         store.insert_arrow(table)
         return table.num_rows
 
@@ -219,6 +260,7 @@ class Session:
     # -- DML --------------------------------------------------------------
     def _insert(self, s: InsertStmt) -> Result:
         store = self._store(s.table)
+        self._txn_touch(store)
         schema = store.info.schema
         if s.select is not None:
             sub = self._select(s.select)
@@ -264,6 +306,7 @@ class Session:
 
     def _update(self, s: UpdateStmt) -> Result:
         store = self._store(s.table)
+        self._txn_touch(store)
         schema = store.info.schema
         arrow_schema = store.arrow_schema
         assigns = s.assignments
@@ -304,6 +347,7 @@ class Session:
 
     def _delete(self, s: DeleteStmt) -> Result:
         store = self._store(s.table)
+        self._txn_touch(store)
         n = store.delete_where(self._host_mask(store, s.where))
         return Result(affected_rows=n)
 

@@ -1,0 +1,333 @@
+"""Meta service: cluster topology, region registry, heartbeats, balancing, TSO.
+
+The reference's meta server (src/meta_server) is a Raft-replicated set of
+manager singletons: ClusterManager (instances/rooms/placement,
+cluster_manager.cpp), RegionManager (peer/leader balance, dead-store
+migration, region_manager.cpp), TableManager (schema + region ranges), and a
+TSO state machine (tso_state_machine.cpp — hybrid physical/logical
+timestamps).  Round-1 build: the same control loops as an in-process service
+with explicit request/response dataclasses (the proto contract), so the
+frontends/stores interact with it exactly the way they would over RPC; the
+Raft replication of the meta state itself lands with the multi-host tier.
+
+Balancing mirrors the reference's decisions (not its code): instances are
+marked FAULTY after missing `faulty_after` seconds of heartbeats and DEAD
+after `dead_after`; dead peers migrate to the least-loaded healthy instance
+in the same resource tag (room-diverse when possible); peer/leader counts
+rebalance toward the mean.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+NORMAL, FAULTY, DEAD, MIGRATE = "NORMAL", "FAULTY", "DEAD", "MIGRATE"
+
+
+@dataclass
+class InstanceInfo:
+    """A store node (reference: pb::InstanceInfo, meta.interface.proto)."""
+    address: str
+    resource_tag: str = ""
+    logical_room: str = ""
+    capacity: int = 100_000
+    status: str = NORMAL
+    last_heartbeat: float = 0.0
+    used: int = 0
+
+
+@dataclass
+class RegionMeta:
+    """One region's metadata (reference: pb::RegionInfo,
+    meta.interface.proto:353)."""
+    region_id: int
+    table_id: int
+    start_row: int = 0            # row-range partitioning of the row axis
+    end_row: int = -1             # -1 = unbounded
+    peers: list[str] = field(default_factory=list)
+    leader: str = ""
+    version: int = 1
+    num_rows: int = 0
+
+
+@dataclass
+class HeartbeatRequest:
+    """store -> meta (reference: StoreHeartBeatRequest,
+    meta.interface.proto:743)."""
+    address: str
+    regions: dict[int, tuple[int, int]] = field(default_factory=dict)
+    # region_id -> (version, num_rows)
+    leader_ids: list[int] = field(default_factory=list)
+
+
+@dataclass
+class BalanceOrder:
+    kind: str                     # add_peer | remove_peer | trans_leader
+    region_id: int
+    target: str = ""
+    source: str = ""
+
+
+@dataclass
+class HeartbeatResponse:
+    orders: list[BalanceOrder] = field(default_factory=list)
+    schema_version: int = 0
+
+
+class Tso:
+    """Hybrid timestamp oracle (reference: tso_state_machine.cpp — physical ms
+    << 18 | logical, batched, monotonic across restarts via save-ahead)."""
+
+    LOGICAL_BITS = 18
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._last_physical = 0
+        self._logical = 0
+        self._save_ahead_ms = 3000
+        self._saved_max = 0
+
+    def gen(self, count: int = 1) -> int:
+        """Returns the FIRST of `count` consecutive timestamps."""
+        with self._mu:
+            now = int(time.time() * 1000)
+            if now <= self._last_physical:
+                now = self._last_physical
+            else:
+                self._logical = 0
+            self._last_physical = now
+            if now + self._save_ahead_ms > self._saved_max:
+                self._saved_max = now + self._save_ahead_ms  # "persist" lease
+            first = (now << self.LOGICAL_BITS) | self._logical
+            self._logical += count
+            if self._logical >= (1 << self.LOGICAL_BITS):
+                self._last_physical += 1
+                self._logical = 0
+            return first
+
+
+class MetaService:
+    def __init__(self, faulty_after: float = 15.0, dead_after: float = 60.0,
+                 peer_count: int = 3, balance_threshold: int = 2,
+                 clock=time.monotonic):
+        self.clock = clock
+        self.faulty_after = faulty_after
+        self.dead_after = dead_after
+        self.peer_count = peer_count
+        self.balance_threshold = balance_threshold
+        self.instances: dict[str, InstanceInfo] = {}
+        self.regions: dict[int, RegionMeta] = {}
+        self.tso = Tso()
+        self.schema_version = 1
+        self._region_ids = itertools.count(1)
+        self._mu = threading.RLock()
+
+    # -- cluster ---------------------------------------------------------
+    def add_instance(self, address: str, resource_tag: str = "",
+                     logical_room: str = "") -> InstanceInfo:
+        with self._mu:
+            inst = InstanceInfo(address, resource_tag, logical_room,
+                                last_heartbeat=self.clock())
+            self.instances[address] = inst
+            return inst
+
+    def drop_instance(self, address: str):
+        """Operator drain (reference: handle migrate / cluster_manager
+        migrate handling): mark MIGRATE, future balancing moves peers away."""
+        with self._mu:
+            if address in self.instances:
+                self.instances[address].status = MIGRATE
+
+    def _healthy(self, tag: str = "") -> list[InstanceInfo]:
+        return [i for i in self.instances.values()
+                if i.status == NORMAL and (not tag or i.resource_tag == tag)]
+
+    def _peer_counts(self) -> dict[str, int]:
+        counts = {a: 0 for a in self.instances}
+        for r in self.regions.values():
+            for p in r.peers:
+                if p in counts:
+                    counts[p] += 1
+        return counts
+
+    def select_instance(self, exclude: set[str], tag: str = "",
+                        prefer_rooms_not_in: set[str] = frozenset()) -> Optional[str]:
+        """Least-loaded placement (reference: select_instance_min,
+        cluster_manager.h:165-173, with logical-room diversity)."""
+        with self._mu:
+            counts = self._peer_counts()
+            cands = [i for i in self._healthy(tag) if i.address not in exclude]
+            if not cands:
+                return None
+            diverse = [i for i in cands if i.logical_room not in prefer_rooms_not_in]
+            pool = diverse or cands
+            return min(pool, key=lambda i: counts[i.address]).address
+
+    # -- regions ---------------------------------------------------------
+    def create_regions(self, table_id: int, n_regions: int,
+                       rows_per_region: int = 1 << 20,
+                       resource_tag: str = "") -> list[RegionMeta]:
+        with self._mu:
+            out = []
+            for i in range(n_regions):
+                rid = next(self._region_ids)
+                peers: list[str] = []
+                rooms: set[str] = set()
+                for _ in range(min(self.peer_count, max(1, len(self._healthy(resource_tag))))):
+                    a = self.select_instance(set(peers), resource_tag, rooms)
+                    if a is None:
+                        break
+                    peers.append(a)
+                    rooms.add(self.instances[a].logical_room)
+                r = RegionMeta(rid, table_id, i * rows_per_region,
+                               (i + 1) * rows_per_region, peers,
+                               peers[0] if peers else "")
+                self.regions[rid] = r
+                out.append(r)
+            return out
+
+    def report_split(self, region_id: int, split_row: int) -> RegionMeta:
+        """Region split finalize (reference: split state machine,
+        region.cpp:4472/4864 — here only the meta-side registration)."""
+        with self._mu:
+            old = self.regions[region_id]
+            rid = next(self._region_ids)
+            new = RegionMeta(rid, old.table_id, split_row, old.end_row,
+                             list(old.peers), old.leader)
+            old.end_row = split_row
+            old.version += 1
+            new.version = old.version
+            self.regions[rid] = new
+            return new
+
+    def route(self, table_id: int, row: int) -> Optional[RegionMeta]:
+        """Row -> region (reference: SchemaFactory region routing)."""
+        with self._mu:
+            for r in self.regions.values():
+                if r.table_id == table_id and r.start_row <= row and \
+                        (r.end_row < 0 or row < r.end_row):
+                    return r
+            return None
+
+    # -- heartbeats + control loop ---------------------------------------
+    def heartbeat(self, req: HeartbeatRequest) -> HeartbeatResponse:
+        with self._mu:
+            inst = self.instances.get(req.address)
+            if inst is None:
+                inst = self.add_instance(req.address)
+            inst.last_heartbeat = self.clock()
+            if inst.status == FAULTY:
+                inst.status = NORMAL
+            for rid, (version, num_rows) in req.regions.items():
+                r = self.regions.get(rid)
+                if r is not None:
+                    r.num_rows = num_rows
+                    r.version = max(r.version, version)
+            for rid in req.leader_ids:
+                r = self.regions.get(rid)
+                if r is not None and req.address in r.peers:
+                    r.leader = req.address
+            resp = HeartbeatResponse(schema_version=self.schema_version)
+            resp.orders.extend(self._orders_for(req.address))
+            return resp
+
+    def tick(self) -> list[BalanceOrder]:
+        """Health check + global balancing (reference: meta background
+        threads store_healthy_check_function + *_load_balance)."""
+        with self._mu:
+            now = self.clock()
+            for inst in self.instances.values():
+                if inst.status in (DEAD, MIGRATE):
+                    continue
+                age = now - inst.last_heartbeat
+                if age > self.dead_after:
+                    inst.status = DEAD
+                elif age > self.faulty_after:
+                    inst.status = FAULTY
+            orders = []
+            orders.extend(self._migrate_dead_peers())
+            orders.extend(self._peer_balance())
+            orders.extend(self._leader_balance())
+            return orders
+
+    def _migrate_dead_peers(self) -> list[BalanceOrder]:
+        orders = []
+        for r in self.regions.values():
+            bad = [p for p in r.peers
+                   if self.instances.get(p) is None
+                   or self.instances[p].status in (DEAD, MIGRATE)]
+            for p in bad:
+                rooms = {self.instances[q].logical_room for q in r.peers
+                         if q in self.instances and q not in bad}
+                tgt = self.select_instance(set(r.peers), prefer_rooms_not_in=rooms)
+                if tgt is None:
+                    continue
+                orders.append(BalanceOrder("add_peer", r.region_id, target=tgt,
+                                           source=p))
+                orders.append(BalanceOrder("remove_peer", r.region_id, source=p))
+                r.peers = [q for q in r.peers if q != p] + [tgt]
+                if r.leader == p:
+                    r.leader = r.peers[0]
+        return orders
+
+    def _peer_balance(self) -> list[BalanceOrder]:
+        """Move peers off overloaded instances (region_manager.cpp:189)."""
+        counts = self._peer_counts()
+        healthy = [i.address for i in self._healthy()]
+        if len(healthy) < 2:
+            return []
+        avg = sum(counts[a] for a in healthy) / len(healthy)
+        orders = []
+        for addr in healthy:
+            while counts[addr] > avg + self.balance_threshold:
+                region = next((r for r in self.regions.values()
+                               if addr in r.peers), None)
+                if region is None:
+                    break
+                rooms = {self.instances[q].logical_room for q in region.peers
+                         if q in self.instances and q != addr}
+                tgt = self.select_instance(set(region.peers),
+                                           prefer_rooms_not_in=rooms)
+                if tgt is None or counts[tgt] + 1 > avg + self.balance_threshold:
+                    break
+                orders.append(BalanceOrder("add_peer", region.region_id,
+                                           target=tgt, source=addr))
+                orders.append(BalanceOrder("remove_peer", region.region_id,
+                                           source=addr))
+                region.peers = [q for q in region.peers if q != addr] + [tgt]
+                if region.leader == addr:
+                    region.leader = region.peers[0]
+                counts[addr] -= 1
+                counts[tgt] += 1
+        return orders
+
+    def _leader_balance(self) -> list[BalanceOrder]:
+        """Spread leaders evenly (region_manager.cpp:159)."""
+        healthy = {i.address for i in self._healthy()}
+        if len(healthy) < 2:
+            return []
+        lcount = {a: 0 for a in healthy}
+        for r in self.regions.values():
+            if r.leader in lcount:
+                lcount[r.leader] += 1
+        avg = sum(lcount.values()) / len(lcount)
+        orders = []
+        for r in self.regions.values():
+            if r.leader in lcount and lcount[r.leader] > avg + self.balance_threshold:
+                cands = [p for p in r.peers if p in healthy and
+                         lcount.get(p, 1 << 30) < avg]
+                if cands:
+                    tgt = min(cands, key=lambda a: lcount[a])
+                    orders.append(BalanceOrder("trans_leader", r.region_id,
+                                               target=tgt, source=r.leader))
+                    lcount[r.leader] -= 1
+                    lcount[tgt] += 1
+                    r.leader = tgt
+        return orders
+
+    def _orders_for(self, address: str) -> list[BalanceOrder]:
+        return []   # per-heartbeat piggyback orders reserved for round 2

@@ -1,0 +1,104 @@
+"""Native engine build + ctypes binding.
+
+Compiles engine.cpp to a shared library on first import (g++ is in the image;
+pybind11 is not, so the binding is a C ABI over ctypes).  Falls back cleanly
+if no compiler is available — callers check `available()`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(__file__)
+_SO = os.path.join(_HERE, "build", "libbkengine.so")
+_SRC = os.path.join(_HERE, "engine.cpp")
+
+_lock = threading.Lock()
+_lib = None
+_err: str | None = None
+
+
+def _build() -> str | None:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return None
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", _SRC, "-o", _SO]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except Exception as e:  # pragma: no cover
+        return f"{type(e).__name__}: {e}"
+    if r.returncode != 0:
+        return r.stderr[-2000:]
+    return None
+
+
+def _sig(lib):
+    c = ctypes
+    P8 = c.POINTER(c.c_uint8)
+    P64 = c.POINTER(c.c_int64)
+    lib.bk_batch_new.restype = c.c_void_p
+    lib.bk_batch_new.argtypes = [c.c_int64]
+    lib.bk_batch_free.argtypes = [c.c_void_p]
+    lib.bk_batch_append_i64.argtypes = [c.c_void_p, P64, P8, c.c_int64]
+    lib.bk_batch_append_f64.argtypes = [c.c_void_p, c.POINTER(c.c_double), P8, c.c_int64]
+    lib.bk_batch_append_bytes.argtypes = [c.c_void_p, P8, P64, P8, c.c_int64]
+    lib.bk_batch_total.restype = c.c_int64
+    lib.bk_batch_total.argtypes = [c.c_void_p]
+    lib.bk_batch_dump.argtypes = [c.c_void_p, P8, P64]
+    lib.bk_table_new.restype = c.c_void_p
+    lib.bk_table_free.argtypes = [c.c_void_p]
+    lib.bk_table_open_wal.restype = c.c_int
+    lib.bk_table_open_wal.argtypes = [c.c_void_p, c.c_char_p]
+    lib.bk_table_wal_sync.argtypes = [c.c_void_p]
+    lib.bk_table_write_batch.restype = c.c_uint64
+    lib.bk_table_write_batch.argtypes = [c.c_void_p, P8, P8, P64, P8, P64, c.c_int64]
+    lib.bk_table_snapshot.restype = c.c_uint64
+    lib.bk_table_snapshot.argtypes = [c.c_void_p]
+    lib.bk_table_get.restype = c.c_int64
+    lib.bk_table_get.argtypes = [c.c_void_p, P8, c.c_int64, c.c_uint64, P8,
+                                 c.c_int64, P64]
+    lib.bk_table_scan.restype = c.c_void_p
+    lib.bk_table_scan.argtypes = [c.c_void_p, P8, c.c_int64, P8, c.c_int64,
+                                  c.c_uint64, c.c_int64]
+    lib.bk_scan_count.restype = c.c_int64
+    lib.bk_scan_count.argtypes = [c.c_void_p]
+    lib.bk_scan_total_key_bytes.restype = c.c_int64
+    lib.bk_scan_total_key_bytes.argtypes = [c.c_void_p]
+    lib.bk_scan_total_val_bytes.restype = c.c_int64
+    lib.bk_scan_total_val_bytes.argtypes = [c.c_void_p]
+    lib.bk_scan_dump.argtypes = [c.c_void_p, P8, P64, P8, P64]
+    lib.bk_scan_free.argtypes = [c.c_void_p]
+    lib.bk_table_gc.argtypes = [c.c_void_p, c.c_uint64]
+    lib.bk_table_num_keys.restype = c.c_int64
+    lib.bk_table_num_keys.argtypes = [c.c_void_p]
+    return lib
+
+
+def get_lib():
+    """Load (building if needed) the native engine; None if unavailable."""
+    global _lib, _err
+    with _lock:
+        if _lib is not None or _err is not None:
+            return _lib
+        err = _build()
+        if err is not None:
+            _err = err
+            return None
+        try:
+            _lib = _sig(ctypes.CDLL(_SO))
+        except OSError as e:  # pragma: no cover
+            _err = str(e)
+            return None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def build_error() -> str | None:
+    get_lib()
+    return _err

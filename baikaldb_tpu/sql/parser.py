@@ -18,7 +18,7 @@ from .lexer import SqlError, Token, tokenize
 from .stmt import (ColumnDef, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
                    DescribeStmt, DropDatabaseStmt, DropTableStmt, ExplainStmt,
                    InsertStmt, JoinClause, OrderItem, SelectItem, SelectStmt,
-                   ShowStmt, TableRef, TruncateStmt, UpdateStmt, UseStmt)
+                   ShowStmt, TableRef, TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
 
 _AGG_FUNCS = {"count", "sum", "avg", "min", "max", "stddev", "std",
               "stddev_samp", "variance", "var_samp", "group_concat"}
@@ -125,6 +125,15 @@ class Parser:
         if t.value == "use":
             self.advance()
             return UseStmt(self.ident())
+        if t.value == "begin":
+            self.advance()
+            return TxnStmt("begin")
+        if t.value == "commit":
+            self.advance()
+            return TxnStmt("commit")
+        if t.value == "rollback":
+            self.advance()
+            return TxnStmt("rollback")
         if t.value == "show":
             return self.show_stmt()
         if t.value in ("describe", "desc"):

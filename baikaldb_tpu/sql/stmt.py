@@ -139,3 +139,8 @@ class DescribeStmt:
 class ExplainStmt:
     stmt: SelectStmt
     fmt: Optional[str] = None
+
+
+@dataclass
+class TxnStmt:
+    kind: str      # begin | commit | rollback

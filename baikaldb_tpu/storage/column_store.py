@@ -80,6 +80,7 @@ class TableStore:
         self.region_rows = region_rows
         self.arrow_schema = schema_to_arrow(info.schema)
         self._lock = threading.RLock()
+        self._mutations = 0
         self._next_region = 1
         self.regions: list[Region] = [Region(self._alloc_region_id(),
                                              self.arrow_schema.empty_table())]
@@ -106,8 +107,11 @@ class TableStore:
 
     @property
     def version(self) -> int:
+        """Monotonic mutation counter.  NOT derived from region versions:
+        transaction rollback rebuilds regions, and a derived version could
+        revisit an old value and alias stale device/stats caches."""
         with self._lock:
-            return sum(r.version for r in self.regions) + len(self.regions)
+            return self._mutations
 
     def device_table_batch(self) -> ColumnBatch:
         """Whole-table device batch with table-wide string dictionaries.
@@ -166,6 +170,7 @@ class TableStore:
         """Append rows (column order/type coerced to the table schema)."""
         table = _coerce(table, self.arrow_schema)
         with self._lock:
+            self._mutations += 1
             last = self.regions[-1]
             last.data = pa.concat_tables([last.data, table]).combine_chunks()
             last.version += 1
@@ -179,6 +184,7 @@ class TableStore:
         """Delete rows where host_mask_fn(pa.Table) -> bool np.ndarray."""
         deleted = 0
         with self._lock:
+            self._mutations += 1
             for r in self.regions:
                 if not r.num_rows:
                     continue
@@ -193,6 +199,7 @@ class TableStore:
         """Update rows in place: assign_fn(pa.Table, mask) -> pa.Table."""
         updated = 0
         with self._lock:
+            self._mutations += 1
             for r in self.regions:
                 if not r.num_rows:
                     continue
@@ -205,6 +212,7 @@ class TableStore:
 
     def truncate(self):
         with self._lock:
+            self._mutations += 1
             self.regions = [Region(self._alloc_region_id(),
                                    self.arrow_schema.empty_table())]
 
@@ -230,6 +238,7 @@ class TableStore:
     def load_parquet(self, directory: str):
         files = sorted(f for f in os.listdir(directory) if f.endswith(".parquet"))
         with self._lock:
+            self._mutations += 1
             self.regions = []
             for f in files:
                 t = pq.read_table(os.path.join(directory, f))

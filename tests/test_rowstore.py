@@ -1,0 +1,172 @@
+"""Row-tier tests (reference: test_key_encoder.cpp, test_table_key.cpp,
+test_rocksdb.cpp, transaction tests): key ordering, MVCC visibility, WAL
+recovery, transactions + conflicts, native/python codec agreement."""
+
+import os
+
+import numpy as np
+import pytest
+
+from baikaldb_tpu.native import available, build_error
+from baikaldb_tpu.storage import _pykeys
+from baikaldb_tpu.storage.rowstore import ConflictError, KeyCodec, RowCodec, RowTable
+from baikaldb_tpu.types import Field, LType, Schema
+
+SCHEMA = Schema((
+    Field("id", LType.INT64, nullable=False),
+    Field("name", LType.STRING),
+    Field("score", LType.FLOAT64),
+    Field("d", LType.DATE),
+))
+
+
+def test_native_engine_builds():
+    assert available(), f"native engine failed to build: {build_error()}"
+
+
+def test_key_order_preserving():
+    kc = KeyCodec(SCHEMA, ["id"])
+    vals = [-(2**62), -5, -1, 0, 1, 7, 2**62]
+    keys = kc.encode_rows([np.asarray(vals, np.int64)], [None])
+    assert keys == sorted(keys)
+
+    kcf = KeyCodec(SCHEMA, ["score"])
+    fvals = [-1e18, -2.5, -0.0, 0.0, 1e-9, 3.14, 1e18]
+    fkeys = kcf.encode_rows([np.asarray(fvals, np.float64)], [None])
+    assert fkeys == sorted(fkeys)
+
+    kcs = KeyCodec(SCHEMA, ["name"])
+    svals = ["", "a", "a\x00b", "a\x01", "ab", "b"]
+    skeys = kcs.encode_rows([np.asarray(svals, object)], [None])
+    assert skeys == sorted(skeys)
+
+
+def test_native_matches_python_encoding():
+    if not available():
+        pytest.skip("no native engine")
+    kc = KeyCodec(SCHEMA, ["id", "name"])
+    ids = np.asarray([1, -3, 7], np.int64)
+    names = np.asarray(["x", "a\x00b", ""], object)
+    valid = np.asarray([True, True, False])
+    native = kc.encode_rows([ids, names], [None, valid])
+    pyver = _pykeys.encode_rows(kc.kinds, [ids, names], [None, valid], 3)
+    assert native == pyver
+
+
+def test_row_codec_roundtrip():
+    import datetime
+
+    rc = RowCodec(SCHEMA)
+    row = {"id": 42, "name": "héllo", "score": -1.5,
+           "d": datetime.date(2024, 3, 1)}
+    assert rc.decode(rc.encode(row)) == row
+    row2 = {"id": 1, "name": None, "score": None, "d": None}
+    assert rc.decode(rc.encode(row2)) == row2
+
+
+def test_put_get_scan_mvcc():
+    t = RowTable(SCHEMA, ["id"])
+    t.put_row({"id": 1, "name": "a", "score": 1.0, "d": None})
+    s1 = t.snapshot()
+    t.put_row({"id": 1, "name": "b", "score": 2.0, "d": None})
+    t.put_row({"id": 2, "name": "c", "score": 3.0, "d": None})
+    # snapshot isolation: old snapshot sees old value and no id=2
+    assert t.get_row({"id": 1}, snapshot=s1)["name"] == "a"
+    assert t.get_row({"id": 2}, snapshot=s1) is None
+    assert t.get_row({"id": 1})["name"] == "b"
+    rows = t.scan_rows()
+    assert [r["id"] for r in rows] == [1, 2]
+    t.delete_row({"id": 1})
+    assert t.get_row({"id": 1}) is None
+    assert t.get_row({"id": 1}, snapshot=s1)["name"] == "a"  # still visible
+    assert [r["id"] for r in t.scan_rows()] == [2]
+
+
+def test_gc_collapses_versions():
+    t = RowTable(SCHEMA, ["id"])
+    for i in range(5):
+        t.put_row({"id": 7, "name": f"v{i}", "score": None, "d": None})
+    t.delete_row({"id": 8})
+    keep = t.snapshot()
+    t.gc(keep)
+    assert t.get_row({"id": 7})["name"] == "v4"
+    assert t.num_keys() == 1  # tombstone-only key collected
+
+
+def test_wal_recovery(tmp_path):
+    wal = str(tmp_path / "t.wal")
+    t = RowTable(SCHEMA, ["id"], wal_path=wal)
+    t.put_row({"id": 1, "name": "x", "score": None, "d": None})
+    t.put_row({"id": 2, "name": "y", "score": None, "d": None})
+    t.delete_row({"id": 1})
+    del t
+    t2 = RowTable(SCHEMA, ["id"], wal_path=wal)
+    assert t2.get_row({"id": 1}) is None
+    assert t2.get_row({"id": 2})["name"] == "y"
+
+
+def test_txn_commit_rollback_conflict():
+    t = RowTable(SCHEMA, ["id"])
+    t.put_row({"id": 1, "name": "base", "score": None, "d": None})
+
+    txn = t.begin()
+    txn.put_row({"id": 1, "name": "mine", "score": None, "d": None})
+    txn.put_row({"id": 5, "name": "new", "score": None, "d": None})
+    # read-your-writes inside; invisible outside until commit
+    assert txn.get_row({"id": 1})["name"] == "mine"
+    assert t.get_row({"id": 1})["name"] == "base"
+
+    # concurrent writer conflicts on the locked row
+    other = t.begin()
+    with pytest.raises(ConflictError):
+        other.put_row({"id": 1, "name": "theirs", "score": None, "d": None})
+    other.rollback()
+
+    txn.commit()
+    assert t.get_row({"id": 1})["name"] == "mine"
+    assert t.get_row({"id": 5})["name"] == "new"
+
+    # rollback leaves no trace and releases locks
+    t2 = t.begin()
+    t2.put_row({"id": 9, "name": "tmp", "score": None, "d": None})
+    t2.rollback()
+    assert t.get_row({"id": 9}) is None
+    t3 = t.begin()
+    t3.put_row({"id": 9, "name": "ok", "score": None, "d": None})
+    t3.commit()
+    assert t.get_row({"id": 9})["name"] == "ok"
+
+
+def test_txn_savepoints():
+    t = RowTable(SCHEMA, ["id"])
+    txn = t.begin()
+    txn.put_row({"id": 1, "name": "a", "score": None, "d": None})
+    sp = txn.savepoint()
+    txn.put_row({"id": 2, "name": "b", "score": None, "d": None})
+    txn.rollback_to(sp)
+    txn.commit()
+    assert t.get_row({"id": 1}) is not None
+    assert t.get_row({"id": 2}) is None
+
+
+def test_atomic_batch_is_single_seq():
+    t = RowTable(SCHEMA, ["id"])
+    txn = t.begin()
+    for i in range(10):
+        txn.put_row({"id": i, "name": str(i), "score": None, "d": None})
+    before = t.snapshot()
+    txn.commit()
+    # nothing at `before`, everything after
+    assert t.scan_rows(snapshot=before) == []
+    assert len(t.scan_rows()) == 10
+
+
+def test_composite_and_null_keys():
+    t = RowTable(SCHEMA, ["id", "name"])
+    t.put_row({"id": 1, "name": "b", "score": 1.0, "d": None})
+    t.put_row({"id": 1, "name": None, "score": 2.0, "d": None})
+    t.put_row({"id": 1, "name": "a", "score": 3.0, "d": None})
+    rows = t.scan_rows()
+    # NULL key sorts first, then 'a', then 'b'
+    assert [r["name"] for r in rows] == [None, "a", "b"]
+    assert t.get_row({"id": 1, "name": None})["score"] == 2.0

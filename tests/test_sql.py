@@ -258,3 +258,46 @@ def test_window_arity_errors():
     s.execute("INSERT INTO wa VALUES (1)")
     with pytest.raises(Exception):
         s.query("SELECT FIRST_VALUE() OVER () FROM wa")
+
+
+def test_sql_transactions():
+    s = Session()
+    s.execute("CREATE TABLE tx (a BIGINT)")
+    s.execute("INSERT INTO tx VALUES (1)")
+    s.execute("BEGIN")
+    s.execute("INSERT INTO tx VALUES (2), (3)")
+    s.execute("UPDATE tx SET a = a * 10 WHERE a = 1")
+    assert sorted(r["a"] for r in s.query("SELECT a FROM tx")) == [2, 3, 10]
+    s.execute("ROLLBACK")
+    assert [r["a"] for r in s.query("SELECT a FROM tx")] == [1]
+    s.execute("BEGIN")
+    s.execute("INSERT INTO tx VALUES (7)")
+    s.execute("COMMIT")
+    assert sorted(r["a"] for r in s.query("SELECT a FROM tx")) == [1, 7]
+    s.execute("ROLLBACK")   # outside txn: no-op
+    assert sorted(r["a"] for r in s.query("SELECT a FROM tx")) == [1, 7]
+
+
+def test_rollback_then_insert_no_stale_cache():
+    """Regression: version counter must stay monotonic across ROLLBACK so
+    device-batch caches never alias (caught in round-1 code review)."""
+    s = Session()
+    s.execute("CREATE TABLE rbc (a BIGINT)")
+    s.execute("INSERT INTO rbc VALUES (1)")
+    s.execute("BEGIN")
+    s.execute("UPDATE rbc SET a = 99")
+    assert s.query("SELECT a FROM rbc") == [{"a": 99}]   # caches at this version
+    s.execute("ROLLBACK")
+    s.execute("INSERT INTO rbc VALUES (2)")
+    assert sorted(r["a"] for r in s.query("SELECT a FROM rbc")) == [1, 2]
+
+
+def test_ddl_implicitly_commits_txn():
+    s = Session()
+    s.execute("CREATE TABLE dtx (a BIGINT)")
+    s.execute("INSERT INTO dtx VALUES (1)")
+    s.execute("BEGIN")
+    s.execute("INSERT INTO dtx VALUES (2)")
+    s.execute("CREATE TABLE other (b BIGINT)")   # DDL -> implicit commit
+    s.execute("ROLLBACK")                         # no-op now
+    assert sorted(r["a"] for r in s.query("SELECT a FROM dtx")) == [1, 2]
