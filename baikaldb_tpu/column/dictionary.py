@@ -31,12 +31,13 @@ NULL_CODE = np.int32(-1)
 class Dictionary:
     """An immutable sorted dictionary for one string column."""
 
-    __slots__ = ("values", "_id")
+    __slots__ = ("values", "_id", "_ft_index")
 
     def __init__(self, values: np.ndarray):
         # values must be sorted unique unicode/objects
         self.values = values
         self._id = id(values)
+        self._ft_index = None   # lazily-built fulltext index (index/fulltext)
 
     # -- construction ---------------------------------------------------
     @staticmethod

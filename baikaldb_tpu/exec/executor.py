@@ -31,8 +31,9 @@ from ..ops.hashagg import (AggSpec, group_aggregate_dense,
                            group_aggregate_sorted, scalar_aggregate)
 from ..ops.sort import SortKey, sort_batch, top_k
 from ..plan.nodes import (AggNode, DistinctNode, FilterNode, JoinNode,
-                          LimitNode, PlanNode, ProjectNode, ScanNode, SortNode,
-                          UnionNode, ValuesNode, WindowNode)
+                          LimitNode, MembershipNode, PlanNode, ProjectNode,
+                          ScalarSourceNode, ScanNode, SortNode, UnionNode,
+                          ValuesNode, WindowNode)
 from ..column.batch import concat_batches
 from ..types import LType
 
@@ -155,6 +156,70 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         parts = [_harmonize(p, node.schema) for p in parts]
         parts = _align_string_dicts(parts)
         return concat_batches(parts)
+
+    if isinstance(node, MembershipNode):
+        child = _eval(node.children[0], batches, overflows)
+        sub = _eval(node.children[1], batches, overflows)
+        sub_name = sub.names[0]
+        if len(sub) == 0:
+            # empty list: IN -> FALSE, NOT IN -> TRUE (no NULLs to consider)
+            n = len(child)
+            data = jnp.broadcast_to(jnp.asarray(node.negate), (n,))
+            names = list(child.names) + [node.out_name]
+            cols = list(child.columns) + [
+                Column(data, child.column(node.key_col).validity, LType.BOOL)]
+            return ColumnBatch(tuple(names), cols, child.sel, child.num_rows)
+        probe = ColumnBatch((node.key_col,), [child.column(node.key_col)],
+                            child.sel, None)
+        probe2, build2 = join_ops._align_string_keys(
+            probe, [node.key_col], sub, [sub_name])
+        xc = probe2.column(node.key_col)
+        bc = build2.column(sub_name)
+        bsel = sub.sel_mask()
+        bvalid = bc.valid_mask() & bsel
+        sentinel = (jnp.iinfo if bc.data.dtype.kind in "iu"
+                    else jnp.finfo)(bc.data.dtype).max
+        bkey = jnp.where(bvalid, bc.data, sentinel)
+        bsorted = jnp.sort(bkey)
+        nlive = jnp.sum(bvalid)
+        pos = jnp.searchsorted(bsorted, xc.data)
+        hit = (pos < nlive) & \
+            (jnp.take(bsorted, jnp.clip(pos, 0, len(sub) - 1), mode="clip")
+             == xc.data)
+        has_null_in_list = jnp.any(bsel & ~bc.valid_mask())
+        found = hit
+        if node.negate:
+            data = ~found
+        else:
+            data = found
+        # SQL three-valued IN: NULL key -> NULL; a miss with NULLs
+        # in the list -> NULL
+        validity = xc.valid_mask() & (found | ~has_null_in_list)
+        names = list(child.names) + [node.out_name]
+        cols = list(child.columns) + [Column(data, validity, LType.BOOL)]
+        return ColumnBatch(tuple(names), cols, child.sel, child.num_rows)
+
+    if isinstance(node, ScalarSourceNode):
+        child = _eval(node.children[0], batches, overflows)
+        sub = compact(_eval(node.children[1], batches, overflows))
+        n = len(child)
+        names = list(child.names)
+        cols = list(child.columns)
+        has_row = sub.live_count() > 0
+        for i, name in enumerate(node.col_names):
+            c = sub.columns[i]
+            if len(sub) == 0:
+                # zero-capacity source: constant NULL
+                v0 = jnp.zeros((), c.data.dtype)
+                val0 = jnp.asarray(False)
+            else:
+                v0 = c.data[0]
+                val0 = c.valid_mask()[0] & has_row   # empty subquery -> NULL
+            cols.append(Column(jnp.broadcast_to(v0, (n,)),
+                               jnp.broadcast_to(val0, (n,)), c.ltype,
+                               c.dictionary))
+            names.append(name)
+        return ColumnBatch(tuple(names), cols, child.sel, child.num_rows)
 
     if isinstance(node, WindowNode):
         from ..ops.window import window_compute

@@ -144,6 +144,22 @@ class WindowCall(Expr):
                 f"partition {list(self.partition_by)} order {list(self.order_by)})")
 
 
+@dataclass(frozen=True, eq=False)
+class Subquery(Expr):
+    """A (SELECT ...) appearing inside an expression: scalar subquery, or the
+    right side of IN/EXISTS (reference: ApplyNode + subquery planning,
+    src/exec/apply_node.cpp / logical_planner subquery handling).  `stmt` is a
+    sql.stmt.SelectStmt (opaque here to avoid a layer cycle)."""
+
+    stmt: Any = None
+
+    def key(self):
+        return ("subq", id(self.stmt))
+
+    def __repr__(self):
+        return "(subquery)"
+
+
 def _wrap(v) -> Expr:
     return v if isinstance(v, Expr) else Lit(v)
 

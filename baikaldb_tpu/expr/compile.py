@@ -449,6 +449,7 @@ def _default_type_rule(op, argts):
         "not": LType.BOOL, "xor": LType.BOOL, "is_null": LType.BOOL,
         "is_not_null": LType.BOOL, "like": LType.BOOL, "not_like": LType.BOOL,
         "in": LType.BOOL, "not_in": LType.BOOL, "between": LType.BOOL,
+        "match_against": LType.BOOL,
         "case_when": argts[1] if len(argts) > 1 else LType.NULL,
         "if": argts[1] if len(argts) > 1 else LType.NULL,
         "ifnull": argts[0] if argts else LType.NULL,
@@ -814,6 +815,31 @@ def _like(e, batch):
 @_raw("not_like")
 def _not_like(e, batch):
     return _like_impl(e, batch, True)
+
+
+@_raw("match_against")
+def _match_against(e, batch):
+    """MATCH(col) AGAINST('query' [IN BOOLEAN MODE]) — fulltext search.
+
+    Compiles exactly like LIKE: the inverted index (index/fulltext.py) over
+    the column's dictionary answers the boolean query host-side as a
+    per-code mask, gathered by code on device (reference: reverse index +
+    boolean executor, include/reverse/)."""
+    a = _eval(e.args[0], batch)
+    q = e.args[1]
+    if not (isinstance(q, Lit) and isinstance(q.value, str)):
+        raise ExprError("AGAINST requires a string literal")
+    boolean_mode = bool(e.args[2].value) if len(e.args) > 2 else False
+    if not (isinstance(a, Column) and a.ltype is LType.STRING
+            and a.dictionary is not None):
+        raise ExprError("MATCH requires a dictionary-encoded string column")
+    from ..index.fulltext import index_for_dictionary
+
+    ix = index_for_dictionary(a.dictionary)
+    mask = ix.query_mask(q.value, boolean_mode=boolean_mode)
+    hit = jnp.take(jnp.asarray(mask), jnp.clip(a.data, 0, None), mode="clip")
+    hit = jnp.where(a.data >= 0, hit, False)
+    return Column(hit, a.validity, LType.BOOL)
 
 
 @_raw("cast")

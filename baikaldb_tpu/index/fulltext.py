@@ -1,0 +1,151 @@
+"""Fulltext inverted index + boolean query engine.
+
+The reference builds a 3-level LSM-ish inverted index in RocksDB with
+per-term posting lists and a boolean query executor
+(include/reverse/reverse_index.h:30, boolean_engine/boolean_executor.h),
+fronted by tokenizers (char split / word segment, reverse_common.cpp).
+
+TPU-native re-design: text columns are dictionary-encoded
+(column/dictionary.py), so the index is built over the *distinct values* —
+posting lists map token -> sorted dictionary codes.  A boolean query then
+produces a bitmask over codes (tiny), and the per-row answer is one device
+gather by code: fulltext search costs O(dict) host work + O(N) device gather,
+and composes with every other predicate inside the same jitted kernel.
+
+Query syntax (MySQL boolean mode subset): bare terms (OR semantics in
+natural mode, AND in boolean mode), +term (must), -term (must not),
+"quoted phrase" (consecutive tokens).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from bisect import bisect_left
+
+import numpy as np
+
+_WORD_RE = re.compile(r"[\w]+", re.UNICODE)
+
+
+def tokenize_words(text: str) -> list[str]:
+    """Unicode word split + lowercase (the reference's simple segmenter)."""
+    return [t.lower() for t in _WORD_RE.findall(text)]
+
+
+def tokenize_ngrams(text: str, n: int = 2) -> list[str]:
+    """Character n-grams for CJK-ish text (the char-split tokenizer analog)."""
+    s = re.sub(r"\s+", "", text.lower())
+    if len(s) < n:
+        return [s] if s else []
+    return [s[i:i + n] for i in range(len(s) - n + 1)]
+
+
+class InvertedIndex:
+    """token -> sorted array of document ids (dictionary codes)."""
+
+    def __init__(self, tokenizer=tokenize_words):
+        self.tokenizer = tokenizer
+        self.postings: dict[str, np.ndarray] = {}
+        self.doc_tokens: list[list[str]] = []
+        self.n_docs = 0
+
+    @staticmethod
+    def build(values, tokenizer=tokenize_words) -> "InvertedIndex":
+        ix = InvertedIndex(tokenizer)
+        tmp: dict[str, list[int]] = {}
+        for i, v in enumerate(values):
+            toks = tokenizer("" if v is None else str(v))
+            ix.doc_tokens.append(toks)
+            for t in set(toks):
+                tmp.setdefault(t, []).append(i)
+        ix.postings = {t: np.asarray(ids, np.int32) for t, ids in tmp.items()}
+        ix.n_docs = len(ix.doc_tokens)
+        return ix
+
+    # -- retrieval -------------------------------------------------------
+    def term_docs(self, term: str) -> np.ndarray:
+        return self.postings.get(term.lower(), np.zeros(0, np.int32))
+
+    def phrase_docs(self, phrase: list[str]) -> np.ndarray:
+        """Documents containing the tokens consecutively."""
+        if not phrase:
+            return np.zeros(0, np.int32)
+        cand = self.term_docs(phrase[0])
+        for t in phrase[1:]:
+            cand = np.intersect1d(cand, self.term_docs(t))
+        out = []
+        for d in cand:
+            toks = self.doc_tokens[int(d)]
+            for i in range(len(toks) - len(phrase) + 1):
+                if toks[i:i + len(phrase)] == phrase:
+                    out.append(int(d))
+                    break
+        return np.asarray(out, np.int32)
+
+    def query_mask(self, query: str, boolean_mode: bool = False) -> np.ndarray:
+        """-> bool mask over documents (dictionary codes)."""
+        must, must_not, should = parse_boolean_query(query, self.tokenizer)
+        mask = np.zeros(self.n_docs, bool)
+        if boolean_mode:
+            # MySQL boolean mode: all +terms required; bare terms optional
+            # when +terms exist, otherwise at least one must match
+            if must:
+                mask[:] = True
+                for g in must:
+                    m = np.zeros(self.n_docs, bool)
+                    m[self._docs(g)] = True
+                    mask &= m
+            elif should:
+                for g in should:
+                    mask[self._docs(g)] = True
+        else:
+            # natural language mode: any term matches
+            for g in must + should:
+                mask[self._docs(g)] = True
+        for g in must_not:
+            mask[self._docs(g)] = False
+        return mask
+
+    def _docs(self, group) -> np.ndarray:
+        if isinstance(group, list):
+            return self.phrase_docs(group)
+        return self.term_docs(group)
+
+
+def parse_boolean_query(query: str, tokenizer):
+    """-> (must, must_not, should); phrases are token lists."""
+    must, must_not, should = [], [], []
+    for m in re.finditer(r'([+-]?)"([^"]*)"|([+-]?)(\S+)', query):
+        sign = m.group(1) or m.group(3) or ""
+        if m.group(2) is not None:
+            item = tokenizer(m.group(2))
+            if not item:
+                continue
+        else:
+            toks = tokenizer(m.group(4))
+            if not toks:
+                continue
+            item = toks[0] if len(toks) == 1 else toks
+        bucket = must if sign == "+" else must_not if sign == "-" else should
+        bucket.append(item)
+    return must, must_not, should
+
+
+# ---------------------------------------------------------------------------
+# per-dictionary index (used by the expr compiler's MATCH..AGAINST).  The
+# index hangs off the immutable Dictionary object itself, so its lifetime and
+# identity exactly track the dictionary (no id()-reuse staleness, no global
+# cache growth).
+
+_build_lock = threading.Lock()
+
+
+def index_for_dictionary(dictionary) -> InvertedIndex:
+    ix = dictionary._ft_index
+    if ix is not None:
+        return ix
+    with _build_lock:
+        if dictionary._ft_index is None:
+            dictionary._ft_index = InvertedIndex.build(dictionary.values)
+        return dictionary._ft_index

@@ -104,9 +104,11 @@ class Tso:
                 self._saved_max = now + self._save_ahead_ms  # "persist" lease
             first = (now << self.LOGICAL_BITS) | self._logical
             self._logical += count
-            if self._logical >= (1 << self.LOGICAL_BITS):
+            while self._logical >= (1 << self.LOGICAL_BITS):
+                # batch crossed into the next physical tick: carry the
+                # remainder so no timestamp in the batch is re-issued
                 self._last_physical += 1
-                self._logical = 0
+                self._logical -= 1 << self.LOGICAL_BITS
             return first
 
 

@@ -135,6 +135,32 @@ class DistinctNode(PlanNode):
 
 
 @dataclass
+class ScalarSourceNode(PlanNode):
+    """Broadcast a 1-row subplan result onto the main stream as constant
+    columns (uncorrelated scalar subquery; reference: subquery decorrelation
+    + DualScan bridging).  children = [main, subplan]."""
+    col_names: list[str] = field(default_factory=list)
+
+    def _label(self):
+        return f"ScalarSource({self.col_names})"
+
+
+@dataclass
+class MembershipNode(PlanNode):
+    """x IN (subquery) as a VALUE column (for subquery predicates nested under
+    OR/CASE/...): appends a nullable BOOL column with SQL IN semantics
+    (NULL key -> NULL; not-found with NULLs in the list -> NULL).
+    children = [main, subplan]."""
+    key_col: str = ""
+    out_name: str = ""
+    negate: bool = False
+
+    def _label(self):
+        n = "NOT IN" if self.negate else "IN"
+        return f"Membership({self.key_col} {n} subquery -> {self.out_name})"
+
+
+@dataclass
 class WindowNode(PlanNode):
     """Window functions over one (partition, order) spec (reference:
     src/exec/window_node.cpp)."""

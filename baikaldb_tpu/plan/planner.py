@@ -23,7 +23,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, WindowCall, walk
+from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, Subquery, WindowCall, walk
 from ..expr.compile import infer_type
 from ..meta.catalog import Catalog
 from ..ops.hashagg import AggSpec, agg_result_type
@@ -31,8 +31,8 @@ from ..sql.lexer import SqlError
 from ..sql.stmt import JoinClause, SelectStmt, TableRef
 from ..types import Field, LType, Schema
 from .nodes import (AggNode, DistinctNode, FilterNode, JoinNode, LimitNode,
-                    PlanNode, ProjectNode, ScanNode, SortNode, UnionNode,
-                    ValuesNode, WindowNode)
+                    MembershipNode, PlanNode, ProjectNode, ScalarSourceNode,
+                    ScanNode, SortNode, UnionNode, ValuesNode, WindowNode)
 
 MAX_DENSE_GROUPS = 1 << 20
 
@@ -47,6 +47,7 @@ class Scope:
     def __init__(self):
         self.tables: dict[str, Schema] = {}   # label -> schema (plain col names)
         self.order: list[str] = []
+        self.extras: dict[str, LType] = {}    # injected columns (subqueries)
 
     def add(self, label: str, schema: Schema):
         if label in self.tables:
@@ -63,6 +64,8 @@ class Scope:
             if name not in sch:
                 raise PlanError(f"unknown column {table}.{name}")
             return f"{table}.{name}", sch.field(name).ltype
+        if name in self.extras:
+            return name, self.extras[name]
         hits = [(lbl, self.tables[lbl]) for lbl in self.order if name in self.tables[lbl]]
         if not hits:
             raise PlanError(f"unknown column {name!r}")
@@ -87,6 +90,7 @@ class Planner:
         self.default_db = default_db
         self.stats_fn = stats_fn      # (table_key, col) -> dict | None
         self._ids = itertools.count()
+        self._ctes: dict[str, SelectStmt] = {}
 
     def _tmp(self, prefix: str) -> str:
         return f"__{prefix}{next(self._ids)}"
@@ -98,6 +102,17 @@ class Planner:
         return plan
 
     def _plan_query(self, stmt: SelectStmt) -> PlanNode:
+        # WITH scopes over the WHOLE statement including every union arm
+        if stmt.ctes:
+            saved = self._ctes
+            self._ctes = dict(saved)
+            for name, sub in stmt.ctes:
+                self._ctes[name] = sub
+            try:
+                inner = copy_stmt_without_ctes(stmt)
+                return self._plan_query(inner)
+            finally:
+                self._ctes = saved
         if stmt.union is None:
             return self._plan_single(stmt)
         # union chain: plan every arm bare, then ORDER BY/LIMIT of the head
@@ -183,11 +198,27 @@ class Planner:
 
         resolve = _Resolver(scope)
 
+        # subqueries (reference: ApplyNode + DeCorrelate pass): IN/EXISTS
+        # conjuncts become semi/anti joins; scalar subqueries become broadcast
+        # columns injected by a ScalarSourceNode
+        holder = [plan]
+        where_ast: Optional[Expr] = None
+        if stmt.where is not None:
+            for c in _conjuncts(stmt.where):
+                if self._try_subquery_conjunct(c, holder, scope, resolve):
+                    continue
+                c = self._subst_scalar(c, holder, scope)
+                where_ast = c if where_ast is None else Call("and", (where_ast, c))
+        sub_items = [self._subst_scalar(item.expr, holder, scope)
+                     if item.expr is not None else None for item in stmt.items]
+        sub_having = self._subst_scalar(stmt.having, holder, scope) \
+            if stmt.having is not None else None
+        plan = holder[0]
+
         # WHERE
-        where = resolve(stmt.where) if stmt.where is not None else None
+        where = resolve(where_ast) if where_ast is not None else None
         if where is not None:
             plan = self._push_predicates(plan, where, stmt)
-            flatf = plan.schema or flat
 
         # expand select items
         items: list[tuple[str, Expr]] = []
@@ -202,7 +233,7 @@ class Planner:
                         items.append((f.name if len(labels) == 1 else f"{lbl}.{f.name}",
                                       ColRef(f"{lbl}.{f.name}")))
             else:
-                e = resolve(item.expr)
+                e = resolve(sub_items[i])
                 items.append((item.alias or _display_name(item.expr), e))
         # de-duplicate display names
         seen: dict[str, int] = {}
@@ -216,9 +247,10 @@ class Planner:
             named_items.append((n, e))
 
         # MySQL scoping: GROUP BY / HAVING / ORDER BY may reference select
-        # aliases (reference: logical_planner name resolution)
-        alias_map = {item.alias: item.expr for item in stmt.items
-                     if item.alias and item.expr is not None}
+        # aliases (reference: logical_planner name resolution); aliases map to
+        # the scalar-substituted exprs so Subquery nodes never resurface
+        alias_map = {item.alias: se for item, se in zip(stmt.items, sub_items)
+                     if item.alias and se is not None}
 
         def subst_alias(e: Optional[Expr]) -> Optional[Expr]:
             if e is None:
@@ -249,7 +281,10 @@ class Planner:
                 if not 0 <= idx < len(named_items):
                     raise PlanError(f"GROUP BY position {g.value} out of range")
                 group_exprs[gi] = named_items[idx][1]
-        having = resolve(subst_alias(stmt.having)) if stmt.having is not None else None
+        having = resolve(subst_alias(sub_having)) if sub_having is not None else None
+        for o in stmt.order_by:
+            if any(isinstance(x, Subquery) for x in walk(o.expr)):
+                raise PlanError("subqueries in ORDER BY are not supported")
         order_items = [(resolve(subst_alias(o.expr)), o.asc) for o in stmt.order_by]
 
         has_agg = (any(_contains_agg(e) for _, e in named_items)
@@ -323,6 +358,22 @@ class Planner:
 
     # ------------------------------------------------------------------
     def _plan_table_ref(self, ref: TableRef, scope: Scope) -> PlanNode:
+        if ref.subquery is None and ref.database is None and \
+                ref.name in self._ctes:
+            # CTE reference: plan as a derived table under its label.  The
+            # CTE's own name is hidden while planning its body (non-recursive
+            # CTEs: an inner reference resolves to the real table, and a
+            # self-referencing shadow cannot recurse forever)
+            import copy
+            ref2 = copy.copy(ref)
+            ref2.subquery = self._ctes[ref.name]
+            ref2.alias = ref.alias or ref.name
+            saved = self._ctes
+            self._ctes = {k: v for k, v in saved.items() if k != ref.name}
+            try:
+                return self._plan_table_ref(ref2, scope)
+            finally:
+                self._ctes = saved
         if ref.subquery is not None:
             sub = self._plan_query(ref.subquery)
             label = ref.label
@@ -436,7 +487,7 @@ class Planner:
         def scan_label_walk(n: PlanNode):
             if isinstance(n, ScanNode):
                 scan_labels.add(n.label)
-            for c in n.children:
+            for c in _pushable_children(n):
                 scan_label_walk(c)
 
         scan_label_walk(plan)
@@ -573,6 +624,185 @@ class Planner:
             having = rewrite(having)
             plan = FilterNode(children=[plan], pred=having, schema=plan.schema)
         return plan, named_items, None, order_items
+
+    # -- subqueries ------------------------------------------------------
+    def _try_subquery_conjunct(self, c: Expr, holder, scope, resolve) -> bool:
+        """IN/NOT IN (SELECT..) and [NOT] EXISTS(SELECT..) conjuncts become
+        semi/anti joins against the subplan (the decorrelation the reference
+        does in DeCorrelate + Separate).  Returns True if handled."""
+        anti = False
+        if isinstance(c, Call) and c.op == "not" and len(c.args) == 1 and \
+                isinstance(c.args[0], Call) and c.args[0].op == "exists":
+            c = c.args[0]
+            anti = True
+        if not isinstance(c, Call):
+            return False
+        if c.op == "in_subquery":
+            # IN as a semi join is exact: NULL keys and NULL-list misses both
+            # evaluate to NULL -> dropped by WHERE, same as the join drop
+            x = resolve(c.args[0])
+            sub = c.args[1]
+            assert isinstance(sub, Subquery)
+            subplan = self._plan_query(sub.stmt)
+            if len(subplan.schema.fields) != 1:
+                raise PlanError("IN subquery must return exactly one column")
+            holder[0], key = self._ensure_col(holder[0], x)
+            rkey = subplan.schema.fields[0].name
+            jn = JoinNode(children=[holder[0], subplan], how="semi",
+                          left_keys=[key], right_keys=[rkey],
+                          schema=holder[0].schema)
+            jn.subquery_right = True
+            holder[0] = jn
+            return True
+        # NOT IN must NOT become an anti join: with a NULL in the list the
+        # predicate is NULL (row dropped); the MembershipNode value path
+        # implements that, so leave it to _subst_scalar
+        if c.op == "not_in_subquery":
+            return False
+        if c.op == "exists":
+            sub = c.args[0]
+            assert isinstance(sub, Subquery)
+            self._plan_exists(sub.stmt, holder, scope, anti)
+            return True
+        return False
+
+    def _plan_exists(self, substmt, holder, scope, anti: bool):
+        """[NOT] EXISTS: equality-correlated -> semi/anti join on the
+        correlation keys; uncorrelated -> semi/anti join on a constant key
+        (keeps the whole decision inside the jitted program)."""
+        if substmt.table is None:
+            raise PlanError("EXISTS subquery needs a FROM clause")
+        subscope = Scope()
+        subplan = self._plan_table_ref(substmt.table, subscope)
+        for j in substmt.joins:
+            subplan = self._plan_join(subplan, j, subscope, substmt)
+        inner_resolve = _Resolver(subscope)
+        outer_resolve = _Resolver(scope)
+        inner_where = None
+        pairs: list[tuple[str, str]] = []   # (outer qualified, inner qualified)
+        for c in _conjuncts(substmt.where) if substmt.where is not None else []:
+            try:
+                rc = inner_resolve(c)
+                inner_where = rc if inner_where is None else \
+                    Call("and", (inner_where, rc))
+                continue
+            except PlanError:
+                pass
+            # correlated equality: one side inner, one side outer
+            if isinstance(c, Call) and c.op == "eq" and len(c.args) == 2 and \
+                    all(isinstance(a, ColRef) for a in c.args):
+                a, b = c.args
+                for inner_e, outer_e in ((a, b), (b, a)):
+                    try:
+                        iq = inner_resolve(inner_e)
+                        oq = outer_resolve(outer_e)
+                        pairs.append((oq.name, iq.name))
+                        break
+                    except PlanError:
+                        continue
+                else:
+                    raise PlanError(f"unsupported correlated predicate {c!r}")
+                continue
+            raise PlanError(f"unsupported correlated predicate {c!r} "
+                            "(round 1 supports equality correlation)")
+        if inner_where is not None:
+            subplan = FilterNode(children=[subplan], pred=inner_where,
+                                 schema=subplan.schema)
+        how = "anti" if anti else "semi"
+        if pairs:
+            lkeys = [o for o, _ in pairs]
+            rkeys = [i for _, i in pairs]
+        else:
+            # uncorrelated: join both sides on a constant key
+            holder[0], lk = self._ensure_col(holder[0], Lit(1))
+            subplan, rk = self._ensure_col(subplan, Lit(1))
+            lkeys, rkeys = [lk], [rk]
+        jn = JoinNode(children=[holder[0], subplan], how=how,
+                      left_keys=lkeys, right_keys=rkeys,
+                      schema=holder[0].schema)
+        jn.subquery_right = True
+        holder[0] = jn
+
+    def _subst_scalar(self, e: Optional[Expr], holder, scope) -> Optional[Expr]:
+        """Replace uncorrelated scalar Subquery nodes with injected broadcast
+        columns (ScalarSourceNode)."""
+        if e is None:
+            return None
+        if isinstance(e, Subquery):
+            subplan = self._plan_query(e.stmt)
+            if len(subplan.schema.fields) != 1:
+                raise PlanError("scalar subquery must return exactly one column")
+            f0 = subplan.schema.fields[0]
+            name = self._tmp("sq")
+            subplan = ProjectNode(children=[subplan], exprs=[ColRef(f0.name)],
+                                  names=[name],
+                                  schema=Schema((Field(name, f0.ltype),)))
+            base = holder[0]
+            holder[0] = ScalarSourceNode(
+                children=[base, subplan], col_names=[name],
+                schema=Schema(tuple(list(base.schema.fields) +
+                                    [Field(name, f0.ltype)])))
+            scope.extras[name] = f0.ltype
+            return ColRef(name)
+        if isinstance(e, Call) and e.op in ("in_subquery", "not_in_subquery"):
+            # nested (non-conjunct) membership: compute as a value column
+            x = self._subst_scalar(e.args[0], holder, scope)
+            sub = e.args[1]
+            assert isinstance(sub, Subquery)
+            subplan = self._plan_query(sub.stmt)
+            if len(subplan.schema.fields) != 1:
+                raise PlanError("IN subquery must return exactly one column")
+            xr = _Resolver(scope)(x)
+            holder[0], key = self._ensure_col(holder[0], xr)
+            out = self._tmp("inq")
+            holder[0] = MembershipNode(
+                children=[holder[0], subplan], key_col=key, out_name=out,
+                negate=(e.op == "not_in_subquery"),
+                schema=Schema(tuple(list(holder[0].schema.fields) +
+                                    [Field(out, LType.BOOL)])))
+            scope.extras[out] = LType.BOOL
+            return ColRef(out)
+        if isinstance(e, Call) and e.op == "exists":
+            # nested EXISTS: uncorrelated only -> COUNT(*) > 0 scalar subquery
+            sub = e.args[0]
+            assert isinstance(sub, Subquery)
+            import copy
+            from ..sql.stmt import SelectItem
+            cnt = copy.copy(sub.stmt)
+            cnt.items = [SelectItem(AggCall("count_star", ()), "n")]
+            cnt.order_by = []
+            cnt.limit = None
+            return Call("gt", (self._subst_scalar(Subquery(cnt), holder, scope),
+                               Lit(0)))
+        if isinstance(e, AggCall):
+            return AggCall(e.op, tuple(self._subst_scalar(a, holder, scope)
+                                       for a in e.args), e.distinct)
+        if isinstance(e, WindowCall):
+            return WindowCall(e.op,
+                              tuple(self._subst_scalar(a, holder, scope)
+                                    for a in e.args),
+                              tuple(self._subst_scalar(a, holder, scope)
+                                    for a in e.partition_by),
+                              tuple((self._subst_scalar(x, holder, scope), asc)
+                                    for x, asc in e.order_by),
+                              e.running)
+        if isinstance(e, Call):
+            return Call(e.op, tuple(self._subst_scalar(a, holder, scope)
+                                    for a in e.args))
+        return e
+
+    def _ensure_col(self, plan: PlanNode, e: Expr) -> tuple[PlanNode, str]:
+        """Make expr available as a named column (hidden projection)."""
+        if isinstance(e, ColRef):
+            return plan, e.name
+        name = self._tmp("jx")
+        keep = [f.name for f in plan.schema.fields]
+        sch = Schema(tuple(list(plan.schema.fields) +
+                           [Field(name, infer_type(e, plan.schema))]))
+        plan = ProjectNode(children=[plan],
+                           exprs=[ColRef(n) for n in keep] + [e],
+                           names=keep + [name], schema=sch)
+        return plan, name
 
     def _plan_windows(self, plan, named_items, order_items):
         """Extract WindowCalls -> WindowNode(s), one per (partition, order)
@@ -802,6 +1032,8 @@ class Planner:
                 used.update(node.partition_names)
                 used.update(k for k, _ in node.order_keys)
                 used.update(s.input for s in node.specs if s.input)
+            elif isinstance(node, MembershipNode):
+                used.add(node.key_col)
             elif isinstance(node, SortNode):
                 used.update(k for k, _ in node.keys)
             for c in node.children:
@@ -898,6 +1130,17 @@ def _join_schema(left: PlanNode, right: PlanNode, how: str) -> Schema:
     return Schema(tuple(fields))
 
 
+def _pushable_children(node: PlanNode):
+    """Children that share the outer query's row stream: subquery subplans
+    (semi/anti right sides, scalar sources) are separate scopes and must not
+    receive outer predicates even when labels collide."""
+    if isinstance(node, ScalarSourceNode):
+        return node.children[:1]
+    if isinstance(node, JoinNode) and getattr(node, "subquery_right", False):
+        return node.children[:1]
+    return node.children
+
+
 def _push_into_scans(node: PlanNode, pushed: dict[str, Expr]):
     if isinstance(node, ScanNode):
         if node.label in pushed:
@@ -907,7 +1150,7 @@ def _push_into_scans(node: PlanNode, pushed: dict[str, Expr]):
         return
     # do not push through joins' right side for left joins: planner already
     # excluded those labels
-    for c in node.children:
+    for c in _pushable_children(node):
         _push_into_scans(c, pushed)
 
 
@@ -919,6 +1162,13 @@ def _display_name(e: Expr) -> str:
     if isinstance(e, ColRef):
         return e.name.split(".")[-1] if e.table is None else e.name
     return repr(e)
+
+
+def copy_stmt_without_ctes(stmt: SelectStmt) -> SelectStmt:
+    import copy
+    s = copy.copy(stmt)
+    s.ctes = []
+    return s
 
 
 def dreplace_union(stmt: SelectStmt) -> SelectStmt:

@@ -27,7 +27,7 @@ KEYWORDS = {
     "create", "table", "database", "drop", "truncate", "alter", "add",
     "primary", "key", "unique", "index", "fulltext", "if", "show", "tables",
     "databases", "describe", "desc", "explain", "use", "begin", "commit",
-    "rollback", "div", "mod", "interval", "semi", "anti",
+    "rollback", "div", "mod", "interval", "semi", "anti", "with",
     "count", "sum", "avg", "min", "max",
 }
 

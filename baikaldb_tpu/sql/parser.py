@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, WindowCall
+from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, Subquery, WindowCall
 from .lexer import SqlError, Token, tokenize
 from .stmt import (ColumnDef, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
                    DescribeStmt, DropDatabaseStmt, DropTableStmt, ExplainStmt,
@@ -106,7 +106,7 @@ class Parser:
         t = self.peek()
         if t.kind != "KW":
             raise SqlError(f"expected statement, got {t.value!r} at {t.pos}")
-        if t.value == "select":
+        if t.value in ("select", "with"):
             return self.select_stmt()
         if t.value in ("insert", "replace"):
             return self.insert_stmt()
@@ -158,7 +158,19 @@ class Parser:
 
         ORDER BY / LIMIT after a UNION bind to the WHOLE union (MySQL), so
         they are parsed once here, after the union chain."""
+        ctes: list = []
+        if self.try_kw("with"):
+            while True:
+                name = self.ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                sub = self.select_stmt()
+                self.expect_op(")")
+                ctes.append((name, sub))
+                if not self.try_op(","):
+                    break
         stmt = self._select_core()
+        stmt.ctes = ctes
         tail = stmt
         while self.try_kw("union"):
             mode = "all" if self.try_kw("all") else "distinct"
@@ -536,6 +548,12 @@ class Parser:
                 continue
             if self.try_kw("in"):
                 self.expect_op("(")
+                if self.peek().kind == "KW" and self.peek().value == "select":
+                    sub = self.select_stmt()
+                    self.expect_op(")")
+                    e = Call("not_in_subquery" if neg else "in_subquery",
+                             (e, Subquery(sub)))
+                    continue
                 args = [e, self._in_item()]
                 while self.try_op(","):
                     args.append(self._in_item())
@@ -601,6 +619,9 @@ class Parser:
 
     def _primary(self) -> Expr:
         t = self.peek()
+        if t.kind == "IDENT" and t.value.lower() == "match" and \
+                self.peek(1).kind == "OP" and self.peek(1).value == "(":
+            return self._match_against()
         if t.kind == "NUM":
             self.advance()
             return Lit(_num(t.value))
@@ -616,6 +637,12 @@ class Parser:
                 return Lit(t.value == "true")
             if t.value == "case":
                 return self._case_expr()
+            if t.value == "exists":
+                self.advance()
+                self.expect_op("(")
+                sub = self.select_stmt()
+                self.expect_op(")")
+                return Call("exists", (Subquery(sub),))
             if t.value == "cast":
                 self.advance()
                 self.expect_op("(")
@@ -632,6 +659,10 @@ class Parser:
             if t.value == "if":
                 return self._call_or_ident()
         if self.try_op("("):
+            if self.peek().kind == "KW" and self.peek().value == "select":
+                sub = self.select_stmt()
+                self.expect_op(")")
+                return Subquery(sub)
             e = self.expr()
             self.expect_op(")")
             return e
@@ -729,6 +760,32 @@ class Parser:
         if not self._try_ctx(word):
             t = self.peek()
             raise SqlError(f"expected {word.upper()!r}, got {t.value!r} at {t.pos}")
+
+    def _match_against(self) -> Expr:
+        """MATCH (col) AGAINST ('query' [IN NATURAL LANGUAGE MODE |
+        IN BOOLEAN MODE])"""
+        self.advance()                      # match
+        self.expect_op("(")
+        col_e = self.expr()
+        self.expect_op(")")
+        t = self.peek()
+        if not (t.kind == "IDENT" and t.value.lower() == "against"):
+            raise SqlError(f"expected AGAINST at {t.pos}")
+        self.advance()
+        self.expect_op("(")
+        q = self.peek()
+        if q.kind != "STR":
+            raise SqlError(f"AGAINST requires a string literal at {q.pos}")
+        self.advance()
+        boolean_mode = False
+        if self.try_kw("in"):
+            mode_words = []
+            while self.peek().kind == "IDENT" or (self.peek().kind == "KW" and
+                                                  self.peek().value == "natural"):
+                mode_words.append(self.advance().value.lower())
+            boolean_mode = "boolean" in mode_words
+        self.expect_op(")")
+        return Call("match_against", (col_e, Lit(q.value), Lit(boolean_mode)))
 
     def _maybe_over(self, op: str, args: tuple):
         """Parse an optional OVER(...) clause -> WindowCall or None.

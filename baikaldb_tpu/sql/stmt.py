@@ -55,6 +55,7 @@ class SelectStmt:
     offset: int = 0
     distinct: bool = False
     union: Optional[tuple[str, "SelectStmt"]] = None  # ("all"|"distinct", rhs)
+    ctes: list[tuple[str, "SelectStmt"]] = field(default_factory=list)
 
 
 @dataclass

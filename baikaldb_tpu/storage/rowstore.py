@@ -406,14 +406,15 @@ class Txn:
         self._writes[key] = (1, b"")
 
     def savepoint(self) -> int:
-        self._savepoints.append(len(self._order))
+        # snapshot the whole buffered write set: a later write to a key first
+        # written BEFORE the savepoint must roll back to the earlier value
+        self._savepoints.append((dict(self._writes), list(self._order)))
         return len(self._savepoints) - 1
 
     def rollback_to(self, sp: int):
-        keep = self._savepoints[sp]
-        for k in self._order[keep:]:
-            del self._writes[k]
-        del self._order[keep:]
+        writes, order = self._savepoints[sp]
+        self._writes = dict(writes)
+        self._order = list(order)
         del self._savepoints[sp:]
 
     def commit(self) -> int:

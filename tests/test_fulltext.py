@@ -1,0 +1,56 @@
+"""Fulltext index tests (reference: test_reverse_common*.cpp — tokenizers,
+posting lists, boolean query semantics) + MATCH..AGAINST through SQL."""
+
+import numpy as np
+
+from baikaldb_tpu.exec.session import Session
+from baikaldb_tpu.index.fulltext import (InvertedIndex, tokenize_ngrams,
+                                         tokenize_words)
+
+
+def test_tokenizers():
+    assert tokenize_words("Hello, World! x2") == ["hello", "world", "x2"]
+    assert tokenize_ngrams("abcd", 2) == ["ab", "bc", "cd"]
+    assert tokenize_ngrams("a", 2) == ["a"]
+
+
+def test_postings_and_phrase():
+    docs = ["the quick brown fox", "quick blue hare", "lazy brown dog",
+            "the fox is quick"]
+    ix = InvertedIndex.build(docs)
+    assert ix.term_docs("quick").tolist() == [0, 1, 3]
+    assert ix.term_docs("missing").tolist() == []
+    assert ix.phrase_docs(["quick", "brown"]).tolist() == [0]
+    assert ix.phrase_docs(["brown", "fox"]).tolist() == [0]
+
+
+def test_boolean_query_modes():
+    docs = ["apple banana", "apple cherry", "banana cherry", "durian"]
+    ix = InvertedIndex.build(docs)
+    # natural mode: any term
+    assert ix.query_mask("apple banana").tolist() == [True, True, True, False]
+    # boolean: +required -excluded
+    assert ix.query_mask("+apple -cherry", True).tolist() == [True, False, False, False]
+    assert ix.query_mask("+apple +cherry", True).tolist() == [False, True, False, False]
+    assert ix.query_mask('"banana cherry"', True).tolist() == [False, False, True, False]
+
+
+def test_match_against_sql():
+    s = Session()
+    s.execute("CREATE TABLE docs (id BIGINT, body TEXT)")
+    s.execute("INSERT INTO docs VALUES "
+              "(1, 'TPU native analytical engine'), "
+              "(2, 'row store with MVCC'), "
+              "(3, 'native row codec'), "
+              "(4, NULL)")
+    rows = s.query("SELECT id FROM docs WHERE MATCH(body) AGAINST('native') ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 3]
+    rows = s.query("SELECT id FROM docs WHERE "
+                   "MATCH(body) AGAINST('+native -codec' IN BOOLEAN MODE) ORDER BY id")
+    assert [r["id"] for r in rows] == [1]
+    rows = s.query("SELECT id FROM docs WHERE "
+                   "MATCH(body) AGAINST('\"row store\"' IN BOOLEAN MODE)")
+    assert [r["id"] for r in rows] == [2]
+    # composes with other predicates in the same kernel
+    rows = s.query("SELECT id FROM docs WHERE MATCH(body) AGAINST('native') AND id > 1")
+    assert [r["id"] for r in rows] == [3]

@@ -157,3 +157,15 @@ def test_heartbeat_updates_region_state():
     assert m.regions[rid].num_rows == 12345
     assert m.regions[rid].version == 5
     assert m.regions[rid].leader == leader
+
+
+def test_tso_batch_overflow_no_duplicates():
+    """Regression: a batch crossing the logical-counter boundary must not
+    re-issue timestamps (caught in round-1 code review)."""
+    m, _ = make_cluster(1)
+    m.tso._logical = (1 << 18) - 2
+    m.tso._last_physical = 10**10
+    import time as _t
+    first = m.tso.gen(count=10)
+    nxt = m.tso.gen()
+    assert nxt >= first + 10

@@ -170,3 +170,17 @@ def test_composite_and_null_keys():
     # NULL key sorts first, then 'a', then 'b'
     assert [r["name"] for r in rows] == [None, "a", "b"]
     assert t.get_row({"id": 1, "name": None})["score"] == 2.0
+
+
+def test_savepoint_restores_overwritten_key():
+    """Regression: a key written before AND after a savepoint must roll back
+    to the pre-savepoint value (caught in round-1 code review)."""
+    t = RowTable(SCHEMA, ["id"])
+    txn = t.begin()
+    txn.put_row({"id": 1, "name": "v1", "score": None, "d": None})
+    sp = txn.savepoint()
+    txn.put_row({"id": 1, "name": "v2", "score": None, "d": None})
+    txn.rollback_to(sp)
+    assert txn.get_row({"id": 1})["name"] == "v1"
+    txn.commit()
+    assert t.get_row({"id": 1})["name"] == "v1"

@@ -1,0 +1,118 @@
+"""Subquery tests (reference: ApplyNode / DeCorrelate / subquery planning in
+logical_planner.cpp): IN/NOT IN subqueries, [NOT] EXISTS with equality
+correlation, scalar subqueries."""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Session
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = Session()
+    s.execute("CREATE TABLE o (id BIGINT, cust VARCHAR(8), amt DOUBLE)")
+    s.execute("INSERT INTO o VALUES (1,'a',10),(2,'b',20),(3,'a',30),(4,'c',40)")
+    s.execute("CREATE TABLE c (name VARCHAR(8), vip BIGINT)")
+    s.execute("INSERT INTO c VALUES ('a',1),('b',0)")
+    return s
+
+
+def test_in_subquery(sess):
+    rows = sess.query("SELECT id FROM o WHERE cust IN (SELECT name FROM c) ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 2, 3]
+    rows = sess.query("SELECT id FROM o WHERE cust IN "
+                      "(SELECT name FROM c WHERE vip = 1) ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 3]
+
+
+def test_not_in_subquery(sess):
+    rows = sess.query("SELECT id FROM o WHERE cust NOT IN (SELECT name FROM c) ORDER BY id")
+    assert [r["id"] for r in rows] == [4]
+
+
+def test_exists_correlated(sess):
+    rows = sess.query("SELECT id FROM o WHERE EXISTS "
+                      "(SELECT 1 FROM c WHERE c.name = o.cust AND c.vip = 1) ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 3]
+    rows = sess.query("SELECT id FROM o WHERE NOT EXISTS "
+                      "(SELECT 1 FROM c WHERE c.name = o.cust) ORDER BY id")
+    assert [r["id"] for r in rows] == [4]
+
+
+def test_exists_uncorrelated(sess):
+    rows = sess.query("SELECT id FROM o WHERE EXISTS (SELECT 1 FROM c WHERE vip = 9)")
+    assert rows == []
+    rows = sess.query("SELECT id FROM o WHERE EXISTS (SELECT 1 FROM c) ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 2, 3, 4]
+
+
+def test_scalar_subquery_where(sess):
+    rows = sess.query("SELECT id FROM o WHERE amt > (SELECT AVG(amt) FROM o) ORDER BY id")
+    assert [r["id"] for r in rows] == [3, 4]   # avg = 25
+
+
+def test_scalar_subquery_select_item(sess):
+    rows = sess.query("SELECT id, amt - (SELECT MIN(amt) FROM o) d FROM o ORDER BY id")
+    assert [r["d"] for r in rows] == [0.0, 10.0, 20.0, 30.0]
+
+
+def test_scalar_subquery_empty_is_null(sess):
+    rows = sess.query("SELECT id FROM o WHERE amt > (SELECT amt FROM o WHERE id = 99)")
+    assert rows == []
+
+
+def test_subquery_label_collision_no_pushdown_leak(sess):
+    # outer filter on o must not leak into the inner scan of the same table
+    rows = sess.query("SELECT id FROM o WHERE amt > 15 AND id IN "
+                      "(SELECT id FROM o) ORDER BY id")
+    assert [r["id"] for r in rows] == [2, 3, 4]
+
+
+def test_cte(sess):
+    rows = sess.query(
+        "WITH big AS (SELECT id, amt FROM o WHERE amt >= 20), "
+        "     vips AS (SELECT name FROM c WHERE vip = 1) "
+        "SELECT b.id FROM big b JOIN o ON b.id = o.id "
+        "WHERE o.cust IN (SELECT name FROM vips) ORDER BY b.id")
+    assert [r["id"] for r in rows] == [3]
+    rows = sess.query("WITH t2 AS (SELECT COUNT(*) n FROM o) SELECT n FROM t2")
+    assert rows == [{"n": 4}]
+
+
+def test_not_in_subquery_null_semantics():
+    """SQL: x NOT IN (list containing NULL) is NULL -> no rows."""
+    s = Session()
+    s.execute("CREATE TABLE n1 (x BIGINT)")
+    s.execute("INSERT INTO n1 VALUES (1),(2)")
+    s.execute("CREATE TABLE n2 (x BIGINT)")
+    s.execute("INSERT INTO n2 VALUES (1),(NULL)")
+    assert s.query("SELECT x FROM n1 WHERE x NOT IN (SELECT x FROM n2)") == []
+    s.execute("DELETE FROM n2 WHERE x IS NULL")
+    assert s.query("SELECT x FROM n1 WHERE x NOT IN (SELECT x FROM n2)") == [{"x": 2}]
+
+
+def test_in_subquery_under_or(sess):
+    """Regression: subquery predicates nested under OR use the membership
+    value path (caught in round-1 verification)."""
+    rows = sess.query("SELECT id FROM o WHERE id IN (SELECT vip FROM c) "
+                      "OR amt > 35 ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 4]   # vip values {1,0}; amt 40
+
+
+def test_cte_over_union_and_self_shadow(sess):
+    rows = sess.query("WITH cc AS (SELECT id FROM o WHERE id <= 2) "
+                      "SELECT id FROM cc UNION ALL SELECT id FROM cc ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 1, 2, 2]
+    # CTE shadowing the table it reads: inner name = real table, no recursion
+    rows = sess.query("WITH o AS (SELECT id FROM o WHERE id = 1) SELECT id FROM o")
+    assert rows == [{"id": 1}]
+
+
+def test_empty_table_subqueries(sess):
+    s2 = Session(sess.db)
+    s2.execute("CREATE TABLE IF NOT EXISTS empty_t (x BIGINT)")
+    rows = s2.query("SELECT id FROM o WHERE amt > (SELECT AVG(x) FROM empty_t)")
+    assert rows == []
+    rows = s2.query("SELECT id FROM o WHERE id NOT IN (SELECT x FROM empty_t) "
+                    "ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 2, 3, 4]
