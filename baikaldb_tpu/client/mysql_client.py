@@ -1,0 +1,181 @@
+"""Minimal MySQL-protocol client (the baikal-client SDK analog).
+
+The reference ships a C++ SDK over libmariadb with service discovery and
+connection pools (baikal-client/).  Round 1 provides the protocol core: a
+pure-python client that speaks protocol 41 text mode against any MySQL-
+compatible server (including server/mysql_server.py), plus a tiny connection
+pool.  Service discovery against the meta service arrives with the
+distributed deployment tier.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from ..server.mysql_server import Packets, lenenc_int
+
+
+class MySQLError(RuntimeError):
+    def __init__(self, code: int, msg: str):
+        super().__init__(f"({code}) {msg}")
+        self.code = code
+
+
+def _read_lenenc(data: bytes, pos: int) -> tuple[Optional[int], int]:
+    b = data[pos]
+    if b < 0xFB:
+        return b, pos + 1
+    if b == 0xFB:
+        return None, pos + 1
+    if b == 0xFC:
+        return struct.unpack_from("<H", data, pos + 1)[0], pos + 3
+    if b == 0xFD:
+        return int.from_bytes(data[pos + 1:pos + 4], "little"), pos + 4
+    return struct.unpack_from("<Q", data, pos + 1)[0], pos + 9
+
+
+@dataclass
+class QueryResult:
+    columns: list[str]
+    rows: list[tuple]
+    affected_rows: int = 0
+
+
+class Connection:
+    def __init__(self, host: str = "127.0.0.1", port: int = 3306,
+                 user: str = "root", database: str = ""):
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.p = Packets(self.sock)
+        self._handshake(user, database)
+
+    def _handshake(self, user: str, database: str):
+        greet = self.p.read()
+        if greet is None:
+            raise ConnectionError("no handshake from server")
+        if greet[0] == 0xFF:
+            raise MySQLError(struct.unpack_from("<H", greet, 1)[0],
+                             greet[9:].decode(errors="replace"))
+        caps = 0x00000200 | 0x00008000 | 0x00000001      # PROTOCOL_41|SECURE|LONG_PW
+        if database:
+            caps |= 0x00000008
+        payload = (struct.pack("<I", caps) + struct.pack("<I", 1 << 24) +
+                   bytes([0x21]) + b"\x00" * 23 + user.encode() + b"\x00" +
+                   bytes([0]))                            # empty auth response
+        if database:
+            payload += database.encode() + b"\x00"
+        self.p.write(payload)
+        resp = self.p.read()
+        if resp is None:
+            raise ConnectionError("server closed during auth")
+        if resp[0] == 0xFF:
+            raise MySQLError(struct.unpack_from("<H", resp, 1)[0],
+                             resp[9:].decode(errors="replace"))
+
+    def query(self, sql: str) -> QueryResult:
+        self.p.reset()
+        self.p.write(b"\x03" + sql.encode())
+        first = self.p.read()
+        if first is None:
+            raise ConnectionError("server closed")
+        if first[0] == 0xFF:
+            raise MySQLError(struct.unpack_from("<H", first, 1)[0],
+                             first[9:].decode(errors="replace"))
+        if first[0] == 0x00:                              # OK packet
+            affected, pos = _read_lenenc(first, 1)
+            return QueryResult([], [], affected or 0)
+        ncols, _ = _read_lenenc(first, 0)
+        columns = []
+        while True:
+            pkt = self.p.read()
+            if pkt is None:
+                raise ConnectionError("server closed mid result")
+            if pkt[0] == 0xFE and len(pkt) < 9:           # EOF
+                break
+            # column definition: skip catalog/schema/table/org_table, read name
+            pos = 0
+            vals = []
+            for _ in range(6):
+                ln, pos = _read_lenenc(pkt, pos)
+                vals.append(pkt[pos:pos + (ln or 0)])
+                pos += ln or 0
+            columns.append(vals[4].decode())
+        rows = []
+        while True:
+            pkt = self.p.read()
+            if pkt is None:
+                raise ConnectionError("server closed mid rows")
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            if pkt[0] == 0xFF:
+                raise MySQLError(struct.unpack_from("<H", pkt, 1)[0],
+                                 pkt[9:].decode(errors="replace"))
+            pos = 0
+            row = []
+            for _ in range(ncols):
+                if pkt[pos] == 0xFB:
+                    row.append(None)
+                    pos += 1
+                else:
+                    ln, pos = _read_lenenc(pkt, pos)
+                    row.append(pkt[pos:pos + ln].decode())
+                    pos += ln
+            rows.append(tuple(row))
+        return QueryResult(columns, rows)
+
+    def ping(self) -> bool:
+        self.p.reset()
+        self.p.write(b"\x0e")
+        r = self.p.read()
+        return r is not None and r[0] == 0x00
+
+    def close(self):
+        try:
+            self.p.reset()
+            self.p.write(b"\x01")
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Pool:
+    """Tiny connection pool (reference: baikal_client connection pools with
+    health checks; health = ping-on-borrow here)."""
+
+    def __init__(self, host: str, port: int, size: int = 4, user: str = "root"):
+        self.host, self.port, self.user = host, port, user
+        self.size = size
+        self._idle: list[Connection] = []
+        self._mu = threading.Lock()
+
+    def acquire(self) -> Connection:
+        with self._mu:
+            while self._idle:
+                c = self._idle.pop()
+                try:
+                    if c.ping():
+                        return c
+                except OSError:
+                    pass
+                c.close()
+        return Connection(self.host, self.port, self.user)
+
+    def release(self, c: Connection):
+        with self._mu:
+            if len(self._idle) < self.size:
+                self._idle.append(c)
+                return
+        c.close()
+
+    def query(self, sql: str) -> QueryResult:
+        c = self.acquire()
+        try:
+            return c.query(sql)
+        finally:
+            self.release(c)

@@ -42,29 +42,46 @@ class ExecError(RuntimeError):
     pass
 
 
-def compile_plan(plan: PlanNode) -> Callable:
-    """-> fn(table_batches: dict) -> (ColumnBatch, overflow_flags list).
+def compile_plan(plan: PlanNode, trace: bool = False) -> Callable:
+    """-> fn(table_batches: dict) -> (ColumnBatch, overflow_flags[, counts]).
 
     The returned fn is pure/traceable; wrap in jax.jit by the session.  Join
     caps live on the plan nodes (mutated by the retry loop, forcing re-trace).
-    """
+    With trace=True the result also carries per-node live-row counts — the
+    EXPLAIN ANALYZE feed (reference: TraceNode tree, include/runtime/
+    trace_state.h, surfaced via EXPLAIN FORMAT=analyze)."""
 
     join_order: list = []
+    trace_order: list = []
 
     def run(batches: dict):
         overflows: list = []
-        out = _eval(plan, batches, overflows)
+        counts: list = []
+        trace_order.clear()
+        ctx = (overflows, counts if trace else None, trace_order)
+        out = _sub(plan, batches, overflows, ctx)
         # nodes are host objects: expose them on the closure (filled at trace
         # time), return only the traced flags
         join_order.clear()
         join_order.extend(n for n, _ in overflows)
+        if trace:
+            return out, tuple(f for _, f in overflows), tuple(counts)
         return out, tuple(f for _, f in overflows)
 
     run.join_order = join_order
+    run.trace_order = trace_order
     return run
 
 
-def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
+def _eval_traced(node: PlanNode, batches: dict, ctx):
+    overflows, counts, trace_order = ctx
+    out = _eval(node, batches, overflows, ctx)
+    trace_order.append(node)
+    counts.append(out.live_count())
+    return out
+
+
+def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBatch:
     if isinstance(node, ScanNode):
         b = batches[node.table_key]
         names = tuple(f"{node.label}.{c}" for c in node.columns)
@@ -75,11 +92,11 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         return out
 
     if isinstance(node, FilterNode):
-        child = _eval(node.child(), batches, overflows)
+        child = _sub(node.child(), batches, overflows, ctx)
         return child.and_sel(eval_predicate(node.pred, child))
 
     if isinstance(node, ProjectNode):
-        child = _eval(node.child(), batches, overflows)
+        child = _sub(node.child(), batches, overflows, ctx)
         n = len(child)
         cols = []
         for e in node.exprs:
@@ -88,8 +105,8 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         return ColumnBatch(tuple(node.names), cols, child.sel, child.num_rows)
 
     if isinstance(node, JoinNode):
-        left = _eval(node.children[0], batches, overflows)
-        right = _eval(node.children[1], batches, overflows)
+        left = _sub(node.children[0], batches, overflows, ctx)
+        right = _sub(node.children[1], batches, overflows, ctx)
         if node.how == "cross":
             if node.cap is None:
                 node.cap = max(1, len(left) * len(right))
@@ -104,7 +121,7 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         return out
 
     if isinstance(node, AggNode):
-        child = _eval(node.child(), batches, overflows)
+        child = _sub(node.child(), batches, overflows, ctx)
         if not node.key_names:
             return scalar_aggregate(child, node.specs)
         shift = getattr(node, "key_shift", {}) or {}
@@ -131,12 +148,12 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         return group_aggregate_sorted(child, node.key_names, node.specs, mg)
 
     if isinstance(node, DistinctNode):
-        child = _eval(node.child(), batches, overflows)
+        child = _sub(node.child(), batches, overflows, ctx)
         mg = max(1, len(child))
         return group_aggregate_sorted(child, list(child.names), [], mg)
 
     if isinstance(node, SortNode):
-        child = _eval(node.child(), batches, overflows)
+        child = _sub(node.child(), batches, overflows, ctx)
         keys = [SortKey(k, asc) for k, asc in node.keys]
         if node.limit is not None:
             out = top_k(child, keys, node.limit + node.offset)
@@ -146,11 +163,11 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         return sort_batch(child, keys)
 
     if isinstance(node, LimitNode):
-        child = _eval(node.child(), batches, overflows)
+        child = _sub(node.child(), batches, overflows, ctx)
         return head(child, node.limit, node.offset)
 
     if isinstance(node, UnionNode):
-        parts = [compact(_eval(c, batches, overflows)) for c in node.children]
+        parts = [compact(_sub(c, batches, overflows, ctx)) for c in node.children]
         names = [f.name for f in node.schema.fields]
         parts = [p.rename(names) for p in parts]
         parts = [_harmonize(p, node.schema) for p in parts]
@@ -158,8 +175,8 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         return concat_batches(parts)
 
     if isinstance(node, MembershipNode):
-        child = _eval(node.children[0], batches, overflows)
-        sub = _eval(node.children[1], batches, overflows)
+        child = _sub(node.children[0], batches, overflows, ctx)
+        sub = _sub(node.children[1], batches, overflows, ctx)
         sub_name = sub.names[0]
         if len(sub) == 0:
             # empty list: IN -> FALSE, NOT IN -> TRUE (no NULLs to consider)
@@ -200,8 +217,8 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         return ColumnBatch(tuple(names), cols, child.sel, child.num_rows)
 
     if isinstance(node, ScalarSourceNode):
-        child = _eval(node.children[0], batches, overflows)
-        sub = compact(_eval(node.children[1], batches, overflows))
+        child = _sub(node.children[0], batches, overflows, ctx)
+        sub = compact(_sub(node.children[1], batches, overflows, ctx))
         n = len(child)
         names = list(child.names)
         cols = list(child.columns)
@@ -224,7 +241,7 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
     if isinstance(node, WindowNode):
         from ..ops.window import window_compute
 
-        child = _eval(node.child(), batches, overflows)
+        child = _sub(node.child(), batches, overflows, ctx)
         keys = [SortKey(k, asc) for k, asc in node.order_keys]
         return window_compute(child, node.partition_names, keys, node.specs)
 
@@ -237,6 +254,12 @@ def _eval(node: PlanNode, batches: dict, overflows: list) -> ColumnBatch:
         return ColumnBatch(tuple(node.names), cols)
 
     raise ExecError(f"unknown plan node {type(node).__name__}")
+
+
+def _sub(node, batches, overflows, ctx):
+    if ctx is not None and ctx[1] is not None:
+        return _eval_traced(node, batches, ctx)
+    return _eval(node, batches, overflows, ctx)
 
 
 def _broadcast(c: Column, n: int) -> Column:

@@ -12,6 +12,7 @@ compiled XLA executable.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -31,11 +32,15 @@ from ..sql.stmt import (CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
                         DescribeStmt, DropDatabaseStmt, DropTableStmt,
                         ExplainStmt, InsertStmt, SelectStmt, ShowStmt,
                         TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
-from ..storage.column_store import TableStore
+from ..storage.column_store import TableStore, schema_to_arrow
 from ..types import Field, LType, Schema
 from .executor import compile_plan
 
 MAX_JOIN_RETRIES = 4
+
+
+def _empty_info(name: str):
+    return schema_to_arrow(Catalog.INFORMATION_SCHEMA[name]).empty_table()
 
 
 def _qualify_free(e):
@@ -80,6 +85,9 @@ class Database:
     def __init__(self):
         self.catalog = Catalog()
         self.stores: dict[str, TableStore] = {}
+        # query statistics ring (reference: slow-SQL collection + print_agg_sql,
+        # network_server.h:82-107) — feeds information_schema.query_log
+        self.query_log = deque(maxlen=1000)
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]
@@ -118,6 +126,8 @@ class Session:
         if isinstance(s, SelectStmt):
             return self._select(s)
         if isinstance(s, ExplainStmt):
+            if s.fmt == "analyze":
+                return self._explain_analyze(s.stmt)
             plan = self._planner().plan_select(s.stmt)
             return Result(columns=["plan"], plan_text=plan.tree_repr(),
                           arrow=pa.table({"plan": plan.tree_repr().split("\n")}))
@@ -188,6 +198,8 @@ class Session:
 
     def _store(self, tref) -> TableStore:
         db = tref.database or self.current_db
+        if db == "information_schema":
+            raise PlanError("information_schema tables are read-only")
         key = f"{db}.{tref.name}"
         if key not in self.db.stores:
             # registers lazily in case catalog was populated externally
@@ -372,9 +384,51 @@ class Session:
         plan = entry["plan"]
         batches, shape_key = self._collect_batches(plan)
         entry["versions"] = {tk: v for tk, v, _ in shape_key}
+        t0 = time.perf_counter()
         result = self._run_plan(entry, batches, shape_key)
         table = result.to_arrow()
+        dur_ms = (time.perf_counter() - t0) * 1e3
+        if cache_key is not None:
+            self.db.query_log.append((cache_key[0], dur_ms, table.num_rows))
         return Result(columns=list(table.column_names), arrow=table)
+
+    def _explain_analyze(self, stmt: SelectStmt) -> Result:
+        """EXPLAIN ANALYZE: run the query once, report per-operator live-row
+        counts + compile/run wall time (reference: EXPLAIN FORMAT='analyze'
+        over the TraceNode tree, trace_state.h)."""
+        plan = self._planner().plan_select(stmt)
+        batches, shape_key = self._collect_batches(plan)
+        # settle join caps first (the overflow-retry loop), so traced counts
+        # describe the plan that actually runs, not a truncated first attempt
+        entry = {"plan": plan, "compiled": {}, "versions": {}}
+        self._run_plan(entry, batches, shape_key)
+        raw = compile_plan(plan, trace=True)
+        fn = jax.jit(raw)
+        t0 = time.perf_counter()
+        out, flags, counts = fn(batches)
+        jax.block_until_ready(jax.tree.leaves(counts))
+        compile_and_run = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        out, flags, counts = fn(batches)
+        jax.block_until_ready(jax.tree.leaves(counts))
+        run_time = time.perf_counter() - t1
+        by_node = {id(n): int(c) for n, c in zip(raw.trace_order, counts)}
+
+        lines: list[str] = []
+
+        def render(node: PlanNode, indent: int):
+            rows = by_node.get(id(node))
+            suffix = f"  rows={rows}" if rows is not None else ""
+            lines.append("  " * indent + node._label() + suffix)
+            for c in node.children:
+                render(c, indent + 1)
+
+        render(plan, 0)
+        lines.append(f"-- run: {run_time * 1e3:.2f} ms "
+                     f"(first incl. compile: {compile_and_run * 1e3:.2f} ms)")
+        txt = "\n".join(lines)
+        return Result(columns=["plan"], plan_text=txt,
+                      arrow=pa.table({"plan": lines}))
 
     def _collect_batches(self, plan: PlanNode):
         from ..plan.nodes import ScanNode
@@ -384,9 +438,16 @@ class Session:
 
         def walk_plan(n: PlanNode):
             if isinstance(n, ScanNode) and n.table_key not in batches:
+                db, name = n.table_key.split(".", 1)
+                if db == "information_schema":
+                    b = ColumnBatch.from_arrow(self._info_schema_table(name))
+                    batches[n.table_key] = b
+                    key_parts.append((n.table_key, -1, len(b)))
+                    for c in n.children:
+                        walk_plan(c)
+                    return
                 store = self.db.stores.get(n.table_key)
                 if store is None:
-                    db, name = n.table_key.split(".", 1)
                     info = self.db.catalog.get_table(db, name)
                     store = self.db.stores[n.table_key] = TableStore(info)
                 batches[n.table_key] = store.device_table_batch()
@@ -397,6 +458,45 @@ class Session:
 
         walk_plan(plan)
         return batches, tuple(sorted(key_parts))
+
+    def _info_schema_table(self, name: str) -> pa.Table:
+        cat = self.db.catalog
+        if name == "tables":
+            rows = []
+            for db in cat.databases():
+                for t in cat.tables(db):
+                    info = cat.get_table(db, t)
+                    st = self.db.stores.get(f"{db}.{t}")
+                    rows.append((db, t, st.num_rows if st else 0, info.version))
+            return pa.table({
+                "table_schema": [r[0] for r in rows],
+                "table_name": [r[1] for r in rows],
+                "table_rows": pa.array([r[2] for r in rows], pa.int64()),
+                "version": pa.array([r[3] for r in rows], pa.int64()),
+            }) if rows else _empty_info("tables")
+        if name == "columns":
+            rows = []
+            for db in cat.databases():
+                for t in cat.tables(db):
+                    info = cat.get_table(db, t)
+                    for f in info.schema.fields:
+                        rows.append((db, t, f.name, f.ltype.value,
+                                     "YES" if f.nullable else "NO"))
+            return pa.table({
+                "table_schema": [r[0] for r in rows],
+                "table_name": [r[1] for r in rows],
+                "column_name": [r[2] for r in rows],
+                "data_type": [r[3] for r in rows],
+                "is_nullable": [r[4] for r in rows],
+            }) if rows else _empty_info("columns")
+        if name == "query_log":
+            log = list(self.db.query_log)
+            return pa.table({
+                "query": [q for q, _, _ in log],
+                "duration_ms": pa.array([m for _, m, _ in log], pa.float64()),
+                "result_rows": pa.array([r for _, _, r in log], pa.int64()),
+            }) if log else _empty_info("query_log")
+        raise PlanError(f"unknown information_schema table {name!r}")
 
     def _run_plan(self, entry: dict, batches: dict, shape_key) -> ColumnBatch:
         plan = entry["plan"]

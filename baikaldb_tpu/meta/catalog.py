@@ -87,6 +87,8 @@ class Catalog:
     # -- namespaces / databases ----------------------------------------
     def create_database(self, name: str, namespace: str = "default",
                         if_not_exists: bool = False):
+        if name == "information_schema":
+            raise ValueError("information_schema is reserved")
         with self._lock:
             if name in self._databases:
                 if if_not_exists:
@@ -107,7 +109,7 @@ class Catalog:
 
     def databases(self) -> list[str]:
         with self._lock:
-            return sorted(self._databases)
+            return sorted(set(self._databases) | {"information_schema"})
 
     # -- tables ---------------------------------------------------------
     def create_table(self, database: str, name: str, schema: Schema,
@@ -138,7 +140,29 @@ class Catalog:
             del self._tables[key]
             self._databases[database].discard(name)
 
+    INFORMATION_SCHEMA = {
+        "tables": Schema((Field("table_schema", LType.STRING),
+                          Field("table_name", LType.STRING),
+                          Field("table_rows", LType.INT64),
+                          Field("version", LType.INT64))),
+        "columns": Schema((Field("table_schema", LType.STRING),
+                           Field("table_name", LType.STRING),
+                           Field("column_name", LType.STRING),
+                           Field("data_type", LType.STRING),
+                           Field("is_nullable", LType.STRING))),
+        "query_log": Schema((Field("query", LType.STRING),
+                             Field("duration_ms", LType.FLOAT64),
+                             Field("result_rows", LType.INT64))),
+    }
+
     def get_table(self, database: str, name: str) -> TableInfo:
+        if database == "information_schema":
+            # virtual tables rendered from catalog state (reference:
+            # src/common/information_schema.cpp)
+            sch = self.INFORMATION_SCHEMA.get(name)
+            if sch is None:
+                raise ValueError(f"unknown information_schema table {name!r}")
+            return TableInfo(0, "default", "information_schema", name, sch)
         with self._lock:
             key = f"{database}.{name}"
             if key not in self._tables:
@@ -150,6 +174,8 @@ class Catalog:
             return f"{database}.{name}" in self._tables
 
     def tables(self, database: str) -> list[str]:
+        if database == "information_schema":
+            return sorted(self.INFORMATION_SCHEMA)
         with self._lock:
             return sorted(self._databases.get(database, ()))
 

@@ -1,0 +1,26 @@
+"""`python -m baikaldb_tpu.server` — the `baikaldb` frontend binary analog
+(reference: src/protocol/main.cpp startup sequence)."""
+
+import argparse
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser(description="baikaldb_tpu MySQL-protocol server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=28000)
+    args = ap.parse_args()
+
+    from .mysql_server import MySQLServer
+
+    srv = MySQLServer(host=args.host, port=args.port).start()
+    print(f"baikaldb_tpu listening on {args.host}:{srv.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

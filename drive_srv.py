@@ -1,0 +1,24 @@
+import sys; sys.path.insert(0, "/root/repo")
+from baikaldb_tpu.client.mysql_client import Connection, MySQLError
+
+c = Connection(port=28123)
+print("ping:", c.ping())
+c.query("CREATE TABLE inv (sku VARCHAR(16), qty BIGINT)")
+c.query("INSERT INTO inv VALUES ('apple', 5), ('pear', 7), ('apple', 2)")
+r = c.query("SELECT sku, SUM(qty) total FROM inv GROUP BY sku ORDER BY total DESC")
+print("cols:", r.columns, "rows:", r.rows)
+r = c.query("EXPLAIN ANALYZE SELECT sku, SUM(qty) FROM inv GROUP BY sku")
+print("analyze:")
+for row in r.rows: print("   ", row[0])
+r = c.query("SELECT table_name, table_rows FROM information_schema.tables "
+            "WHERE table_schema = 'default'")
+print("info_schema:", r.rows)
+# probes
+try:
+    c.query("SELEC typo")
+except MySQLError as e:
+    print("syntax probe ->", e)
+print("ping after error:", c.ping())
+c2 = Connection(port=28123)   # second concurrent client
+print("second conn sees table:", c2.query("SELECT COUNT(*) FROM inv").rows)
+c.close(); c2.close()

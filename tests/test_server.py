@@ -1,0 +1,98 @@
+"""Wire-protocol tests: a real TCP round trip through the MySQL server and
+the client SDK (reference: the protocol layer exercised by any mysql client;
+here client and server are both ours, meeting at the socket)."""
+
+import threading
+
+import pytest
+
+from baikaldb_tpu.client.mysql_client import Connection, MySQLError, Pool
+from baikaldb_tpu.server.mysql_server import MySQLServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = MySQLServer(port=0).start()
+    yield srv
+    srv.stop()
+
+
+def test_connect_ping_quit(server):
+    c = Connection(port=server.port)
+    assert c.ping()
+    c.close()
+
+
+def test_ddl_dml_select_roundtrip(server):
+    c = Connection(port=server.port)
+    c.query("CREATE TABLE wire (id BIGINT, name VARCHAR(16), v DOUBLE)")
+    r = c.query("INSERT INTO wire VALUES (1,'a',1.5),(2,'b',NULL),(3,NULL,3.0)")
+    assert r.affected_rows == 3
+    r = c.query("SELECT id, name, v FROM wire ORDER BY id")
+    assert r.columns == ["id", "name", "v"]
+    assert r.rows[0] == ("1", "a", "1.5")
+    assert r.rows[1][2] is None
+    assert r.rows[2][1] is None
+    r = c.query("SELECT name, COUNT(*) n FROM wire GROUP BY name ORDER BY n DESC, name")
+    assert len(r.rows) == 3
+    c.close()
+
+
+def test_error_packet(server):
+    c = Connection(port=server.port)
+    with pytest.raises(MySQLError):
+        c.query("SELECT broken syntax here FROM")
+    # connection still usable after an error
+    assert c.ping()
+    c.close()
+
+
+def test_use_database(server):
+    c = Connection(port=server.port)
+    c.query("CREATE DATABASE IF NOT EXISTS wiredb")
+    c.query("USE wiredb")
+    c.query("CREATE TABLE t2 (x BIGINT)")
+    c.query("INSERT INTO t2 VALUES (7)")
+    r = c.query("SELECT x FROM t2")
+    assert r.rows == [("7",)]
+    c.close()
+
+
+def test_concurrent_connections_share_database(server):
+    c1 = Connection(port=server.port)
+    c2 = Connection(port=server.port)
+    c1.query("CREATE TABLE shared (x BIGINT)")
+    c1.query("INSERT INTO shared VALUES (42)")
+    r = c2.query("SELECT x FROM shared")
+    assert r.rows == [("42",)]
+    c1.close()
+    c2.close()
+
+
+def test_transactions_per_connection(server):
+    c1 = Connection(port=server.port)
+    c1.query("CREATE TABLE wtx (x BIGINT)")
+    c1.query("INSERT INTO wtx VALUES (1)")
+    c1.query("BEGIN")
+    c1.query("INSERT INTO wtx VALUES (2)")
+    c1.query("ROLLBACK")
+    r = c1.query("SELECT COUNT(*) FROM wtx")
+    assert r.rows == [("1",)]
+    c1.close()
+
+
+def test_pool(server):
+    pool = Pool("127.0.0.1", server.port, size=2)
+    pool.query("CREATE TABLE pooled (x BIGINT)")
+    pool.query("INSERT INTO pooled VALUES (1)")
+    results = []
+
+    def worker():
+        results.append(pool.query("SELECT COUNT(*) FROM pooled").rows[0][0])
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert results == ["1"] * 6

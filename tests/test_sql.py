@@ -301,3 +301,43 @@ def test_ddl_implicitly_commits_txn():
     s.execute("CREATE TABLE other (b BIGINT)")   # DDL -> implicit commit
     s.execute("ROLLBACK")                         # no-op now
     assert sorted(r["a"] for r in s.query("SELECT a FROM dtx")) == [1, 2]
+
+
+def test_explain_analyze(sess):
+    txt = sess.execute("EXPLAIN ANALYZE SELECT g, SUM(v) s FROM t "
+                       "WHERE v > 0 GROUP BY g").plan_text
+    assert "rows=" in txt and "-- run:" in txt
+
+
+def test_information_schema(sess):
+    rows = sess.query("SELECT table_name, table_rows FROM information_schema.tables "
+                      "WHERE table_schema = 'default' ORDER BY table_name")
+    names = [r["table_name"] for r in rows]
+    assert "t" in names and "r" in names
+    cols = sess.query("SELECT column_name, data_type FROM information_schema.columns "
+                      "WHERE table_name = 't' ORDER BY column_name")
+    assert {c["column_name"] for c in cols} == {"id", "g", "v", "d"}
+    sess.query("SELECT COUNT(*) FROM t")   # generate a log entry
+    log = sess.query("SELECT query FROM information_schema.query_log")
+    assert any("COUNT(*)" in r["query"] for r in log)
+
+
+def test_information_schema_read_only(sess):
+    with pytest.raises(Exception):
+        sess.execute("INSERT INTO information_schema.query_log VALUES ('x', 1.0, 1)")
+    with pytest.raises(Exception):
+        sess.execute("CREATE DATABASE information_schema")
+    names = [r[0] for r in sess.execute("SHOW TABLES FROM information_schema").rows]
+    assert "tables" in names and "columns" in names
+
+
+def test_explain_analyze_join_counts():
+    """Regression: EXPLAIN ANALYZE settles join caps before tracing so row
+    counts match real execution (caught in round-1 code review)."""
+    s = Session()
+    s.execute("CREATE TABLE ea (k BIGINT)")
+    s.execute("CREATE TABLE eb (k BIGINT, v BIGINT)")
+    s.execute("INSERT INTO ea VALUES (1), (2)")
+    s.execute("INSERT INTO eb VALUES (1,1),(1,2),(1,3),(1,4),(2,5),(2,6),(2,7),(2,8)")
+    txt = s.execute("EXPLAIN ANALYZE SELECT ea.k, v FROM ea JOIN eb ON ea.k = eb.k").plan_text
+    assert "rows=8" in txt   # join output, not the truncated first-cap attempt
