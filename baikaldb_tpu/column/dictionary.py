@@ -60,6 +60,9 @@ class Dictionary:
             d = d.combine_chunks()
         values = np.asarray(d.dictionary.to_pylist(), dtype=str)
         null_mask = np.asarray(d.indices.is_null())
+        if len(values) == 0:
+            # all-NULL column: empty dictionary, every code NULL
+            return Dictionary(values), np.full(len(arr), NULL_CODE, np.int32)
         codes = d.indices.fill_null(0).to_numpy(zero_copy_only=False).astype(np.int32)
         order = np.argsort(values, kind="stable")
         rank = np.empty_like(order)

@@ -21,14 +21,14 @@ import numpy as np
 import pyarrow as pa
 
 from ..column.batch import ColumnBatch
-from ..expr.compile import eval_expr, eval_predicate
+from ..expr.compile import eval_expr, eval_output, eval_predicate
 from ..meta.catalog import Catalog, IndexInfo, parse_type
 from ..ops.compact import compact
 from ..plan.nodes import JoinNode, PlanNode
 from ..plan.planner import PlanError, Planner
 from ..sql.lexer import SqlError
 from ..sql.parser import parse_sql
-from ..sql.stmt import (CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
+from ..sql.stmt import (AlterTableStmt, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
                         DescribeStmt, DropDatabaseStmt, DropTableStmt,
                         ExplainStmt, InsertStmt, SelectStmt, ShowStmt,
                         TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
@@ -41,6 +41,14 @@ MAX_JOIN_RETRIES = 4
 
 def _empty_info(name: str):
     return schema_to_arrow(Catalog.INFORMATION_SCHEMA[name]).empty_table()
+
+
+def _stmt_image(kind: str, s) -> str:
+    where = f" WHERE {s.where!r}" if getattr(s, "where", None) is not None else ""
+    if kind == "update":
+        sets = ", ".join(f"{n}={e!r}" for n, e in s.assignments)
+        return f"UPDATE {s.table.name} SET {sets}{where}"
+    return f"DELETE FROM {s.table.name}{where}"
 
 
 def _qualify_free(e):
@@ -88,6 +96,8 @@ class Database:
         # query statistics ring (reference: slow-SQL collection + print_agg_sql,
         # network_server.h:82-107) — feeds information_schema.query_log
         self.query_log = deque(maxlen=1000)
+        from ..storage.binlog import Binlog
+        self.binlog = Binlog()
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]
@@ -102,6 +112,22 @@ class Session:
         # at the column tier; the row tier has its own Txn machinery —
         # storage/rowstore.py)
         self._txn_backup: Optional[dict] = None
+        # binlog events buffered until COMMIT (discarded on ROLLBACK) so CDC
+        # subscribers never see uncommitted changes
+        self._txn_binlog: list = []
+
+    def _log_binlog(self, event_type, db_name, table, rows=None, statement="",
+                    affected=0):
+        if rows and len(rows) > 1000:
+            # bulk ingest: statement image only (avoid O(n) python row images)
+            statement = statement or f"bulk insert {len(rows)} rows"
+            rows = None
+        if self._txn_backup is not None:
+            self._txn_binlog.append((event_type, db_name, table, rows,
+                                     statement, affected))
+            return
+        self.db.binlog.append(event_type, db_name, table, rows=rows,
+                              statement=statement, affected=affected)
 
     # -- public API -------------------------------------------------------
     def execute(self, sql: str) -> Result:
@@ -121,8 +147,9 @@ class Session:
         # DDL implicitly commits any open transaction (MySQL semantics);
         # rolling back across a schema change is not supported
         if isinstance(s, (CreateTableStmt, DropTableStmt, CreateDatabaseStmt,
-                          DropDatabaseStmt, TruncateStmt)):
+                          DropDatabaseStmt, TruncateStmt, AlterTableStmt)):
             self._txn_backup = None
+            self._flush_txn_binlog()
         if isinstance(s, SelectStmt):
             return self._select(s)
         if isinstance(s, ExplainStmt):
@@ -139,6 +166,8 @@ class Session:
             return self._delete(s)
         if isinstance(s, CreateTableStmt):
             return self._create_table(s)
+        if isinstance(s, AlterTableStmt):
+            return self._alter_table(s)
         if isinstance(s, DropTableStmt):
             db = s.table.database or self.current_db
             self.db.catalog.drop_table(db, s.table.name, s.if_exists)
@@ -214,10 +243,15 @@ class Session:
         snapshots of touched tables, restored on ROLLBACK."""
         if s.kind == "begin":
             # a new BEGIN implicitly commits any previous txn (MySQL behavior)
+            self._flush_txn_binlog()
             self._txn_backup = {}
             return Result()
         if self._txn_backup is None:
             return Result()      # COMMIT/ROLLBACK outside txn: no-op
+        if s.kind == "commit":
+            self._txn_backup = None
+            self._flush_txn_binlog()
+            return Result()
         if s.kind == "rollback":
             for key, snap in self._txn_backup.items():
                 store = self.db.stores.get(key)
@@ -226,7 +260,15 @@ class Session:
                     if snap.num_rows:
                         store.insert_arrow(snap)
         self._txn_backup = None
+        self._txn_binlog.clear()    # rolled back: subscribers never see these
         return Result()
+
+    def _flush_txn_binlog(self):
+        for ev in self._txn_binlog:
+            event_type, db_name, table, rows, statement, affected = ev
+            self.db.binlog.append(event_type, db_name, table, rows=rows,
+                                  statement=statement, affected=affected)
+        self._txn_binlog.clear()
 
     def _txn_touch(self, store: TableStore):
         """Record a pre-image before the first mutation inside a txn."""
@@ -263,11 +305,78 @@ class Session:
         for kind, name, cols in s.indexes:
             indexes.append(IndexInfo(name or f"idx_{'_'.join(cols)}", kind, cols))
         info = self.db.catalog.create_table(db, s.table.name, schema, indexes,
+                                            options=dict(s.options),
                                             if_not_exists=s.if_not_exists)
         key = f"{db}.{s.table.name}"
         if key not in self.db.stores:
             self.db.stores[key] = TableStore(info)
         return Result()
+
+    def _alter_table(self, s: AlterTableStmt) -> Result:
+        """ALTER TABLE ADD/DROP COLUMN (reference: online column DDL via the
+        meta DDLManager; single-node: immediate schema rewrite)."""
+        db = s.table.database or self.current_db
+        info = self.db.catalog.get_table(db, s.table.name)
+        fields = list(info.schema.fields)
+        store = self._store(s.table)
+        if s.action == "add_column":
+            if s.column.name in info.schema:
+                raise PlanError(f"column {s.column.name!r} exists")
+            if not s.column.nullable and store.num_rows:
+                raise PlanError("cannot ADD COLUMN ... NOT NULL to a non-empty "
+                                "table (existing rows would hold NULL)")
+            fields.append(Field(s.column.name, parse_type(s.column.type_name),
+                                s.column.nullable))
+        elif s.action == "drop_column":
+            if s.column_name not in info.schema:
+                raise PlanError(f"unknown column {s.column_name!r}")
+            if len(fields) == 1:
+                raise PlanError("cannot drop the last column")
+            fields = [f for f in fields if f.name != s.column_name]
+            # indexes referencing the dropped column go with it
+            info.indexes = [ix for ix in info.indexes
+                            if s.column_name not in ix.columns]
+        else:
+            raise PlanError(f"unsupported ALTER action {s.action!r}")
+        new_schema = Schema(tuple(fields))
+        store.alter_schema(new_schema)   # bumps info.version itself
+        self.db.binlog.append("ddl", db, s.table.name,
+                              statement=f"ALTER TABLE {s.table.name} {s.action}")
+        return Result()
+
+    def ttl_tick(self, now=None) -> int:
+        """Purge expired rows of every TTL table (reference: store-side TTL
+        timers).  TTL tables declare options TTL=<seconds> and
+        TTL_COLUMN=<datetime col> (default create_time)."""
+        import datetime
+
+        now = now or datetime.datetime.now()
+        purged = 0
+        for key, store in list(self.db.stores.items()):
+            opts = store.info.options or {}
+            if "ttl" not in opts:
+                continue
+            try:
+                col = opts.get("ttl_column", "create_time")
+                f = store.info.schema.field(col) if col in store.info.schema else None
+                if f is None or not f.ltype.is_temporal:
+                    raise ValueError(f"TTL column {col!r} missing or not temporal")
+                cutoff = now - datetime.timedelta(seconds=int(opts["ttl"]))
+                if f.ltype is LType.DATE:
+                    cutoff = cutoff.date()
+                n = store.purge_expired(col, cutoff)
+            except Exception as exc:
+                # one misconfigured table must not block the sweep
+                import logging
+                logging.getLogger(__name__).warning("TTL skip %s: %s", key, exc)
+                continue
+            if n:
+                db, name = key.split(".", 1)
+                self.db.binlog.append("delete", db, name,
+                                      statement=f"TTL purge {col} < {cutoff}",
+                                      affected=n)
+            purged += n
+        return purged
 
     # -- DML --------------------------------------------------------------
     def _insert(self, s: InsertStmt) -> Result:
@@ -282,11 +391,20 @@ class Session:
             else:
                 t = t.rename_columns(schema.names()[:t.num_columns])
             store.insert_arrow(t)
+            db_name = s.table.database or self.current_db
+            if t.num_rows > 1000:
+                self._log_binlog("insert", db_name, s.table.name,
+                                 statement=f"bulk insert {t.num_rows} rows",
+                                 affected=t.num_rows)
+            else:
+                self._log_binlog("insert", db_name, s.table.name,
+                                 rows=t.to_pylist(), affected=t.num_rows)
             return Result(affected_rows=t.num_rows)
         cols = s.columns or schema.names()
         if any(len(r) != len(cols) for r in s.rows):
             raise SqlError("VALUES row length does not match column list")
         rows = [dict(zip(cols, r)) for r in s.rows]
+        db_name = s.table.database or self.current_db
         for r in rows:
             for f in schema.fields:
                 if f.name in r and r[f.name] is not None and f.ltype.is_temporal \
@@ -300,6 +418,8 @@ class Session:
                         r[f.name] = datetime.datetime(1970, 1, 1) + \
                             datetime.timedelta(microseconds=v)
         store.insert_rows(rows)
+        self._log_binlog("insert", db_name, s.table.name, rows=rows,
+                         affected=len(rows))
         return Result(affected_rows=len(rows))
 
     def _host_mask(self, store: TableStore, where):
@@ -330,15 +450,15 @@ class Session:
             b = ColumnBatch.from_arrow(region_table)
             out = region_table
             for name, e in assigns:
-                c = eval_expr(_qualify_free(e), b)
+                c = eval_output(_qualify_free(e), b)
                 data, valid = c.to_numpy()
                 f = arrow_schema.field(name)
+                if np.ndim(data) == 0:
+                    data = np.broadcast_to(data, (region_table.num_rows,))
                 if c.ltype is LType.STRING and c.dictionary is not None:
-                    vals = c.dictionary.decode(data.astype(np.int32))
+                    vals = c.dictionary.decode(np.asarray(data, np.int32))
                 else:
                     vals = data
-                if np.ndim(vals) == 0:
-                    vals = np.broadcast_to(vals, (region_table.num_rows,))
                 old = out.column(name).to_pylist()
                 newcol = []
                 vl = vals.tolist() if hasattr(vals, "tolist") else list(vals)
@@ -347,7 +467,7 @@ class Session:
                         dead = valid is not None and (np.ndim(valid) == 0 and not valid
                                                       or np.ndim(valid) > 0 and not valid[i])
                         newcol.append(None if dead else
-                                      vl[i if np.ndim(vals) else 0])
+                                      vl[i])
                     else:
                         newcol.append(old[i])
                 idx = out.column_names.index(name)
@@ -355,12 +475,20 @@ class Session:
             return out
 
         n = store.update_where(self._host_mask(store, s.where), assign_fn)
+        if n:
+            self._log_binlog("update", s.table.database or self.current_db,
+                             s.table.name,
+                             statement=_stmt_image("update", s), affected=n)
         return Result(affected_rows=n)
 
     def _delete(self, s: DeleteStmt) -> Result:
         store = self._store(s.table)
         self._txn_touch(store)
         n = store.delete_where(self._host_mask(store, s.where))
+        if n:
+            self._log_binlog("delete", s.table.database or self.current_db,
+                             s.table.name,
+                             statement=_stmt_image("delete", s), affected=n)
         return Result(affected_rows=n)
 
     # -- SELECT ---------------------------------------------------------

@@ -15,7 +15,7 @@ from typing import Optional
 
 from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, Subquery, WindowCall
 from .lexer import SqlError, Token, tokenize
-from .stmt import (ColumnDef, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
+from .stmt import (AlterTableStmt, ColumnDef, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
                    DescribeStmt, DropDatabaseStmt, DropTableStmt, ExplainStmt,
                    InsertStmt, JoinClause, OrderItem, SelectItem, SelectStmt,
                    ShowStmt, TableRef, TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
@@ -118,6 +118,8 @@ class Parser:
             return self.create_stmt()
         if t.value == "drop":
             return self.drop_stmt()
+        if t.value == "alter":
+            return self.alter_stmt()
         if t.value == "truncate":
             self.advance()
             self.try_kw("table")
@@ -436,10 +438,16 @@ class Parser:
             if not self.try_op(","):
                 break
         self.expect_op(")")
-        # swallow table options (ENGINE=..., etc.)
+        # table options (ENGINE=x, TTL=n, TTL_COLUMN=c, ...) -> options dict
+        options: dict = {}
         while not self.at_end() and self.peek().value != ";":
-            self.advance()
-        return CreateTableStmt(table, cols, pk, indexes, ine)
+            t = self.advance()
+            if t.kind in ("IDENT", "KW") and self.try_op("="):
+                v = self.advance()
+                options[t.value.lower()] = v.value
+        stmt = CreateTableStmt(table, cols, pk, indexes, ine)
+        stmt.options = options
+        return stmt
 
     def _type_name(self) -> str:
         base = self.ident()
@@ -471,6 +479,35 @@ class Parser:
                 self.advance()
             return True
         return False
+
+    def alter_stmt(self):
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        table = self.table_name()
+        from .stmt import AlterTableStmt
+        if self.try_kw("add"):
+            if self.peek().kind == "KW" and self.peek().value in ("index", "key",
+                                                                  "unique",
+                                                                  "fulltext"):
+                raise SqlError("ALTER TABLE ADD INDEX is not supported yet")
+            # ADD [COLUMN] name type
+            if self.peek().kind == "IDENT" and self.peek().value.lower() == "column":
+                self.advance()
+            name = self.ident()
+            tname = self._type_name()
+            nullable = True
+            if self.try_kw("not"):
+                self.expect_kw("null")
+                nullable = False
+            self.try_kw("null")
+            return AlterTableStmt(table, "add_column",
+                                  ColumnDef(name, tname, nullable))
+        if self.try_kw("drop"):
+            if self.peek().kind == "IDENT" and self.peek().value.lower() == "column":
+                self.advance()
+            return AlterTableStmt(table, "drop_column", column_name=self.ident())
+        t = self.peek()
+        raise SqlError(f"unsupported ALTER TABLE action {t.value!r} at {t.pos}")
 
     def drop_stmt(self):
         self.expect_kw("drop")

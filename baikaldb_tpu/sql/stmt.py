@@ -95,6 +95,15 @@ class CreateTableStmt:
     primary_key: list[str] = field(default_factory=list)
     indexes: list[tuple[str, str, list[str]]] = field(default_factory=list)  # (kind,name,cols)
     if_not_exists: bool = False
+    options: dict = field(default_factory=dict)
+
+
+@dataclass
+class AlterTableStmt:
+    table: TableRef
+    action: str                         # add_column | drop_column
+    column: Optional[ColumnDef] = None
+    column_name: str = ""
 
 
 @dataclass

@@ -228,6 +228,30 @@ class TableStore:
             self.regions.append(new)
             region = new
 
+    def alter_schema(self, new_schema: Schema):
+        """Online schema change (reference: column DDL via DDLManager +
+        region backfill; here: rewrite region tables to the new arrow schema —
+        added columns fill NULL, dropped columns vanish)."""
+        with self._lock:
+            self._mutations += 1
+            self.info.schema = new_schema
+            self.info.version += 1
+            self.arrow_schema = schema_to_arrow(new_schema)
+            for r in self.regions:
+                r.data = _coerce(r.data, self.arrow_schema)
+                r.version += 1
+
+    def purge_expired(self, ttl_column: str, expire_before) -> int:
+        """TTL purge (reference: TTL delete loops, store.cpp:46-48 timers +
+        ttl_delete_node): delete rows whose ttl_column < expire_before."""
+        import pyarrow.compute as pc
+
+        def mask_fn(t: pa.Table):
+            col = t.column(ttl_column)
+            return np.asarray(pc.less(col, pa.scalar(expire_before)).fill_null(False))
+
+        return self.delete_where(mask_fn)
+
     # -- persistence ----------------------------------------------------
     def save_parquet(self, directory: str):
         os.makedirs(directory, exist_ok=True)

@@ -50,11 +50,28 @@ def main():
              AggSpec("avg", "v", "a"), AggSpec("min", "v", "mn")]
     pred = (col("v") * lit(2.0) + lit(1.0)) > lit(0.5)
 
-    @jax.jit
-    def step(b):
-        out = group_aggregate_dense(b.and_sel(eval_predicate(pred, b)),
-                                    ["g"], [n_groups], specs)
-        return tuple(c.data for c in out.columns) + (out.sel,)
+    use_pallas = os.environ.get("BENCH_KERNEL", "") == "pallas"
+    if use_pallas:
+        # hand-written fused kernel (ops/pallas_kernels.py): COUNT+SUM only,
+        # for comparing against the XLA segment_sum lowering on real TPU
+        from baikaldb_tpu.ops.pallas_kernels import filtered_group_sum
+
+        interpret = platform == "cpu"   # compiled pallas needs real TPU
+
+        @jax.jit
+        def step(b):
+            m = eval_predicate(pred, b)
+            counts, sums = filtered_group_sum(
+                b.column("g").data, b.column("v").data, m, n_groups,
+                interpret=interpret)
+            return (b.column("g").data[:1], counts.astype(jnp.int64), sums,
+                    sums / jnp.maximum(counts, 1), counts, m[:1])
+    else:
+        @jax.jit
+        def step(b):
+            out = group_aggregate_dense(b.and_sel(eval_predicate(pred, b)),
+                                        ["g"], [n_groups], specs)
+            return tuple(c.data for c in out.columns) + (out.sel,)
 
     out = jax.block_until_ready(step(batch))      # compile + warm
     times = []

@@ -341,3 +341,13 @@ def test_explain_analyze_join_counts():
     s.execute("INSERT INTO eb VALUES (1,1),(1,2),(1,3),(1,4),(2,5),(2,6),(2,7),(2,8)")
     txt = s.execute("EXPLAIN ANALYZE SELECT ea.k, v FROM ea JOIN eb ON ea.k = eb.k").plan_text
     assert "rows=8" in txt   # join output, not the truncated first-cap attempt
+
+
+def test_update_set_string_literal():
+    """Regression: SET col = 'literal' goes through the egress-aware expr
+    path (caught in round-1 verification)."""
+    s = Session()
+    s.execute("CREATE TABLE usl (id BIGINT, tag VARCHAR(8))")
+    s.execute("INSERT INTO usl VALUES (1, 'a'), (2, 'b')")
+    assert s.execute("UPDATE usl SET tag = 'zz' WHERE id = 2").affected_rows == 1
+    assert s.query("SELECT tag FROM usl ORDER BY id") == [{"tag": "a"}, {"tag": "zz"}]
