@@ -98,6 +98,7 @@ class Database:
         self.query_log = deque(maxlen=1000)
         from ..storage.binlog import Binlog
         self.binlog = Binlog()
+        self.qos = None          # optional utils.qos.QosManager
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]
@@ -132,6 +133,12 @@ class Session:
     # -- public API -------------------------------------------------------
     def execute(self, sql: str) -> Result:
         stmts = parse_sql(sql)
+        if self.db.qos is not None:
+            # COMMIT/ROLLBACK are exempt: shedding load must never pin open
+            # transactions; batches are charged per statement
+            billable = sum(1 for s in stmts if not isinstance(s, TxnStmt))
+            if billable:
+                self.db.qos.admit(sql, cost=float(billable))
         if len(stmts) == 1 and isinstance(stmts[0], SelectStmt):
             return self._select(stmts[0], cache_key=(sql, self.current_db))
         res = Result()

@@ -9,11 +9,21 @@ def main():
     ap = argparse.ArgumentParser(description="baikaldb_tpu MySQL-protocol server")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=28000)
+    ap.add_argument("--qos-rate", type=float, default=0.0,
+                    help="global queries/sec admission limit (0 = off)")
     args = ap.parse_args()
 
     from .mysql_server import MySQLServer
 
-    srv = MySQLServer(host=args.host, port=args.port).start()
+    qos = None
+    if args.qos_rate > 0:
+        from ..utils.qos import QosManager
+
+        qos = QosManager(global_rate=args.qos_rate,
+                         global_burst=2 * args.qos_rate,
+                         sign_rate=args.qos_rate / 4,
+                         sign_burst=args.qos_rate / 2)
+    srv = MySQLServer(host=args.host, port=args.port, qos=qos).start()
     print(f"baikaldb_tpu listening on {args.host}:{srv.port}", flush=True)
     try:
         while True:

@@ -107,8 +107,10 @@ class MySQLServer:
     scale, the data plane lives on the TPU)."""
 
     def __init__(self, db: Optional[Database] = None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, qos=None):
         self.db = db or Database()
+        if qos is not None:
+            self.db.qos = qos
         self.host = host
         self.port = port
         self._listener: Optional[socket.socket] = None

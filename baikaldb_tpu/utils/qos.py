@@ -1,0 +1,85 @@
+"""QoS admission control: token buckets per SQL signature.
+
+The reference meters work per "SQL sign" (a hash of the normalized statement)
+with token buckets and a reject strategy under overload (include/engine/
+qos.h:105-114, src/engine/qos.cpp).  Same design here, host-side: each
+distinct SQL text maps to a bucket; acquiring a token admits the query,
+an empty bucket under overload raises RejectedError (the frontend returns
+a MySQL error instead of queueing unboundedly).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class RejectedError(RuntimeError):
+    """Admission rejected under overload (reference: reject strategy)."""
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: float, clock=time.monotonic):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.clock = clock
+        self._last = clock()
+        self._mu = threading.Lock()
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        with self._mu:
+            now = self.clock()
+            self.tokens = min(self.burst, self.tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self.tokens >= n:
+                self.tokens -= n
+                return True
+            return False
+
+
+class QosManager:
+    """Per-sign buckets + a global bucket (the store-level QoS analog)."""
+
+    def __init__(self, global_rate: float = 10_000.0, global_burst: float = 20_000.0,
+                 sign_rate: float = 1_000.0, sign_burst: float = 2_000.0,
+                 clock=time.monotonic):
+        self.clock = clock
+        self.global_bucket = TokenBucket(global_rate, global_burst, clock)
+        self.sign_rate = sign_rate
+        self.sign_burst = sign_burst
+        self._signs: dict[int, TokenBucket] = {}
+        self._mu = threading.Lock()
+        self.rejected = 0
+        self.admitted = 0
+
+    def _bucket(self, sign: int) -> TokenBucket:
+        with self._mu:
+            b = self._signs.get(sign)
+            if b is None:
+                b = self._signs[sign] = TokenBucket(self.sign_rate,
+                                                    self.sign_burst, self.clock)
+            return b
+
+    @staticmethod
+    def sign_of(sql: str) -> int:
+        """Normalized statement signature (reference: SQL sign)."""
+        import re
+
+        norm = re.sub(r"\s+", " ", sql.strip().lower())
+        norm = re.sub(r"'(?:[^'\\]|\\.)*'", "?", norm)
+        norm = re.sub(r"\b\d+(\.\d+)?\b", "?", norm)
+        norm = re.sub(r"\s*([=<>!,()+\-*/])\s*", r"\1", norm)
+        return hash(norm) & 0x7FFFFFFFFFFFFFFF
+
+    def admit(self, sql: str, cost: float = 1.0):
+        """Raise RejectedError when either the statement's bucket or the
+        global bucket is exhausted."""
+        sign = self.sign_of(sql)
+        if not self._bucket(sign).try_acquire(cost):
+            self.rejected += 1
+            raise RejectedError(f"per-statement rate exceeded (sign {sign:x})")
+        if not self.global_bucket.try_acquire(cost):
+            self.rejected += 1
+            raise RejectedError("server overloaded (global rate exceeded)")
+        self.admitted += 1

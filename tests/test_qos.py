@@ -1,0 +1,85 @@
+"""QoS tests (reference: test_qos.cpp): token buckets, sign normalization,
+reject under overload, session integration."""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Session
+from baikaldb_tpu.utils.qos import QosManager, RejectedError, TokenBucket
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_token_bucket_refills():
+    clock = FakeClock()
+    b = TokenBucket(rate=10, burst=5, clock=clock)
+    assert all(b.try_acquire() for _ in range(5))
+    assert not b.try_acquire()
+    clock.t += 0.5           # +5 tokens
+    assert all(b.try_acquire() for _ in range(5))
+    assert not b.try_acquire()
+
+
+def test_sign_normalization():
+    a = QosManager.sign_of("SELECT * FROM t WHERE id = 5")
+    b = QosManager.sign_of("select *  from t where id=  99")
+    c = QosManager.sign_of("SELECT * FROM t WHERE name = 'bob'")
+    d = QosManager.sign_of("SELECT * FROM t WHERE name = 'alice'")
+    assert a == b and c == d and a != c
+
+
+def test_reject_per_sign_and_global():
+    clock = FakeClock()
+    q = QosManager(global_rate=100, global_burst=100, sign_rate=1,
+                   sign_burst=2, clock=clock)
+    q.admit("SELECT 1")
+    q.admit("SELECT 2")      # same sign (number normalized)
+    with pytest.raises(RejectedError):
+        q.admit("SELECT 3")
+    q.admit("SELECT x FROM other")   # different sign still admitted
+    assert q.rejected == 1 and q.admitted == 3
+
+
+def test_session_integration():
+    clock = FakeClock()
+    s = Session()
+    s.execute("CREATE TABLE qt (x BIGINT)")
+    s.db.qos = QosManager(sign_rate=1, sign_burst=1, clock=clock)
+    s.execute("INSERT INTO qt VALUES (1)")
+    with pytest.raises(RejectedError):
+        s.execute("INSERT INTO qt VALUES (2)")
+    clock.t += 2.0
+    s.execute("INSERT INTO qt VALUES (3)")
+    s.db.qos = None
+    assert s.execute("SELECT COUNT(*) FROM qt").scalar() == 2
+
+
+def test_commit_rollback_exempt_and_batch_cost():
+    """Regression: txn control statements always admit; multi-statement
+    batches are charged per statement (caught in round-1 code review)."""
+    clock = FakeClock()
+    s = Session()
+    s.execute("CREATE TABLE qe (x BIGINT)")
+    s.db.qos = QosManager(sign_rate=0.001, sign_burst=2, global_rate=1000,
+                          global_burst=1000, clock=clock)
+    s.execute("BEGIN")
+    s.execute("INSERT INTO qe VALUES (1)")
+    with pytest.raises(RejectedError):
+        for _ in range(5):
+            s.execute("INSERT INTO qe VALUES (2)")
+    s.execute("ROLLBACK")          # exempt: must succeed under overload
+    assert s.db.qos.admitted >= 1
+    s.db.qos = None
+    assert s.execute("SELECT COUNT(*) FROM qe").scalar() == 0
+
+    s.db.qos = QosManager(sign_rate=1000, sign_burst=1000, global_rate=0.001,
+                          global_burst=3, clock=clock)
+    with pytest.raises(RejectedError):
+        # one call, four statements: must cost 4 > burst 3
+        s.execute("INSERT INTO qe VALUES (1); INSERT INTO qe VALUES (2); "
+                  "INSERT INTO qe VALUES (3); INSERT INTO qe VALUES (4)")
