@@ -189,6 +189,17 @@ QUERIES = {
           AND l_discount BETWEEN 0.05 AND 0.07
           AND l_quantity < 24
     """,
+    # Q4: order priority checking (correlated EXISTS)
+    "q4": """
+        SELECT o_orderpriority, COUNT(*) AS order_count
+        FROM orders
+        WHERE o_orderdate >= '1993-07-01' AND o_orderdate < '1993-10-01'
+          AND EXISTS (
+            SELECT 1 FROM lineitem
+            WHERE l_orderkey = o_orderkey AND l_commitdate < l_receiptdate)
+        GROUP BY o_orderpriority
+        ORDER BY o_orderpriority
+    """,
     # Q12: shipping modes and order priority
     "q12": """
         SELECT l_shipmode,

@@ -142,3 +142,18 @@ def test_q14_lite(env):
     dp = f.l_extendedprice * (1 - f.l_discount)
     want = 100.0 * dp[f.l_discount > 0.05].sum() / dp.sum()
     assert abs(got - want) < 1e-9
+
+
+def test_q4(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q4"])
+    o, li = dfs["orders"], dfs["lineitem"]
+    late = li[li.l_commitdate < li.l_receiptdate].l_orderkey.unique()
+    f = o[(o.o_orderdate >= _d("1993-07-01")) & (o.o_orderdate < _d("1993-10-01"))
+          & o.o_orderkey.isin(late)]
+    g = f.groupby("o_orderpriority").size().reset_index(name="n") \
+         .sort_values("o_orderpriority")
+    assert len(rows) == len(g)
+    for r, (_, w) in zip(rows, g.iterrows()):
+        assert r["o_orderpriority"] == w.o_orderpriority
+        assert r["order_count"] == w.n
