@@ -251,22 +251,29 @@ def _arrow_to_column(arr, typ) -> Column:
         typ = pa.float64()
     if pa.types.is_date32(typ):
         ltype = LType.DATE
-        np_data = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+        work = arr.cast(pa.int32())
     elif pa.types.is_timestamp(typ):
         ltype = LType.DATETIME
-        np_data = arr.cast(pa.timestamp("us")).cast(pa.int64()).to_numpy(zero_copy_only=False)
+        work = arr.cast(pa.timestamp("us")).cast(pa.int64())
     else:
         ltype = _arrow_ltype_map().get(typ)
         if ltype is None:
             raise TypeError(f"unsupported arrow type {typ}")
-        np_data = arr.to_numpy(zero_copy_only=False)
+        work = arr
     if arr.null_count:
         validity = ~np.asarray(arr.is_null())
-        np_data = np.nan_to_num(np_data) if np_data.dtype.kind == "f" else np_data
-        if np_data.dtype == object:
-            np_data = np.where(validity, np_data, 0)
+        if not pa.types.is_floating(work.type):
+            # fill nulls at the Arrow level: pyarrow's to_numpy renders a
+            # null-bearing int array as float64+NaN, which corrupts 64-bit
+            # integers beyond 2^53 (caught in round-2 regression)
+            fill = False if pa.types.is_boolean(work.type) else 0
+            work = pc.fill_null(work, fill)
+        np_data = work.to_numpy(zero_copy_only=False)
+        if np_data.dtype.kind == "f":
+            np_data = np.nan_to_num(np_data)
         np_data = np_data.astype(ltype.np_dtype, copy=False)
         return Column(jnp.asarray(np_data), jnp.asarray(validity), ltype)
+    np_data = work.to_numpy(zero_copy_only=False)
     return Column(jnp.asarray(np_data.astype(ltype.np_dtype, copy=False)), None, ltype)
 
 

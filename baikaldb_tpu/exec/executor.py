@@ -179,12 +179,12 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         sub = _sub(node.children[1], batches, overflows, ctx)
         sub_name = sub.names[0]
         if len(sub) == 0:
-            # empty list: IN -> FALSE, NOT IN -> TRUE (no NULLs to consider)
+            # empty list: IN -> FALSE, NOT IN -> TRUE even for NULL keys —
+            # no comparison ever happens, so the result is non-NULL
             n = len(child)
             data = jnp.broadcast_to(jnp.asarray(node.negate), (n,))
             names = list(child.names) + [node.out_name]
-            cols = list(child.columns) + [
-                Column(data, child.column(node.key_col).validity, LType.BOOL)]
+            cols = list(child.columns) + [Column(data, None, LType.BOOL)]
             return ColumnBatch(tuple(names), cols, child.sel, child.num_rows)
         probe = ColumnBatch((node.key_col,), [child.column(node.key_col)],
                             child.sel, None)
@@ -210,8 +210,11 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         else:
             data = found
         # SQL three-valued IN: NULL key -> NULL; a miss with NULLs
-        # in the list -> NULL
-        validity = xc.valid_mask() & (found | ~has_null_in_list)
+        # in the list -> NULL.  A live-empty list (all rows filtered out,
+        # nonzero capacity) behaves like the empty fast path above: no
+        # comparison happens, so even NULL keys yield a non-NULL result
+        live_empty = nlive == 0
+        validity = (xc.valid_mask() | live_empty) & (found | ~has_null_in_list)
         names = list(child.names) + [node.out_name]
         cols = list(child.columns) + [Column(data, validity, LType.BOOL)]
         return ColumnBatch(tuple(names), cols, child.sel, child.num_rows)
@@ -222,7 +225,11 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         n = len(child)
         names = list(child.names)
         cols = list(child.columns)
-        has_row = sub.live_count() > 0
+        live = sub.live_count()
+        has_row = live > 0
+        # MySQL ER_SUBQUERY_NO_1_ROW (1242): flag rides back with the join
+        # overflow flags; the session raises instead of retrying
+        overflows.append((node, live > 1))
         for i, name in enumerate(node.col_names):
             c = sub.columns[i]
             if len(sub) == 0:

@@ -24,7 +24,7 @@ from ..column.batch import ColumnBatch
 from ..expr.compile import eval_expr, eval_output, eval_predicate
 from ..meta.catalog import Catalog, IndexInfo, parse_type
 from ..ops.compact import compact
-from ..plan.nodes import JoinNode, PlanNode
+from ..plan.nodes import JoinNode, PlanNode, ScalarSourceNode
 from ..plan.planner import PlanError, Planner
 from ..sql.lexer import SqlError
 from ..sql.parser import parse_sql
@@ -646,6 +646,8 @@ class Session:
             grew = False
             for node, flag in zip(raw.join_order, flags):
                 if bool(flag):
+                    if isinstance(node, ScalarSourceNode):
+                        raise PlanError("Subquery returns more than 1 row")
                     node.cap = max(1, (node.cap or 1024) * 4)
                     grew = True
             if not grew:

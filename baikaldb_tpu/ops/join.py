@@ -104,21 +104,23 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     pk, pvalid = _key_array(probe, probe_keys)
     bk, bvalid = _key_array(build, build_keys)
 
-    # build side: dead/null-key rows -> +inf sentinel, sorted to the end
+    # build side: order by (is_dead, key) — liveness primary — so live rows
+    # form a contiguous sorted prefix of exactly n_live entries.  A sentinel
+    # replaces the dead tail's keys to keep the array globally sorted; a LIVE
+    # key equal to dtype-max still sorts before every dead row, so the
+    # first-dead clamp below is exact for all key values
     bdead = jnp.zeros(len(build), bool)
     if build.sel is not None:
         bdead = bdead | ~build.sel
     if bvalid is not None:
         bdead = bdead | ~bvalid
-    bk_s_key = jnp.where(bdead, _sentinel_max(bk.dtype), bk)
-    order = jnp.argsort(bk_s_key, stable=True)
-    bk_sorted = bk_s_key[order]
-    blive_sorted = ~bdead[order]
+    order = jnp.lexsort((bk, bdead))
+    n_live = jnp.sum(~bdead).astype(jnp.int32)
+    bk_sorted = jnp.where(jnp.arange(len(build)) < n_live,
+                          bk[order], _sentinel_max(bk.dtype))
 
     lo = jnp.searchsorted(bk_sorted, pk, side="left")
     hi = jnp.searchsorted(bk_sorted, pk, side="right")
-    # guard sentinel collision: a probe key equal to the sentinel must verify
-    # against build liveness below (gathered per match), so just clamp counts
     psel_dead = jnp.zeros(len(probe), bool)
     if probe.sel is not None:
         psel_dead = psel_dead | ~probe.sel
@@ -126,9 +128,8 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     if pvalid is not None:
         pdead = pdead | ~pvalid
     counts = jnp.where(pdead, 0, hi - lo)
-    # drop matches that land on dead build rows (only possible at the sentinel
-    # run, which is contiguous at the tail)
-    first_dead = jnp.sum(blive_sorted).astype(lo.dtype)
+    # drop matches that land in the dead tail (probe key == sentinel value)
+    first_dead = n_live.astype(lo.dtype)
     counts = jnp.where(lo >= first_dead, 0, jnp.minimum(counts, first_dead - lo))
 
     if how == "semi":

@@ -66,6 +66,10 @@ class FilterNode(PlanNode):
 class ProjectNode(PlanNode):
     exprs: list[Expr] = field(default_factory=list)
     names: list[str] = field(default_factory=list)
+    # True when this Project wraps a derived table / CTE body: its subtree is
+    # a separate name scope, so outer predicate pushdown must stop here even
+    # when an inner scan shares a table label with an outer table
+    derived: bool = False
 
     def _label(self):
         return f"Project({', '.join(f'{n}={e!r}' for n, e in zip(self.names, self.exprs))})"

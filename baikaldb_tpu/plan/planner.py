@@ -383,6 +383,7 @@ class Planner:
             exprs = [ColRef(f.name) for f in sub.schema.fields]
             names = [f"{label}.{f.name}" for f in sub.schema.fields]
             return ProjectNode(children=[sub], exprs=exprs, names=names,
+                               derived=True,
                                schema=Schema(tuple(Field(n, f.ltype, f.nullable)
                                                    for n, f in zip(names, sub.schema.fields))))
         db = ref.database or self.default_db
@@ -1138,6 +1139,8 @@ def _pushable_children(node: PlanNode):
         return node.children[:1]
     if isinstance(node, JoinNode) and getattr(node, "subquery_right", False):
         return node.children[:1]
+    if isinstance(node, ProjectNode) and node.derived:
+        return []
     return node.children
 
 

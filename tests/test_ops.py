@@ -259,3 +259,21 @@ def test_sorted_groupby_single_null_group():
     out = group_aggregate_sorted(kb, ["k"], [AggSpec("sum", "v", "s")], max_groups=8)
     rows = sorted(out.to_arrow().to_pylist(), key=lambda r: (r["k"] is None, str(r["k"])))
     assert rows == [{"k": 1, "s": 70}, {"k": None, "s": 30}]
+
+
+def test_join_live_key_equal_to_dtype_max():
+    """Regression (round-1 advisor, low): a live build key equal to the dtype
+    max must not be confused with the dead-row sentinel run."""
+    import numpy as np
+    from baikaldb_tpu.exec.session import Session
+
+    s = Session()
+    s.execute("CREATE TABLE jl (k BIGINT, v BIGINT)")
+    s.execute("CREATE TABLE jr (k BIGINT, w BIGINT)")
+    mx = np.iinfo(np.int64).max
+    s.execute(f"INSERT INTO jl VALUES ({mx}, 1), (7, 2)")
+    # build side: one live max-key row, one deleted row, one NULL-key row
+    s.execute(f"INSERT INTO jr VALUES ({mx}, 10), (5, 99), (NULL, 11)")
+    s.execute("DELETE FROM jr WHERE w = 99")
+    rows = s.query("SELECT jl.v, jr.w FROM jl JOIN jr ON jl.k = jr.k")
+    assert rows == [{"v": 1, "w": 10}]

@@ -116,3 +116,43 @@ def test_empty_table_subqueries(sess):
     rows = s2.query("SELECT id FROM o WHERE id NOT IN (SELECT x FROM empty_t) "
                     "ORDER BY id")
     assert [r["id"] for r in rows] == [1, 2, 3, 4]
+
+
+def test_derived_table_label_collision_no_pushdown_leak():
+    """Regression (round-1 advisor, high): an outer WHERE conjunct on table t
+    must NOT be pushed into a derived table that scans the same table t."""
+    s = Session()
+    s.execute("CREATE TABLE t (x BIGINT)")
+    s.execute("INSERT INTO t VALUES (5),(6),(7)")
+    rows = s.query("SELECT t.x, d.c FROM t, (SELECT COUNT(*) c FROM t) d "
+                   "WHERE t.x = 5")
+    assert rows == [{"x": 5, "c": 3}]
+    # same shape via CTE
+    rows = s.query("WITH d AS (SELECT COUNT(*) c FROM t) "
+                   "SELECT t.x, d.c FROM t, d WHERE t.x = 5")
+    assert rows == [{"x": 5, "c": 3}]
+
+
+def test_scalar_subquery_more_than_one_row_raises(sess):
+    """Regression (round-1 advisor, medium): MySQL ER_SUBQUERY_NO_1_ROW."""
+    with pytest.raises(Exception, match="more than 1 row"):
+        sess.query("SELECT id FROM o WHERE amt > (SELECT amt FROM o)")
+
+
+def test_not_in_empty_subquery_with_null_key():
+    """Regression (round-1 advisor, low): NULL NOT IN (empty set) is TRUE —
+    no comparison happens, so NULL-key rows survive."""
+    s = Session()
+    s.execute("CREATE TABLE a1 (x BIGINT)")
+    s.execute("INSERT INTO a1 VALUES (1),(NULL)")
+    s.execute("CREATE TABLE a2 (x BIGINT)")
+    s.execute("INSERT INTO a2 VALUES (9)")
+    s.execute("DELETE FROM a2 WHERE x = 9")
+    rows = s.query("SELECT COUNT(*) n FROM a1 WHERE x NOT IN (SELECT x FROM a2)")
+    assert rows == [{"n": 2}]
+    # live-empty variant: nonzero capacity, all rows filtered out (caught in
+    # round-2 code review) — must behave identically to the capacity-0 case
+    s.execute("INSERT INTO a2 VALUES (9)")
+    rows = s.query("SELECT COUNT(*) n FROM a1 "
+                   "WHERE x NOT IN (SELECT x FROM a2 WHERE x < 0)")
+    assert rows == [{"n": 2}]
