@@ -31,13 +31,14 @@ NULL_CODE = np.int32(-1)
 class Dictionary:
     """An immutable sorted dictionary for one string column."""
 
-    __slots__ = ("values", "_id", "_ft_index")
+    __slots__ = ("values", "_id", "_ft_index", "_hash_cache")
 
     def __init__(self, values: np.ndarray):
         # values must be sorted unique unicode/objects
         self.values = values
         self._id = id(values)
         self._ft_index = None   # lazily-built fulltext index (index/fulltext)
+        self._hash_cache = None
 
     # -- construction ---------------------------------------------------
     @staticmethod
@@ -101,6 +102,20 @@ class Dictionary:
     def match_mask(self, pred) -> np.ndarray:
         """Boolean per-code table for an arbitrary string predicate."""
         return np.asarray([bool(pred(v)) for v in self.values], dtype=bool)
+
+    def value_hashes(self) -> np.ndarray:
+        """Per-code uint32 hash of the VALUE (not the code).  Equal strings
+        hash equal across different dictionaries, so shuffle partitioning of
+        string keys (parallel/shuffle.py) co-locates matches from two tables
+        without a host-side dictionary merge; collisions only affect load
+        balance, never correctness."""
+        if self._hash_cache is None:
+            import zlib
+
+            self._hash_cache = np.asarray(
+                [zlib.crc32(v.encode("utf-8")) for v in self.values],
+                dtype=np.uint32)
+        return self._hash_cache
 
     def decode(self, codes: np.ndarray) -> np.ndarray:
         out = np.empty(len(codes), dtype=object)

@@ -27,14 +27,16 @@ from ..expr.ast import ColRef, Lit
 from ..expr.compile import eval_expr, eval_output, eval_predicate, infer_type
 from ..ops import join as join_ops
 from ..ops.compact import compact, head
-from ..ops.hashagg import (AggSpec, group_aggregate_dense,
-                           group_aggregate_sorted, scalar_aggregate)
+from ..ops.hashagg import (AggSpec, MERGE_OP, finalize_partials,
+                           group_aggregate_dense, group_aggregate_sorted,
+                           partial_specs, scalar_aggregate)
 from ..ops.sort import SortKey, sort_batch, top_k
-from ..plan.nodes import (AggNode, DistinctNode, FilterNode, JoinNode,
-                          LimitNode, MembershipNode, PlanNode, ProjectNode,
-                          ScalarSourceNode, ScanNode, SortNode, UnionNode,
-                          ValuesNode, WindowNode)
+from ..plan.nodes import (AggNode, DistinctNode, ExchangeNode, FilterNode,
+                          JoinNode, LimitNode, MembershipNode, PlanNode,
+                          ProjectNode, ScalarSourceNode, ScanNode, SortNode,
+                          UnionNode, ValuesNode, WindowNode)
 from ..column.batch import concat_batches
+from ..parallel.mesh import AXIS, shard_map
 from ..types import LType
 
 
@@ -42,31 +44,55 @@ class ExecError(RuntimeError):
     pass
 
 
-def compile_plan(plan: PlanNode, trace: bool = False) -> Callable:
+def compile_plan(plan: PlanNode, trace: bool = False, mesh=None) -> Callable:
     """-> fn(table_batches: dict) -> (ColumnBatch, overflow_flags[, counts]).
 
     The returned fn is pure/traceable; wrap in jax.jit by the session.  Join
     caps live on the plan nodes (mutated by the retry loop, forcing re-trace).
     With trace=True the result also carries per-node live-row counts — the
     EXPLAIN ANALYZE feed (reference: TraceNode tree, include/runtime/
-    trace_state.h, surfaced via EXPLAIN FORMAT=analyze)."""
+    trace_state.h, surfaced via EXPLAIN FORMAT=analyze).
+
+    With ``mesh`` set, the plan must have been through plan/distribute.py:
+    the WHOLE query runs inside one shard_map over the mesh's row axis —
+    table batches arrive shard-partitioned, ExchangeNodes lower to
+    all_gather/all_to_all over ICI, partial aggregates merge via
+    psum/pmin/pmax, and the final (replicated) result leaves the program.
+    This is the MPP fragment DAG (SURVEY §3.2) as a single XLA program."""
 
     join_order: list = []
     trace_order: list = []
+    n_shards = int(mesh.devices.size) if mesh is not None else 0
 
-    def run(batches: dict):
+    def run_local(batches: dict):
         overflows: list = []
         counts: list = []
         trace_order.clear()
-        ctx = (overflows, counts if trace else None, trace_order)
+        ctx = (overflows, counts if trace else None, trace_order, n_shards)
         out = _sub(plan, batches, overflows, ctx)
         # nodes are host objects: expose them on the closure (filled at trace
         # time), return only the traced flags
         join_order.clear()
         join_order.extend(n for n, _ in overflows)
+        flags = tuple(f for _, f in overflows)
+        if n_shards:
+            # flags carry NEEDED capacities: the retry must satisfy the
+            # hungriest shard, so reduce with pmax
+            flags = tuple(jax.lax.pmax(jnp.asarray(f), AXIS) for f in flags)
         if trace:
-            return out, tuple(f for _, f in overflows), tuple(counts)
-        return out, tuple(f for _, f in overflows)
+            return out, flags, tuple(counts)
+        return out, flags
+
+    if mesh is None:
+        run = run_local
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        smapped = shard_map(run_local, mesh=mesh, in_specs=(P(AXIS),),
+                            out_specs=P(), check_vma=False)
+
+        def run(batches: dict):
+            return smapped(batches)
 
     run.join_order = join_order
     run.trace_order = trace_order
@@ -74,10 +100,13 @@ def compile_plan(plan: PlanNode, trace: bool = False) -> Callable:
 
 
 def _eval_traced(node: PlanNode, batches: dict, ctx):
-    overflows, counts, trace_order = ctx
+    overflows, counts, trace_order, n_shards = ctx
     out = _eval(node, batches, overflows, ctx)
     trace_order.append(node)
-    counts.append(out.live_count())
+    c = out.live_count()
+    if n_shards and getattr(node, "dist", None) == "shard":
+        c = jax.lax.psum(c, AXIS)
+    counts.append(c)
     return out
 
 
@@ -120,9 +149,24 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         # label-qualified names are globally unique, no suffixing occurs
         return out
 
+    if isinstance(node, ExchangeNode):
+        child = _sub(node.child(), batches, overflows, ctx)
+        if node.kind == "gather":
+            return _all_gather_batch(child)
+        n = ctx[3]
+        keys = node.keys if node.keys is not None else list(child.names)
+        if node.cap is None:
+            node.cap = max(1, 2 * len(child) // max(1, n))
+        out, ovf = _repartition_exec(child, keys, n, node.cap)
+        overflows.append((node, ovf))
+        return out
+
     if isinstance(node, AggNode):
         child = _sub(node.child(), batches, overflows, ctx)
+        merge = node.merge
         if not node.key_names:
+            if merge:
+                return _scalar_agg_merged(child, node.specs)
             return scalar_aggregate(child, node.specs)
         shift = getattr(node, "key_shift", {}) or {}
         if node.strategy == "dense":
@@ -134,8 +178,12 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
                     c = cols[i]
                     cols[i] = dreplace(c, data=c.data - jnp.asarray(mn, c.data.dtype))
                 work = ColumnBatch(work.names, cols, work.sel, work.num_rows)
-            out = group_aggregate_dense(work, node.key_names, node.domains,
+            if merge:
+                out = _dense_agg_merged(work, node.key_names, node.domains,
                                         node.specs)
+            else:
+                out = group_aggregate_dense(work, node.key_names, node.domains,
+                                            node.specs)
             if shift:
                 cols = list(out.columns)
                 for kn, mn in shift.items():
@@ -156,7 +204,14 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         child = _sub(node.child(), batches, overflows, ctx)
         keys = [SortKey(k, asc) for k, asc in node.keys]
         if node.limit is not None:
-            out = top_k(child, keys, node.limit + node.offset)
+            k = node.limit + node.offset
+            if node.dist_topk:
+                # per-shard top-k, all_gather the candidates, final top-k —
+                # the TopNSorter merge of per-region streams (src/runtime/
+                # topn_sorter.cpp) as two kernels + one collective
+                local = top_k(child, keys, min(k, len(child)))
+                child = _all_gather_batch(local)
+            out = top_k(child, keys, k)
             if node.offset:
                 out = head(out, node.limit, node.offset)
             return out
@@ -227,9 +282,9 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         cols = list(child.columns)
         live = sub.live_count()
         has_row = live > 0
-        # MySQL ER_SUBQUERY_NO_1_ROW (1242): flag rides back with the join
-        # overflow flags; the session raises instead of retrying
-        overflows.append((node, live > 1))
+        # MySQL ER_SUBQUERY_NO_1_ROW (1242): the live count rides back with
+        # the needed-capacity flags; the session raises when it exceeds 1
+        overflows.append((node, jnp.asarray(live, jnp.int32)))
         for i, name in enumerate(node.col_names):
             c = sub.columns[i]
             if len(sub) == 0:
@@ -267,6 +322,75 @@ def _sub(node, batches, overflows, ctx):
     if ctx is not None and ctx[1] is not None:
         return _eval_traced(node, batches, ctx)
     return _eval(node, batches, overflows, ctx)
+
+
+# -- mesh collectives (dist mode; plan/distribute.py inserts the markers) ----
+
+def _all_gather_batch(b: ColumnBatch) -> ColumnBatch:
+    """Shard-partitioned rows -> replicated full batch (one all_gather)."""
+    def ag(x):
+        return jax.lax.all_gather(x, AXIS, axis=0, tiled=True)
+
+    cols = [dreplace(c, data=ag(c.data),
+                     validity=None if c.validity is None else ag(c.validity))
+            for c in b.columns]
+    return ColumnBatch(b.names, cols, ag(b.sel_mask()), None)
+
+
+def _repartition_exec(b: ColumnBatch, keys: list[str], n: int, cap: int):
+    """Hash-partition local rows on ``keys`` + all_to_all: equal keys land on
+    one shard (the ExchangeSender/Receiver pair as one collective)."""
+    from ..parallel.shuffle import repartition_collective
+
+    return repartition_collective(b, keys, n, cap)
+
+
+def _merge_collective(op: str, x):
+    if op == "sum":
+        return jax.lax.psum(x, AXIS)
+    if op == "min":
+        return jax.lax.pmin(x, AXIS)
+    if op == "max":
+        return jax.lax.pmax(x, AXIS)
+    raise ExecError(f"no collective merge for {op}")
+
+
+def _merge_partial_cols(part: ColumnBatch, parts: list[AggSpec],
+                        key_names: list[str]):
+    """psum/pmin/pmax-merge the aggregate columns of a local partial table."""
+    cols = []
+    for name, c in zip(part.names, part.columns):
+        if name in key_names:
+            cols.append(c)
+            continue
+        spec = next(s for s in parts if s.out_name == name)
+        merged = _merge_collective(MERGE_OP[spec.op], c.data)
+        validity = c.validity
+        if validity is not None:
+            validity = jax.lax.psum(validity.astype(jnp.int32), AXIS) > 0
+        cols.append(dreplace(c, data=merged, validity=validity))
+    return cols
+
+
+def _dense_agg_merged(batch: ColumnBatch, key_names: list[str],
+                      domains: list[int], specs: list[AggSpec]) -> ColumnBatch:
+    """Per-shard dense partial group-by + in-network merge (the partial
+    AggNode on every region + MERGE_AGG_NODE on the coordinator,
+    src/exec/agg_node.cpp, as psum/pmin/pmax over ICI)."""
+    parts, fin = partial_specs(specs)
+    part = group_aggregate_dense(batch, key_names, domains, parts)
+    cols = _merge_partial_cols(part, parts, key_names)
+    present = jax.lax.psum(part.sel_mask().astype(jnp.int32), AXIS) > 0
+    merged = ColumnBatch(part.names, cols, present, None)
+    return finalize_partials(merged, fin, key_names)
+
+
+def _scalar_agg_merged(batch: ColumnBatch, specs: list[AggSpec]) -> ColumnBatch:
+    parts, fin = partial_specs(specs)
+    part = scalar_aggregate(batch, parts)
+    cols = _merge_partial_cols(part, parts, [])
+    merged = ColumnBatch(part.names, cols, None, None)
+    return finalize_partials(merged, fin, [])
 
 
 def _broadcast(c: Column, n: int) -> Column:

@@ -105,9 +105,17 @@ class Database:
 
 
 class Session:
-    def __init__(self, db: Optional[Database] = None, database: str = "default"):
+    def __init__(self, db: Optional[Database] = None, database: str = "default",
+                 mesh=None):
+        """``mesh``: a jax.sharding.Mesh with one axis — when set, every
+        SELECT plans through plan/distribute.py and executes as a single
+        shard_map program over the mesh (scans row-sharded across devices,
+        exchanges as ICI collectives — the MPP mode, SURVEY §3.2)."""
         self.db = db or Database()
         self.current_db = database
+        self.mesh = mesh
+        # sharded device batches, keyed (table_key, version)
+        self._mesh_batches: dict = {}
         self._plan_cache: dict = {}
         # active SQL transaction: table_key -> pre-txn snapshot (copy-on-write
         # at the column tier; the row tier has its own Txn machinery —
@@ -162,7 +170,7 @@ class Session:
         if isinstance(s, ExplainStmt):
             if s.fmt == "analyze":
                 return self._explain_analyze(s.stmt)
-            plan = self._planner().plan_select(s.stmt)
+            plan = self._plan_select(s.stmt)
             return Result(columns=["plan"], plan_text=plan.tree_repr(),
                           arrow=pa.table({"plan": plan.tree_repr().split("\n")}))
         if isinstance(s, InsertStmt):
@@ -231,6 +239,20 @@ class Session:
                 return None
 
         return Planner(self.db.catalog, self.db.stores, self.current_db, stats_fn)
+
+    def _plan_select(self, stmt: SelectStmt) -> PlanNode:
+        """Logical+physical planning, plus the distribution pass (the
+        Separate/MppAnalyzer analog) when this session is mesh-bound."""
+        plan = self._planner().plan_select(stmt)
+        if self.mesh is not None:
+            from ..plan.distribute import distribute
+
+            def rows_fn(table_key: str) -> int:
+                st = self.db.stores.get(table_key)
+                return st.num_rows if st is not None else 0
+
+            plan = distribute(plan, int(self.mesh.devices.size), rows_fn)
+        return plan
 
     def _store(self, tref) -> TableStore:
         db = tref.database or self.current_db
@@ -512,7 +534,7 @@ class Session:
             if stale:
                 entry = None
         if entry is None:
-            plan = self._planner().plan_select(stmt)
+            plan = self._plan_select(stmt)
             entry = {"plan": plan, "compiled": {}, "versions": {}}
             if cache_key:
                 self._plan_cache[cache_key] = entry
@@ -531,13 +553,14 @@ class Session:
         """EXPLAIN ANALYZE: run the query once, report per-operator live-row
         counts + compile/run wall time (reference: EXPLAIN FORMAT='analyze'
         over the TraceNode tree, trace_state.h)."""
-        plan = self._planner().plan_select(stmt)
+        plan = self._plan_select(stmt)
         batches, shape_key = self._collect_batches(plan)
         # settle join caps first (the overflow-retry loop), so traced counts
         # describe the plan that actually runs, not a truncated first attempt
         entry = {"plan": plan, "compiled": {}, "versions": {}}
         self._run_plan(entry, batches, shape_key)
-        raw = compile_plan(plan, trace=True)
+        raw = compile_plan(plan, trace=True,
+                           mesh=self.mesh if batches else None)
         fn = jax.jit(raw)
         t0 = time.perf_counter()
         out, flags, counts = fn(batches)
@@ -576,6 +599,9 @@ class Session:
                 db, name = n.table_key.split(".", 1)
                 if db == "information_schema":
                     b = ColumnBatch.from_arrow(self._info_schema_table(name))
+                    if self.mesh is not None:
+                        from ..parallel.mesh import shard_batch
+                        b = shard_batch(b, self.mesh)
                     batches[n.table_key] = b
                     key_parts.append((n.table_key, -1, len(b)))
                     for c in n.children:
@@ -585,7 +611,10 @@ class Session:
                 if store is None:
                     info = self.db.catalog.get_table(db, name)
                     store = self.db.stores[n.table_key] = TableStore(info)
-                batches[n.table_key] = store.device_table_batch()
+                if self.mesh is not None:
+                    batches[n.table_key] = self._sharded_batch(n.table_key, store)
+                else:
+                    batches[n.table_key] = store.device_table_batch()
                 key_parts.append((n.table_key, store.version,
                                   len(batches[n.table_key])))
             for c in n.children:
@@ -593,6 +622,22 @@ class Session:
 
         walk_plan(plan)
         return batches, tuple(sorted(key_parts))
+
+    def _sharded_batch(self, table_key: str, store: TableStore) -> ColumnBatch:
+        """Row-shard a table across the mesh (cached per table version) —
+        the region-to-store placement analog: each mesh device holds one
+        horizontal slice, padded to SPMD-equal length."""
+        from ..parallel.mesh import shard_batch
+
+        ck = (table_key, store.version)
+        b = self._mesh_batches.get(ck)
+        if b is None:
+            # drop stale versions of this table before caching the new one
+            self._mesh_batches = {k: v for k, v in self._mesh_batches.items()
+                                  if k[0] != table_key}
+            b = shard_batch(store.device_table_batch(), self.mesh)
+            self._mesh_batches[ck] = b
+        return b
 
     def _info_schema_table(self, name: str) -> pa.Table:
         cat = self.db.catalog
@@ -635,20 +680,30 @@ class Session:
 
     def _run_plan(self, entry: dict, batches: dict, shape_key) -> ColumnBatch:
         plan = entry["plan"]
+        # a plan with no scans has no sharded state (distribute leaves it
+        # fully replicated) — run it as a plain single-device program
+        mesh = self.mesh if batches else None
         for _ in range(MAX_JOIN_RETRIES + 1):
             pair = entry["compiled"].get(shape_key)
             if pair is None:
-                raw = compile_plan(plan)
+                raw = compile_plan(plan, mesh=mesh)
                 pair = (jax.jit(raw), raw)
                 entry["compiled"][shape_key] = pair
             fn, raw = pair
             out, flags = fn(batches)
             grew = False
             for node, flag in zip(raw.join_order, flags):
-                if bool(flag):
-                    if isinstance(node, ScalarSourceNode):
+                needed = int(flag)
+                if isinstance(node, ScalarSourceNode):
+                    if needed > 1:
                         raise PlanError("Subquery returns more than 1 row")
-                    node.cap = max(1, (node.cap or 1024) * 4)
+                    continue
+                if needed > (node.cap or 0):
+                    # flags carry the exact required capacity (join output
+                    # cardinality / max shuffle-bucket size): jump straight
+                    # there (padded to a power of two so repeated runs with
+                    # slightly different data reuse the compiled executable)
+                    node.cap = max(16, 1 << (needed - 1).bit_length())
                     grew = True
             if not grew:
                 return compact(out)

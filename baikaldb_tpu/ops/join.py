@@ -92,10 +92,15 @@ def join(probe: ColumnBatch, probe_keys: list[str],
          build: ColumnBatch, build_keys: list[str],
          how: str = "inner", cap: int | None = None,
          suffix: str = "_r"):
-    """Returns (out_batch, overflow_flag).
+    """Returns (out_batch, needed_rows).
+
+    ``needed_rows`` (traced int32) is the true output cardinality; the caller
+    retries with cap >= needed_rows when it exceeds ``cap`` (the static-shape
+    overflow protocol — one exact retry instead of blind growth).
 
     how: inner | left | semi | anti.
-    - semi/anti keep probe's capacity and just refine sel (no expansion).
+    - semi/anti keep probe's capacity and just refine sel (no expansion;
+      needed_rows is 0).
     - inner/left emit up to ``cap`` rows (default: probe capacity), pairing
       each probe row with every matching build row.
     Column names: probe names keep their own; clashing build names get suffix.
@@ -133,9 +138,9 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     counts = jnp.where(lo >= first_dead, 0, jnp.minimum(counts, first_dead - lo))
 
     if how == "semi":
-        return probe.and_sel(counts > 0), jnp.asarray(False)
+        return probe.and_sel(counts > 0), jnp.int32(0)
     if how == "anti":
-        return probe.and_sel(counts == 0), jnp.asarray(False)
+        return probe.and_sel(counts == 0), jnp.int32(0)
 
     if how == "left":
         # NULL-key probe rows still survive a LEFT JOIN (with NULL build side);
@@ -149,8 +154,7 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     if cap is None:
         cap = len(probe)
     offsets = jnp.cumsum(out_counts)
-    total = offsets[-1] if len(probe) else jnp.int32(0)
-    overflow = total > cap
+    total = (offsets[-1] if len(probe) else jnp.int32(0)).astype(jnp.int32)
     starts = offsets - out_counts
     # output row j -> probe row i = searchsorted(offsets, j, 'right')
     j = jnp.arange(cap)
@@ -176,7 +180,7 @@ def join(probe: ColumnBatch, probe_keys: list[str],
         names.append(name)
         cols.append(c)
     out = ColumnBatch(tuple(names), cols, live_out, None)
-    return out, overflow
+    return out, total
 
 
 def cross_join(probe: ColumnBatch, build: ColumnBatch, cap: int | None = None,
@@ -198,5 +202,6 @@ def cross_join(probe: ColumnBatch, build: ColumnBatch, cap: int | None = None,
     for n, c in zip(out_b.names, out_b.columns):
         names.append(n if n not in names else n + suffix)
         cols.append(c)
-    overflow = jnp.asarray(np_ * nb > cap)
-    return ColumnBatch(tuple(names), cols, live, None), overflow
+    needed = jnp.int32(np_ * nb)     # full capacity, not live count: the
+    # positional pi/bi mapping above needs cap >= np_*nb rows to be exact
+    return ColumnBatch(tuple(names), cols, live, None), needed

@@ -35,19 +35,36 @@ from ..utils.hashing import partition_ids
 from .mesh import AXIS, shard_map
 
 
-def _local_repartition(b: ColumnBatch, key_names: list[str], n: int, cap: int):
-    """Shard-local: -> ([n, cap]-shaped batch pytree, valid [n, cap], overflow)."""
-    # canonicalize NULL key lanes to 0 before hashing so every NULL-key row
-    # routes to the same shard (the local sort path canonicalizes the same
-    # way; validity still separates NULL from key 0 in the local group-by)
+def partition_key_arrays(b: ColumnBatch, key_names: list[str]) -> list:
+    """Key columns -> hashable lanes for shuffle partitioning.
+
+    String columns hash by VALUE (codes mapped through the dictionary's
+    per-value hash table), so two tables with different dictionaries still
+    co-locate equal strings.  NULL lanes canonicalize to 0 so every NULL-key
+    row routes to one shard (validity still separates NULL from key 0 in the
+    local group-by/join)."""
+    from ..types import LType
+
     keys = []
     for k in key_names:
         c = b.column(k)
         d = c.data
+        if c.ltype is LType.STRING and c.dictionary is not None:
+            if len(c.dictionary) == 0:
+                d = jnp.zeros(d.shape, jnp.uint32)
+            else:
+                table = jnp.asarray(c.dictionary.value_hashes())
+                d = jnp.take(table, jnp.clip(d, 0, len(c.dictionary) - 1),
+                             mode="clip")
         if c.validity is not None:
             d = jnp.where(c.validity, d, jnp.zeros((), d.dtype))
         keys.append(d)
-    dest = partition_ids(keys, n)
+    return keys
+
+
+def _local_repartition(b: ColumnBatch, key_names: list[str], n: int, cap: int):
+    """Shard-local: -> ([n, cap]-shaped batch pytree, valid [n, cap], overflow)."""
+    dest = partition_ids(partition_key_arrays(b, key_names), n)
     sel = b.sel_mask()
     dest = jnp.where(sel, dest, n)                    # dead rows -> bucket n
     order = jnp.argsort(dest, stable=True)
@@ -57,7 +74,7 @@ def _local_repartition(b: ColumnBatch, key_names: list[str], n: int, cap: int):
     start = jnp.searchsorted(dest_s, jnp.arange(n + 1))
     rank = idx - start[jnp.clip(dest_s, 0, n)]
     counts = start[1:] - start[:-1]                   # per-dest counts [n]
-    overflow = jnp.any(counts > cap)
+    needed = counts.max().astype(jnp.int32) if n else jnp.int32(0)
     # scatter into [n, cap] send buffer (dest-major)
     slot = jnp.where((dest_s < n) & (rank < cap), dest_s * cap + rank, n * cap)
     valid = jnp.zeros((n * cap + 1,), bool).at[slot].set(True)[:n * cap]
@@ -71,27 +88,38 @@ def _local_repartition(b: ColumnBatch, key_names: list[str], n: int, cap: int):
         data = scatter_col(c.data)
         validity = None if c.validity is None else scatter_col(c.validity)
         cols.append(Column(data, validity, c.ltype, c.dictionary))
-    return cols, valid.reshape(n, cap), overflow
+    return cols, valid.reshape(n, cap), needed
 
 
 def _all_to_all(x):
     return jax.lax.all_to_all(x, AXIS, split_axis=0, concat_axis=0, tiled=True)
 
 
+def repartition_collective(b: ColumnBatch, key_names: list[str], n: int,
+                           cap: int):
+    """Shard-local body of the exchange: hash-partition + ONE all_to_all.
+
+    -> (repartitioned local batch [n*cap rows], needed: per-shard max bucket
+    size, int32).  Usable only inside shard_map; shared by the standalone
+    dist_* kernels below and the SQL executor's ExchangeNode lowering."""
+    cols, valid, needed = _local_repartition(b, key_names, n, cap)
+    out_cols = []
+    for c in cols:
+        data = _all_to_all(c.data).reshape(n * cap)
+        validity = None if c.validity is None else \
+            _all_to_all(c.validity).reshape(n * cap)
+        out_cols.append(Column(data, validity, c.ltype, c.dictionary))
+    sel = _all_to_all(valid).reshape(n * cap)
+    return ColumnBatch(b.names, out_cols, sel, None), needed
+
+
 def repartition_fn(names, key_names: list[str], n: int, cap: int):
     """Build the shard-local repartition function (for use inside shard_map)."""
 
     def fn(b: ColumnBatch):
-        cols, valid, overflow = _local_repartition(b, key_names, n, cap)
-        out_cols = []
-        for c in cols:
-            data = _all_to_all(c.data).reshape(n * cap)
-            validity = None if c.validity is None else \
-                _all_to_all(c.validity).reshape(n * cap)
-            out_cols.append(Column(data, validity, c.ltype, c.dictionary))
-        sel = _all_to_all(valid).reshape(n * cap)
-        any_overflow = jax.lax.psum(overflow.astype(jnp.int32), AXIS) > 0
-        return ColumnBatch(names, out_cols, sel, None), any_overflow
+        out, needed = repartition_collective(b, key_names, n, cap)
+        any_overflow = jax.lax.pmax(needed, AXIS) > cap
+        return ColumnBatch(names, out.columns, out.sel, None), any_overflow
 
     return fn
 
@@ -142,9 +170,9 @@ def dist_join(probe: ColumnBatch, probe_keys: list[str],
     in_b = jax.tree.map(lambda _: P(AXIS), bshard)
 
     def local(pb: ColumnBatch, bb: ColumnBatch):
-        out, ovf = join_ops.join(pb, probe_keys, bb, build_keys, how=how,
-                                 cap=local_cap)
-        any_ovf = jax.lax.psum(ovf.astype(jnp.int32), AXIS) > 0
+        out, needed = join_ops.join(pb, probe_keys, bb, build_keys, how=how,
+                                    cap=local_cap)
+        any_ovf = jax.lax.pmax(needed, AXIS) > local_cap
         return out, any_ovf
 
     probe_local = _local_view(pshard, n)

@@ -1,0 +1,204 @@
+"""Plan distribution pass — the Separate / MppAnalyzer analog.
+
+The reference splits a physical plan into frontend manager nodes plus
+per-region store fragments (src/physical_plan/separate.cpp:43) and, for MPP,
+into a DAG of fragments connected by exchange nodes with a hash-partition
+count chosen from statistics (src/physical_plan/mpp_analyzer.cpp:33-87,723).
+The TPU-native redesign keeps ONE program: this pass annotates every plan
+node with its row distribution over the mesh axis —
+
+  - ``shard``: rows are partitioned across mesh devices (the Region fan-out
+    analog; table scans start here),
+  - ``rep``:   every device holds the identical full value (the coordinator
+    state analog),
+
+and inserts explicit :class:`ExchangeNode`s where the distribution must
+change.  exec/executor.py then runs the whole annotated plan inside a single
+``shard_map``, so every Exchange lowers to an XLA collective over ICI
+(all_gather / all_to_all) instead of an RPC, and partial-aggregate merges
+lower to psum/pmin/pmax (the MERGE_AGG_NODE analog, proto/plan.proto:14-16).
+
+Join strategy (the JoinTypeAnalyzer/MppAnalyzer choice): with both sides
+sharded, either *broadcast* the build side (all_gather — right side small:
+the reference's index-join-shaped case) or *repartition both sides* on the
+join keys (all_to_all — the MPP shuffle join).  The decision uses estimated
+row counts propagated bottom-up from table statistics, like the reference
+sizing exchanges from statistics (mpp_analyzer.cpp:723-728).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+from .nodes import (AggNode, DistinctNode, ExchangeNode, FilterNode, JoinNode,
+                    LimitNode, MembershipNode, PlanNode, ProjectNode,
+                    ScalarSourceNode, ScanNode, SortNode, UnionNode,
+                    ValuesNode, WindowNode)
+
+SHARD = "shard"
+REP = "rep"
+
+# build sides at or below this estimated row count are broadcast (all_gather)
+# rather than shuffle-repartitioned; dims in a star schema land here
+BROADCAST_ROWS = 1 << 16
+
+
+def distribute(plan: PlanNode, n_shards: int,
+               rows_fn: Optional[Callable[[str], int]] = None,
+               broadcast_rows: Optional[int] = None) -> PlanNode:
+    """Annotate ``plan`` in place and insert Exchange nodes; returns the (new)
+    root.  ``rows_fn(table_key) -> row count`` feeds the broadcast-vs-shuffle
+    join decision; absent stats are treated as small (broadcast)."""
+    if broadcast_rows is None:
+        broadcast_rows = BROADCAST_ROWS     # module attr: patchable in tests
+    d = _Distributor(n_shards, rows_fn or (lambda tk: 0), broadcast_rows)
+    dist, _ = d.visit(plan)
+    if dist == SHARD:
+        root = ExchangeNode(children=[plan], schema=plan.schema, kind="gather")
+        root.dist = REP
+        return root
+    return plan
+
+
+class _Distributor:
+    def __init__(self, n_shards: int, rows_fn, broadcast_rows: int):
+        self.n = n_shards
+        self.rows_fn = rows_fn
+        self.broadcast_rows = broadcast_rows
+
+    # -- exchange insertion helpers --------------------------------------
+    def _gather(self, parent: PlanNode, i: int):
+        child = parent.children[i]
+        ex = ExchangeNode(children=[child], schema=child.schema, kind="gather")
+        ex.dist = REP
+        parent.children[i] = ex
+
+    def _repartition(self, parent: PlanNode, i: int,
+                     keys: Optional[list[str]]):
+        child = parent.children[i]
+        ex = ExchangeNode(children=[child], schema=child.schema,
+                          kind="repartition",
+                          keys=None if keys is None else list(keys))
+        ex.dist = SHARD
+        parent.children[i] = ex
+
+    # -- the pass --------------------------------------------------------
+    def visit(self, node: PlanNode) -> tuple[str, int]:
+        """-> (dist, estimated rows); sets node.dist."""
+        dist, est = self._visit(node)
+        node.dist = dist
+        return dist, est
+
+    def _visit(self, node: PlanNode) -> tuple[str, int]:
+        if isinstance(node, ScanNode):
+            return SHARD, max(1, int(self.rows_fn(node.table_key) or 1))
+
+        if isinstance(node, ValuesNode):
+            return REP, max(1, len(node.exprs))
+
+        if isinstance(node, (FilterNode, ProjectNode)):
+            return self.visit(node.child())
+
+        if isinstance(node, JoinNode):
+            dl, el = self.visit(node.children[0])
+            dr, er = self.visit(node.children[1])
+            est = el if node.how in ("semi", "anti") else max(el, er)
+            if node.how == "cross":
+                est = el * er
+            if dl == REP and dr == REP:
+                return REP, est
+            if dl == SHARD and dr == REP:
+                return SHARD, est          # broadcast join, build replicated
+            if dl == REP and dr == SHARD:
+                # replicated probe over sharded build would duplicate output
+                # rows on every shard; collect the build side instead
+                self._gather(node, 1)
+                return REP, est
+            # both sharded: broadcast small builds, shuffle big ones
+            if node.how == "cross" or er <= self.broadcast_rows \
+                    or er * self.n <= el:
+                self._gather(node, 1)
+            else:
+                self._repartition(node, 0, node.left_keys)
+                self._repartition(node, 1, node.right_keys)
+            return SHARD, est
+
+        if isinstance(node, AggNode):
+            d, e = self.visit(node.child())
+            has_distinct = any(s.distinct for s in node.specs)
+            if not node.key_names:
+                if d == SHARD:
+                    if has_distinct:
+                        self._gather(node, 0)
+                    else:
+                        node.merge = "collective"
+                return REP, 1
+            est = min(e, math.prod(x + 1 for x in node.domains)
+                      if node.strategy == "dense" else (node.max_groups or e))
+            if d == REP:
+                return REP, est
+            if node.strategy == "dense" and not has_distinct:
+                node.merge = "collective"   # psum/pmin/pmax partial merge
+                return REP, est
+            # sorted strategy or DISTINCT aggregates: co-locate each group on
+            # one shard, then aggregate locally (the MPP hash-agg plan)
+            self._repartition(node, 0, node.key_names)
+            return SHARD, est
+
+        if isinstance(node, DistinctNode):
+            d, e = self.visit(node.child())
+            if d == SHARD:
+                # keys=None: hash on ALL child columns (resolved at trace time)
+                self._repartition(node, 0, None)
+            return d, e
+
+        if isinstance(node, SortNode):
+            d, e = self.visit(node.child())
+            est = min(e, node.limit + node.offset) if node.limit is not None else e
+            if d == SHARD:
+                if node.limit is not None:
+                    # per-shard top-k, all_gather, final top-k (executor)
+                    node.dist_topk = True
+                else:
+                    self._gather(node, 0)
+            return REP, est
+
+        if isinstance(node, LimitNode):
+            d, e = self.visit(node.child())
+            if d == SHARD:
+                self._gather(node, 0)
+            return REP, min(e, node.limit + node.offset)
+
+        if isinstance(node, UnionNode):
+            dists = []
+            est = 0
+            for i, c in enumerate(node.children):
+                dc, ec = self.visit(c)
+                dists.append(dc)
+                est += ec
+            if all(dc == SHARD for dc in dists):
+                return SHARD, est
+            for i, dc in enumerate(dists):
+                if dc == SHARD:
+                    self._gather(node, i)
+            return REP, est
+
+        if isinstance(node, (MembershipNode, ScalarSourceNode)):
+            dm, em = self.visit(node.children[0])
+            ds, _ = self.visit(node.children[1])
+            if ds == SHARD:
+                # every shard's probe rows need the full subquery result
+                self._gather(node, 1)
+            return dm, em
+
+        if isinstance(node, WindowNode):
+            d, e = self.visit(node.child())
+            if d == SHARD:
+                self._gather(node, 0)
+            return REP, e
+
+        if isinstance(node, ExchangeNode):   # pragma: no cover - pass runs once
+            raise ValueError("plan already distributed")
+
+        raise ValueError(f"distribute: unknown node {type(node).__name__}")

@@ -25,6 +25,10 @@ class PlanNode:
     children: list["PlanNode"] = field(default_factory=list)
     # output schema, filled by the binder/planner
     schema: Optional[Schema] = None
+    # row distribution over the mesh axis, set by plan/distribute.py:
+    # "shard" (rows partitioned across devices) | "rep" (replicated) | None
+    # (single-device plan)
+    dist: Optional[str] = None
 
     def child(self) -> "PlanNode":
         return self.children[0]
@@ -98,10 +102,14 @@ class AggNode(PlanNode):
     strategy: str = "sorted"                 # dense | sorted
     domains: list[int] = field(default_factory=list)     # dense: per-key domain
     max_groups: int = 0                      # sorted: static group cap
+    # "collective": per-shard partials merged in-network (psum/pmin/pmax) —
+    # the partial-AggNode + MERGE_AGG_NODE pair as one collective
+    merge: str = ""
 
     def _label(self):
         s = f"dense{self.domains}" if self.strategy == "dense" else f"sorted<= {self.max_groups}"
-        return f"Agg(keys={self.key_names} {s} aggs={[sp.out_name for sp in self.specs]})"
+        m = " merge=collective" if self.merge else ""
+        return f"Agg(keys={self.key_names} {s} aggs={[sp.out_name for sp in self.specs]}{m})"
 
 
 @dataclass
@@ -109,10 +117,13 @@ class SortNode(PlanNode):
     keys: list[tuple[str, bool]] = field(default_factory=list)  # (col, asc)
     limit: Optional[int] = None              # fused top-k
     offset: int = 0
+    # distributed top-k: per-shard top-k, all_gather, final top-k
+    dist_topk: bool = False
 
     def _label(self):
         lim = f" limit={self.limit}+{self.offset}" if self.limit is not None else ""
-        return f"Sort({self.keys}{lim})"
+        d = " dist-topk" if self.dist_topk else ""
+        return f"Sort({self.keys}{lim}{d})"
 
 
 @dataclass
@@ -162,6 +173,33 @@ class MembershipNode(PlanNode):
     def _label(self):
         n = "NOT IN" if self.negate else "IN"
         return f"Membership({self.key_col} {n} subquery -> {self.out_name})"
+
+
+@dataclass
+class ExchangeNode(PlanNode):
+    """Data movement across the mesh (inserted by plan/distribute.py — the
+    Separate/MppAnalyzer analog).  Unlike the reference's ExchangeSender/
+    Receiver pair shipping Arrow batches over brpc (src/exec/
+    exchange_sender_node.cpp, mpp_analyzer.cpp), this lowers to ONE XLA
+    collective inside the jitted program:
+
+    - kind="gather":       all_gather over ICI — shard-partitioned rows become
+                           replicated (broadcast-join build sides, final
+                           result collection, small subquery results).
+    - kind="repartition":  hash-partition rows on ``keys`` + all_to_all, so
+                           equal keys land on one shard (distributed join /
+                           high-cardinality group-by).  ``cap`` is the static
+                           per-destination capacity; overflow rides the flag
+                           channel and the session retries with a larger cap.
+    """
+    kind: str = "gather"
+    keys: list[str] = field(default_factory=list)
+    cap: Optional[int] = None
+
+    def _label(self):
+        if self.kind == "gather":
+            return "Exchange(gather -> replicated)"
+        return f"Exchange(repartition on {self.keys} cap={self.cap})"
 
 
 @dataclass

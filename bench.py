@@ -9,18 +9,53 @@ Prints ONE JSON line:
   {"metric": ..., "value": rows/sec on device, "unit": "rows/sec",
    "vs_baseline": speedup_over_pyarrow}
 
-Env knobs: BENCH_ROWS (default 100M; auto-reduced on CPU), BENCH_REPEATS.
+Robustness contract (the driver depends on it): this script ALWAYS prints its
+JSON line and exits 0, even when the accelerator backend is wedged.  Backend
+init is probed in a subprocess with a timeout first — a dead TPU tunnel HANGS
+instead of failing — and on probe failure the benchmark re-execs itself under
+a forced-CPU environment (JAX_PLATFORMS=cpu, PYTHONPATH cleared to bypass any
+site hook that would still touch the accelerator plugin).
+
+Env knobs: BENCH_ROWS (default 100M; auto-reduced on CPU), BENCH_REPEATS,
+BENCH_KERNEL=pallas, BENCH_PROBE_TIMEOUT (s).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+_FORCED_FLAG = "BENCH_FORCED_CPU"
 
-def main():
+
+def _probe_backend(timeout_s: float) -> str | None:
+    """Initialise the JAX backend in a THROWAWAY subprocess; return the
+    platform name, or None if init fails or hangs (wedged tunnel)."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    out = r.stdout.strip().splitlines()
+    return out[-1] if out else None
+
+
+def _reexec_cpu():
+    """Replace this process with a forced-CPU run of the same benchmark."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""          # bypass accelerator site hooks entirely
+    env[_FORCED_FLAG] = "1"
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
+def run_bench() -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -106,13 +141,37 @@ def main():
     got_n = np.asarray(out[1])[:n_groups]   # slot n_groups is the NULL-key slot
     assert np.array_equal(want_n, got_n), "benchmark kernel wrong"
 
-    print(json.dumps({
+    return {
         "metric": f"filter+GROUP BY rows/sec ({n_rows / 1e6:.0f}M rows, "
                   f"{platform})",
         "value": round(dev_rps, 1),
         "unit": "rows/sec",
         "vs_baseline": round(dev_rps / bas_rps, 3),
-    }))
+    }
+
+
+def main():
+    forced = os.environ.get(_FORCED_FLAG) == "1"
+    if not forced:
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+        platform = _probe_backend(probe_timeout)
+        if platform is None:
+            # backend init failed or hung: never touch it from this process
+            _reexec_cpu()
+    try:
+        result = run_bench()
+    except Exception as e:                          # noqa: BLE001
+        if not forced:
+            # backend probed healthy but the run itself died: record the
+            # accelerator-side failure, then retry once on CPU
+            print(f"bench: accelerator run failed, retrying on CPU: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            _reexec_cpu()
+        result = {"metric": "filter+GROUP BY rows/sec (failed)", "value": 0,
+                  "unit": "rows/sec", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":

@@ -147,8 +147,8 @@ def test_partial_merge_protocol():
 def test_inner_join_unique():
     probe = batch_of({"k": [1, 2, 3, 4, 9], "pv": [10, 20, 30, 40, 90]})
     build = batch_of({"k": [2, 3, 4, 5], "bv": [200, 300, 400, 500]})
-    out, ovf = join(probe, ["k"], build, ["k"], how="inner")
-    assert not bool(ovf)
+    out, needed = join(probe, ["k"], build, ["k"], how="inner")
+    assert int(needed) == 3 <= len(probe)
     rows = out.to_arrow().to_pylist()
     assert [(r["k"], r["pv"], r["bv"]) for r in rows] == [
         (2, 20, 200), (3, 30, 300), (4, 40, 400)]
@@ -157,8 +157,8 @@ def test_inner_join_unique():
 def test_inner_join_duplicates_expansion():
     probe = batch_of({"k": [1, 2], "pv": [10, 20]})
     build = batch_of({"k": [2, 2, 2, 1], "bv": [1, 2, 3, 4]})
-    out, ovf = join(probe, ["k"], build, ["k"], how="inner", cap=8)
-    assert not bool(ovf)
+    out, needed = join(probe, ["k"], build, ["k"], how="inner", cap=8)
+    assert int(needed) == 4 <= 8
     rows = sorted([(r["k"], r["bv"]) for r in out.to_arrow().to_pylist()])
     assert rows == [(1, 4), (2, 1), (2, 2), (2, 3)]
 
@@ -166,8 +166,10 @@ def test_inner_join_duplicates_expansion():
 def test_join_overflow_flag():
     probe = batch_of({"k": [2, 2]})
     build = batch_of({"k": [2, 2, 2]})
-    out, ovf = join(probe, ["k"], build, ["k"], how="inner", cap=2)
-    assert bool(ovf)
+    out, needed = join(probe, ["k"], build, ["k"], how="inner", cap=2)
+    # the flag channel reports the exact required capacity (2 probe rows x 3
+    # matching build rows), so the caller retries once with cap >= 6
+    assert int(needed) == 6 > 2
 
 
 def test_left_join_nulls():
@@ -218,8 +220,8 @@ def test_join_respects_sel():
 def test_cross_join():
     a = batch_of({"x": [1, 2]})
     b = batch_of({"y": [10, 20, 30]})
-    out, ovf = cross_join(a, b)
-    assert not bool(ovf)
+    out, needed = cross_join(a, b)
+    assert int(needed) == 6
     assert len(out.to_arrow()) == 6
 
 

@@ -10,9 +10,15 @@ from baikaldb_tpu.exec.session import Session
 from baikaldb_tpu.models import tpch
 
 
-@pytest.fixture(scope="module")
-def env():
-    s = Session()
+@pytest.fixture(scope="module", params=["single", "mesh"])
+def env(request):
+    """Every TPC-H golden check runs twice: single-device and distributed
+    over the 8-virtual-device mesh (VERDICT r1 #1 'done when')."""
+    if request.param == "mesh":
+        from baikaldb_tpu.parallel.mesh import make_mesh
+        s = Session(mesh=make_mesh(8))
+    else:
+        s = Session()
     tables = tpch.load_into(s, scale=0.002, seed=7)
     dfs = {k: t.to_pandas() for k, t in tables.items()}
     return s, dfs
