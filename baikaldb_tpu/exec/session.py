@@ -37,6 +37,9 @@ from ..types import Field, LType, Schema
 from .executor import compile_plan
 
 MAX_JOIN_RETRIES = 4
+# INSERT..SELECT at or below this lands in the hot (WAL-durable) row tier;
+# above it, the bulk cold path (durable at the next checkpoint)
+HOT_INSERT_ROWS = 100_000
 
 
 def _empty_info(name: str):
@@ -88,9 +91,15 @@ class Result:
 
 
 class Database:
-    """Shared engine state: catalog + table stores (one per server)."""
+    """Shared engine state: catalog + table stores (one per server).
 
-    def __init__(self):
+    With ``data_dir`` set the engine is durable: every table gets a WAL for
+    hot DML (storage/column_store.py row tier), DDL persists the catalog as
+    JSON, and ``checkpoint()`` flushes cold Parquet + resets WALs.  A new
+    Database over the same directory recovers committed state — the analog
+    of baikalStore restart recovery (SURVEY §3.4)."""
+
+    def __init__(self, data_dir: Optional[str] = None):
         self.catalog = Catalog()
         self.stores: dict[str, TableStore] = {}
         # query statistics ring (reference: slow-SQL collection + print_agg_sql,
@@ -99,9 +108,84 @@ class Database:
         from ..storage.binlog import Binlog
         self.binlog = Binlog()
         self.qos = None          # optional utils.qos.QosManager
+        self.data_dir = data_dir
+        if data_dir:
+            import os
+            os.makedirs(data_dir, exist_ok=True)
+            self._recover()
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]
+
+    def make_store(self, info) -> TableStore:
+        """Create a table's store; durable (WAL-attached) under data_dir."""
+        key = f"{info.database}.{info.name}"
+        if not self.data_dir:
+            return TableStore(info)
+        import os
+        st = TableStore(info)
+        pq_dir = os.path.join(self.data_dir, key)
+        if os.path.isdir(pq_dir):
+            st.load_parquet(pq_dir)
+        st.durable_dir = pq_dir
+        st.attach_wal(os.path.join(self.data_dir, key + ".wal"))
+        return st
+
+    # -- durability -------------------------------------------------------
+    def save_catalog(self):
+        if not self.data_dir:
+            return
+        import json
+        import os
+        dbs = [d for d in self.catalog.databases()
+               if d != "information_schema"]
+        out = {"databases": dbs, "tables": []}
+        for db in dbs:
+            for t in self.catalog.tables(db):
+                info = self.catalog.get_table(db, t)
+                out["tables"].append({
+                    "database": db, "name": t,
+                    "fields": [[f.name, f.ltype.value, f.nullable]
+                               for f in info.schema.fields],
+                    "indexes": [[ix.name, ix.kind, list(ix.columns)]
+                                for ix in info.indexes],
+                    "options": dict(info.options or {}),
+                })
+        tmp = os.path.join(self.data_dir, "catalog.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, os.path.join(self.data_dir, "catalog.json"))
+
+    def _recover(self):
+        import json
+        import os
+        path = os.path.join(self.data_dir, "catalog.json")
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            saved = json.load(f)
+        for db in saved["databases"]:
+            if db not in self.catalog.databases():
+                self.catalog.create_database(db, if_not_exists=True)
+        for t in saved["tables"]:
+            fields = tuple(Field(n, LType(v), nullable)
+                           for n, v, nullable in t["fields"])
+            indexes = [IndexInfo(n, k, cols) for n, k, cols in t["indexes"]]
+            info = self.catalog.create_table(
+                t["database"], t["name"], Schema(fields), indexes,
+                options=t["options"], if_not_exists=True)
+            key = f"{t['database']}.{t['name']}"
+            self.stores[key] = self.make_store(info)
+
+    def checkpoint(self):
+        """Flush every table's live state to Parquet + reset WALs (the
+        hot->cold flush boundary, region_olap.cpp:445)."""
+        if not self.data_dir:
+            raise RuntimeError("checkpoint requires a data_dir")
+        import os
+        for key, st in self.stores.items():
+            st.checkpoint(os.path.join(self.data_dir, key))
+        self.save_catalog()
 
 
 class Session:
@@ -117,10 +201,10 @@ class Session:
         # sharded device batches, keyed (table_key, version)
         self._mesh_batches: dict = {}
         self._plan_cache: dict = {}
-        # active SQL transaction: table_key -> pre-txn snapshot (copy-on-write
-        # at the column tier; the row tier has its own Txn machinery —
-        # storage/rowstore.py)
-        self._txn_backup: Optional[dict] = None
+        # active SQL transaction: table_key -> storage TxnContext (row-tier
+        # locks + buffered WAL writes + zero-copy region pre-images; the
+        # reference's Transaction, src/engine/transaction.cpp:98-396)
+        self._sql_txn: Optional[dict] = None
         # binlog events buffered until COMMIT (discarded on ROLLBACK) so CDC
         # subscribers never see uncommitted changes
         self._txn_binlog: list = []
@@ -131,7 +215,7 @@ class Session:
             # bulk ingest: statement image only (avoid O(n) python row images)
             statement = statement or f"bulk insert {len(rows)} rows"
             rows = None
-        if self._txn_backup is not None:
+        if self._sql_txn is not None:
             self._txn_binlog.append((event_type, db_name, table, rows,
                                      statement, affected))
             return
@@ -163,8 +247,7 @@ class Session:
         # rolling back across a schema change is not supported
         if isinstance(s, (CreateTableStmt, DropTableStmt, CreateDatabaseStmt,
                           DropDatabaseStmt, TruncateStmt, AlterTableStmt)):
-            self._txn_backup = None
-            self._flush_txn_binlog()
+            self._commit_txn()
         if isinstance(s, SelectStmt):
             return self._select(s)
         if isinstance(s, ExplainStmt):
@@ -186,18 +269,22 @@ class Session:
         if isinstance(s, DropTableStmt):
             db = s.table.database or self.current_db
             self.db.catalog.drop_table(db, s.table.name, s.if_exists)
-            self.db.stores.pop(f"{db}.{s.table.name}", None)
+            st = self.db.stores.pop(f"{db}.{s.table.name}", None)
+            self._drop_durable(f"{db}.{s.table.name}", st)
+            self.db.save_catalog()
             return Result()
         if isinstance(s, TruncateStmt):
             self._store(s.table).truncate()
             return Result()
         if isinstance(s, CreateDatabaseStmt):
             self.db.catalog.create_database(s.name, if_not_exists=s.if_not_exists)
+            self.db.save_catalog()
             return Result()
         if isinstance(s, DropDatabaseStmt):
             self.db.catalog.drop_database(s.name, s.if_exists)
             for k in [k for k in self.db.stores if k.startswith(s.name + ".")]:
-                del self.db.stores[k]
+                self._drop_durable(k, self.db.stores.pop(k))
+            self.db.save_catalog()
             return Result()
         if isinstance(s, UseStmt):
             if s.database not in self.db.catalog.databases():
@@ -226,6 +313,21 @@ class Session:
                 "Key": ["PRI" if f.name in pkcols else "" for f in info.schema.fields],
             }))
         raise SqlError(f"unsupported statement {type(s).__name__}")
+
+    def _drop_durable(self, key: str, store):
+        """Remove a dropped table's WAL + Parquet from data_dir."""
+        if not self.db.data_dir:
+            return
+        import os
+        import shutil
+        if store is not None:
+            store.row_table = None      # release the WAL file handle
+        wal = os.path.join(self.db.data_dir, key + ".wal")
+        if os.path.exists(wal):
+            os.remove(wal)
+        pq_dir = os.path.join(self.db.data_dir, key)
+        if os.path.isdir(pq_dir):
+            shutil.rmtree(pq_dir)
 
     # -- helpers ------------------------------------------------------------
     def _planner(self) -> Planner:
@@ -262,35 +364,41 @@ class Session:
         if key not in self.db.stores:
             # registers lazily in case catalog was populated externally
             info = self.db.catalog.get_table(db, tref.name)
-            self.db.stores[key] = TableStore(info)
+            self.db.stores[key] = self.db.make_store(info)
         return self.db.stores[key]
 
     # -- transactions ------------------------------------------------------
     def _txn_stmt(self, s: TxnStmt) -> Result:
         """BEGIN/COMMIT/ROLLBACK (reference: transaction_planner.cpp +
-        TransactionNode fan-out).  Single-node semantics: copy-on-write
-        snapshots of touched tables, restored on ROLLBACK."""
+        TransactionNode fan-out).  Each touched table gets a storage
+        TxnContext: pessimistic row locks + row-tier write buffer + zero-copy
+        region pre-images; COMMIT is one atomic WAL batch per table."""
         if s.kind == "begin":
             # a new BEGIN implicitly commits any previous txn (MySQL behavior)
-            self._flush_txn_binlog()
-            self._txn_backup = {}
+            self._commit_txn()
+            self._sql_txn = {}
             return Result()
-        if self._txn_backup is None:
+        if self._sql_txn is None:
             return Result()      # COMMIT/ROLLBACK outside txn: no-op
         if s.kind == "commit":
-            self._txn_backup = None
-            self._flush_txn_binlog()
+            self._commit_txn()
             return Result()
-        if s.kind == "rollback":
-            for key, snap in self._txn_backup.items():
-                store = self.db.stores.get(key)
-                if store is not None:
-                    store.truncate()
-                    if snap.num_rows:
-                        store.insert_arrow(snap)
-        self._txn_backup = None
+        for tctx in self._sql_txn.values():
+            tctx.rollback()
+        self._sql_txn = None
         self._txn_binlog.clear()    # rolled back: subscribers never see these
         return Result()
+
+    def _commit_txn(self):
+        if self._sql_txn is not None:
+            try:
+                for tctx in self._sql_txn.values():
+                    tctx.commit()
+            finally:
+                # even a failed WAL write must not trap the session in the
+                # transaction (the contexts released their leases already)
+                self._sql_txn = None
+        self._flush_txn_binlog()
 
     def _flush_txn_binlog(self):
         for ev in self._txn_binlog:
@@ -299,24 +407,25 @@ class Session:
                                   statement=statement, affected=affected)
         self._txn_binlog.clear()
 
-    def _txn_touch(self, store: TableStore):
-        """Record a pre-image before the first mutation inside a txn."""
-        if self._txn_backup is None:
-            return
+    def _tctx(self, store: TableStore):
+        """The open transaction's per-table context (created on first touch),
+        or None in autocommit."""
+        if self._sql_txn is None:
+            return None
         key = f"{store.info.database}.{store.info.name}"
-        if key not in self._txn_backup:
-            self._txn_backup[key] = store.snapshot()
+        if key not in self._sql_txn:
+            self._sql_txn[key] = store.begin_txn()
+        return self._sql_txn[key]
 
     def load_arrow(self, table_name: str, table: pa.Table,
                    database: str | None = None) -> int:
         """Bulk ingest (the importer/fast_importer analog, src/tools/importer):
         appends an Arrow table straight into the column store, bypassing SQL
-        row parsing."""
+        row parsing (cold path — durable at the next Database.checkpoint)."""
         from ..sql.stmt import TableRef
 
         store = self._store(TableRef(database, table_name))
-        self._txn_touch(store)
-        store.insert_arrow(table)
+        store.insert_arrow(table, self._tctx(store))
         return table.num_rows
 
     # -- DDL --------------------------------------------------------------
@@ -338,7 +447,8 @@ class Session:
                                             if_not_exists=s.if_not_exists)
         key = f"{db}.{s.table.name}"
         if key not in self.db.stores:
-            self.db.stores[key] = TableStore(info)
+            self.db.stores[key] = self.db.make_store(info)
+        self.db.save_catalog()
         return Result()
 
     def _alter_table(self, s: AlterTableStmt) -> Result:
@@ -371,6 +481,7 @@ class Session:
         store.alter_schema(new_schema)   # bumps info.version itself
         self.db.binlog.append("ddl", db, s.table.name,
                               statement=f"ALTER TABLE {s.table.name} {s.action}")
+        self.db.save_catalog()
         return Result()
 
     def ttl_tick(self, now=None) -> int:
@@ -410,7 +521,6 @@ class Session:
     # -- DML --------------------------------------------------------------
     def _insert(self, s: InsertStmt) -> Result:
         store = self._store(s.table)
-        self._txn_touch(store)
         schema = store.info.schema
         if s.select is not None:
             sub = self._select(s.select)
@@ -419,7 +529,12 @@ class Session:
                 t = t.rename_columns(s.columns)
             else:
                 t = t.rename_columns(schema.names()[:t.num_columns])
-            store.insert_arrow(t)
+            if t.num_rows <= HOT_INSERT_ROWS:
+                # small INSERT..SELECT takes the hot path: PK-checked and
+                # WAL-durable like INSERT..VALUES
+                store.insert_rows(t.to_pylist(), self._tctx(store))
+            else:
+                store.insert_arrow(t, self._tctx(store), check_dups=True)
             db_name = s.table.database or self.current_db
             if t.num_rows > 1000:
                 self._log_binlog("insert", db_name, s.table.name,
@@ -446,7 +561,7 @@ class Session:
                     else:
                         r[f.name] = datetime.datetime(1970, 1, 1) + \
                             datetime.timedelta(microseconds=v)
-        store.insert_rows(rows)
+        store.insert_rows(rows, self._tctx(store))
         self._log_binlog("insert", db_name, s.table.name, rows=rows,
                          affected=len(rows))
         return Result(affected_rows=len(rows))
@@ -467,7 +582,6 @@ class Session:
 
     def _update(self, s: UpdateStmt) -> Result:
         store = self._store(s.table)
-        self._txn_touch(store)
         schema = store.info.schema
         arrow_schema = store.arrow_schema
         assigns = s.assignments
@@ -503,7 +617,9 @@ class Session:
                 out = out.set_column(idx, f, pa.array(newcol, type=f.type))
             return out
 
-        n = store.update_where(self._host_mask(store, s.where), assign_fn)
+        n = store.update_where(self._host_mask(store, s.where), assign_fn,
+                               self._tctx(store),
+                               changed_cols=[name for name, _ in assigns])
         if n:
             self._log_binlog("update", s.table.database or self.current_db,
                              s.table.name,
@@ -512,8 +628,8 @@ class Session:
 
     def _delete(self, s: DeleteStmt) -> Result:
         store = self._store(s.table)
-        self._txn_touch(store)
-        n = store.delete_where(self._host_mask(store, s.where))
+        n = store.delete_where(self._host_mask(store, s.where),
+                               self._tctx(store))
         if n:
             self._log_binlog("delete", s.table.database or self.current_db,
                              s.table.name,
@@ -610,7 +726,7 @@ class Session:
                 store = self.db.stores.get(n.table_key)
                 if store is None:
                     info = self.db.catalog.get_table(db, name)
-                    store = self.db.stores[n.table_key] = TableStore(info)
+                    store = self.db.stores[n.table_key] = self.db.make_store(info)
                 if self.mesh is not None:
                     batches[n.table_key] = self._sharded_batch(n.table_key, store)
                 else:

@@ -1,12 +1,23 @@
-"""Columnar table storage: host Arrow tier + device-resident region cache.
+"""Columnar table storage: host Arrow tier + device cache, backed by the
+MVCC row tier for durability and transactions.
 
-The reference's OLAP tier stores rows as Parquet column files managed by
-ColumnFileManager (src/column, include/column/file_manager.h:272) and converts
-row data to columns via row2column readers; scans produce Arrow RecordBatches.
-Here the host tier is a pyarrow Table per region (persistable to Parquet), and
-the *device tier* is a lazily-built, cached ColumnBatch per region — the
-TPU-resident column cache that scans read from (the ParquetCache analog,
-include/column/parquet_cache.h:168).
+Two tiers, mirroring the reference's hot/cold split (hot rows in RocksDB,
+cold Parquet flushed by region_olap.cpp:445):
+
+- **Cold / columnar**: a pyarrow Table per Region (persistable to Parquet)
+  plus a lazily-built device ColumnBatch cache — what every query scans
+  (the ParquetCache analog, include/column/parquet_cache.h:168).
+- **Hot / row delta**: every SQL DML statement also writes the C++ MVCC row
+  tier (storage/rowstore.py -> native/engine.cpp) keyed by an implicit
+  ``__rowid``; with a WAL attached this makes committed DML durable — on
+  restart the WAL deltas replay over the last Parquet checkpoint (the
+  reference's recovery from applied_index + raft log, region.h:644).
+
+Transactions take region *pre-image references* (Arrow tables are immutable,
+so capture is O(1) — no data copy, unlike the round-1 whole-table snapshot)
+plus pessimistic row locks and buffered row-tier writes via rowstore.Txn;
+rollback restores the references and discards the buffer (reference:
+src/engine/transaction.cpp:98-396).
 
 Regions partition the row axis (the reference's key-range Region shards,
 include/store/region.h:445); round 1 splits by fixed row-count ranges and the
@@ -26,9 +37,11 @@ import pyarrow.parquet as pq
 
 from ..column.batch import ColumnBatch
 from ..meta.catalog import TableInfo
-from ..types import LType, Schema
+from ..types import Field, LType, Schema
+from .rowstore import ConflictError, KeyCodec, RowTable, Txn
 
 DEFAULT_REGION_ROWS = 1 << 20  # split threshold on the row axis
+ROWID = "__rowid"              # hidden parquet column carrying row identity
 
 
 def schema_to_arrow(schema: Schema) -> pa.Schema:
@@ -51,9 +64,14 @@ class Region:
     arrives with the distributed store tier)."""
     region_id: int
     data: pa.Table
+    rowids: Optional[np.ndarray] = None      # int64 [num_rows]
     version: int = 1
     _device: Optional[ColumnBatch] = None
     _device_version: int = -1
+
+    def __post_init__(self):
+        if self.rowids is None:
+            self.rowids = np.zeros(self.data.num_rows, np.int64)
 
     @property
     def num_rows(self) -> int:
@@ -67,30 +85,194 @@ class Region:
         return self._device
 
 
+class TxnContext:
+    """One table's open-transaction state: buffered row-tier writes with
+    pessimistic locks (rowstore.Txn) + column-tier undo as region pre-image
+    REFERENCES (Arrow immutability makes capture copy-free)."""
+
+    def __init__(self, store: "TableStore"):
+        self.store = store
+        self.row_txn: Txn = store.row_table.begin()
+        self._snap = None
+
+    def _capture(self):
+        """Called by the store (under its lock) before the first mutation."""
+        if self._snap is None:
+            st = self.store
+            self._snap = (list(st.regions),
+                          [(r, r.data, r.rowids, r.version) for r in st.regions])
+
+    def commit(self):
+        try:
+            if self.store.wal_path is not None:
+                self.row_txn.commit()   # one atomic WAL batch + fsync
+            else:
+                # non-durable store: the buffered rows would never be read —
+                # just release the row locks
+                self.row_txn.rollback()
+        finally:
+            # release the writer lease even on a failed WAL write, or every
+            # later statement on this table would conflict forever
+            self.store._end_txn(self)
+
+    def rollback(self):
+        self.row_txn.rollback()
+        st = self.store
+        with st._lock:
+            if self._snap is not None:
+                regions, states = self._snap
+                st.regions = list(regions)
+                for r, data, rowids, version in states:
+                    r.data = data
+                    r.rowids = rowids
+                    # versions stay monotonic so stale device/stats caches
+                    # can never alias a rolled-back state
+                    r.version = max(r.version, version) + 1
+                st._mutations += 1
+                st._pk_stale = True
+        st._end_txn(self)
+
+
 class TableStore:
     """All regions of one table + DML on the host tier.
 
-    OLTP writes (insert/delete/update) mutate the host Arrow data and bump
-    versions; the device cache refreshes lazily.  This mirrors the reference's
-    hot row store feeding the cold column tier (region_olap.cpp), collapsed to
-    one tier for round 1."""
+    Writes mutate the host Arrow data (the read-optimized copy every query
+    scans) AND mirror into the row tier for WAL durability; the device cache
+    refreshes lazily."""
 
-    def __init__(self, info: TableInfo, region_rows: int = DEFAULT_REGION_ROWS):
+    def __init__(self, info: TableInfo, region_rows: int = DEFAULT_REGION_ROWS,
+                 wal_path: str | None = None):
         self.info = info
         self.region_rows = region_rows
         self.arrow_schema = schema_to_arrow(info.schema)
         self._lock = threading.RLock()
         self._mutations = 0
         self._next_region = 1
+        self._next_rowid = 1
         self.regions: list[Region] = [Region(self._alloc_region_id(),
                                              self.arrow_schema.empty_table())]
+        self.wal_path = None
+        self.durable_dir: Optional[str] = None   # Parquet checkpoint home
+        self._writer: Optional[TxnContext] = None
+        self._build_row_tier(None)
+        # primary-key uniqueness index (lazy; bulk loads mark it stale)
+        pk = info.primary_key() if hasattr(info, "primary_key") else None
+        self._pk_cols = list(pk.columns) if pk else None
+        self._pk_codec = KeyCodec(info.schema, self._pk_cols) if pk else None
+        self._pk_index: Optional[dict] = None
+        self._pk_stale = True
+        if wal_path:
+            self.attach_wal(wal_path)
 
+    # -- row tier ---------------------------------------------------------
+    def _row_schema(self) -> Schema:
+        return Schema((Field(ROWID, LType.INT64, False),
+                       Field("__del", LType.BOOL, True))
+                      + self.info.schema.fields)
+
+    def _build_row_tier(self, wal_path: str | None):
+        self.row_table = RowTable(self._row_schema(), [ROWID],
+                                  wal_path=wal_path)
+        self.wal_path = wal_path
+
+    def attach_wal(self, path: str):
+        """Open (and replay) the WAL: committed hot deltas since the last
+        checkpoint apply over the current cold state (reference: restart
+        recovery from applied_index + log replay, include/store/region.h:644)."""
+        self._build_row_tier(path)
+        rows = self.row_table.scan_rows()
+        if rows:
+            self._apply_deltas(rows)
+        for r in rows:
+            self._next_rowid = max(self._next_rowid, int(r[ROWID]) + 1)
+
+    def _apply_deltas(self, rows: list[dict]):
+        """Replay WAL rows (inserts / updates / __del markers) over cold."""
+        with self._lock:
+            loc = {}
+            for reg in self.regions:
+                for off, rid in enumerate(reg.rowids):
+                    loc[int(rid)] = (reg, off)
+            per_region: dict[int, dict[int, Optional[dict]]] = {}
+            appends: list[dict] = []
+            for row in rows:
+                rid = int(row[ROWID])
+                if rid in loc:
+                    reg, off = loc[rid]
+                    patch = per_region.setdefault(reg.region_id, {})
+                    patch[off] = None if row.get("__del") else row
+                elif not row.get("__del"):
+                    appends.append(row)
+            for reg in self.regions:
+                patch = per_region.get(reg.region_id)
+                if not patch:
+                    continue
+                py = reg.data.to_pylist()
+                keep = np.ones(reg.num_rows, bool)
+                for off, row in patch.items():
+                    if row is None:
+                        keep[off] = False
+                    else:
+                        py[off] = {f.name: row.get(f.name)
+                                   for f in self.info.schema.fields}
+                cols = {f.name: [r[f.name] for r in py]
+                        for f in self.arrow_schema}
+                reg.data = pa.table(cols, schema=self.arrow_schema) \
+                    .filter(pa.array(keep))
+                reg.rowids = reg.rowids[keep]
+                reg.version += 1
+            if appends:
+                rowids = np.asarray([int(r[ROWID]) for r in appends], np.int64)
+                cols = {f.name: [r.get(f.name) for r in appends]
+                        for f in self.arrow_schema}
+                self._append_table(pa.table(cols, schema=self.arrow_schema),
+                                   rowids)
+            self._mutations += 1
+            self._pk_stale = True
+
+    def checkpoint(self, directory: str):
+        """Flush the full live state to Parquet and reset the WAL — the
+        hot->cold flush (region_olap.cpp:445 flush_to_cold)."""
+        with self._lock:
+            self.save_parquet(directory)
+            self._reset_wal()
+
+    # -- transactions -----------------------------------------------------
+    def begin_txn(self) -> TxnContext:
+        with self._lock:
+            if self._writer is not None:
+                raise ConflictError(
+                    f"table {self.info.name} locked by an open transaction")
+            tctx = TxnContext(self)
+            self._writer = tctx
+            return tctx
+
+    def _end_txn(self, tctx: TxnContext):
+        with self._lock:
+            if self._writer is tctx:
+                self._writer = None
+
+    def _writer_check(self, tctx: Optional[TxnContext]):
+        """Statement-level write admission: an open transaction holds the
+        table's writer lease; concurrent writers conflict (the coarse analog
+        of the reference's per-row pessimistic locks + 2PC ordering)."""
+        if self._writer is not None and self._writer is not tctx:
+            raise ConflictError(
+                f"table {self.info.name} locked by an open transaction")
+        if tctx is not None:
+            tctx._capture()
+
+    # -- reads ----------------------------------------------------------
     def _alloc_region_id(self) -> int:
         rid = self._next_region
         self._next_region += 1
         return rid
 
-    # -- reads ----------------------------------------------------------
+    def _alloc_rowids(self, n: int) -> np.ndarray:
+        start = self._next_rowid
+        self._next_rowid += n
+        return np.arange(start, start + n, dtype=np.int64)
+
     @property
     def num_rows(self) -> int:
         with self._lock:
@@ -165,41 +347,160 @@ class TableStore:
             cache[1][column] = st
             return st
 
+    # -- primary-key index -----------------------------------------------
+    def _ensure_pk_index(self):
+        if self._pk_codec is None:
+            return None
+        if self._pk_index is None or self._pk_stale:
+            idx: dict = {}
+            with self._lock:
+                for reg in self.regions:
+                    if not reg.num_rows:
+                        continue
+                    keys = self._encode_pk_table(reg.data)
+                    for k, rid in zip(keys, reg.rowids):
+                        idx[k] = int(rid)
+            self._pk_index = idx
+            self._pk_stale = False
+        return self._pk_index
+
+    def _encode_pk_table(self, table: pa.Table) -> list[bytes]:
+        cols, valids = [], []
+        for name in self._pk_cols:
+            arr = table.column(name)
+            f = self.info.schema.field(name)
+            if f.ltype is LType.STRING:
+                cols.append(np.asarray(arr.to_pylist(), dtype=object))
+            elif f.ltype is LType.DATE:
+                cols.append(np.asarray(arr.cast(pa.int32()).to_numpy(
+                    zero_copy_only=False), np.int64))
+            elif f.ltype.is_temporal:
+                cols.append(np.asarray(
+                    arr.cast(pa.timestamp("us")).cast(pa.int64()).to_numpy(
+                        zero_copy_only=False), np.int64))
+            elif f.ltype.is_float:
+                cols.append(arr.to_numpy(zero_copy_only=False))
+            else:
+                nulls = arr.null_count
+                work = arr.fill_null(0) if nulls else arr
+                cols.append(np.asarray(work.to_numpy(zero_copy_only=False),
+                                       np.int64))
+            valids.append(~np.asarray(arr.is_null()) if arr.null_count
+                          else None)
+        n = table.num_rows
+        return self._pk_codec.encode_rows(cols, valids) if n else []
+
+    def _check_duplicates(self, table: pa.Table):
+        """INSERT-time primary-key uniqueness (reference: rocksdb key
+        collision -> ER_DUP_ENTRY)."""
+        if self._pk_codec is None or not table.num_rows:
+            return
+        idx = self._ensure_pk_index()
+        keys = self._encode_pk_table(table)
+        seen = set()
+        for k in keys:
+            if k in idx or k in seen:
+                raise ConflictError(
+                    f"Duplicate entry for key 'PRIMARY' in table "
+                    f"{self.info.name!r}")
+            seen.add(k)
+        return keys
+
     # -- writes ---------------------------------------------------------
-    def insert_arrow(self, table: pa.Table):
-        """Append rows (column order/type coerced to the table schema)."""
-        table = _coerce(table, self.arrow_schema)
-        with self._lock:
-            self._mutations += 1
-            last = self.regions[-1]
-            last.data = pa.concat_tables([last.data, table]).combine_chunks()
-            last.version += 1
+    def _append_table(self, table: pa.Table, rowids: np.ndarray,
+                      split: bool = True):
+        last = self.regions[-1]
+        last.data = pa.concat_tables([last.data, table]).combine_chunks()
+        last.rowids = np.concatenate([last.rowids, rowids])
+        last.version += 1
+        if split:
             self._maybe_split(last)
 
-    def insert_rows(self, rows: list[dict]):
-        cols = {f.name: [r.get(f.name) for r in rows] for f in self.arrow_schema}
-        self.insert_arrow(pa.table(cols, schema=self.arrow_schema))
-
-    def delete_where(self, host_mask_fn) -> int:
-        """Delete rows where host_mask_fn(pa.Table) -> bool np.ndarray."""
-        deleted = 0
+    def insert_arrow(self, table: pa.Table, tctx: Optional[TxnContext] = None,
+                     check_dups: bool = False):
+        """Bulk/cold append (the importer/fast_importer path): rows land in
+        the column tier only — durable at the next checkpoint, not per-row
+        WAL'd (exactly the reference's SST-building fast importer, which
+        also trusts its input unless ``check_dups`` is requested)."""
+        table = _coerce(table, self.arrow_schema)
         with self._lock:
+            self._writer_check(tctx)
+            if check_dups:
+                self._check_duplicates(table)
             self._mutations += 1
+            self._pk_stale = True
+            rowids = self._alloc_rowids(table.num_rows)
+            self._append_table(table, rowids)
+
+    def insert_rows(self, rows: list[dict], tctx: Optional[TxnContext] = None):
+        """Hot insert (SQL INSERT ... VALUES): duplicate-PK checked, written
+        to the row tier (WAL-durable / lock-buffered) AND the column tier."""
+        cols = {f.name: [r.get(f.name) for r in rows] for f in self.arrow_schema}
+        table = pa.table(cols, schema=self.arrow_schema)
+        with self._lock:
+            self._writer_check(tctx)
+            new_keys = self._check_duplicates(table)
+            self._mutations += 1
+            rowids = self._alloc_rowids(len(rows))
+            recs = [dict(r, **{ROWID: int(rid)})
+                    for r, rid in zip(rows, rowids)]
+            self._write_hot(recs, tctx)
+            self._append_table(table, rowids)
+            if new_keys and self._pk_index is not None and not self._pk_stale:
+                for k, rid in zip(new_keys, rowids):
+                    self._pk_index[k] = int(rid)
+
+    def delete_where(self, host_mask_fn, tctx: Optional[TxnContext] = None) -> int:
+        """Delete rows where host_mask_fn(pa.Table) -> bool np.ndarray.
+        Column tier filters; row tier records __del markers per rowid."""
+        deleted = 0
+        markers: list[dict] = []
+        with self._lock:
+            self._writer_check(tctx)
+            self._mutations += 1
+            # a fresh PK index maintains itself incrementally: we know the
+            # exact keys leaving the table (no O(n) rebuild on next insert)
+            fresh = (self._pk_codec is not None and
+                     self._pk_index is not None and not self._pk_stale)
+            dead_keys: list[bytes] = []
             for r in self.regions:
                 if not r.num_rows:
                     continue
                 mask = np.asarray(host_mask_fn(r.data), dtype=bool)
                 if mask.any():
+                    if fresh:
+                        dead_keys.extend(
+                            self._encode_pk_table(r.data.filter(pa.array(mask))))
+                    markers.extend({ROWID: int(rid), "__del": True}
+                                   for rid in r.rowids[mask])
                     r.data = r.data.filter(pa.array(~mask))
+                    r.rowids = r.rowids[~mask]
                     r.version += 1
                     deleted += int(mask.sum())
+            if fresh:
+                for k in dead_keys:
+                    self._pk_index.pop(k, None)
+            else:
+                self._pk_stale = True
+            self._write_hot(markers, tctx)
         return deleted
 
-    def update_where(self, host_mask_fn, assign_fn) -> int:
-        """Update rows in place: assign_fn(pa.Table, mask) -> pa.Table."""
+    def update_where(self, host_mask_fn, assign_fn,
+                     tctx: Optional[TxnContext] = None,
+                     changed_cols: Optional[list[str]] = None) -> int:
+        """Update rows in place: assign_fn(pa.Table, mask) -> pa.Table.
+        Row tier records the full new row versions under the same rowids.
+        ``changed_cols`` (the assignment targets) lets the PK index survive
+        updates that don't touch key columns."""
         updated = 0
+        hot: list[dict] = []
         with self._lock:
+            self._writer_check(tctx)
             self._mutations += 1
+            if self._pk_cols is not None and (
+                    changed_cols is None or
+                    any(c in self._pk_cols for c in changed_cols)):
+                self._pk_stale = True
             for r in self.regions:
                 if not r.num_rows:
                     continue
@@ -208,13 +509,49 @@ class TableStore:
                     r.data = _coerce(assign_fn(r.data, mask), self.arrow_schema)
                     r.version += 1
                     updated += int(mask.sum())
+                    new_rows = r.data.filter(pa.array(mask)).to_pylist()
+                    hot.extend(dict(row, **{ROWID: int(rid)})
+                               for row, rid in zip(new_rows, r.rowids[mask]))
+            self._write_hot(hot, tctx)
         return updated
 
+    def _write_hot(self, recs: list[dict], tctx: Optional[TxnContext]):
+        if not recs:
+            return
+        if tctx is not None:
+            # in-txn rows always buffer (that's where the row LOCKS live);
+            # TxnContext.commit drops the buffer for non-durable stores
+            for rec in recs:
+                tctx.row_txn.put_row(rec)
+            return
+        if self.wal_path is None:
+            return      # non-durable autocommit: nothing would ever read it
+        kc, rc = self.row_table.key_codec, self.row_table.row_codec
+        self.row_table.write_batch(
+            [(0, kc.encode_one(rec), rc.encode(rec)) for rec in recs])
+
     def truncate(self):
+        """DDL-grade wipe: resets regions AND the row tier/WAL (TRUNCATE is
+        an implicit commit; it is never part of a transaction).  Durable
+        stores rewrite the Parquet checkpoint too, or the truncated rows
+        would resurrect on restart."""
         with self._lock:
+            if self._writer is not None:
+                raise ConflictError("TRUNCATE while a transaction is open")
             self._mutations += 1
+            self._pk_stale = True
             self.regions = [Region(self._alloc_region_id(),
                                    self.arrow_schema.empty_table())]
+            self._reset_wal()
+            if self.durable_dir:
+                self.save_parquet(self.durable_dir)
+
+    def _reset_wal(self):
+        path = self.wal_path
+        if path and os.path.exists(path):
+            self.row_table = None
+            os.remove(path)
+        self._build_row_tier(path)
 
     def _maybe_split(self, region: Region):
         """Row-count split (the reference splits oversized regions,
@@ -222,24 +559,47 @@ class TableStore:
         while region.num_rows > self.region_rows:
             keep = region.data.slice(0, self.region_rows)
             rest = region.data.slice(self.region_rows)
+            keep_ids = region.rowids[:self.region_rows]
+            rest_ids = region.rowids[self.region_rows:]
             region.data = keep.combine_chunks()
+            region.rowids = keep_ids
             region.version += 1
-            new = Region(self._alloc_region_id(), rest.combine_chunks())
+            new = Region(self._alloc_region_id(), rest.combine_chunks(),
+                         rest_ids)
             self.regions.append(new)
             region = new
 
     def alter_schema(self, new_schema: Schema):
-        """Online schema change (reference: column DDL via DDLManager +
-        region backfill; here: rewrite region tables to the new arrow schema —
-        added columns fill NULL, dropped columns vanish)."""
+        """Online schema change (reference: column DDL via the DDLManager;
+        here: rewrite region tables to the new arrow schema — added columns
+        fill NULL, dropped columns vanish).  The row tier resets (its value
+        encoding is schema-bound): ALTER implies a checkpoint boundary."""
         with self._lock:
+            if self._writer is not None:
+                raise ConflictError("ALTER while a transaction is open")
             self._mutations += 1
+            self._pk_stale = True
             self.info.schema = new_schema
             self.info.version += 1
             self.arrow_schema = schema_to_arrow(new_schema)
             for r in self.regions:
                 r.data = _coerce(r.data, self.arrow_schema)
                 r.version += 1
+            # the WAL's value encoding is schema-bound, so ALTER is a
+            # checkpoint boundary: flush the rewritten cold state FIRST or
+            # committed hot deltas since the last checkpoint would vanish
+            if self.durable_dir:
+                self.save_parquet(self.durable_dir)
+            self._reset_wal()
+            if self._pk_cols:
+                missing = [c for c in self._pk_cols if c not in new_schema]
+                if missing:
+                    self._pk_cols = None
+                    self._pk_codec = None
+                    self._pk_index = None
+                else:
+                    self._pk_codec = KeyCodec(new_schema, self._pk_cols)
+                    self._pk_index = None
 
     def purge_expired(self, ttl_column: str, expire_before) -> int:
         """TTL purge (reference: TTL delete loops, store.cpp:46-48 timers +
@@ -256,18 +616,34 @@ class TableStore:
     def save_parquet(self, directory: str):
         os.makedirs(directory, exist_ok=True)
         with self._lock:
+            for f in os.listdir(directory):
+                if f.endswith(".parquet"):
+                    os.remove(os.path.join(directory, f))
             for r in self.regions:
-                pq.write_table(r.data, os.path.join(directory, f"region_{r.region_id}.parquet"))
+                t = r.data.append_column(ROWID, pa.array(r.rowids, pa.int64()))
+                pq.write_table(t, os.path.join(directory,
+                                               f"region_{r.region_id}.parquet"))
 
     def load_parquet(self, directory: str):
         files = sorted(f for f in os.listdir(directory) if f.endswith(".parquet"))
         with self._lock:
             self._mutations += 1
+            self._pk_stale = True
             self.regions = []
             for f in files:
                 t = pq.read_table(os.path.join(directory, f))
+                if ROWID in t.column_names:
+                    rowids = np.asarray(t.column(ROWID).to_numpy(
+                        zero_copy_only=False), np.int64)
+                    t = t.drop_columns([ROWID])
+                else:
+                    rowids = self._alloc_rowids(t.num_rows)
+                if len(rowids):
+                    self._next_rowid = max(self._next_rowid,
+                                           int(rowids.max()) + 1)
                 self.regions.append(Region(self._alloc_region_id(),
-                                           _coerce(t, self.arrow_schema)))
+                                           _coerce(t, self.arrow_schema),
+                                           rowids))
             if not self.regions:
                 self.regions = [Region(self._alloc_region_id(),
                                        self.arrow_schema.empty_table())]
