@@ -1,0 +1,296 @@
+"""Raft runtime: transport, replicated regions, group management.
+
+The reference hosts one braft::StateMachine per Region inside baikalStore
+processes connected by brpc (include/store/region.h:445).  Here the same
+roles split differently: the native core (native/raft.cpp) decides, this
+module moves bytes and applies commits.  ``LocalBus`` is an in-process
+transport with deterministic delivery plus partition/kill controls — the
+multi-node-without-a-cluster test pattern the reference uses by faking
+topology into SchemaFactory (SURVEY §4), but covering election/failover
+paths braft-based tests cannot drive deterministically.
+
+A ``ReplicatedRegion`` applies committed write batches to its own MVCC row
+table (native/engine.cpp), so each peer holds a real storage replica; a
+snapshot is the serialized row table (install replaces the replica's state —
+the reference's SST-streaming install_snapshot analog)."""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, Optional
+
+from ..storage.rowstore import RowTable
+from ..types import Field, LType, Schema
+from .core import CONFIG, DATA, LEADER, SNAPSHOT_KIND, Committed, RaftCore
+
+
+# -- write-batch / snapshot codecs ------------------------------------------
+
+def encode_ops(ops: list[tuple[int, bytes, bytes]]) -> bytes:
+    parts = [struct.pack("<I", len(ops))]
+    for op, k, v in ops:
+        parts.append(struct.pack("<BI", op, len(k)))
+        parts.append(k)
+        parts.append(struct.pack("<I", len(v)))
+        parts.append(v)
+    return b"".join(parts)
+
+
+def decode_ops(data: bytes) -> list[tuple[int, bytes, bytes]]:
+    (n,) = struct.unpack_from("<I", data, 0)
+    pos = 4
+    out = []
+    for _ in range(n):
+        op, klen = struct.unpack_from("<BI", data, pos)
+        pos += 5
+        k = data[pos:pos + klen]
+        pos += klen
+        (vlen,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        v = data[pos:pos + vlen]
+        pos += vlen
+        out.append((op, k, v))
+    return out
+
+
+class ReplicatedRegion:
+    """One peer's replica of one region: Raft core + MVCC row table."""
+
+    def __init__(self, node_id: int, peers: list[int], seed: int = 1,
+                 schema: Optional[Schema] = None,
+                 key_columns: Optional[list[str]] = None):
+        self.core = RaftCore(node_id, peers, seed=seed)
+        self.node_id = node_id
+        self.schema = schema or Schema((Field("k", LType.INT64, False),
+                                        Field("v", LType.STRING, True)))
+        self.key_columns = key_columns or [self.schema.fields[0].name]
+        self.table = RowTable(self.schema, self.key_columns)
+        self.applied_index = 0
+
+    def apply_committed(self) -> list[Committed]:
+        """Drain the core's committed entries into the row table."""
+        commits = self.core.drain_commits()
+        for c in commits:
+            if c.kind == DATA:
+                self.table.write_batch(decode_ops(c.data))
+                self.applied_index = c.index
+            elif c.kind == SNAPSHOT_KIND:
+                self._install_snapshot(c.data)
+                self.applied_index = c.index
+            else:
+                self.applied_index = c.index
+        return commits
+
+    # -- snapshots --------------------------------------------------------
+    def snapshot_bytes(self) -> bytes:
+        pairs = self.table.scan_raw()
+        return encode_ops([(0, k, v) for k, v in pairs])
+
+    def _install_snapshot(self, data: bytes):
+        self.table = RowTable(self.schema, self.key_columns)
+        self.table.write_batch(decode_ops(data))
+
+    def compact(self):
+        """Snapshot own state into the core, truncating the log (the
+        space-efficient snapshot scheme: state, not log history)."""
+        self.core.compact(self.core.commit_index, self.snapshot_bytes())
+
+    # -- reads ------------------------------------------------------------
+    def rows(self) -> list[dict]:
+        return self.table.scan_rows()
+
+
+class LocalBus:
+    """Deterministic in-process transport with fault injection."""
+
+    def __init__(self):
+        self.nodes: dict[int, ReplicatedRegion] = {}
+        self.down: set[int] = set()
+        self.blocked: set[tuple[int, int]] = set()   # (src, dst) pairs
+
+    def add(self, region: ReplicatedRegion):
+        self.nodes[region.node_id] = region
+
+    def kill(self, node_id: int):
+        self.down.add(node_id)
+
+    def revive(self, node_id: int):
+        self.down.discard(node_id)
+
+    def partition(self, group_a: list[int], group_b: list[int]):
+        for a in group_a:
+            for b in group_b:
+                self.blocked.add((a, b))
+                self.blocked.add((b, a))
+
+    def heal(self):
+        self.blocked.clear()
+
+    # -- drive ------------------------------------------------------------
+    def pump(self, max_rounds: int = 200):
+        """Deliver messages until quiescent; apply commits as they appear."""
+        for _ in range(max_rounds):
+            moved = False
+            for nid, node in list(self.nodes.items()):
+                if nid in self.down:
+                    node.core.drain_messages()   # drop a dead node's output
+                    continue
+                for dest, msg in node.core.drain_messages():
+                    moved = True
+                    if dest in self.down or dest not in self.nodes:
+                        continue
+                    if (nid, dest) in self.blocked:
+                        continue
+                    self.nodes[dest].core.receive(msg)
+            for nid, node in self.nodes.items():
+                if nid not in self.down:
+                    node.apply_committed()
+            if not moved:
+                return
+        raise RuntimeError("bus did not quiesce")
+
+    def advance(self, ticks: int = 1):
+        """ticks x (tick every live node, then deliver to quiescence)."""
+        for _ in range(ticks):
+            for nid, node in self.nodes.items():
+                if nid not in self.down:
+                    node.core.tick()
+            self.pump()
+
+    def elect(self, max_ticks: int = 400) -> int:
+        """Advance until some live node is leader; returns its id."""
+        for _ in range(max_ticks):
+            ldr = self.leader()
+            if ldr is not None:
+                return ldr
+            self.advance(1)
+        raise RuntimeError("no leader elected")
+
+    def leader(self) -> Optional[int]:
+        """The leader a quorum actually follows.  A leader partitioned from
+        the majority still THINKS it leads (it cannot learn otherwise until
+        healed); counting it would route writes into a black hole, so a
+        candidate only qualifies when a quorum of its config is live and at
+        its term following it."""
+        best = None
+        for nid, node in self.nodes.items():
+            if nid in self.down or node.core.role != LEADER:
+                continue
+            peers = node.core.peers()
+            follows = 0
+            for p in peers:
+                if p == nid:
+                    follows += 1
+                    continue
+                other = self.nodes.get(p)
+                if other is None or p in self.down or \
+                        (nid, p) in self.blocked or (p, nid) in self.blocked:
+                    continue    # unreachable: cannot sustain this leader
+                if other.core.term == node.core.term and \
+                        other.core.leader == nid:
+                    follows += 1
+            if follows >= len(peers) // 2 + 1:
+                if best is None or node.core.term > \
+                        self.nodes[best].core.term:
+                    best = nid
+        return best
+
+
+class RaftGroup:
+    """One replicated region group — the store-fleet view the meta service
+    balances (region -> peers, leader)."""
+
+    def __init__(self, region_id: int, peer_ids: list[int], seed: int = 1,
+                 schema: Optional[Schema] = None,
+                 key_columns: Optional[list[str]] = None,
+                 bus: Optional[LocalBus] = None):
+        self.region_id = region_id
+        self.schema = schema
+        self.key_columns = key_columns
+        self.seed = seed
+        self.bus = bus or LocalBus()
+        for pid in peer_ids:
+            self.bus.add(ReplicatedRegion(pid, peer_ids, seed=seed,
+                                          schema=schema,
+                                          key_columns=key_columns))
+
+    # -- client API -------------------------------------------------------
+    def leader(self) -> int:
+        ldr = self.bus.leader()
+        if ldr is None:
+            ldr = self.bus.elect()
+        return ldr
+
+    def write(self, ops: list[tuple[int, bytes, bytes]],
+              max_ticks: int = 400) -> bool:
+        """Propose a write batch; returns True once COMMITTED on the leader
+        (the ack the reference gives after braft on_apply).  Retries through
+        elections like FetcherStore's leader-redirect loop."""
+        payload = encode_ops(ops)
+        for _ in range(max_ticks):
+            ldr = self.leader()
+            idx = self.bus.nodes[ldr].core.propose(payload)
+            if idx < 0:
+                self.bus.advance(1)
+                continue
+            for _ in range(max_ticks):
+                self.bus.pump()
+                if self.bus.nodes[ldr].core.commit_index >= idx and \
+                        self.bus.nodes[ldr].node_id not in self.bus.down:
+                    return True
+                if self.bus.nodes[ldr].core.role != LEADER:
+                    break               # deposed mid-write: retry via new leader
+                self.bus.advance(1)
+            else:
+                return False
+        return False
+
+    def put_row(self, region: ReplicatedRegion, row: dict) -> bool:
+        key = region.table.key_codec.encode_one(row)
+        val = region.table.row_codec.encode(row)
+        return self.write([(0, key, val)])
+
+    # -- membership (meta balance orders execute through these) -----------
+    def add_peer(self, new_id: int, max_ticks: int = 400) -> bool:
+        """Single-server membership add (reference: raft_control add_peer).
+        The config change is proposed FIRST; the replica only joins the bus
+        once accepted (a rejected propose must not leave a ghost node whose
+        election timeouts would depose real leaders forever)."""
+        ldr = self.leader()
+        if ldr is None:
+            ldr = self.bus.elect()
+        peers = self.bus.nodes[ldr].core.peers()
+        payload = struct.pack("<Bq", 0, new_id)
+        idx = self.bus.nodes[ldr].core.propose(payload, kind=CONFIG)
+        if idx < 0:
+            return False
+        replica = ReplicatedRegion(new_id, peers + [new_id], seed=self.seed,
+                                   schema=self.schema,
+                                   key_columns=self.key_columns)
+        self.bus.add(replica)
+        for _ in range(max_ticks):
+            self.bus.pump()
+            if replica.core.commit_index >= idx:
+                return True
+            self.bus.advance(1)
+        self.bus.nodes.pop(new_id, None)    # never caught up: no ghost
+        return False
+
+    def remove_peer(self, dead_id: int, max_ticks: int = 400) -> bool:
+        ldr = self.leader()
+        if ldr == dead_id:
+            raise ValueError("transfer leadership before removing the leader")
+        payload = struct.pack("<Bq", 1, dead_id)
+        idx = self.bus.nodes[ldr].core.propose(payload, kind=CONFIG)
+        if idx < 0:
+            return False
+        for _ in range(max_ticks):
+            self.bus.pump()
+            if self.bus.nodes[ldr].core.commit_index >= idx:
+                self.bus.nodes.pop(dead_id, None)
+                return True
+            self.bus.advance(1)
+        return False
+
+    def peers(self) -> list[int]:
+        return sorted(self.bus.nodes[self.leader()].core.peers())
