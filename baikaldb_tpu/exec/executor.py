@@ -142,7 +142,9 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
             out, ovf = join_ops.cross_join(left, right, cap=node.cap)
         else:
             if node.cap is None:
-                node.cap = max(1, len(left))
+                # key-FK joins emit at most max(sides) rows; true many-to-many
+                # expansion beyond that reports its exact need via the flag
+                node.cap = max(1, len(left), len(right))
             out, ovf = join_ops.join(left, node.left_keys, right,
                                      node.right_keys, how=node.how, cap=node.cap)
         overflows.append((node, ovf))

@@ -36,7 +36,9 @@ from ..storage.column_store import TableStore, schema_to_arrow
 from ..types import Field, LType, Schema
 from .executor import compile_plan
 
-MAX_JOIN_RETRIES = 4
+# overflow retries settle at most one operator per re-trace, so a chain of
+# N joins can need N rounds in the worst case (each is a recompile)
+MAX_JOIN_RETRIES = 10
 # INSERT..SELECT at or below this lands in the hot (WAL-durable) row tier;
 # above it, the bulk cold path (durable at the next checkpoint)
 HOT_INSERT_ROWS = 100_000

@@ -448,6 +448,7 @@ def _default_type_rule(op, argts):
         "gt": LType.BOOL, "ge": LType.BOOL, "and": LType.BOOL, "or": LType.BOOL,
         "not": LType.BOOL, "xor": LType.BOOL, "is_null": LType.BOOL,
         "is_not_null": LType.BOOL, "like": LType.BOOL, "not_like": LType.BOOL,
+        "__row_index": LType.INT64,
         "in": LType.BOOL, "not_in": LType.BOOL, "between": LType.BOOL,
         "match_against": LType.BOOL,
         "case_when": argts[1] if len(argts) > 1 else LType.NULL,
@@ -805,6 +806,23 @@ def _like_impl(e, batch, negate):
         hit = jnp.take(jnp.asarray(mask), jnp.clip(a.data, 0, None), mode="clip")
     data = ~hit if negate else hit
     return Column(data, a.validity, LType.BOOL)
+
+
+@_raw("__row_index")
+def _row_index(e, batch):
+    """Internal: a globally-unique row identity (planner-injected for
+    EXISTS-with-residual decorrelation).  Inside a shard_map each shard
+    offsets by its mesh position so identities stay unique across devices."""
+    import jax
+
+    n = len(batch)
+    idx = jnp.arange(n, dtype=jnp.int64)
+    try:
+        from ..parallel.mesh import AXIS
+        idx = idx + jnp.int64(n) * jax.lax.axis_index(AXIS).astype(jnp.int64)
+    except NameError:       # not running under shard_map
+        pass
+    return Column(idx, None, LType.INT64)
 
 
 @_raw("like")

@@ -202,6 +202,7 @@ def cross_join(probe: ColumnBatch, build: ColumnBatch, cap: int | None = None,
     for n, c in zip(out_b.names, out_b.columns):
         names.append(n if n not in names else n + suffix)
         cols.append(c)
-    needed = jnp.int32(np_ * nb)     # full capacity, not live count: the
+    needed = jnp.int64(np_ * nb)     # full capacity, not live count: the
     # positional pi/bi mapping above needs cap >= np_*nb rows to be exact
+    # (int64: a runaway cross product must report, not overflow, its size)
     return ColumnBatch(tuple(names), cols, live, None), needed

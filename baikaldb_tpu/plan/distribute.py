@@ -66,6 +66,10 @@ class _Distributor:
         self.n = n_shards
         self.rows_fn = rows_fn
         self.broadcast_rows = broadcast_rows
+        # plans are DAGs (subquery rewrites share the outer stream between a
+        # Membership probe and its joined subplan): visit shared subtrees
+        # once, or the second walk would find its own inserted Exchanges
+        self._memo: dict[int, tuple[str, int]] = {}
 
     # -- exchange insertion helpers --------------------------------------
     def _gather(self, parent: PlanNode, i: int):
@@ -86,8 +90,12 @@ class _Distributor:
     # -- the pass --------------------------------------------------------
     def visit(self, node: PlanNode) -> tuple[str, int]:
         """-> (dist, estimated rows); sets node.dist."""
+        hit = self._memo.get(id(node))
+        if hit is not None:
+            return hit
         dist, est = self._visit(node)
         node.dist = dist
+        self._memo[id(node)] = (dist, est)
         return dist, est
 
     def _visit(self, node: PlanNode) -> tuple[str, int]:

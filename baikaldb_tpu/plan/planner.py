@@ -211,8 +211,9 @@ class Planner:
                 where_ast = c if where_ast is None else Call("and", (where_ast, c))
         sub_items = [self._subst_scalar(item.expr, holder, scope)
                      if item.expr is not None else None for item in stmt.items]
-        sub_having = self._subst_scalar(stmt.having, holder, scope) \
-            if stmt.having is not None else None
+        # HAVING subqueries substitute AFTER aggregation (_plan_aggregate):
+        # a pre-agg broadcast column could not survive the group-by
+        sub_having = stmt.having
         plan = holder[0]
 
         # WHERE
@@ -293,7 +294,8 @@ class Planner:
 
         if has_agg:
             plan, named_items, having, order_items = self._plan_aggregate(
-                plan, flat, named_items, group_exprs, having, order_items, stmt)
+                plan, flat, named_items, group_exprs, having, order_items,
+                stmt, scope)
         else:
             if having is not None:
                 raise PlanError("HAVING without aggregation")
@@ -423,11 +425,33 @@ class Planner:
         if how == "cross" or on is None:
             if how in ("semi", "anti"):
                 raise PlanError("SEMI/ANTI join requires ON")
-            node = JoinNode(children=[left, right], how="cross",
-                            schema=_join_schema(left, right, "cross"))
-            if on is not None:
-                node = FilterNode(children=[node], pred=on, schema=node.schema)
-            return node
+            if on is None and stmt is not None and stmt.where is not None:
+                # comma-FROM: promote WHERE equality conjuncts linking the
+                # incoming table to tables already in scope into join keys —
+                # the left-deep tree JoinReorder builds (the WHERE reapplies
+                # them later, which is redundant but harmless)
+                lc = {f.name for f in left.schema.fields}
+                rc_ = {f.name for f in right.schema.fields}
+                conj = None
+                for c in _conjuncts(stmt.where):
+                    try:
+                        rcv = resolve(c)
+                    except PlanError:
+                        continue
+                    pair = _equi_pair(rcv, lc, rc_)
+                    if pair is not None:
+                        eq = Call("eq", (ColRef(pair[0]), ColRef(pair[1])))
+                        conj = eq if conj is None else Call("and", (conj, eq))
+                if conj is not None:
+                    on = conj
+                    how = "inner"
+            if on is None or how == "cross":
+                node = JoinNode(children=[left, right], how="cross",
+                                schema=_join_schema(left, right, "cross"))
+                if on is not None:
+                    node = FilterNode(children=[node], pred=on,
+                                      schema=node.schema)
+                return node
         lcols = {f.name for f in left.schema.fields}
         rcols = {f.name for f in right.schema.fields}
         lkeys, rkeys, residual = [], [], None
@@ -436,8 +460,16 @@ class Planner:
             if pair is not None:
                 lkeys.append(pair[0])
                 rkeys.append(pair[1])
-            else:
-                residual = c if residual is None else Call("and", (residual, c))
+                continue
+            refs = _colrefs(c)
+            if refs and refs <= rcols:
+                # right-side-only ON conjunct: filter the build side BEFORE
+                # the join — for LEFT joins this is the only correct place
+                # (post-join it would drop preserved unmatched rows)
+                right = FilterNode(children=[right], pred=c,
+                                   schema=right.schema)
+                continue
+            residual = c if residual is None else Call("and", (residual, c))
         if not lkeys:
             node = JoinNode(children=[left, right], how="cross",
                             schema=_join_schema(left, right, "cross"))
@@ -512,7 +544,7 @@ class Planner:
 
     # ------------------------------------------------------------------
     def _plan_aggregate(self, plan, flat, named_items, group_exprs, having,
-                        order_items, stmt):
+                        order_items, stmt, scope=None):
         sch = plan.schema
         # pre-agg projection: group keys + aggregate inputs
         pre_names: list[str] = []
@@ -623,6 +655,11 @@ class Planner:
         order_items = [(rewrite(e), asc) for e, asc in order_items]
         if having is not None:
             having = rewrite(having)
+            # HAVING may compare against scalar subqueries (TPC-H Q11):
+            # inject them as broadcast columns ABOVE the aggregation
+            hh = [plan]
+            having = self._subst_scalar(having, hh, scope or Scope())
+            plan = hh[0]
             plan = FilterNode(children=[plan], pred=having, schema=plan.schema)
         return plan, named_items, None, order_items
 
@@ -670,7 +707,9 @@ class Planner:
     def _plan_exists(self, substmt, holder, scope, anti: bool):
         """[NOT] EXISTS: equality-correlated -> semi/anti join on the
         correlation keys; uncorrelated -> semi/anti join on a constant key
-        (keeps the whole decision inside the jitted program)."""
+        (keeps the whole decision inside the jitted program).  Correlated
+        conjuncts beyond plain equality (e.g. l2.suppkey <> l1.suppkey)
+        decorrelate through a row-identity membership rewrite."""
         if substmt.table is None:
             raise PlanError("EXISTS subquery needs a FROM clause")
         subscope = Scope()
@@ -681,6 +720,7 @@ class Planner:
         outer_resolve = _Resolver(scope)
         inner_where = None
         pairs: list[tuple[str, str]] = []   # (outer qualified, inner qualified)
+        residuals: list[Expr] = []          # both-scope, non-equality
         for c in _conjuncts(substmt.where) if substmt.where is not None else []:
             try:
                 rc = inner_resolve(c)
@@ -702,13 +742,16 @@ class Planner:
                     except PlanError:
                         continue
                 else:
-                    raise PlanError(f"unsupported correlated predicate {c!r}")
+                    residuals.append(c)
                 continue
-            raise PlanError(f"unsupported correlated predicate {c!r} "
-                            "(round 1 supports equality correlation)")
+            residuals.append(c)
         if inner_where is not None:
             subplan = FilterNode(children=[subplan], pred=inner_where,
                                  schema=subplan.schema)
+        if residuals:
+            self._plan_exists_residual(holder, scope, subscope, subplan,
+                                       pairs, residuals, anti)
+            return
         how = "anti" if anti else "semi"
         if pairs:
             lkeys = [o for o, _ in pairs]
@@ -724,13 +767,65 @@ class Planner:
         jn.subquery_right = True
         holder[0] = jn
 
+    def _plan_exists_residual(self, holder, scope, subscope, subplan,
+                              pairs, residuals, anti: bool):
+        """[NOT] EXISTS whose correlation is not pure equality: join the
+        outer stream (tagged with a synthetic row identity) to the subquery
+        on the equality pairs, filter the residual over the pair columns,
+        and test the row identity's membership in the surviving pairs —
+        semi/anti with arbitrary residuals built from existing operators
+        (the ApplyNode elimination the reference does in DeCorrelate)."""
+        holder[0], rid = self._ensure_col(holder[0], Call("__row_index", ()))
+        comb = Scope()
+        comb.tables.update(scope.tables)
+        comb.tables.update(subscope.tables)
+        comb.order = list(scope.order) + [lbl for lbl in subscope.order
+                                          if lbl not in scope.tables]
+        comb.extras.update(scope.extras)
+        resolve = _Resolver(comb)
+        pred = None
+        for c in residuals:
+            rc = resolve(c)
+            pred = rc if pred is None else Call("and", (pred, rc))
+        if pairs:
+            lkeys = [o for o, _ in pairs]
+            rkeys = [i for _, i in pairs]
+            jn = JoinNode(children=[holder[0], subplan], how="inner",
+                          left_keys=lkeys, right_keys=rkeys,
+                          schema=_join_schema(holder[0], subplan, "inner"))
+        else:
+            jn = JoinNode(children=[holder[0], subplan], how="cross",
+                          schema=_join_schema(holder[0], subplan, "cross"))
+        jn.subquery_right = True
+        filt = FilterNode(children=[jn], pred=pred, schema=jn.schema)
+        pname = self._tmp("xr")
+        proj = ProjectNode(children=[filt], exprs=[ColRef(rid)], names=[pname],
+                           schema=Schema((Field(pname, LType.INT64),)))
+        proj.derived = True        # separate scope: outer pushdown stops here
+        out = self._tmp("exv")
+        holder[0] = MembershipNode(
+            children=[holder[0], proj], key_col=rid, out_name=out,
+            negate=anti,
+            schema=Schema(tuple(list(holder[0].schema.fields) +
+                                [Field(out, LType.BOOL)])))
+        holder[0] = FilterNode(children=[holder[0]], pred=ColRef(out),
+                               schema=holder[0].schema)
+
     def _subst_scalar(self, e: Optional[Expr], holder, scope) -> Optional[Expr]:
         """Replace uncorrelated scalar Subquery nodes with injected broadcast
         columns (ScalarSourceNode)."""
         if e is None:
             return None
         if isinstance(e, Subquery):
-            subplan = self._plan_query(e.stmt)
+            try:
+                subplan = self._plan_query(e.stmt)
+            except PlanError as uncorr_err:
+                # outer references inside: try equality-correlated aggregate
+                # decorrelation (group by the correlation keys + join back)
+                col = self._try_correlated_scalar(e.stmt, holder, scope)
+                if col is None:
+                    raise uncorr_err
+                return col
             if len(subplan.schema.fields) != 1:
                 raise PlanError("scalar subquery must return exactly one column")
             f0 = subplan.schema.fields[0]
@@ -791,6 +886,108 @@ class Planner:
             return Call(e.op, tuple(self._subst_scalar(a, holder, scope)
                                     for a in e.args))
         return e
+
+    def _try_correlated_scalar(self, stmt, holder, scope):
+        """Equality-correlated scalar aggregate subquery -> grouped subquery
+        + LEFT JOIN back on the correlation keys (the reference's ApplyNode
+        -> DeCorrelate rewrite, src/physical_plan de_correlate).
+
+        SELECT agg(x) FROM inner WHERE inner.k = outer.k AND P(inner)
+        becomes
+        LEFT JOIN (SELECT k, agg(x) v FROM inner WHERE P GROUP BY k)
+               ON outer.k = k
+        and the scalar value is the joined ``v`` (NULL when no group —
+        exactly the empty-subquery NULL the row-at-a-time form produces).
+
+        Exception: COUNT of an empty correlation group is 0, not NULL — a
+        bare COUNT item gets an IFNULL(v, 0); COUNT nested inside a larger
+        expression is refused (the join-back NULL would differ from the
+        row-at-a-time 0).
+
+        Returns the value expr, or None when the shape doesn't fit."""
+        import copy
+
+        from ..sql.stmt import SelectItem
+
+        if stmt.table is None or stmt.group_by or stmt.having or \
+                stmt.order_by or stmt.limit is not None:
+            return None
+        if len(stmt.items) != 1 or not _contains_agg(stmt.items[0].expr):
+            return None
+        item = stmt.items[0].expr
+        is_bare_count = isinstance(item, AggCall) and \
+            item.op in ("count", "count_star")
+        if not is_bare_count:
+            def has_count(x):
+                if isinstance(x, AggCall) and x.op in ("count", "count_star"):
+                    return True
+                return isinstance(x, (Call, AggCall)) and \
+                    any(has_count(a) for a in x.args)
+            if has_count(item):
+                return None
+        # trial scope over the subquery's FROM for conjunct classification
+        trial = Scope()
+        try:
+            self._plan_table_ref(stmt.table, trial)
+            for j in stmt.joins:
+                self._plan_table_ref(j.table, trial)
+        except PlanError:
+            return None
+        inner_res = _Resolver(trial)
+        outer_res = _Resolver(scope)
+        inner_conj: list[Expr] = []
+        pairs: list[tuple[Expr, Expr]] = []   # (outer expr, inner expr) RAW
+        for c in _conjuncts(stmt.where) if stmt.where is not None else []:
+            try:
+                inner_res(c)
+                inner_conj.append(c)          # keep unresolved: re-planned
+                continue
+            except PlanError:
+                pass
+            matched = False
+            if isinstance(c, Call) and c.op == "eq" and len(c.args) == 2:
+                a, b = c.args
+                for ie, oe in ((a, b), (b, a)):
+                    try:
+                        inner_res(ie)
+                        outer_res(oe)
+                    except PlanError:
+                        continue
+                    pairs.append((oe, ie))
+                    matched = True
+                    break
+            if not matched:
+                return None
+        if not pairs:
+            return None
+        sub2 = copy.copy(stmt)
+        knames = [self._tmp("ck") for _ in pairs]
+        vname = self._tmp("cv")
+        sub2.items = [SelectItem(ie, kn)
+                      for (_, ie), kn in zip(pairs, knames)] + \
+                     [SelectItem(stmt.items[0].expr, vname)]
+        w = None
+        for c in inner_conj:
+            w = c if w is None else Call("and", (w, c))
+        sub2.where = w
+        sub2.group_by = [ie for _, ie in pairs]
+        sub2.order_by = []
+        sub2.limit = None
+        sub2.offset = 0
+        subplan = self._plan_query(sub2)
+        okeys = []
+        for oe, _ in pairs:
+            holder[0], k = self._ensure_col(holder[0], outer_res(oe))
+            okeys.append(k)
+        jn = JoinNode(children=[holder[0], subplan], how="left",
+                      left_keys=okeys, right_keys=knames,
+                      schema=_join_schema(holder[0], subplan, "left"))
+        jn.subquery_right = True
+        holder[0] = jn
+        scope.extras[vname] = subplan.schema.field(vname).ltype
+        if is_bare_count:
+            return Call("ifnull", (ColRef(vname), Lit(0)))
+        return ColRef(vname)
 
     def _ensure_col(self, plan: PlanNode, e: Expr) -> tuple[PlanNode, str]:
         """Make expr available as a named column (hidden projection)."""
@@ -1098,6 +1295,21 @@ class _Resolver:
         if isinstance(e, Call):
             return Call(e.op, tuple(self(a) for a in e.args))
         return e
+
+
+def _colrefs(e: Expr) -> set[str]:
+    """All column names referenced by an (already-resolved) expression."""
+    out: set[str] = set()
+
+    def walk(x):
+        if isinstance(x, ColRef):
+            out.add(x.name)
+        elif isinstance(x, (Call, AggCall)):
+            for a in x.args:
+                walk(a)
+
+    walk(e)
+    return out
 
 
 def _conjuncts(e: Expr) -> list[Expr]:

@@ -140,14 +140,15 @@ def test_q10(env):
     assert got_rev == want_rev
 
 
-def test_q14_lite(env):
+def test_q14(env):
     s, dfs = env
-    got = s.query(tpch.QUERIES["q14_lite"])[0]["promo_revenue"]
-    li = dfs["lineitem"]
+    got = s.query(tpch.QUERIES["q14"])[0]["promo_revenue"]
+    li, p = dfs["lineitem"], dfs["part"]
     f = li[(li.l_shipdate >= _d("1995-09-01")) & (li.l_shipdate < _d("1995-10-01"))]
-    dp = f.l_extendedprice * (1 - f.l_discount)
-    want = 100.0 * dp[f.l_discount > 0.05].sum() / dp.sum()
-    assert abs(got - want) < 1e-9
+    j = f.merge(p, left_on="l_partkey", right_on="p_partkey")
+    dp = j.l_extendedprice * (1 - j.l_discount)
+    want = 100.0 * dp[j.p_type.str.startswith("PROMO")].sum() / dp.sum()
+    assert abs(got - want) < 1e-6
 
 
 def test_q4(env):

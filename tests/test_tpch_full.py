@@ -1,0 +1,324 @@
+"""TPC-H queries 2,7,8,9,11,13,15,16,17,18,19,20,21,22 golden-checked
+against pandas at tiny scale (the remaining 15 of the 22-query suite; the
+rest live in test_tpch.py).  Completes VERDICT r1 #7."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from baikaldb_tpu.exec.session import Session
+from baikaldb_tpu.models import tpch
+
+
+@pytest.fixture(scope="module")
+def env():
+    s = Session()
+    tables = tpch.load_into(s, scale=0.005, seed=11)
+    dfs = {k: t.to_pandas() for k, t in tables.items()}
+    return s, dfs
+
+
+def _d(iso):
+    return pd.Timestamp(iso).date()
+
+
+def _approx(a, b, tol=1e-6):
+    if a is None and (b is None or (isinstance(b, float) and np.isnan(b))):
+        return True
+    return abs(a - b) <= tol * max(1.0, abs(b))
+
+
+def test_q2(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q2"])
+    p, su, ps = dfs["part"], dfs["supplier"], dfs["partsupp"]
+    n, r = dfs["nation"], dfs["region"]
+    eur = n.merge(r, left_on="n_regionkey", right_on="r_regionkey")
+    eur = eur[eur.r_name == "EUROPE"]
+    sx = su.merge(eur, left_on="s_nationkey", right_on="n_nationkey")
+    j = ps.merge(sx, left_on="ps_suppkey", right_on="s_suppkey")
+    mins = j.groupby("ps_partkey")["ps_supplycost"].min()
+    f = p[(p.p_size == 15) & p.p_type.str.endswith("BRASS")]
+    out = j.merge(f, left_on="ps_partkey", right_on="p_partkey")
+    out = out[out.ps_supplycost == out.ps_partkey.map(mins)]
+    out = out.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                          ascending=[False, True, True, True]).head(100)
+    assert len(rows) == len(out)
+    for got, (_, w) in zip(rows, out.iterrows()):
+        assert got["p_partkey"] == w.p_partkey and got["s_name"] == w.s_name
+        assert _approx(got["s_acctbal"], w.s_acctbal)
+
+
+def test_q7(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q7"])
+    su, li, o, c, n = (dfs["supplier"], dfs["lineitem"], dfs["orders"],
+                       dfs["customer"], dfs["nation"])
+    j = (su.merge(li, left_on="s_suppkey", right_on="l_suppkey")
+           .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+           .merge(c, left_on="o_custkey", right_on="c_custkey")
+           .merge(n.add_prefix("n1_"), left_on="s_nationkey",
+                  right_on="n1_n_nationkey")
+           .merge(n.add_prefix("n2_"), left_on="c_nationkey",
+                  right_on="n2_n_nationkey"))
+    j = j[(((j.n1_n_name == "FRANCE") & (j.n2_n_name == "GERMANY")) |
+           ((j.n1_n_name == "GERMANY") & (j.n2_n_name == "FRANCE")))
+          & (j.l_shipdate >= _d("1995-01-01"))
+          & (j.l_shipdate <= _d("1996-12-31"))]
+    j["l_year"] = pd.to_datetime(j.l_shipdate).dt.year
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    g = (j.groupby(["n1_n_name", "n2_n_name", "l_year"])["volume"].sum()
+          .reset_index().sort_values(["n1_n_name", "n2_n_name", "l_year"]))
+    assert len(rows) == len(g)
+    for got, (_, w) in zip(rows, g.iterrows()):
+        assert got["supp_nation"] == w.n1_n_name
+        assert got["cust_nation"] == w.n2_n_name
+        assert got["l_year"] == w.l_year
+        assert _approx(got["revenue"], w.volume)
+
+
+def test_q8(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q8"])
+    p, li, su, o, c, n, r = (dfs["part"], dfs["lineitem"], dfs["supplier"],
+                             dfs["orders"], dfs["customer"], dfs["nation"],
+                             dfs["region"])
+    j = (p.merge(li, left_on="p_partkey", right_on="l_partkey")
+          .merge(su, left_on="l_suppkey", right_on="s_suppkey")
+          .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+          .merge(c, left_on="o_custkey", right_on="c_custkey")
+          .merge(n.add_prefix("n1_"), left_on="c_nationkey",
+                 right_on="n1_n_nationkey")
+          .merge(r, left_on="n1_n_regionkey", right_on="r_regionkey")
+          .merge(n.add_prefix("n2_"), left_on="s_nationkey",
+                 right_on="n2_n_nationkey"))
+    j = j[(j.r_name == "AMERICA") & (j.o_orderdate >= _d("1995-01-01"))
+          & (j.o_orderdate <= _d("1996-12-31"))
+          & (j.p_type == "ECONOMY ANODIZED STEEL")]
+    j["o_year"] = pd.to_datetime(j.o_orderdate).dt.year
+    j["volume"] = j.l_extendedprice * (1 - j.l_discount)
+    g = j.groupby("o_year").apply(
+        lambda x: x.loc[x.n2_n_name == "BRAZIL", "volume"].sum()
+        / x.volume.sum(), include_groups=False).reset_index(name="share") \
+        .sort_values("o_year")
+    assert len(rows) == len(g)
+    for got, (_, w) in zip(rows, g.iterrows()):
+        assert got["o_year"] == w.o_year and _approx(got["mkt_share"], w.share)
+
+
+def test_q9(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q9"])
+    p, li, su, ps, o, n = (dfs["part"], dfs["lineitem"], dfs["supplier"],
+                           dfs["partsupp"], dfs["orders"], dfs["nation"])
+    j = (p[p.p_name.str.contains("green")]
+         .merge(li, left_on="p_partkey", right_on="l_partkey")
+         .merge(su, left_on="l_suppkey", right_on="s_suppkey")
+         .merge(ps, left_on=["l_suppkey", "l_partkey"],
+                right_on=["ps_suppkey", "ps_partkey"])
+         .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(n, left_on="s_nationkey", right_on="n_nationkey"))
+    j["o_year"] = pd.to_datetime(j.o_orderdate).dt.year
+    j["amount"] = j.l_extendedprice * (1 - j.l_discount) \
+        - j.ps_supplycost * j.l_quantity
+    g = (j.groupby(["n_name", "o_year"])["amount"].sum().reset_index()
+          .sort_values(["n_name", "o_year"], ascending=[True, False]))
+    assert len(rows) == len(g)
+    for got, (_, w) in zip(rows, g.iterrows()):
+        assert got["nation"] == w.n_name and got["o_year"] == w.o_year
+        assert _approx(got["sum_profit"], w.amount)
+
+
+def test_q11(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q11"])
+    ps, su, n = dfs["partsupp"], dfs["supplier"], dfs["nation"]
+    j = (ps.merge(su, left_on="ps_suppkey", right_on="s_suppkey")
+           .merge(n, left_on="s_nationkey", right_on="n_nationkey"))
+    j = j[j.n_name == "GERMANY"]
+    j["value"] = j.ps_supplycost * j.ps_availqty
+    g = j.groupby("ps_partkey")["value"].sum()
+    thresh = j.value.sum() * 0.0005
+    g = g[g > thresh].reset_index().sort_values("value", ascending=False)
+    assert len(rows) == len(g)
+    for got, (_, w) in zip(rows, g.iterrows()):
+        assert got["ps_partkey"] == w.ps_partkey
+        assert _approx(got["value"], w.value)
+
+
+def test_q13(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q13"])
+    c, o = dfs["customer"], dfs["orders"]
+    of = o[~o.o_comment.str.contains("special.*requests", regex=True)]
+    j = c.merge(of, left_on="c_custkey", right_on="o_custkey", how="left")
+    counts = j.groupby("c_custkey")["o_orderkey"].count()
+    dist = counts.value_counts().reset_index()
+    dist.columns = ["c_count", "custdist"]
+    dist = dist.sort_values(["custdist", "c_count"], ascending=[False, False])
+    assert len(rows) == len(dist)
+    for got, (_, w) in zip(rows, dist.iterrows()):
+        assert got["c_count"] == w.c_count and got["custdist"] == w.custdist
+
+
+def test_q15(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q15"])
+    li, su = dfs["lineitem"], dfs["supplier"]
+    f = li[(li.l_shipdate >= _d("1996-01-01")) & (li.l_shipdate < _d("1996-04-01"))]
+    rev = (f.assign(r=f.l_extendedprice * (1 - f.l_discount))
+            .groupby("l_suppkey")["r"].sum())
+    top = rev[rev == rev.max()].reset_index()
+    out = su.merge(top, left_on="s_suppkey", right_on="l_suppkey") \
+            .sort_values("s_suppkey")
+    assert len(rows) == len(out)
+    for got, (_, w) in zip(rows, out.iterrows()):
+        assert got["s_suppkey"] == w.s_suppkey
+        assert _approx(got["total_revenue"], w.r)
+
+
+def test_q16(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q16"])
+    ps, p, su = dfs["partsupp"], dfs["part"], dfs["supplier"]
+    bad = set(su[su.s_comment.str.contains("Customer.*Complaints",
+                                           regex=True)].s_suppkey)
+    j = ps.merge(p, left_on="ps_partkey", right_on="p_partkey")
+    j = j[(j.p_brand != "Brand#45")
+          & ~j.p_type.str.startswith("MEDIUM POLISHED")
+          & j.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])
+          & ~j.ps_suppkey.isin(bad)]
+    g = (j.groupby(["p_brand", "p_type", "p_size"])["ps_suppkey"].nunique()
+          .reset_index(name="cnt")
+          .sort_values(["cnt", "p_brand", "p_type", "p_size"],
+                       ascending=[False, True, True, True]))
+    assert len(rows) == len(g)
+    for got, (_, w) in zip(rows, g.iterrows()):
+        assert (got["p_brand"], got["p_type"], got["p_size"],
+                got["supplier_cnt"]) == (w.p_brand, w.p_type, w.p_size, w.cnt)
+
+
+def test_q17(env):
+    s, dfs = env
+    got = s.query(tpch.QUERIES["q17"])[0]["avg_yearly"]
+    li, p = dfs["lineitem"], dfs["part"]
+    avg = li.groupby("l_partkey")["l_quantity"].mean()
+    j = li.merge(p[(p.p_brand == "Brand#23") & (p.p_container == "MED BOX")],
+                 left_on="l_partkey", right_on="p_partkey")
+    f = j[j.l_quantity < 0.2 * j.l_partkey.map(avg)]
+    want = f.l_extendedprice.sum() / 7.0
+    if len(f) == 0:
+        assert got is None
+    else:
+        assert _approx(got, want)
+
+
+def test_q18(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q18"])
+    c, o, li = dfs["customer"], dfs["orders"], dfs["lineitem"]
+    big = li.groupby("l_orderkey")["l_quantity"].sum()
+    big = set(big[big > 212].index)
+    j = (c.merge(o, left_on="c_custkey", right_on="o_custkey")
+          .merge(li, left_on="o_orderkey", right_on="l_orderkey"))
+    j = j[j.o_orderkey.isin(big)]
+    g = (j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                    "o_totalprice"])["l_quantity"].sum().reset_index()
+          .sort_values(["o_totalprice", "o_orderdate"],
+                       ascending=[False, True]).head(100))
+    assert len(rows) == len(g)
+    for got, (_, w) in zip(rows, g.iterrows()):
+        assert got["o_orderkey"] == w.o_orderkey
+        assert _approx(got["total_qty"], w.l_quantity)
+
+
+def test_q19(env):
+    s, dfs = env
+    got = s.query(tpch.QUERIES["q19"])[0]["revenue"]
+    li, p = dfs["lineitem"], dfs["part"]
+    j = li.merge(p, left_on="l_partkey", right_on="p_partkey")
+    m = j.l_shipmode.isin(["AIR", "REG AIR"]) & \
+        (j.l_shipinstruct == "DELIVER IN PERSON")
+    b1 = (j.p_brand == "Brand#12") & j.p_container.isin(
+        ["SM CASE", "SM BOX", "SM PACK", "SM PKG"]) & \
+        (j.l_quantity >= 1) & (j.l_quantity <= 11) & j.p_size.between(1, 5)
+    b2 = (j.p_brand == "Brand#23") & j.p_container.isin(
+        ["MED BAG", "MED BOX", "MED PKG", "MED PACK"]) & \
+        (j.l_quantity >= 10) & (j.l_quantity <= 20) & j.p_size.between(1, 10)
+    b3 = (j.p_brand == "Brand#34") & j.p_container.isin(
+        ["LG CASE", "LG BOX", "LG PACK", "LG PKG"]) & \
+        (j.l_quantity >= 20) & (j.l_quantity <= 30) & j.p_size.between(1, 15)
+    f = j[m & (b1 | b2 | b3)]
+    want = (f.l_extendedprice * (1 - f.l_discount)).sum()
+    if len(f) == 0:
+        assert got is None
+    else:
+        assert _approx(got, want)
+
+
+def test_q20(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q20"])
+    su, n, ps, p, li = (dfs["supplier"], dfs["nation"], dfs["partsupp"],
+                        dfs["part"], dfs["lineitem"])
+    forest = set(p[p.p_name.str.startswith("forest")].p_partkey)
+    lf = li[(li.l_shipdate >= _d("1994-01-01")) &
+            (li.l_shipdate < _d("1995-01-01"))]
+    qty = lf.groupby(["l_partkey", "l_suppkey"])["l_quantity"].sum()
+    psf = ps[ps.ps_partkey.isin(forest)].copy()
+    key = list(zip(psf.ps_partkey, psf.ps_suppkey))
+    half = np.asarray([0.5 * qty.get(k, np.nan) for k in key])
+    good = set(psf.ps_suppkey[psf.ps_availqty > half])
+    out = su.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    out = out[(out.n_name == "CANADA") & out.s_suppkey.isin(good)] \
+        .sort_values("s_name")
+    assert len(rows) == len(out)
+    for got, (_, w) in zip(rows, out.iterrows()):
+        assert got["s_name"] == w.s_name
+
+
+def test_q21(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q21"])
+    su, li, o, n = (dfs["supplier"], dfs["lineitem"], dfs["orders"],
+                    dfs["nation"])
+    late = li[li.l_receiptdate > li.l_commitdate]
+    multi = li.groupby("l_orderkey")["l_suppkey"].nunique()
+    late_multi = late.groupby("l_orderkey")["l_suppkey"].nunique()
+    j = (su.merge(li, left_on="s_suppkey", right_on="l_suppkey")
+           .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+           .merge(n, left_on="s_nationkey", right_on="n_nationkey"))
+    j = j[(j.o_orderstatus == "F") & (j.l_receiptdate > j.l_commitdate)
+          & (j.n_name == "SAUDI ARABIA")]
+    # EXISTS other supplier on the order
+    j = j[j.l_orderkey.map(multi) > 1]
+    # NOT EXISTS other supplier who was ALSO late on the order: the only
+    # late supplier on the order is this one
+    lm = j.l_orderkey.map(late_multi).fillna(0)
+    j = j[lm == 1]
+    g = (j.groupby("s_name").size().reset_index(name="numwait")
+          .sort_values(["numwait", "s_name"], ascending=[False, True])
+          .head(100))
+    assert len(rows) == len(g)
+    for got, (_, w) in zip(rows, g.iterrows()):
+        assert got["s_name"] == w.s_name and got["numwait"] == w.numwait
+
+
+def test_q22(env):
+    s, dfs = env
+    rows = s.query(tpch.QUERIES["q22"])
+    c, o = dfs["customer"], dfs["orders"]
+    codes = ["13", "31", "23", "29", "30", "18", "17"]
+    cc = c[c.c_phone.str[:2].isin(codes)]
+    avg = cc[cc.c_acctbal > 0].c_acctbal.mean()
+    has_orders = set(o.o_custkey)
+    f = cc[(cc.c_acctbal > avg) & ~cc.c_custkey.isin(has_orders)].copy()
+    f["cntrycode"] = f.c_phone.str[:2]
+    g = (f.groupby("cntrycode")
+          .agg(numcust=("c_acctbal", "size"), tot=("c_acctbal", "sum"))
+          .reset_index().sort_values("cntrycode"))
+    assert len(rows) == len(g)
+    for got, (_, w) in zip(rows, g.iterrows()):
+        assert got["cntrycode"] == w.cntrycode
+        assert got["numcust"] == w.numcust
+        assert _approx(got["totacctbal"], w.tot)
