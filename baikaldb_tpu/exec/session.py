@@ -639,9 +639,153 @@ class Session:
         return Result(affected_rows=n)
 
     # -- SELECT ---------------------------------------------------------
+    def _select_group_concat(self, stmt: SelectStmt) -> Result:
+        """GROUP_CONCAT is an egress aggregate: device strings are dictionary
+        codes, so concatenation happens at the result layer (the reference
+        also accumulates GROUP_CONCAT strings row-wise on CPU,
+        src/expr/agg_fn_call.cpp — same tier, different engine).  Runs the
+        grouped query without the GROUP_CONCAT items plus one detail query
+        (keys + inputs), then assembles strings host-side."""
+        import copy
+
+        from ..expr.ast import AggCall
+        from ..plan.planner import _display_name
+        from ..sql.stmt import SelectItem
+
+        from ..expr.ast import Call as _Call
+        from ..expr.ast import ColRef as _ColRef
+        from ..expr.ast import Lit as _Lit
+
+        gc: dict[int, object] = {}
+        for i, item in enumerate(stmt.items):
+            e = item.expr
+            if isinstance(e, AggCall) and e.op == "group_concat":
+                extra = e.args[1:]
+                if any(not (isinstance(x, _Call) and x.op == "__sep")
+                       for x in extra):
+                    raise PlanError("multi-argument GROUP_CONCAT is not "
+                                    "supported (use CONCAT inside it)")
+                gc[i] = item
+
+        def mentions_gc(e):
+            if isinstance(e, AggCall) and e.op == "group_concat":
+                return True
+            args = getattr(e, "args", ())
+            return any(mentions_gc(a) for a in args)
+
+        if stmt.having is not None and mentions_gc(stmt.having):
+            raise PlanError("GROUP_CONCAT in HAVING is not supported")
+        gc_aliases = {stmt.items[i].alias for i in gc if stmt.items[i].alias}
+        for o in stmt.order_by:
+            if mentions_gc(o.expr) or (isinstance(o.expr, _ColRef) and
+                                       o.expr.table is None and
+                                       o.expr.name in gc_aliases):
+                raise PlanError("GROUP_CONCAT in ORDER BY is not supported")
+        for i, item in enumerate(stmt.items):
+            if i not in gc and mentions_gc(item.expr):
+                raise PlanError("GROUP_CONCAT nested in an expression is "
+                                "not supported")
+
+        # resolve ordinal (GROUP BY 1) and select-alias keys BEFORE copying
+        # them into the helper queries (the planner normally does this)
+        keys = []
+        alias_map = {it.alias: it.expr for it in stmt.items if it.alias}
+        for k in stmt.group_by:
+            if isinstance(k, _Lit) and isinstance(k.value, int):
+                idx = k.value - 1
+                if not 0 <= idx < len(stmt.items) or idx in gc:
+                    raise PlanError(f"GROUP BY ordinal {k.value} is invalid "
+                                    "here")
+                keys.append(stmt.items[idx].expr)
+            elif isinstance(k, _ColRef) and k.table is None and \
+                    k.name in alias_map:
+                if mentions_gc(alias_map[k.name]):
+                    raise PlanError("GROUP BY a GROUP_CONCAT alias is invalid")
+                keys.append(alias_map[k.name])
+            else:
+                keys.append(k)
+        key_aliases = [f"__gck{j}" for j in range(len(keys))]
+        base = copy.copy(stmt)
+        base.group_by = [copy.copy(k) for k in keys]   # resolved form
+        base.items = [it for i, it in enumerate(stmt.items) if i not in gc]
+        n_vis = len(base.items)
+        base.items = base.items + [SelectItem(copy.copy(k), a)
+                                   for k, a in zip(keys, key_aliases)]
+        if not base.items:
+            base.items = [SelectItem(AggCall("count_star", ()), "__gcn")]
+            n_vis = 0
+        main = self._select(base)
+
+        detail = copy.copy(stmt)
+        detail.group_by = []
+        detail.having = None
+        detail.order_by = []
+        detail.limit = None
+        detail.offset = 0
+        detail.distinct = False
+        ins = [gc[i].expr.args[0] for i in gc]
+        detail.items = [SelectItem(copy.copy(k), a)
+                        for k, a in zip(keys, key_aliases)] + \
+                       [SelectItem(copy.copy(e), f"__gcv{j}")
+                        for j, e in enumerate(ins)]
+        drows = self._select(detail).to_pylist()
+        groups: dict[tuple, list[list]] = {}
+        for r in drows:
+            k = tuple(r[a] for a in key_aliases)
+            slot = groups.setdefault(k, [[] for _ in ins])
+            for j in range(len(ins)):
+                v = r[f"__gcv{j}"]
+                if v is not None:
+                    slot[j].append(v)
+
+        mrows = main.to_pylist()
+        mcols = list(main.arrow.column_names)
+        out_cols: dict[str, list] = {}
+        order_names: list[str] = []
+        vis_iter = iter(mcols[:n_vis])
+        gclist = list(gc.items())
+        for i, item in enumerate(stmt.items):
+            if i in gc:
+                j = next(jj for jj, (idx, _) in enumerate(gclist) if idx == i)
+                call = gc[i].expr
+                sep = ","
+                if len(call.args) > 1:
+                    sep = str(call.args[1].args[0].value)   # __sep wrapper
+                vals = []
+                for r in mrows:
+                    k = tuple(r[a] for a in key_aliases)
+                    lst = groups.get(k, [[] for _ in ins])[j]
+                    if call.distinct:
+                        lst = sorted(set(map(str, lst)))
+                    else:
+                        lst = list(map(str, lst))
+                    # MySQL truncates at group_concat_max_len (default 1024)
+                    vals.append(sep.join(lst)[:1024] if lst else None)
+                name = gc[i].alias or _display_name(call)
+                order_names.append(name)
+                out_cols[name] = vals
+            else:
+                name = next(vis_iter)
+                order_names.append(name)
+                out_cols[name] = [r[name] for r in mrows]
+        table = pa.table({n: out_cols[n] for n in order_names})
+        return Result(columns=order_names, arrow=table)
+
     def _select(self, stmt: SelectStmt, cache_key=None) -> Result:
         """Plan cache (reference: state_machine.cpp:1984): one logical plan
         per SQL text, one compiled executable per (table versions, shapes)."""
+        from ..expr.ast import AggCall
+
+        def _has_gc(e):
+            if e is None:
+                return False
+            if isinstance(e, AggCall) and e.op == "group_concat":
+                return True
+            return any(_has_gc(a) for a in getattr(e, "args", ()))
+
+        if any(_has_gc(it.expr) for it in stmt.items) or _has_gc(stmt.having) \
+                or any(_has_gc(o.expr) for o in stmt.order_by):
+            return self._select_group_concat(stmt)
         entry = self._plan_cache.get(cache_key) if cache_key else None
         if entry is not None:
             # stats-derived plan choices (dense group-by domains, key shifts)

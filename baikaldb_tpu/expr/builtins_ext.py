@@ -1,0 +1,466 @@
+"""Extended MySQL builtin library (reference: src/expr/internal_functions.cpp
+4062 LoC + fn_manager registration).
+
+Registers ~80 additional scalar builtins into the expr compiler's tables
+(expr/compile.py imports this module last).  Implementation styles:
+
+- numeric/temporal: jnp elementwise on the VPU (null propagation handled by
+  the _SIMPLE wrapper);
+- string->string / string->scalar: evaluated once per DISTINCT dictionary
+  value on the host, then a device gather by code — O(|dict|) host work
+  instead of O(rows) (the dictionary design, column/dictionary.py);
+- value constants (PI, CURDATE, NOW): trace-time constants.
+
+Deliberately absent (documented): functions whose OUTPUT is a data-dependent
+string set (HEX(int), BIN, INET_NTOA, DATE_FORMAT over datetimes...) — a
+string column needs a static dictionary at trace time, so these evaluate at
+egress only; and RAND/UUID (nondeterministic under jit retrace).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import math
+import zlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..column.batch import Column
+from ..types import LType
+from ..utils import datetime_kernels as dtk
+from .ast import Lit
+from .compile import (ExprError, HostStr, _dict_scalar, _dict_transform,
+                      _eval, _num, _raw, _reg, _str_fn, _TYPE_RULES)
+
+
+# ---------------------------------------------------------------------------
+# math
+
+def _unary(fn, domain=None):
+    def h(a: Column) -> Column:
+        x = _num(a, LType.FLOAT64)
+        data = fn(x)
+        validity = None
+        if domain is not None:
+            validity = domain(x)
+        return Column(data, validity, LType.FLOAT64)
+    return h
+
+
+_reg("asin", _unary(jnp.arcsin, domain=lambda x: jnp.abs(x) <= 1), LType.FLOAT64)
+_reg("acos", _unary(jnp.arccos, domain=lambda x: jnp.abs(x) <= 1), LType.FLOAT64)
+_reg("atan", _unary(jnp.arctan), LType.FLOAT64)
+_reg("atan2", lambda a, b: Column(jnp.arctan2(_num(a, LType.FLOAT64),
+                                              _num(b, LType.FLOAT64)),
+                                  None, LType.FLOAT64), LType.FLOAT64)
+_reg("cot", _unary(lambda x: 1.0 / jnp.tan(x)), LType.FLOAT64)
+_reg("degrees", _unary(jnp.degrees), LType.FLOAT64)
+_reg("radians", _unary(jnp.radians), LType.FLOAT64)
+_reg("sinh", _unary(jnp.sinh), LType.FLOAT64)
+_reg("cosh", _unary(jnp.cosh), LType.FLOAT64)
+_reg("tanh", _unary(jnp.tanh), LType.FLOAT64)
+_reg("pi", lambda: Column(jnp.asarray(math.pi), None, LType.FLOAT64),
+     LType.FLOAT64)
+_reg("bit_count", lambda a: Column(
+    _popcount64(_num(a, LType.INT64).view(jnp.uint64)), None, LType.INT32),
+    LType.INT32)
+
+
+def _popcount64(u):
+    u = u - ((u >> jnp.uint64(1)) & jnp.uint64(0x5555555555555555))
+    u = (u & jnp.uint64(0x3333333333333333)) + \
+        ((u >> jnp.uint64(2)) & jnp.uint64(0x3333333333333333))
+    u = (u + (u >> jnp.uint64(4))) & jnp.uint64(0x0F0F0F0F0F0F0F0F)
+    return ((u * jnp.uint64(0x0101010101010101)) >> jnp.uint64(56)) \
+        .astype(jnp.int32)
+
+
+# log with MySQL's two arities: LOG(x) = ln, LOG(b, x) = log_b(x)
+@_raw("log")
+def _log(e, batch):
+    a = _eval(e.args[0], batch)
+    if len(e.args) == 1:
+        x = _num(a, LType.FLOAT64)
+        return Column(jnp.log(x), (x > 0) if a.validity is None
+                      else a.validity & (x > 0), LType.FLOAT64)
+    b = _eval(e.args[1], batch)
+    xb = _num(a, LType.FLOAT64)
+    xx = _num(b, LType.FLOAT64)
+    ok = (xb > 0) & (xb != 1) & (xx > 0)
+    v = ok if a.validity is None else a.validity & ok
+    if b.validity is not None:
+        v = v & b.validity
+    return Column(jnp.log(xx) / jnp.log(xb), v, LType.FLOAT64)
+
+
+# ---------------------------------------------------------------------------
+# string -> string (host over distinct values, device gather)
+
+_str_fn("soundex_lite", lambda s: s[:1].upper() + s[1:4].lower())
+_str_fn("md5", lambda s: hashlib.md5(s.encode()).hexdigest())
+_str_fn("sha1", lambda s: hashlib.sha1(s.encode()).hexdigest())
+_str_fn("hex_str", lambda s: s.encode().hex().upper())
+_str_fn("to_base64", lambda s: __import__("base64").b64encode(
+    s.encode()).decode())
+_str_fn("from_base64", lambda s: _b64d(s))
+
+
+def _b64d(s: str) -> str:
+    import base64
+    try:
+        return base64.b64decode(s.encode()).decode("utf-8", "replace")
+    except Exception:
+        return ""
+
+
+def _lit_str(e, i, name, default=None):
+    if i >= len(e.args):
+        if default is not None:
+            return default
+        raise ExprError(f"{name} missing argument {i}")
+    a = e.args[i]
+    if not isinstance(a, Lit):
+        raise ExprError(f"{name} argument {i + 1} must be a literal")
+    return a.value
+
+
+def _str_fn2(name, make):
+    """String fn with literal extra args: make(*lits) -> str->str."""
+    @_raw(name)
+    def h(e, batch, make=make, name=name):
+        a = _eval(e.args[0], batch)
+        lits = [e.args[i].value if isinstance(e.args[i], Lit) else None
+                for i in range(1, len(e.args))]
+        if any(x is None for x in lits):
+            raise ExprError(f"{name} extra arguments must be literals")
+        fn = make(*lits)
+        if isinstance(a, HostStr):
+            return HostStr(fn(str(a)))
+        return _dict_transform(a, fn)
+    return h
+
+
+_str_fn2("left", lambda n: lambda s: s[:int(n)] if int(n) > 0 else "")
+_str_fn2("right", lambda n: lambda s: s[-int(n):] if int(n) > 0 else "")
+_str_fn2("repeat", lambda n: lambda s: s * max(0, int(n)))
+_str_fn2("lpad", lambda n, pad: lambda s: _pad(s, int(n), str(pad), True))
+_str_fn2("rpad", lambda n, pad: lambda s: _pad(s, int(n), str(pad), False))
+_str_fn2("replace", lambda old, new: lambda s: s.replace(str(old), str(new)))
+_str_fn2("substring_index",
+         lambda delim, cnt: lambda s: _substring_index(s, str(delim), int(cnt)))
+
+
+def _pad(s: str, n: int, pad: str, left: bool) -> str:
+    if len(s) >= n:
+        return s[:n]
+    if not pad:
+        return ""
+    fill = (pad * n)[:n - len(s)]
+    return fill + s if left else s + fill
+
+
+def _substring_index(s: str, delim: str, cnt: int) -> str:
+    if not delim or cnt == 0:
+        return ""
+    parts = s.split(delim)
+    if cnt > 0:
+        return delim.join(parts[:cnt])
+    return delim.join(parts[cnt:])
+
+
+@_raw("concat_ws")
+def _concat_ws(e, batch):
+    """CONCAT_WS skips NULL arguments (it is NULL only for a NULL separator):
+    a NULL column lane yields the remaining parts joined, not NULL."""
+    from ..column.dictionary import NULL_CODE, Dictionary
+
+    sep = str(_lit_str(e, 0, "CONCAT_WS"))
+    parts = [_eval(a, batch) for a in e.args[1:]]
+    cols = [i for i, p in enumerate(parts) if isinstance(p, Column)]
+    if not cols:
+        return HostStr(sep.join(str(p) for p in parts))
+    if len(cols) > 1:
+        raise ExprError("CONCAT_WS of multiple columns is egress-only")
+    i = cols[0]
+    c = parts[i]
+    if c.dictionary is None:
+        raise ExprError("CONCAT_WS requires a string column")
+    others = [str(p) for j, p in enumerate(parts) if j != i]
+    with_col = [sep.join([str(p) for p in parts[:i]] + [v] +
+                         [str(p) for p in parts[i + 1:]])
+                for v in c.dictionary.values]
+    without = sep.join(others)           # the column lane was NULL: skipped
+    all_vals = np.asarray(with_col + [without], dtype=str)
+    uniq, inv = np.unique(all_vals, return_inverse=True)
+    remap = jnp.asarray(inv.astype(np.int32))
+    null_sub = jnp.asarray(inv[-1].astype(np.int32))
+    codes = jnp.take(remap[:-1] if len(with_col) else remap,
+                     jnp.clip(c.data, 0, None), mode="clip")
+    valid = c.valid_mask()
+    data = jnp.where(valid, codes, null_sub)
+    data = jnp.where(c.data == NULL_CODE, null_sub, data)
+    return Column(data, None, LType.STRING, Dictionary(uniq))
+
+
+# ---------------------------------------------------------------------------
+# string -> scalar
+
+@_raw("ascii")
+def _ascii(e, batch):
+    a = _eval(e.args[0], batch)
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(ord(a[0]) if a else 0, jnp.int64),
+                      None, LType.INT64)
+    return _dict_scalar(a, lambda s: (s.encode()[0] if s else 0), LType.INT64)
+
+
+@_raw("ord")
+def _ord(e, batch):
+    a = _eval(e.args[0], batch)
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(ord(a[0]) if a else 0, jnp.int64),
+                      None, LType.INT64)
+    return _dict_scalar(a, lambda s: (ord(s[0]) if s else 0), LType.INT64)
+
+
+@_raw("crc32")
+def _crc32(e, batch):
+    a = _eval(e.args[0], batch)
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(zlib.crc32(str(a).encode()), jnp.int64),
+                      None, LType.INT64)
+    return _dict_scalar(a, lambda s: zlib.crc32(s.encode()), LType.INT64)
+
+
+@_raw("instr")
+def _instr(e, batch):
+    a = _eval(e.args[0], batch)
+    sub = _lit_str(e, 1, "INSTR")
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(str(a).find(str(sub)) + 1, jnp.int64),
+                      None, LType.INT64)
+    return _dict_scalar(a, lambda s: s.find(str(sub)) + 1, LType.INT64)
+
+
+@_raw("locate")
+def _locate(e, batch):
+    # LOCATE(substr, str [, pos])
+    sub = _lit_str(e, 0, "LOCATE")
+    a = _eval(e.args[1], batch)
+    pos = int(_lit_str(e, 2, "LOCATE", default=1))
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(str(a).find(str(sub), pos - 1) + 1,
+                                  jnp.int64), None, LType.INT64)
+    return _dict_scalar(a, lambda s: s.find(str(sub), pos - 1) + 1,
+                        LType.INT64)
+
+
+@_raw("find_in_set")
+def _find_in_set(e, batch):
+    a = _eval(e.args[0], batch)
+    lst = _lit_str(e, 1, "FIND_IN_SET")
+    items = str(lst).split(",")
+
+    def f(s: str) -> int:
+        try:
+            return items.index(s) + 1
+        except ValueError:
+            return 0
+
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(f(str(a)), jnp.int64), None, LType.INT64)
+    return _dict_scalar(a, f, LType.INT64)
+
+
+@_raw("field")
+def _field(e, batch):
+    a = _eval(e.args[0], batch)
+    items = [str(_lit_str(e, i, "FIELD")) for i in range(1, len(e.args))]
+
+    def f(s: str) -> int:
+        try:
+            return items.index(s) + 1
+        except ValueError:
+            return 0
+
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(f(str(a)), jnp.int64), None, LType.INT64)
+    return _dict_scalar(a, f, LType.INT64)
+
+
+@_raw("strcmp")
+def _strcmp(e, batch):
+    from ..column.dictionary import merge, translate_codes
+
+    a = _eval(e.args[0], batch)
+    b = _eval(e.args[1], batch)
+    if isinstance(a, HostStr) and isinstance(b, HostStr):
+        s, t = str(a), str(b)
+        return Column(jnp.asarray((s > t) - (s < t), jnp.int32), None,
+                      LType.INT32)
+    if isinstance(b, HostStr):
+        return _dict_scalar(a, lambda s: (s > str(b)) - (s < str(b)),
+                            LType.INT32)
+    if isinstance(a, HostStr):
+        return _dict_scalar(b, lambda s: (str(a) > s) - (str(a) < s),
+                            LType.INT32)
+    if a.dictionary is None or b.dictionary is None:
+        raise ExprError("STRCMP requires string columns")
+    # align both sides on a merged dictionary: code order == string order
+    _, ra, rb = merge(a.dictionary, b.dictionary)
+    ca = jnp.take(jnp.asarray(ra), jnp.clip(a.data, 0, None), mode="clip")
+    cb = jnp.take(jnp.asarray(rb), jnp.clip(b.data, 0, None), mode="clip")
+    validity = None
+    if a.validity is not None:
+        validity = a.validity
+    if b.validity is not None:
+        validity = b.validity if validity is None else validity & b.validity
+    return Column(jnp.sign(ca - cb).astype(jnp.int32), validity, LType.INT32)
+
+
+@_raw("regexp_like")
+def _regexp_like(e, batch):
+    import re
+
+    a = _eval(e.args[0], batch)
+    pat = str(_lit_str(e, 1, "REGEXP"))
+    rx = re.compile(pat)
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(bool(rx.search(str(a)))), None, LType.BOOL)
+    mask = a.dictionary.match_mask(lambda s: rx.search(s) is not None)
+    hit = jnp.take(jnp.asarray(mask), jnp.clip(a.data, 0, None), mode="clip")
+    return Column(hit, a.validity, LType.BOOL)
+
+
+@_raw("inet_aton")
+def _inet_aton(e, batch):
+    def f(s: str) -> int:
+        try:
+            parts = [int(x) for x in s.split(".")]
+            if len(parts) != 4 or any(p < 0 or p > 255 for p in parts):
+                return 0
+            return (parts[0] << 24) | (parts[1] << 16) | (parts[2] << 8) | parts[3]
+        except ValueError:
+            return 0
+
+    a = _eval(e.args[0], batch)
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(f(str(a)), jnp.int64), None, LType.INT64)
+    return _dict_scalar(a, f, LType.INT64)
+
+
+# ---------------------------------------------------------------------------
+# temporal
+
+_DAYNAMES = np.asarray(["Monday", "Tuesday", "Wednesday", "Thursday",
+                        "Friday", "Saturday", "Sunday"])
+_MONTHNAMES = np.asarray(["January", "February", "March", "April", "May",
+                          "June", "July", "August", "September", "October",
+                          "November", "December"])
+
+
+def _code_string(codes, names: np.ndarray, validity) -> Column:
+    """Int codes -> STRING column over a FIXED dictionary (sorted + remap)."""
+    from ..column.dictionary import Dictionary
+
+    order = np.argsort(names, kind="stable")
+    rank = np.empty_like(order)
+    rank[order] = np.arange(len(order))
+    remap = jnp.asarray(rank.astype(np.int32))
+    return Column(jnp.take(remap, codes, mode="clip"), validity,
+                  LType.STRING, Dictionary(names[order].astype(str)))
+
+
+@_raw("dayname")
+def _dayname(e, batch):
+    from .compile import _as_days
+
+    a = _eval(e.args[0], batch)
+    wd = dtk.weekday(_as_days(a))          # 0=Monday
+    return _code_string(wd, _DAYNAMES, a.validity)
+
+
+@_raw("monthname")
+def _monthname(e, batch):
+    from .compile import _as_days
+
+    a = _eval(e.args[0], batch)
+    m = dtk.month_of_days(_as_days(a)) - 1
+    return _code_string(m, _MONTHNAMES, a.validity)
+
+
+def _week_mode0(days):
+    """MySQL WEEK(d) mode 0 == python strftime %U: Sunday-start, 00-53.
+    Week 1 begins on the year's first Sunday; earlier days are week 0."""
+    doy = dtk.day_of_year(days)                         # 1-based
+    jan1 = days - (doy - 1)
+    s = (dtk.weekday(jan1) + 1) % 7                     # Sunday=0
+    first_sunday = 1 + (7 - s) % 7                      # its day-of-year
+    return ((doy + 7 - first_sunday) // 7).astype(jnp.int32)
+
+
+def _as_days_lazy(a):
+    from .compile import _as_days
+    return _as_days(a)
+
+
+_reg("week", lambda a: Column(_week_mode0(_as_days_lazy(a)), None,
+                              LType.INT32), LType.INT32)
+_reg("yearweek", lambda a: Column(
+    dtk.year_of_days(_as_days_lazy(a)) * 100 + _week_mode0(_as_days_lazy(a)),
+    None, LType.INT32), LType.INT32)
+_reg("makedate", lambda y, d: Column(
+    (dtk.days_from_civil(_num(y, LType.INT32), jnp.asarray(1, jnp.int32),
+                         jnp.asarray(1, jnp.int32))
+     + _num(d, LType.INT32) - 1).astype(jnp.int32),
+    None, LType.DATE), LType.DATE)
+_reg("time_to_sec", lambda a: Column(
+    (dtk.dt_time_of_day_us(a.data) // dtk.US_PER_SEC).astype(jnp.int64),
+    None, LType.INT64), LType.INT64)
+
+
+@_raw("curdate")
+def _curdate(e, batch):
+    d = (_dt.date.today() - _dt.date(1970, 1, 1)).days
+    return Column(jnp.asarray(d, jnp.int32), None, LType.DATE)
+
+
+@_raw("now")
+def _now(e, batch):
+    t = _dt.datetime.now()
+    us = int((t - _dt.datetime(1970, 1, 1)).total_seconds() * 1e6)
+    return Column(jnp.asarray(us, jnp.int64), None, LType.DATETIME)
+
+
+@_raw("utc_date")
+def _utc_date(e, batch):
+    d = (_dt.datetime.now(_dt.timezone.utc).date() - _dt.date(1970, 1, 1)).days
+    return Column(jnp.asarray(d, jnp.int32), None, LType.DATE)
+
+
+# ---------------------------------------------------------------------------
+# type rules for everything above
+
+_TYPE_RULES.update({
+    "asin": LType.FLOAT64, "acos": LType.FLOAT64, "atan": LType.FLOAT64,
+    "atan2": LType.FLOAT64, "cot": LType.FLOAT64, "degrees": LType.FLOAT64,
+    "radians": LType.FLOAT64, "sinh": LType.FLOAT64, "cosh": LType.FLOAT64,
+    "tanh": LType.FLOAT64, "pi": LType.FLOAT64, "log": LType.FLOAT64,
+    "bit_count": LType.INT32,
+    "md5": LType.STRING, "sha1": LType.STRING, "hex_str": LType.STRING,
+    "to_base64": LType.STRING, "from_base64": LType.STRING,
+    "soundex_lite": LType.STRING,
+    "left": LType.STRING, "right": LType.STRING, "repeat": LType.STRING,
+    "lpad": LType.STRING, "rpad": LType.STRING, "replace": LType.STRING,
+    "substring_index": LType.STRING, "concat_ws": LType.STRING,
+    "ascii": LType.INT64, "ord": LType.INT64, "crc32": LType.INT64,
+    "instr": LType.INT64, "locate": LType.INT64, "find_in_set": LType.INT64,
+    "field": LType.INT64, "strcmp": LType.INT32, "regexp_like": LType.BOOL,
+    "inet_aton": LType.INT64,
+    "dayname": LType.STRING, "monthname": LType.STRING,
+    "week": LType.INT32, "yearweek": LType.INT32, "makedate": LType.DATE,
+    "time_to_sec": LType.INT64, "curdate": LType.DATE, "now": LType.DATETIME,
+    "utc_date": LType.DATE,
+})

@@ -971,3 +971,7 @@ def _hash(e, batch):
     from ..utils.hashing import hash_columns
     cols = [eval_expr(a, batch) for a in e.args]
     return Column(hash_columns([c.data for c in cols]), None, LType.INT64)
+
+
+# extended builtin library registers itself into the tables above
+from . import builtins_ext  # noqa: E402,F401  (import for side effects)

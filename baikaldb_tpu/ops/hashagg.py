@@ -32,23 +32,37 @@ from ..types import LType
 
 
 def agg_result_type(op: str, input_type: LType) -> LType:
-    if op in ("count", "count_star"):
+    if op in ("count", "count_star", "approx_count_distinct"):
         return LType.INT64
     if op == "sum":
         return LType.INT64 if input_type.is_integer else LType.FLOAT64
-    if op in ("avg", "sumsq", "stddev", "stddev_samp", "variance", "var_samp"):
+    if op in ("avg", "sumsq", "stddev", "stddev_samp", "variance", "var_samp",
+              "percentile"):
         return LType.FLOAT64
     if op in ("min", "max"):
         return input_type
     raise ValueError(f"unknown aggregate {op}")
 
 
+# aggregates whose state cannot merge as a single psum/pmin/pmax lane; the
+# distribute pass co-locates each group's rows (repartition/gather) instead
+ROW_AGGS = {"approx_count_distinct", "percentile"}
+
+# HyperLogLog register count for APPROX_COUNT_DISTINCT (the reference keeps
+# 16384-register HLLs in src/common/hll_common.cpp; 512 keeps the dense
+# group table small at <2% typical error)
+HLL_REGISTERS = 512
+
+
 @dataclass(frozen=True)
 class AggSpec:
-    op: str                 # count | count_star | sum | avg | min | max | stddev | variance
+    op: str                 # count | count_star | sum | avg | min | max |
+    #                         stddev/variance family | approx_count_distinct |
+    #                         percentile
     input: Optional[str]    # column name; None for count_star
     out_name: str
     distinct: bool = False
+    param: Optional[float] = None   # percentile fraction
 
 
 def _sum_dtype(c: Column):
@@ -113,7 +127,71 @@ def _scalar_one(batch: ColumnBatch, s: AggSpec, sel) -> Column:
         if s.op.startswith("stddev"):
             v = jnp.sqrt(v)
         return Column(v[None], (n > 0)[None], LType.FLOAT64)
+    if s.op == "approx_count_distinct":
+        regs = _hll_registers(c, live, jnp.zeros_like(c.data, jnp.int32), 1)
+        return Column(_hll_estimate(regs)[:1], None, LType.INT64)
+    if s.op == "percentile":
+        gid = jnp.where(live, 0, 1)
+        v, ok = _segment_percentile(c, gid, 1, s.param)
+        return Column(v, ok, LType.FLOAT64)
     raise ValueError(f"unknown aggregate {s.op}")
+
+
+# -- sketch aggregates --------------------------------------------------
+
+
+def _hll_registers(c: Column, live, gid, ng: int):
+    """Per-group HyperLogLog register table [ng, m] via ONE segment_max —
+    the reference's HLL sketches (src/common/hll_common.cpp) re-expressed as
+    a segment reduction (TPU-native: no per-row register RMW)."""
+    from ..utils.hashing import hash_columns, mix32
+
+    m = HLL_REGISTERS
+    h1 = hash_columns([c.data])
+    h2 = mix32(h1 ^ jnp.uint32(0x9E3779B9))     # independent second stream
+    reg = (h1 % jnp.uint32(m)).astype(jnp.int32)
+    # rho = 1 + leading zeros of the second stream (32-bit)
+    nz = 32 - jnp.ceil(jnp.log2(h2.astype(jnp.float64) + 1.0)).astype(jnp.int32)
+    rho = jnp.clip(nz + 1, 1, 33)
+    slot = jnp.where(live, gid * m + reg, ng * m)
+    regs = jax.ops.segment_max(jnp.where(live, rho, 0), slot,
+                               num_segments=ng * m + 1)[:ng * m]
+    return jnp.maximum(regs, 0).reshape(ng, m)
+
+
+def _hll_estimate(regs):
+    """[ng, m] registers -> cardinality estimate with small-range correction."""
+    m = float(HLL_REGISTERS)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    z = jnp.sum(2.0 ** (-regs.astype(jnp.float64)), axis=1)
+    e = alpha * m * m / z
+    zeros = jnp.sum(regs == 0, axis=1).astype(jnp.float64)
+    small = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    est = jnp.where((e <= 2.5 * m) & (zeros > 0), small, e)
+    return jnp.round(est).astype(jnp.int64)
+
+
+def _segment_percentile(c: Column, gid_v, ng: int, p: float):
+    """Exact percentile per group: sort by (group, value), index into each
+    group's run with linear interpolation (PERCENTILE_CONT semantics).  The
+    reference approximates with t-digest (src/common/tdigest.cpp) because
+    CPU sorts are expensive; on TPU the sort IS the cheap primitive."""
+    x = c.data.astype(jnp.float64)
+    order = jnp.argsort(x, stable=True)
+    order = order[jnp.argsort(gid_v[order], stable=True)]
+    g = gid_v[order]
+    v = x[order]
+    counts = jax.ops.segment_sum(jnp.ones_like(gid_v, jnp.int32), gid_v,
+                                 num_segments=ng + 1)[:ng]
+    starts = jnp.cumsum(counts) - counts
+    tpos = starts.astype(jnp.float64) + p * jnp.maximum(counts - 1, 0)
+    lo = jnp.floor(tpos).astype(jnp.int32)
+    hi = jnp.ceil(tpos).astype(jnp.int32)
+    n = v.shape[0]
+    vlo = jnp.take(v, jnp.clip(lo, 0, max(n - 1, 0)), mode="clip")
+    vhi = jnp.take(v, jnp.clip(hi, 0, max(n - 1, 0)), mode="clip")
+    frac = tpos - lo
+    return vlo + (vhi - vlo) * frac, counts > 0
 
 
 def _scalar_distinct(c: Column, live, s: AggSpec) -> Column:
@@ -242,6 +320,12 @@ def _segment_one(batch: ColumnBatch, s: AggSpec, gid, ng: int, sel) -> Column:
         var = jnp.maximum(var * (n1 / denom_n), 0.0)
         v = jnp.sqrt(var) if s.op.startswith("stddev") else var
         return Column(v, n > 0, LType.FLOAT64)
+    if s.op == "approx_count_distinct":
+        regs = _hll_registers(c, live, gid_v, ng)
+        return Column(_hll_estimate(regs), None, LType.INT64)
+    if s.op == "percentile":
+        v, ok = _segment_percentile(c, gid_v, ng, s.param)
+        return Column(v, ok, LType.FLOAT64)
     raise ValueError(f"unknown aggregate {s.op}")
 
 
@@ -370,6 +454,10 @@ def partial_specs(specs: list[AggSpec]) -> tuple[list[AggSpec], dict]:
         return name
 
     for s in specs:
+        if s.op in ROW_AGGS:
+            # these need each group's ROWS, not a mergeable scalar partial;
+            # the distribute pass must have routed them via repartition
+            raise ValueError(f"{s.op} has no scalar partial form")
         if s.op == "avg":
             finalize[s.out_name] = ("avg", add("sum", s.input, s.distinct),
                                     add("count", s.input, s.distinct))

@@ -133,8 +133,13 @@ class _Distributor:
             return SHARD, est
 
         if isinstance(node, AggNode):
+            from ..ops.hashagg import ROW_AGGS
+
             d, e = self.visit(node.child())
-            has_distinct = any(s.distinct for s in node.specs)
+            # DISTINCT and row-holding sketches (percentile, HLL) cannot
+            # merge scalar partials: co-locate each group's rows instead
+            has_distinct = any(s.distinct or s.op in ROW_AGGS
+                               for s in node.specs)
             if not node.key_names:
                 if d == SHARD:
                     if has_distinct:

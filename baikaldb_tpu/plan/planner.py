@@ -591,7 +591,17 @@ class Planner:
                 op = a.op
                 if op == "count" and len(a.args) > 1:
                     raise PlanError("multi-arg COUNT not supported (round 1)")
-                specs.append(AggSpec(op, inp, out, distinct=a.distinct))
+                param = None
+                if op == "median":
+                    op, param = "percentile", 0.5
+                elif op == "percentile":
+                    if len(a.args) < 2 or not isinstance(a.args[1], Lit):
+                        raise PlanError("PERCENTILE(col, p) needs a literal p")
+                    param = float(a.args[1].value)
+                    if not 0.0 <= param <= 1.0:
+                        raise PlanError("percentile p must be in [0, 1]")
+                specs.append(AggSpec(op, inp, out, distinct=a.distinct,
+                                     param=param))
             agg_out.append((a, out))
 
         if pre_exprs:

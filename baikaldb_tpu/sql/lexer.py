@@ -20,7 +20,7 @@ class Token:
 KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
     "offset", "asc", "desc", "as", "and", "or", "not", "xor", "in", "is",
-    "null", "like", "between", "distinct", "all", "union", "join", "inner",
+    "null", "like", "regexp", "rlike", "between", "distinct", "all", "union", "join", "inner",
     "left", "right", "full", "outer", "cross", "on", "using", "case", "when",
     "then", "else", "end", "cast", "true", "false", "exists", "any",
     "insert", "into", "values", "replace", "update", "set", "delete",

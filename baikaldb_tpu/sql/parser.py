@@ -21,16 +21,21 @@ from .stmt import (AlterTableStmt, ColumnDef, CreateDatabaseStmt, CreateTableStm
                    ShowStmt, TableRef, TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
 
 _AGG_FUNCS = {"count", "sum", "avg", "min", "max", "stddev", "std",
-              "stddev_samp", "variance", "var_samp", "group_concat"}
+              "stddev_samp", "variance", "var_samp", "group_concat",
+              "percentile", "median", "approx_count_distinct"}
 
 _WINDOW_ONLY = {"row_number", "rank", "dense_rank", "ntile", "lead", "lag",
                 "first_value", "last_value"}
 
 _FN_ALIASES = {
     "substring": "substr", "mid": "substr", "ucase": "upper", "lcase": "lower",
-    "ceiling": "ceil", "power": "pow", "log": "ln", "character_length":
+    "ceiling": "ceil", "power": "pow", "character_length":
     "char_length", "curdate": "curdate", "now": "now", "std": "stddev",
     "datediff": "datediff", "adddate": "date_add_days", "subdate": "date_sub_days",
+    "isnull": "is_null", "hex": "hex_str", "current_date": "curdate",
+    "current_timestamp": "now", "sysdate": "now", "localtime": "now",
+    "rlike": "regexp_like", "regexp": "regexp_like", "position": "locate",
+    "lengthb": "length", "approx_distinct": "approx_count_distinct",
 }
 
 _CMP_OPS = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le",
@@ -586,6 +591,11 @@ class Parser:
                 pat = self._add_expr()
                 e = Call("not_like" if neg else "like", (e, pat))
                 continue
+            if self.try_kw("regexp") or self.try_kw("rlike"):
+                pat = self._add_expr()
+                rx = Call("regexp_like", (e, pat))
+                e = Call("not", (rx,)) if neg else rx
+                continue
             if self.try_kw("in"):
                 self.expect_op("(")
                 if self.peek().kind == "KW" and self.peek().value == "select":
@@ -698,6 +708,11 @@ class Parser:
                 raise SqlError("INTERVAL only valid inside DATE_ADD/DATE_SUB")
             if t.value == "if":
                 return self._call_or_ident()
+            # keywords doubling as function names (LEFT(x,n) vs LEFT JOIN,
+            # REPLACE(s,a,b) vs REPLACE INTO, ...): special forms were
+            # handled above, so KW followed by '(' is a call
+            if self.peek(1).kind == "OP" and self.peek(1).value == "(":
+                return self._call_or_ident()
         if self.try_op("("):
             if self.peek().kind == "KW" and self.peek().value == "select":
                 sub = self.select_stmt()
@@ -747,6 +762,15 @@ class Parser:
             args = [self.expr()]
             while self.try_op(","):
                 args.append(self.expr())
+            if lname == "group_concat" and self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "separator":
+                self.advance()
+                sep = self.advance()
+                if sep.kind != "STR":
+                    raise SqlError("SEPARATOR needs a string literal")
+                # marked wrapper: distinguishes the separator from a real
+                # second concat argument (which we reject rather than drop)
+                args.append(Call("__sep", (Lit(sep.value),)))
             self.expect_op(")")
             op = _FN_ALIASES.get(lname, lname)
             w = self._maybe_over(op, tuple(args))
