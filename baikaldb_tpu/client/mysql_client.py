@@ -47,24 +47,41 @@ class QueryResult:
 
 class Connection:
     def __init__(self, host: str = "127.0.0.1", port: int = 3306,
-                 user: str = "root", database: str = ""):
+                 user: str = "root", database: str = "", password: str = ""):
         self.sock = socket.create_connection((host, port), timeout=30)
         self.p = Packets(self.sock)
-        self._handshake(user, database)
+        self._handshake(user, database, password)
 
-    def _handshake(self, user: str, database: str):
+    def _handshake(self, user: str, database: str, password: str):
         greet = self.p.read()
         if greet is None:
             raise ConnectionError("no handshake from server")
         if greet[0] == 0xFF:
             raise MySQLError(struct.unpack_from("<H", greet, 1)[0],
                              greet[9:].decode(errors="replace"))
+        # salt: 8 bytes after server version NUL + thread id, 12 more in the
+        # extension block (protocol 10 layout)
+        pos = greet.find(b"\x00", 1) + 5
+        salt = greet[pos:pos + 8]
+        # the second salt chunk sits past filler/caps/charset/status/reserved
+        salt2_off = pos + 8 + 1 + 2 + 1 + 2 + 2 + 1 + 10
+        salt = salt + greet[salt2_off:salt2_off + 12]
         caps = 0x00000200 | 0x00008000 | 0x00000001      # PROTOCOL_41|SECURE|LONG_PW
         if database:
             caps |= 0x00000008
+        auth = b""
+        if password:
+            import hashlib
+
+            def sha1(b: bytes) -> bytes:
+                return hashlib.sha1(b).digest()
+
+            sha_pw = sha1(password.encode())
+            mask = sha1(salt + sha1(sha_pw))
+            auth = bytes(a ^ b for a, b in zip(sha_pw, mask))
         payload = (struct.pack("<I", caps) + struct.pack("<I", 1 << 24) +
                    bytes([0x21]) + b"\x00" * 23 + user.encode() + b"\x00" +
-                   bytes([0]))                            # empty auth response
+                   bytes([len(auth)]) + auth)
         if database:
             payload += database.encode() + b"\x00"
         self.p.write(payload)
@@ -74,6 +91,98 @@ class Connection:
         if resp[0] == 0xFF:
             raise MySQLError(struct.unpack_from("<H", resp, 1)[0],
                              resp[9:].decode(errors="replace"))
+
+    # -- prepared statements (binary protocol) -------------------------------
+    def prepare(self, sql: str) -> int:
+        """COM_STMT_PREPARE -> statement id."""
+        self.p.reset()
+        self.p.write(b"\x16" + sql.encode())
+        resp = self.p.read()
+        if resp is None:
+            raise ConnectionError("server closed")
+        if resp[0] == 0xFF:
+            raise MySQLError(struct.unpack_from("<H", resp, 1)[0],
+                             resp[9:].decode(errors="replace"))
+        sid = struct.unpack_from("<I", resp, 1)[0]
+        nparams = struct.unpack_from("<H", resp, 7)[0]
+        for _ in range(nparams + (1 if nparams else 0)):   # defs + EOF
+            self.p.read()
+        return sid
+
+    def execute(self, sid: int, params: tuple = ()) -> QueryResult:
+        """COM_STMT_EXECUTE with binary params; decodes binary result rows."""
+        self.p.reset()
+        body = b"\x17" + struct.pack("<I", sid) + b"\x00" + \
+            struct.pack("<I", 1)
+        n = len(params)
+        if n:
+            bitmap = bytearray((n + 7) // 8)
+            types = b""
+            vals = b""
+            for i, v in enumerate(params):
+                if v is None:
+                    bitmap[i // 8] |= 1 << (i % 8)
+                    types += struct.pack("<H", 6)          # MYSQL_TYPE_NULL
+                elif isinstance(v, bool):
+                    types += struct.pack("<H", 1)
+                    vals += struct.pack("<b", int(v))
+                elif isinstance(v, int):
+                    types += struct.pack("<H", 8)
+                    vals += struct.pack("<q", v)
+                elif isinstance(v, float):
+                    types += struct.pack("<H", 5)
+                    vals += struct.pack("<d", v)
+                else:
+                    types += struct.pack("<H", 253)
+                    b = str(v).encode()
+                    vals += lenenc_int(len(b)) + b
+            body += bytes(bitmap) + b"\x01" + types + vals
+        self.p.write(body)
+        first = self.p.read()
+        if first is None:
+            raise ConnectionError("server closed")
+        if first[0] == 0xFF:
+            raise MySQLError(struct.unpack_from("<H", first, 1)[0],
+                             first[9:].decode(errors="replace"))
+        if first[0] == 0x00:
+            affected, _ = _read_lenenc(first, 1)
+            return QueryResult([], [], affected or 0)
+        ncols, _ = _read_lenenc(first, 0)
+        columns = []
+        while True:
+            pkt = self.p.read()
+            if pkt is None:
+                raise ConnectionError("server closed mid result")
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            pos = 0
+            vals2 = []
+            for _ in range(6):
+                ln, pos = _read_lenenc(pkt, pos)
+                vals2.append(pkt[pos:pos + (ln or 0)])
+                pos += ln or 0
+            columns.append(vals2[4].decode())
+        rows = []
+        while True:
+            pkt = self.p.read()
+            if pkt is None:
+                raise ConnectionError("server closed mid rows")
+            if pkt[0] == 0xFE and len(pkt) < 9:
+                break
+            # binary row: 0x00 header + null bitmap (offset 2) + lenenc vals
+            nb = (ncols + 9) // 8
+            bitmap = pkt[1:1 + nb]
+            pos = 1 + nb
+            row = []
+            for i in range(ncols):
+                if bitmap[(i + 2) // 8] & (1 << ((i + 2) % 8)):
+                    row.append(None)
+                else:
+                    ln, pos = _read_lenenc(pkt, pos)
+                    row.append(pkt[pos:pos + ln].decode())
+                    pos += ln
+            rows.append(tuple(row))
+        return QueryResult(columns, rows)
 
     def query(self, sql: str) -> QueryResult:
         self.p.reset()

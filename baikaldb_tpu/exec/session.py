@@ -32,6 +32,9 @@ from ..sql.stmt import (AlterTableStmt, CreateDatabaseStmt, CreateTableStmt, Del
                         DescribeStmt, DropDatabaseStmt, DropTableStmt,
                         ExplainStmt, InsertStmt, SelectStmt, ShowStmt,
                         TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
+from ..meta.privileges import READ, WRITE, AccessError, PrivilegeManager
+from ..sql.stmt import (CreateUserStmt, DropUserStmt, GrantStmt, HandleStmt,
+                        LoadDataStmt, RevokeStmt)
 from ..storage.column_store import TableStore, schema_to_arrow
 from ..types import Field, LType, Schema
 from .executor import compile_plan
@@ -54,6 +57,67 @@ def _stmt_image(kind: str, s) -> str:
         sets = ", ".join(f"{n}={e!r}" for n, e in s.assignments)
         return f"UPDATE {s.table.name} SET {sets}{where}"
     return f"DELETE FROM {s.table.name}{where}"
+
+
+def _is_vector_component(name: str, vcols: dict) -> bool:
+    if not name.startswith("__"):
+        return False
+    return _component_owner(name, vcols) is not None
+
+def _component_owner(name: str, vcols: dict):
+    for v in vcols:
+        if name.startswith(f"__{v}_") and name[len(v) + 3:].isdigit():
+            return v
+    return None
+
+
+def _parse_vector(v, dim: int):
+    if v is None:
+        return [None] * dim
+    if isinstance(v, str):
+        body = v.strip().lstrip("[").rstrip("]").replace(",", " ")
+        vals = [float(x) for x in body.split()]
+    else:
+        vals = [float(x) for x in v]
+    if len(vals) != dim:
+        raise PlanError(f"vector literal has {len(vals)} components, "
+                        f"expected {dim}")
+    return vals
+
+
+def _expand_vector_arrow(t: pa.Table, vcols: dict) -> pa.Table:
+    """Split list-typed vector columns into float32 component columns
+    (NULL vectors allowed, like the row path)."""
+    for name, dim in vcols.items():
+        if name not in t.column_names:
+            continue
+        rows = t.column(name).to_pylist()
+        mat = np.zeros((len(rows), dim), np.float32)
+        isnull = np.zeros(len(rows), bool)
+        for i, v in enumerate(rows):
+            if v is None:
+                isnull[i] = True
+                continue
+            if len(v) != dim:
+                raise PlanError(f"vector column {name!r} expects dim {dim}")
+            mat[i] = v
+        t = t.drop_columns([name])
+        for i in range(dim):
+            t = t.append_column(
+                f"__{name}_{i}",
+                pa.array(mat[:, i], pa.float32(),
+                         mask=isnull if isnull.any() else None))
+    return t
+
+
+def _expand_vector_row(r: dict, vcols: dict) -> dict:
+    out = dict(r)
+    for name, dim in vcols.items():
+        if name in out:
+            vals = _parse_vector(out.pop(name), dim)
+            for i, x in enumerate(vals):
+                out[f"__{name}_{i}"] = x
+    return out
 
 
 def _qualify_free(e):
@@ -110,6 +174,10 @@ class Database:
         from ..storage.binlog import Binlog
         self.binlog = Binlog()
         self.qos = None          # optional utils.qos.QosManager
+        self.privileges = PrivilegeManager()
+        # live connections for SHOW PROCESSLIST (id -> dict), kept by the
+        # wire server (reference: show processlist over NetworkServer conns)
+        self.processlist: dict[int, dict] = {}
         self.data_dir = data_dir
         if data_dir:
             import os
@@ -192,13 +260,16 @@ class Database:
 
 class Session:
     def __init__(self, db: Optional[Database] = None, database: str = "default",
-                 mesh=None):
+                 mesh=None, user: str = "root"):
         """``mesh``: a jax.sharding.Mesh with one axis — when set, every
         SELECT plans through plan/distribute.py and executes as a single
         shard_map program over the mesh (scans row-sharded across devices,
-        exchanges as ICI collectives — the MPP mode, SURVEY §3.2)."""
+        exchanges as ICI collectives — the MPP mode, SURVEY §3.2).
+        ``user``: the authenticated account; statements are checked against
+        its grants (reference: privilege_manager + per-statement checks)."""
         self.db = db or Database()
         self.current_db = database
+        self.user = user
         self.mesh = mesh
         # sharded device batches, keyed (table_key, version)
         self._mesh_batches: dict = {}
@@ -224,6 +295,107 @@ class Session:
         self.db.binlog.append(event_type, db_name, table, rows=rows,
                               statement=statement, affected=affected)
 
+    # -- access control ---------------------------------------------------
+    def _stmt_dbs(self, s) -> set[str]:
+        """Databases a SELECT reads — FROM/joins/CTEs/unions AND expression
+        subqueries (WHERE/items/HAVING), so a subquery can't read around the
+        grants (coarse db-granular enforcement like the reference's)."""
+        from ..expr.ast import Subquery
+
+        out: set[str] = set()
+
+        def walk_expr(e):
+            if e is None:
+                return
+            if isinstance(e, Subquery):
+                walk_sel(e.stmt)
+                return
+            for a in getattr(e, "args", ()):
+                walk_expr(a)
+
+        def walk_sel(st):
+            refs = ([st.table] if st.table is not None else []) + \
+                   [j.table for j in st.joins]
+            for r in refs:
+                if r.subquery is not None:
+                    walk_sel(r.subquery)
+                else:
+                    out.add(r.database or self.current_db)
+            for j in st.joins:
+                walk_expr(j.on)
+            for it in st.items:
+                walk_expr(it.expr)
+            walk_expr(st.where)
+            walk_expr(st.having)
+            for _, sub in st.ctes:
+                walk_sel(sub)
+            if st.union is not None:
+                walk_sel(st.union[1])
+
+        walk_sel(s)
+        return out or {self.current_db}
+
+    def _access_check(self, s):
+        P = self.db.privileges
+        if isinstance(s, (CreateUserStmt, DropUserStmt, GrantStmt,
+                          RevokeStmt, HandleStmt)):
+            u = P.users.get(self.user)
+            if u is None or not u.is_super:
+                raise AccessError(f"{type(s).__name__} requires SUPER")
+            return
+        if isinstance(s, SelectStmt):
+            for db in self._stmt_dbs(s):
+                P.check(self.user, db, READ)
+            return
+        if isinstance(s, (InsertStmt, UpdateStmt, DeleteStmt, TruncateStmt,
+                          LoadDataStmt)):
+            P.check(self.user, s.table.database or self.current_db, WRITE)
+            # reads feeding the write are grants too (INSERT..SELECT,
+            # subqueries in WHERE/assignments)
+            if isinstance(s, InsertStmt) and s.select is not None:
+                for db in self._stmt_dbs(s.select):
+                    P.check(self.user, db, READ)
+            from ..expr.ast import Subquery
+
+            def sub_dbs(e):
+                if e is None:
+                    return
+                if isinstance(e, Subquery):
+                    for db in self._stmt_dbs(e.stmt):
+                        P.check(self.user, db, READ)
+                    return
+                for a in getattr(e, "args", ()):
+                    sub_dbs(a)
+
+            sub_dbs(getattr(s, "where", None))
+            for _, e in getattr(s, "assignments", []) or []:
+                sub_dbs(e)
+            return
+        if isinstance(s, (CreateTableStmt, DropTableStmt, AlterTableStmt)):
+            P.check(self.user, s.table.database or self.current_db, WRITE)
+            return
+        if isinstance(s, CreateDatabaseStmt):
+            P.check(self.user, s.name, WRITE)
+            return
+        if isinstance(s, DropDatabaseStmt):
+            P.check(self.user, s.name, WRITE)
+            return
+        if isinstance(s, UseStmt):
+            P.check(self.user, s.database, READ)
+            return
+        if isinstance(s, ExplainStmt):
+            for db in self._stmt_dbs(s.stmt):
+                P.check(self.user, db, READ)
+            return
+        if isinstance(s, DescribeStmt):
+            P.check(self.user, s.table.database or self.current_db, READ)
+            return
+        if isinstance(s, ShowStmt):
+            # SHOW against another db needs a grant THERE, not on current
+            db = s.database or (s.table.database if s.table is not None
+                                else None) or self.current_db
+            P.check(self.user, db, READ)
+
     # -- public API -------------------------------------------------------
     def execute(self, sql: str) -> Result:
         stmts = parse_sql(sql)
@@ -234,9 +406,13 @@ class Session:
             if billable:
                 self.db.qos.admit(sql, cost=float(billable))
         if len(stmts) == 1 and isinstance(stmts[0], SelectStmt):
+            self._access_check(stmts[0])
             return self._select(stmts[0], cache_key=(sql, self.current_db))
         res = Result()
         for s in stmts:
+            # check immediately before EACH statement: an earlier USE in the
+            # same batch changes what an unqualified name resolves to
+            self._access_check(s)
             res = self._execute_stmt(s)
         return res
 
@@ -296,25 +472,220 @@ class Session:
         if isinstance(s, TxnStmt):
             return self._txn_stmt(s)
         if isinstance(s, ShowStmt):
-            if s.what == "databases":
-                names = self.db.catalog.databases()
-                return Result(columns=["Database"], arrow=pa.table({"Database": names}))
-            db = s.database or self.current_db
-            names = self.db.catalog.tables(db)
-            return Result(columns=[f"Tables_in_{db}"],
-                          arrow=pa.table({f"Tables_in_{db}": names}))
+            return self._show(s)
+        if isinstance(s, CreateUserStmt):
+            self.db.privileges.create_user(s.name, s.password, s.if_not_exists)
+            return Result()
+        if isinstance(s, DropUserStmt):
+            self.db.privileges.drop_user(s.name, s.if_exists)
+            return Result()
+        if isinstance(s, GrantStmt):
+            self.db.privileges.grant(s.user, s.level, s.db)
+            return Result()
+        if isinstance(s, RevokeStmt):
+            self.db.privileges.revoke(s.user, s.db)
+            return Result()
+        if isinstance(s, LoadDataStmt):
+            return self._load_data(s)
+        if isinstance(s, HandleStmt):
+            return self._handle(s)
         if isinstance(s, DescribeStmt):
             db = s.table.database or self.current_db
             info = self.db.catalog.get_table(db, s.table.name)
             pk = info.primary_key()
             pkcols = set(pk.columns) if pk else set()
-            return Result(columns=["Field", "Type", "Null", "Key"], arrow=pa.table({
-                "Field": [f.name for f in info.schema.fields],
-                "Type": [f.ltype.value for f in info.schema.fields],
-                "Null": ["YES" if f.nullable else "NO" for f in info.schema.fields],
-                "Key": ["PRI" if f.name in pkcols else "" for f in info.schema.fields],
-            }))
+            vcols = (info.options or {}).get("vector_cols") or {}
+            names, types, nulls, keys = [], [], [], []
+            for f in info.schema.fields:
+                owner = _component_owner(f.name, vcols)
+                if owner is not None:
+                    if not names or names[-1] != owner:
+                        names.append(owner)
+                        types.append(f"vector({vcols[owner]})")
+                        nulls.append("YES")
+                        keys.append("")
+                    continue
+                names.append(f.name)
+                types.append(f.ltype.value)
+                nulls.append("YES" if f.nullable else "NO")
+                keys.append("PRI" if f.name in pkcols else "")
+            return Result(columns=["Field", "Type", "Null", "Key"],
+                          arrow=pa.table({"Field": names, "Type": types,
+                                          "Null": nulls, "Key": keys}))
         raise SqlError(f"unsupported statement {type(s).__name__}")
+
+    # -- SHOW / admin surface ---------------------------------------------
+    def _show(self, s: ShowStmt) -> Result:
+        """SHOW command family (reference: show_helper.cpp's registry)."""
+        import fnmatch
+
+        cat = self.db.catalog
+        if s.what == "databases":
+            names = cat.databases()
+            return Result(columns=["Database"],
+                          arrow=pa.table({"Database": names}))
+        if s.what == "tables":
+            db = s.database or self.current_db
+            names = cat.tables(db)
+            return Result(columns=[f"Tables_in_{db}"],
+                          arrow=pa.table({f"Tables_in_{db}": names}))
+        if s.what == "create_table":
+            db = s.table.database or self.current_db
+            info = cat.get_table(db, s.table.name)
+            lines = []
+            pk = info.primary_key()
+            auto_col = (info.options or {}).get("auto_increment")
+            for f in info.schema.fields:
+                bits = [f"  `{f.name}` {f.ltype.value.upper()}"]
+                if not f.nullable:
+                    bits.append("NOT NULL")
+                if f.name == auto_col:
+                    bits.append("AUTO_INCREMENT")
+                lines.append(" ".join(bits))
+            if pk:
+                lines.append("  PRIMARY KEY (" +
+                             ", ".join(f"`{c}`" for c in pk.columns) + ")")
+            for ix in info.indexes:
+                if ix.kind == "primary":
+                    continue
+                kw = {"unique": "UNIQUE KEY", "fulltext": "FULLTEXT KEY"} \
+                    .get(ix.kind, "KEY")
+                lines.append(f"  {kw} `{ix.name}` (" +
+                             ", ".join(f"`{c}`" for c in ix.columns) + ")")
+            ddl = f"CREATE TABLE `{s.table.name}` (\n" + ",\n".join(lines) + \
+                "\n)"
+            return Result(columns=["Table", "Create Table"], arrow=pa.table(
+                {"Table": [s.table.name], "Create Table": [ddl]}))
+        if s.what == "columns":
+            return self._execute_stmt(DescribeStmt(s.table))
+        if s.what == "index":
+            db = s.table.database or self.current_db
+            info = cat.get_table(db, s.table.name)
+            rows = []
+            for ix in info.indexes:
+                for seq, c in enumerate(ix.columns, 1):
+                    rows.append((s.table.name, ix.name, ix.kind, seq, c))
+            return Result(
+                columns=["Table", "Key_name", "Index_type", "Seq_in_index",
+                         "Column_name"],
+                arrow=pa.table({
+                    "Table": [r[0] for r in rows],
+                    "Key_name": [r[1] for r in rows],
+                    "Index_type": [r[2] for r in rows],
+                    "Seq_in_index": pa.array([r[3] for r in rows], pa.int64()),
+                    "Column_name": [r[4] for r in rows],
+                }))
+        if s.what in ("variables", "status"):
+            vals = {
+                "version": "8.0.0-baikaldb-tpu",
+                "version_comment": "baikaldb_tpu (JAX/XLA)",
+                "lower_case_table_names": "0",
+                "max_allowed_packet": str(1 << 24),
+                "character_set_server": "utf8mb4",
+                "autocommit": "ON",
+            } if s.what == "variables" else {
+                "Threads_connected": str(len(self.db.processlist)),
+                "Queries": str(len(self.db.query_log)),
+                "Uptime": "0",
+            }
+            items = sorted(vals.items())
+            if s.pattern:
+                items = [(k, v) for k, v in items
+                         if fnmatch.fnmatch(k, s.pattern.replace("%", "*"))]
+            return Result(columns=["Variable_name", "Value"], arrow=pa.table({
+                "Variable_name": [k for k, _ in items],
+                "Value": [v for _, v in items]}))
+        if s.what == "processlist":
+            # snapshot: connection threads insert/pop concurrently
+            rows = sorted(dict(self.db.processlist).items())
+            return Result(
+                columns=["Id", "User", "Host", "db", "Command", "Info"],
+                arrow=pa.table({
+                    "Id": pa.array([i for i, _ in rows], pa.int64()),
+                    "User": [r.get("user", "") for _, r in rows],
+                    "Host": [r.get("host", "") for _, r in rows],
+                    "db": [r.get("db", "") for _, r in rows],
+                    "Command": [r.get("command", "Sleep") for _, r in rows],
+                    "Info": [r.get("info", "") for _, r in rows],
+                }))
+        if s.what == "grants":
+            user = s.user or self.user
+            gs = self.db.privileges.grants_of(user)
+            lines = [f"GRANT {lv} ON {'*' if db == '*' else db}.* TO "
+                     f"'{user}'" for db, lv in gs]
+            return Result(columns=[f"Grants for {user}"],
+                          arrow=pa.table({f"Grants for {user}": lines}))
+        if s.what == "regions":
+            rows = []
+            for key, st in sorted(self.db.stores.items()):
+                if s.table is not None:
+                    db = s.table.database or self.current_db
+                    if key != f"{db}.{s.table.name}":
+                        continue
+                for r in st.regions:
+                    rows.append((key, r.region_id, r.num_rows, r.version))
+            return Result(
+                columns=["Table", "Region_id", "Rows", "Version"],
+                arrow=pa.table({
+                    "Table": [r[0] for r in rows],
+                    "Region_id": pa.array([r[1] for r in rows], pa.int64()),
+                    "Rows": pa.array([r[2] for r in rows], pa.int64()),
+                    "Version": pa.array([r[3] for r in rows], pa.int64()),
+                }))
+        raise SqlError(f"unsupported SHOW {s.what!r}")
+
+    def _load_data(self, s: LoadDataStmt) -> Result:
+        """LOAD DATA INFILE: CSV -> bulk columnar ingest (reference:
+        load_planner + the importer; here pyarrow's CSV reader feeds
+        insert_arrow directly)."""
+        from pyarrow import csv as pacsv
+
+        store = self._store(s.table)
+        names = store.info.schema.names()
+        ropt = pacsv.ReadOptions(column_names=names,
+                                 skip_rows=s.ignore_lines)
+        popt = pacsv.ParseOptions(delimiter=s.sep)
+        copt = pacsv.ConvertOptions(
+            column_types={f.name: schema_to_arrow(store.info.schema).field(
+                f.name).type for f in store.info.schema.fields},
+            null_values=["", "\\N", "NULL"], strings_can_be_null=True)
+        table = pacsv.read_csv(s.path, read_options=ropt,
+                               parse_options=popt, convert_options=copt)
+        store.insert_arrow(table, self._tctx(store), check_dups=True)
+        db_name = s.table.database or self.current_db
+        self._log_binlog("insert", db_name, s.table.name,
+                         statement=f"LOAD DATA INFILE {s.path!r}",
+                         affected=table.num_rows)
+        return Result(affected_rows=table.num_rows)
+
+    def _handle(self, s: HandleStmt) -> Result:
+        """Operator commands (reference: handle_helper.cpp's map; the subset
+        that has a real in-process counterpart)."""
+        if s.command == "checkpoint":
+            self.db.checkpoint()
+            return Result()
+        if s.command in ("ttl", "ttl_tick"):
+            return Result(affected_rows=self.ttl_tick())
+        if s.command == "gc":
+            for st in self.db.stores.values():
+                if st.row_table is not None:
+                    st.row_table.gc(st.row_table.snapshot())
+            return Result()
+        if s.command == "split" and len(s.args) >= 2:
+            # handle split <db.table> <region_rows>: force a smaller split
+            # threshold and re-split oversized regions.  `db.t` lexes as
+            # three tokens, so rejoin everything before the row count.
+            key, rows = "".join(s.args[:-1]), int(s.args[-1])
+            st = self.db.stores.get(key)
+            if st is None:
+                raise PlanError(f"unknown table {key!r}")
+            st.region_rows = rows
+            with st._lock:
+                for r in list(st.regions):
+                    st._maybe_split(r)
+                st._mutations += 1
+            return Result()
+        raise SqlError(f"unsupported HANDLE command {s.command!r}")
 
     def _drop_durable(self, key: str, store):
         """Remove a dropped table's WAL + Parquet from data_dir."""
@@ -427,6 +798,9 @@ class Session:
         from ..sql.stmt import TableRef
 
         store = self._store(TableRef(database, table_name))
+        vcols = (store.info.options or {}).get("vector_cols") or {}
+        if vcols:
+            table = _expand_vector_arrow(table, vcols)
         store.insert_arrow(table, self._tctx(store))
         return table.num_rows
 
@@ -434,10 +808,38 @@ class Session:
     def _create_table(self, s: CreateTableStmt) -> Result:
         db = s.table.database or self.current_db
         fields = []
+        vector_cols: dict[str, int] = {}
         for c in s.columns:
+            tl = c.type_name.strip().lower()
+            if tl.startswith("vector"):
+                # VECTOR(d): stored as d hidden FLOAT32 component columns, so
+                # distance expressions fuse into the one-jit query program
+                # (the faiss sidecar re-designed as columns; reference:
+                # vector_index.cpp stores blobs + a faiss index)
+                try:
+                    dim = int(tl.split("(")[1].rstrip(") "))
+                except (IndexError, ValueError):
+                    raise PlanError("VECTOR needs a dimension: VECTOR(d)")
+                if not 1 <= dim <= 4096:
+                    raise PlanError("VECTOR dimension out of range")
+                vector_cols[c.name] = dim
+                for i in range(dim):
+                    fields.append(Field(f"__{c.name}_{i}", LType.FLOAT32,
+                                        True))
+                continue
             lt = parse_type(c.type_name)
             nullable = c.nullable and c.name not in s.primary_key
             fields.append(Field(c.name, lt, nullable))
+        options = dict(s.options)
+        if vector_cols:
+            options["vector_cols"] = vector_cols
+        auto_cols = [c for c in s.columns if c.auto_increment]
+        if auto_cols:
+            if len(auto_cols) > 1:
+                raise PlanError("only one AUTO_INCREMENT column allowed")
+            if not parse_type(auto_cols[0].type_name).is_integer:
+                raise PlanError("AUTO_INCREMENT requires an integer column")
+            options["auto_increment"] = auto_cols[0].name
         schema = Schema(tuple(fields))
         indexes = []
         if s.primary_key:
@@ -445,7 +847,7 @@ class Session:
         for kind, name, cols in s.indexes:
             indexes.append(IndexInfo(name or f"idx_{'_'.join(cols)}", kind, cols))
         info = self.db.catalog.create_table(db, s.table.name, schema, indexes,
-                                            options=dict(s.options),
+                                            options=options,
                                             if_not_exists=s.if_not_exists)
         key = f"{db}.{s.table.name}"
         if key not in self.db.stores:
@@ -546,10 +948,25 @@ class Session:
                 self._log_binlog("insert", db_name, s.table.name,
                                  rows=t.to_pylist(), affected=t.num_rows)
             return Result(affected_rows=t.num_rows)
-        cols = s.columns or schema.names()
+        vcols = (store.info.options or {}).get("vector_cols") or {}
+        # positional VALUES address user-visible columns (vector columns by
+        # their own names, components hidden)
+        cols = s.columns or self._user_columns(store)
         if any(len(r) != len(cols) for r in s.rows):
             raise SqlError("VALUES row length does not match column list")
         rows = [dict(zip(cols, r)) for r in s.rows]
+        if vcols:
+            rows = [_expand_vector_row(r, vcols) for r in rows]
+        auto_col = (store.info.options or {}).get("auto_increment")
+        if auto_col:
+            missing = [i for i, r in enumerate(rows)
+                       if r.get(auto_col) is None]
+            if missing:
+                ids = store.next_auto_incr(auto_col, len(missing))
+                for i, v in zip(missing, ids):
+                    rows[i][auto_col] = v
+            # explicit ids advance the counter inside the store (all ingest
+            # paths — VALUES, INSERT..SELECT, LOAD DATA — share that hook)
         db_name = s.table.database or self.current_db
         for r in rows:
             for f in schema.fields:
@@ -567,6 +984,19 @@ class Session:
         self._log_binlog("insert", db_name, s.table.name, rows=rows,
                          affected=len(rows))
         return Result(affected_rows=len(rows))
+
+    def _user_columns(self, store: TableStore) -> list[str]:
+        """Declared column order with vector components collapsed back to
+        their user-visible vector column name."""
+        vcols = (store.info.options or {}).get("vector_cols") or {}
+        out: list[str] = []
+        for n in store.info.schema.names():
+            owner = _component_owner(n, vcols)
+            if owner is None:
+                out.append(n)
+            elif not out or out[-1] != owner:
+                out.append(owner)
+        return out
 
     def _host_mask(self, store: TableStore, where):
         """Build host mask fn: predicate evaluated by the SAME device compiler

@@ -48,6 +48,10 @@ class Scope:
         self.tables: dict[str, Schema] = {}   # label -> schema (plain col names)
         self.order: list[str] = []
         self.extras: dict[str, LType] = {}    # injected columns (subqueries)
+        # vector columns: "label.name" -> (dim, ["label.__name_0", ...]);
+        # distance functions expand over the components (plan/planner.py
+        # _Resolver) so ANN fuses into the query program
+        self.vector_cols: dict[str, tuple[int, list[str]]] = {}
 
     def add(self, label: str, schema: Schema):
         if label in self.tables:
@@ -230,6 +234,8 @@ class Planner:
                     if lbl not in scope.tables:
                         raise PlanError(f"unknown table {lbl!r} in {lbl}.*")
                     for f in scope.tables[lbl].fields:
+                        if f.name.startswith("__"):
+                            continue   # hidden columns (vector components)
                         # multi-table *: qualify clashing display names
                         items.append((f.name if len(labels) == 1 else f"{lbl}.{f.name}",
                                       ColRef(f"{lbl}.{f.name}")))
@@ -392,6 +398,10 @@ class Planner:
         info = self.catalog.get_table(db, ref.name)
         label = ref.label
         scope.add(label, info.schema)
+        for vname, dim in ((info.options or {}).get("vector_cols")
+                           or {}).items():
+            scope.vector_cols[f"{label}.{vname}"] = (
+                int(dim), [f"{label}.__{vname}_{i}" for i in range(int(dim))])
         sch = Schema(tuple(Field(f"{label}.{f.name}", f.ltype, f.nullable)
                            for f in info.schema.fields))
         return ScanNode(table_key=f"{db}.{ref.name}", label=label,
@@ -1303,8 +1313,59 @@ class _Resolver:
                               tuple((self(x), asc) for x, asc in e.order_by),
                               e.running)
         if isinstance(e, Call):
+            if e.op in ("l2_distance", "cosine_distance", "inner_product"):
+                return self._vector_distance(e)
             return Call(e.op, tuple(self(a) for a in e.args))
         return e
+
+    def _vector_distance(self, e: Call) -> Expr:
+        """Expand a distance call over the vector's component columns: the
+        ANN score becomes a plain arithmetic expression that fuses into the
+        jitted program — `ORDER BY L2_DISTANCE(col, '[...]') LIMIT k` rides
+        the existing top-k, WHERE filters, joins, the mesh (reference routes
+        ANN through a faiss sidecar, vector_index.cpp:2341)."""
+        if len(e.args) != 2 or not isinstance(e.args[0], ColRef) or \
+                not isinstance(e.args[1], Lit):
+            raise PlanError(f"{e.op.upper()}(vector_column, '[...]') "
+                            "expected")
+        ref, lit = e.args
+        key = None
+        if ref.table is not None:
+            key = f"{ref.table}.{ref.name}"
+            if key not in self.scope.vector_cols:
+                raise PlanError(f"{key} is not a VECTOR column")
+        else:
+            hits = [k for k in self.scope.vector_cols
+                    if k.endswith(f".{ref.name}")]
+            if not hits:
+                raise PlanError(f"{ref.name!r} is not a VECTOR column")
+            if len(hits) > 1:
+                raise PlanError(f"ambiguous vector column {ref.name!r}")
+            key = hits[0]
+        dim, comps = self.scope.vector_cols[key]
+        from ..exec.session import _parse_vector
+        q = _parse_vector(lit.value, dim)
+
+        def add_all(terms):
+            out = terms[0]
+            for t in terms[1:]:
+                out = Call("add", (out, t))
+            return out
+
+        if e.op == "l2_distance":
+            return Call("sqrt", (add_all([
+                Call("mul", (d := Call("sub", (ColRef(c), Lit(float(qi)))), d))
+                for c, qi in zip(comps, q)]),))
+        dot = add_all([Call("mul", (ColRef(c), Lit(float(qi))))
+                       for c, qi in zip(comps, q)])
+        if e.op == "inner_product":
+            return dot
+        # cosine_distance = 1 - dot/(|a| * |q|)
+        norm_a = Call("sqrt", (add_all([
+            Call("mul", (ColRef(c), ColRef(c))) for c in comps]),))
+        qn = float(sum(x * x for x in q) ** 0.5) or 1.0
+        return Call("sub", (Lit(1.0), Call("div", (dot, Call("mul", (
+            norm_a, Lit(qn)))))))
 
 
 def _colrefs(e: Expr) -> set[str]:

@@ -15,10 +15,13 @@ from typing import Optional
 
 from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, Subquery, WindowCall
 from .lexer import SqlError, Token, tokenize
-from .stmt import (AlterTableStmt, ColumnDef, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
-                   DescribeStmt, DropDatabaseStmt, DropTableStmt, ExplainStmt,
-                   InsertStmt, JoinClause, OrderItem, SelectItem, SelectStmt,
-                   ShowStmt, TableRef, TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
+from .stmt import (AlterTableStmt, ColumnDef, CreateDatabaseStmt,
+                   CreateTableStmt, CreateUserStmt, DeleteStmt, DescribeStmt,
+                   DropDatabaseStmt, DropTableStmt, DropUserStmt, ExplainStmt,
+                   GrantStmt, HandleStmt, InsertStmt, JoinClause,
+                   LoadDataStmt, OrderItem, RevokeStmt, SelectItem,
+                   SelectStmt, ShowStmt, TableRef, TruncateStmt, TxnStmt,
+                   UpdateStmt, UseStmt)
 
 _AGG_FUNCS = {"count", "sum", "avg", "min", "max", "stddev", "std",
               "stddev_samp", "variance", "var_samp", "group_concat",
@@ -109,6 +112,23 @@ class Parser:
     # -- statements ------------------------------------------------------
     def statement(self):
         t = self.peek()
+        # statement words that must NOT be reserved identifiers (a column
+        # named `load` or `handle` keeps working): dispatch on IDENT here
+        if t.kind == "IDENT":
+            w = t.value.lower()
+            if w == "grant":
+                return self.grant_stmt()
+            if w == "revoke":
+                return self.revoke_stmt()
+            if w == "load":
+                return self.load_data_stmt()
+            if w == "handle":
+                self.advance()
+                cmd = self.advance().value
+                args = []
+                while not self.at_end() and self.peek().value != ";":
+                    args.append(self.advance().value)
+                return HandleStmt(cmd.lower(), args)
         if t.kind != "KW":
             raise SqlError(f"expected statement, got {t.value!r} at {t.pos}")
         if t.value in ("select", "with"):
@@ -143,6 +163,7 @@ class Parser:
             return TxnStmt("rollback")
         if t.value == "show":
             return self.show_stmt()
+
         if t.value in ("describe", "desc"):
             self.advance()
             return DescribeStmt(self.table_name())
@@ -392,6 +413,20 @@ class Parser:
         if self.try_kw("database"):
             ine = self._if_not_exists()
             return CreateDatabaseStmt(self.ident(), ine)
+        if self.peek().kind == "IDENT" and self.peek().value.lower() == "user":
+            self.advance()
+            ine = self._if_not_exists()
+            name = self._user_name()
+            password = ""
+            if self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "identified":
+                self.advance()
+                self.expect_kw("by")
+                t = self.advance()
+                if t.kind != "STR":
+                    raise SqlError("IDENTIFIED BY needs a string literal")
+                password = t.value
+            return CreateUserStmt(name, password, ine)
         self.expect_kw("table")
         ine = self._if_not_exists()
         table = self.table_name()
@@ -419,6 +454,7 @@ class Parser:
                 tname = self._type_name()
                 nullable = True
                 primary = False
+                auto_inc = False
                 while True:
                     if self.try_kw("not"):
                         self.expect_kw("null")
@@ -429,15 +465,19 @@ class Parser:
                         self.expect_kw("key")
                         primary = True
                     elif self.peek().kind == "IDENT" and \
-                            self.peek().value.lower() in ("default", "comment",
-                                                          "auto_increment"):
+                            self.peek().value.lower() == "auto_increment":
+                        self.advance()
+                        auto_inc = True
+                    elif self.peek().kind == "IDENT" and \
+                            self.peek().value.lower() in ("default", "comment"):
                         self.advance()
                         if self.peek().kind in ("NUM", "STR") or \
                                 (self.peek().kind == "KW" and self.peek().value == "null"):
                             self.advance()
                     else:
                         break
-                cols.append(ColumnDef(cname, tname, nullable, primary))
+                cols.append(ColumnDef(cname, tname, nullable, primary,
+                                      auto_inc))
                 if primary:
                     pk = [cname]
             if not self.try_op(","):
@@ -456,6 +496,7 @@ class Parser:
 
     def _type_name(self) -> str:
         base = self.ident()
+        args = []
         if self.try_op("("):
             depth = 1
             while depth:
@@ -464,9 +505,14 @@ class Parser:
                     depth += 1
                 elif v == ")":
                     depth -= 1
+                else:
+                    args.append(str(v))
         if self.peek().kind == "IDENT" and self.peek().value.lower() == "unsigned":
             self.advance()
             return base + " unsigned"
+        if base.lower() == "vector" and args:
+            # the dimension is semantic, not display width: keep it
+            return f"vector({args[0]})"
         return base
 
     def _paren_name_list(self) -> list[str]:
@@ -519,9 +565,99 @@ class Parser:
         if self.try_kw("database"):
             ie = self._if_exists()
             return DropDatabaseStmt(self.ident(), ie)
+        if self.peek().kind == "IDENT" and self.peek().value.lower() == "user":
+            self.advance()
+            ie = self._if_exists()
+            return DropUserStmt(self._user_name(), ie)
         self.expect_kw("table")
         ie = self._if_exists()
         return DropTableStmt(self.table_name(), ie)
+
+    def _user_name(self) -> str:
+        t = self.advance()
+        if t.kind not in ("STR", "IDENT"):
+            raise SqlError(f"expected user name, got {t.value!r}")
+        name = t.value
+        if self.try_op("@"):               # 'user'@'host': host ignored
+            self.advance()
+        return name
+
+    def grant_stmt(self) -> GrantStmt:
+        """GRANT ALL | SELECT ON db.* | *.* TO 'user' (reference:
+        privilege_manager grants; table-level grants collapse to db)."""
+        self.advance()                      # GRANT
+        level = self.advance().value.lower()
+        if level == "all" and self.peek().value.lower() == "privileges":
+            self.advance()
+        self.expect_kw("on")
+        db = self._grant_target()
+        to = self.advance()
+        if to.value.lower() != "to":
+            raise SqlError(f"expected TO, got {to.value!r}")
+        return GrantStmt(level, db, self._user_name())
+
+    def revoke_stmt(self) -> RevokeStmt:
+        self.advance()                      # REVOKE
+        # level list (ALL [PRIVILEGES], SELECT, INSERT, ...) — ignored on
+        # revoke: it clears the db grant entirely
+        while not self.at_end() and self.peek().value.lower() != "on":
+            self.advance()
+        self.expect_kw("on")
+        db = self._grant_target()
+        frm = self.advance()
+        if frm.value.lower() != "from":
+            raise SqlError(f"expected FROM, got {frm.value!r}")
+        return RevokeStmt(db, self._user_name())
+
+    def _grant_target(self) -> str:
+        if self.try_op("*"):
+            if self.try_op("."):
+                self.expect_op("*")
+            return "*"
+        db = self.ident()
+        if self.try_op("."):
+            if not self.try_op("*"):
+                self.ident()               # table-level -> db-level
+        return db
+
+    def load_data_stmt(self) -> LoadDataStmt:
+        """LOAD DATA [LOCAL] INFILE 'path' INTO TABLE t
+        [FIELDS TERMINATED BY 'c'] [IGNORE n LINES]"""
+        self.advance()                      # LOAD
+        if self.peek().value.lower() != "data":
+            raise SqlError("expected DATA after LOAD")
+        self.advance()
+        if self.peek().value.lower() == "local":
+            self.advance()
+        if self.peek().value.lower() != "infile":
+            raise SqlError("expected INFILE")
+        self.advance()
+        t = self.advance()
+        if t.kind != "STR":
+            raise SqlError("INFILE needs a string path")
+        path = t.value
+        self.expect_kw("into")
+        self.expect_kw("table")
+        table = self.table_name()
+        sep = ","
+        ignore = 0
+        while not self.at_end() and self.peek().value != ";":
+            v = self.peek().value.lower()
+            if v == "fields":
+                self.advance()
+                if self.peek().value.lower() == "terminated":
+                    self.advance()
+                    self.expect_kw("by")
+                    st = self.advance()
+                    sep = st.value
+            elif v == "ignore":
+                self.advance()
+                ignore = self._int_lit()
+                if self.peek().value.lower() == "lines":
+                    self.advance()
+            else:
+                break
+        return LoadDataStmt(path, table, sep, ignore)
 
     def _if_exists(self) -> bool:
         if self.try_kw("if"):
@@ -531,6 +667,8 @@ class Parser:
         return False
 
     def show_stmt(self) -> ShowStmt:
+        """SHOW surface (reference: show_helper.cpp's 5.5k-LoC command map —
+        the high-traffic subset)."""
         self.expect_kw("show")
         if self.try_kw("tables"):
             db = None
@@ -539,6 +677,45 @@ class Parser:
             return ShowStmt("tables", db)
         if self.try_kw("databases"):
             return ShowStmt("databases")
+        if self.try_kw("create"):
+            self.expect_kw("table")
+            return ShowStmt("create_table", table=self.table_name())
+        if self.try_kw("index") or (self.peek().value.lower() in
+                                    ("indexes", "keys") and self.advance()):
+            self.expect_kw("from")
+            return ShowStmt("index", table=self.table_name())
+        word = self.peek().value.lower()
+        if word == "columns":
+            self.advance()
+            self.expect_kw("from")
+            return ShowStmt("columns", table=self.table_name())
+        if word in ("variables", "status"):
+            self.advance()
+            pat = None
+            if self.try_kw("like"):
+                pat = self.advance().value
+            return ShowStmt(word, pattern=pat)
+        if word == "full" and self.peek(1).value.lower() == "processlist":
+            self.advance()
+            self.advance()
+            return ShowStmt("processlist")
+        if word == "processlist":
+            self.advance()
+            return ShowStmt("processlist")
+        if word == "grants":
+            self.advance()
+            user = None
+            if self.try_kw("for") or self.peek().value.lower() == "for":
+                if self.peek().value.lower() == "for":
+                    self.advance()
+                user = self._user_name()
+            return ShowStmt("grants", user=user)
+        if word == "regions":
+            self.advance()
+            tbl = None
+            if self.try_kw("from"):
+                tbl = self.table_name()
+            return ShowStmt("regions", table=tbl)
         t = self.peek()
         raise SqlError(f"unsupported SHOW {t.value!r} at {t.pos}")
 

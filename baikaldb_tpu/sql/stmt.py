@@ -86,6 +86,7 @@ class ColumnDef:
     type_name: str
     nullable: bool = True
     primary: bool = False
+    auto_increment: bool = False
 
 
 @dataclass
@@ -136,8 +137,12 @@ class UseStmt:
 
 @dataclass
 class ShowStmt:
-    what: str                     # tables | databases
+    what: str   # tables | databases | create_table | columns | index |
+    #             variables | status | processlist | grants | regions
     database: Optional[str] = None
+    table: Optional[TableRef] = None
+    pattern: Optional[str] = None
+    user: Optional[str] = None
 
 
 @dataclass
@@ -154,3 +159,44 @@ class ExplainStmt:
 @dataclass
 class TxnStmt:
     kind: str      # begin | commit | rollback
+
+
+@dataclass
+class CreateUserStmt:
+    name: str
+    password: str = ""
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropUserStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class GrantStmt:
+    level: str                          # all | select
+    db: str                             # database name or "*"
+    user: str
+
+
+@dataclass
+class RevokeStmt:
+    db: str
+    user: str
+
+
+@dataclass
+class LoadDataStmt:
+    path: str
+    table: TableRef
+    sep: str = ","
+    ignore_lines: int = 0
+
+
+@dataclass
+class HandleStmt:
+    """Operator admin command (reference: handle_helper.cpp command map)."""
+    command: str
+    args: list = field(default_factory=list)

@@ -154,6 +154,10 @@ class TableStore:
         self.wal_path = None
         self.durable_dir: Optional[str] = None   # Parquet checkpoint home
         self._writer: Optional[TxnContext] = None
+        # AUTO_INCREMENT high-water mark, lazily seeded from max(col)+1 (the
+        # reference allocates ranges from meta's auto_incr_state_machine;
+        # single-process: the store IS the allocator)
+        self._auto_incr: Optional[int] = None
         self._build_row_tier(None)
         # primary-key uniqueness index (lazy; bulk loads mark it stale)
         pk = info.primary_key() if hasattr(info, "primary_key") else None
@@ -347,6 +351,24 @@ class TableStore:
             cache[1][column] = st
             return st
 
+    def next_auto_incr(self, col: str, n: int) -> list[int]:
+        """Allocate n consecutive AUTO_INCREMENT ids (monotonic; rollback
+        never reuses a burned range, like MySQL/the reference)."""
+        import pyarrow.compute as pc
+
+        with self._lock:
+            if self._auto_incr is None:
+                mx = 0
+                for r in self.regions:
+                    if r.num_rows:
+                        m = pc.max(r.data.column(col)).as_py()
+                        if m is not None:
+                            mx = max(mx, int(m))
+                self._auto_incr = mx
+            start = self._auto_incr + 1
+            self._auto_incr += n
+            return list(range(start, start + n))
+
     # -- primary-key index -----------------------------------------------
     def _ensure_pk_index(self):
         if self._pk_codec is None:
@@ -409,6 +431,19 @@ class TableStore:
     # -- writes ---------------------------------------------------------
     def _append_table(self, table: pa.Table, rowids: np.ndarray,
                       split: bool = True):
+        # every ingest path advances the AUTO_INCREMENT watermark past
+        # explicitly-supplied ids (MySQL semantics; later auto ids must not
+        # collide with bulk-loaded ones)
+        auto_col = (self.info.options or {}).get("auto_increment")
+        if auto_col and auto_col in table.column_names and table.num_rows:
+            import pyarrow.compute as pc
+
+            mx = pc.max(table.column(auto_col)).as_py()
+            if mx is not None:
+                if self._auto_incr is None:
+                    self._auto_incr = int(mx)
+                else:
+                    self._auto_incr = max(self._auto_incr, int(mx))
         last = self.regions[-1]
         last.data = pa.concat_tables([last.data, table]).combine_chunks()
         last.rowids = np.concatenate([last.rowids, rowids])

@@ -1,0 +1,84 @@
+"""SQL-reachable vector ANN (VERDICT r1 #6): VECTOR(d) columns store as
+hidden float32 components, distance functions expand to fused arithmetic,
+and `ORDER BY L2_DISTANCE(...) LIMIT k` rides the standard top-k — composing
+with WHERE, joins, and the mesh (reference: faiss sidecar behind
+IndexSelector, vector_index.cpp:2341)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from baikaldb_tpu.exec.session import Session
+from baikaldb_tpu.plan.planner import PlanError
+
+
+@pytest.fixture(scope="module")
+def s():
+    sess = Session()
+    sess.execute("CREATE TABLE docs (id BIGINT, tag VARCHAR, emb VECTOR(4))")
+    sess.execute("INSERT INTO docs VALUES (1, 'a', '[1,0,0,0]'), "
+                 "(2, 'b', '[0,1,0,0]'), (3, 'a', '[0.9,0.1,0,0]'), "
+                 "(4, 'b', '[0,0,1,0]'), (5, 'a', NULL)")
+    return sess
+
+
+def test_l2_topk(s):
+    # MySQL ORDER BY: NULL distances (NULL vectors) sort first ASC
+    r = s.query("SELECT id, L2_DISTANCE(emb, '[1,0,0,0]') d FROM docs "
+                "ORDER BY d LIMIT 3")
+    assert [x["id"] for x in r] == [5, 1, 3]
+    assert r[0]["d"] is None and r[1]["d"] == pytest.approx(0.0)
+    # the ANN idiom filters NULLs via the distance expression
+    r = s.query("SELECT id, L2_DISTANCE(emb, '[1,0,0,0]') d FROM docs "
+                "WHERE L2_DISTANCE(emb, '[1,0,0,0]') IS NOT NULL "
+                "ORDER BY d LIMIT 2")
+    assert [x["id"] for x in r] == [1, 3]
+
+
+def test_ann_composes_with_where(s):
+    r = s.query("SELECT id FROM docs WHERE tag = 'b' "
+                "ORDER BY L2_DISTANCE(emb, '[1,0,0,0]') LIMIT 1")
+    assert r == [{"id": 2}]
+
+
+def test_cosine_and_inner_product(s):
+    r = s.query("SELECT id, COSINE_DISTANCE(emb, '[1,0,0,0]') c FROM docs "
+                "WHERE COSINE_DISTANCE(emb, '[1,0,0,0]') IS NOT NULL "
+                "ORDER BY c LIMIT 1")
+    assert r[0]["id"] == 1 and abs(r[0]["c"]) < 1e-6
+    r = s.query("SELECT id FROM docs "
+                "ORDER BY INNER_PRODUCT(emb, '[1,0,0,0]') DESC LIMIT 1")
+    assert r == [{"id": 1}]
+
+
+def test_star_hides_components_describe_shows_vector(s):
+    assert set(s.query("SELECT * FROM docs WHERE id = 1")[0]) == {"id", "tag"}
+    assert any(row["Type"] == "vector(4)" for row in s.query("DESCRIBE docs"))
+
+
+def test_errors(s):
+    with pytest.raises(PlanError):
+        s.query("SELECT L2_DISTANCE(tag, '[1,0]') FROM docs")
+    with pytest.raises(PlanError):
+        s.execute("INSERT INTO docs VALUES (9, 'x', '[1,2]')")   # wrong dim
+
+
+def test_golden_topk_and_mesh(s):
+    from baikaldb_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(3)
+    n, d = 500, 16
+    mat = rng.normal(size=(n, d)).astype(np.float32)
+    s.execute("CREATE TABLE big (id BIGINT, emb VECTOR(16))")
+    s.load_arrow("big", pa.table({"id": np.arange(n),
+                                  "emb": list(mat.tolist())}))
+    q = rng.normal(size=d).astype(np.float32)
+    qs = "[" + ",".join(str(float(x)) for x in q) + "]"
+    want = [int(i) for i in np.argsort(((mat - q) ** 2).sum(axis=1))[:10]]
+    r = s.query(f"SELECT id FROM big ORDER BY L2_DISTANCE(emb, '{qs}') "
+                "LIMIT 10")
+    assert [x["id"] for x in r] == want
+    dist = Session(db=s.db, mesh=make_mesh(8))
+    r2 = dist.query(f"SELECT id FROM big ORDER BY L2_DISTANCE(emb, '{qs}') "
+                    "LIMIT 10")
+    assert [x["id"] for x in r2] == want
