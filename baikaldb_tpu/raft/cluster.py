@@ -26,6 +26,23 @@ from .core import CONFIG, DATA, LEADER, SNAPSHOT_KIND, Committed, RaftCore
 
 # -- write-batch / snapshot codecs ------------------------------------------
 
+# replicated command kinds (the store-side op_type dispatch, region.cpp:1680)
+CMD_WRITE = 0        # apply ops immediately
+CMD_PREPARE = 1      # buffer ops under txn_id (2PC phase 1)
+CMD_COMMIT = 2       # apply buffered txn_id (2PC phase 2)
+CMD_ROLLBACK = 3     # drop buffered txn_id
+CMD_DECIDE = 4       # primary-region commit decision record
+
+
+def encode_cmd(cmd: int, txn_id: int, ops_bytes: bytes = b"") -> bytes:
+    return struct.pack("<BQ", cmd, txn_id) + ops_bytes
+
+
+def decode_cmd(data: bytes) -> tuple[int, int, bytes]:
+    cmd, txn_id = struct.unpack_from("<BQ", data, 0)
+    return cmd, txn_id, data[9:]
+
+
 def encode_ops(ops: list[tuple[int, bytes, bytes]]) -> bytes:
     parts = [struct.pack("<I", len(ops))]
     for op, k, v in ops:
@@ -53,6 +70,18 @@ def decode_ops(data: bytes) -> list[tuple[int, bytes, bytes]]:
     return out
 
 
+def _ops_size(data: bytes) -> int:
+    """Byte length of the leading encode_ops section."""
+    (n,) = struct.unpack_from("<I", data, 0)
+    pos = 4
+    for _ in range(n):
+        _, klen = struct.unpack_from("<BI", data, pos)
+        pos += 5 + klen
+        (vlen,) = struct.unpack_from("<I", data, pos)
+        pos += 4 + vlen
+    return pos
+
+
 class ReplicatedRegion:
     """One peer's replica of one region: Raft core + MVCC row table."""
 
@@ -66,13 +95,31 @@ class ReplicatedRegion:
         self.key_columns = key_columns or [self.schema.fields[0].name]
         self.table = RowTable(self.schema, self.key_columns)
         self.applied_index = 0
+        # 2PC replicated state: prepared-but-undecided txns and the primary
+        # region's decision log (reference: prepared-txn recovery from
+        # METAINFO_CF, transaction_pool.cpp)
+        self.prepared: dict[int, bytes] = {}
+        self.decisions: dict[int, int] = {}   # txn -> CMD_COMMIT|CMD_ROLLBACK
 
     def apply_committed(self) -> list[Committed]:
-        """Drain the core's committed entries into the row table."""
+        """Drain the core's committed entries into the row table (the
+        braft on_apply analog, with the store's op_type dispatch)."""
         commits = self.core.drain_commits()
         for c in commits:
             if c.kind == DATA:
-                self.table.write_batch(decode_ops(c.data))
+                cmd, txn_id, body = decode_cmd(c.data)
+                if cmd == CMD_WRITE:
+                    self.table.write_batch(decode_ops(body))
+                elif cmd == CMD_PREPARE:
+                    self.prepared[txn_id] = body
+                elif cmd == CMD_COMMIT:
+                    ops = self.prepared.pop(txn_id, None)
+                    if ops is not None:
+                        self.table.write_batch(decode_ops(ops))
+                elif cmd == CMD_ROLLBACK:
+                    self.prepared.pop(txn_id, None)
+                elif cmd == CMD_DECIDE:
+                    self.decisions[txn_id] = body[0]
                 self.applied_index = c.index
             elif c.kind == SNAPSHOT_KIND:
                 self._install_snapshot(c.data)
@@ -83,12 +130,40 @@ class ReplicatedRegion:
 
     # -- snapshots --------------------------------------------------------
     def snapshot_bytes(self) -> bytes:
+        """Full replica state: rows + prepared txns + decisions (install
+        must not lose 2PC state, or an in-doubt txn could resolve wrong)."""
         pairs = self.table.scan_raw()
-        return encode_ops([(0, k, v) for k, v in pairs])
+        out = [encode_ops([(0, k, v) for k, v in pairs])]
+        out.append(struct.pack("<I", len(self.prepared)))
+        for txn, ops in sorted(self.prepared.items()):
+            out.append(struct.pack("<QI", txn, len(ops)) + ops)
+        out.append(struct.pack("<I", len(self.decisions)))
+        for txn, d in sorted(self.decisions.items()):
+            out.append(struct.pack("<QB", txn, d))
+        return b"".join(out)
 
     def _install_snapshot(self, data: bytes):
         self.table = RowTable(self.schema, self.key_columns)
-        self.table.write_batch(decode_ops(data))
+        ops = decode_ops(data)
+        self.table.write_batch(ops)
+        pos = _ops_size(data)
+        self.prepared = {}
+        self.decisions = {}
+        if pos >= len(data):
+            return                      # pre-2PC snapshot format
+        (np_,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        for _ in range(np_):
+            txn, ln = struct.unpack_from("<QI", data, pos)
+            pos += 12
+            self.prepared[txn] = data[pos:pos + ln]
+            pos += ln
+        (nd,) = struct.unpack_from("<I", data, pos)
+        pos += 4
+        for _ in range(nd):
+            txn, d = struct.unpack_from("<QB", data, pos)
+            pos += 9
+            self.decisions[txn] = d
 
     def compact(self):
         """Snapshot own state into the core, truncating the log (the
@@ -226,20 +301,28 @@ class RaftGroup:
         """Propose a write batch; returns True once COMMITTED on the leader
         (the ack the reference gives after braft on_apply).  Retries through
         elections like FetcherStore's leader-redirect loop."""
-        payload = encode_ops(ops)
+        return self.propose_cmd(CMD_WRITE, 0, encode_ops(ops), max_ticks)
+
+    def propose_cmd(self, cmd: int, txn_id: int, ops_bytes: bytes = b"",
+                    max_ticks: int = 400) -> bool:
+        """Propose a replicated command and wait for leader commit.  False
+        when no quorum exists (the region is unavailable)."""
+        payload = encode_cmd(cmd, txn_id, ops_bytes)
         for _ in range(max_ticks):
-            ldr = self.leader()
+            try:
+                ldr = self.leader()
+            except RuntimeError:
+                return False               # no electable quorum
             idx = self.bus.nodes[ldr].core.propose(payload)
             if idx < 0:
                 self.bus.advance(1)
                 continue
             for _ in range(max_ticks):
                 self.bus.pump()
-                if self.bus.nodes[ldr].core.commit_index >= idx and \
-                        self.bus.nodes[ldr].node_id not in self.bus.down:
+                if self.bus.nodes[ldr].core.commit_index >= idx:
                     return True
                 if self.bus.nodes[ldr].core.role != LEADER:
-                    break               # deposed mid-write: retry via new leader
+                    break
                 self.bus.advance(1)
             else:
                 return False

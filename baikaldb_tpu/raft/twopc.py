@@ -1,0 +1,105 @@
+"""Two-phase commit across raft region groups.
+
+The reference commits a multi-region DML by PREPARE fan-out, then COMMIT on
+the PRIMARY region first, then the secondaries; a secondary crashing with a
+prepared txn recovers by asking the primary whether the decision landed
+(src/exec/fetcher_store.cpp:1848-1904 primary-first commit,
+src/store/region.cpp:684 exec_txn_query_primary_region, transaction_pool.cpp
+prepared-txn recovery).
+
+Here each participant is a RaftGroup (raft-replicated itself, so "a region
+crashed" means its quorum is gone or its coordinator died): PREPARE/COMMIT/
+ROLLBACK are replicated commands in each group's log, and the commit
+DECISION is a replicated record on the primary group — the single source of
+truth for in-doubt resolution."""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from .cluster import (CMD_COMMIT, CMD_DECIDE, CMD_PREPARE, CMD_ROLLBACK,
+                      RaftGroup, encode_ops)
+
+_txn_ids = itertools.count(1)
+_txn_lock = threading.Lock()
+
+
+def next_txn_id() -> int:
+    with _txn_lock:
+        return next(_txn_ids)
+
+
+class TwoPhaseError(RuntimeError):
+    pass
+
+
+class TwoPhaseCoordinator:
+    """Coordinates one multi-region write (the DML manager node analog).
+
+    ``crash_after`` (test hook): "prepare" kills the coordinator after the
+    prepare fan-out, "primary" after the primary commit — the two windows
+    the reference's recovery protocol must cover."""
+
+    def __init__(self, groups: list[RaftGroup]):
+        if not groups:
+            raise ValueError("need at least one participant")
+        self.primary = groups[0]
+        self.secondaries = groups[1:]
+        self.groups = groups
+
+    def write(self, per_group_ops: dict[int, list], crash_after: str = "",
+              txn_id: int | None = None) -> int:
+        """ops per region_id; returns the txn id.  Raises TwoPhaseError on a
+        failed prepare (everything rolled back)."""
+        txn = txn_id or next_txn_id()
+        by_region = {g.region_id: g for g in self.groups}
+        # phase 1: PREPARE everywhere (each is itself raft-committed)
+        prepared = []
+        for rid, ops in per_group_ops.items():
+            g = by_region[rid]
+            if not g.propose_cmd(CMD_PREPARE, txn, encode_ops(ops)):
+                for p in prepared:
+                    p.propose_cmd(CMD_ROLLBACK, txn)
+                raise TwoPhaseError(f"prepare failed on region {rid}")
+            prepared.append(g)
+        if crash_after == "prepare":
+            return txn                    # coordinator dies here
+        # decision record + commit on the PRIMARY first: once this is in the
+        # primary's log the txn is globally COMMITTED
+        self.primary.propose_cmd(CMD_DECIDE, txn, bytes([CMD_COMMIT]))
+        self.primary.propose_cmd(CMD_COMMIT, txn)
+        if crash_after == "primary":
+            return txn                    # coordinator dies here
+        for g in self.secondaries:
+            if g.region_id in per_group_ops:
+                g.propose_cmd(CMD_COMMIT, txn)
+        return txn
+
+
+def resolve_in_doubt(group: RaftGroup, primary: RaftGroup, txn_id: int) -> str:
+    """Recovery for a prepared-but-undecided txn on ``group``: ask the
+    primary (reference: region.cpp:598/684 — in-doubt secondaries query the
+    primary region's txn state).  -> "committed" | "rolled_back" | "none"."""
+    ldr = primary.bus.nodes[primary.leader()]
+    decision = ldr.decisions.get(txn_id)
+    if decision == CMD_COMMIT:
+        group.propose_cmd(CMD_COMMIT, txn_id)
+        return "committed"
+    # no decision recorded: the coordinator died before the commit point —
+    # the txn must abort everywhere (the primary's own prepare, if any,
+    # rolls back too)
+    for g in (group, primary):
+        if txn_id in g.bus.nodes[g.leader()].prepared:
+            g.propose_cmd(CMD_ROLLBACK, txn_id)
+    return "rolled_back" if decision is None else "none"
+
+
+def recover_all(groups: list[RaftGroup], primary: RaftGroup) -> dict[int, str]:
+    """Resolve every in-doubt txn across ``groups`` against the primary."""
+    out: dict[int, str] = {}
+    for g in groups:
+        ldr = g.bus.nodes[g.leader()]
+        for txn in sorted(list(ldr.prepared)):
+            out[txn] = resolve_in_doubt(g, primary, txn)
+    return out

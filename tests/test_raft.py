@@ -10,7 +10,8 @@ actually moves a replica."""
 import pytest
 
 from baikaldb_tpu.raft import RaftGroup, ReplicatedRegion, raft_available
-from baikaldb_tpu.raft.cluster import decode_ops, encode_ops
+from baikaldb_tpu.raft.cluster import (CMD_WRITE, decode_ops,
+                                       encode_cmd, encode_ops)
 from baikaldb_tpu.raft.core import LEADER
 
 pytestmark = pytest.mark.skipif(not raft_available(),
@@ -85,7 +86,7 @@ def test_partition_minority_cannot_commit():
     others = [n for n in g.bus.nodes if n != ldr]
     g.bus.partition([ldr], others)
     idx = g.bus.nodes[ldr].core.propose(
-        encode_ops([(0, b"k", b"v")]))
+        encode_cmd(CMD_WRITE, 0, encode_ops([(0, b"k", b"v")])))
     pre = g.bus.nodes[ldr].core.commit_index
     g.bus.advance(30)
     assert g.bus.nodes[ldr].core.commit_index < max(idx, pre + 1) or idx < 0

@@ -1,0 +1,103 @@
+"""2PC across raft region groups (VERDICT r1 #5 'done when': a crash between
+prepare and commit leaves no torn multi-region write; in-doubt recovery
+queries the primary)."""
+
+import pytest
+
+from baikaldb_tpu.raft import RaftGroup, raft_available
+from baikaldb_tpu.raft.twopc import (TwoPhaseCoordinator, TwoPhaseError,
+                                     recover_all, resolve_in_doubt)
+
+pytestmark = pytest.mark.skipif(not raft_available(),
+                                reason="native raft core unavailable")
+
+
+def make_groups(n=2):
+    return [RaftGroup(region_id=i + 1, peer_ids=[i * 10 + 1, i * 10 + 2,
+                                                 i * 10 + 3], seed=i + 3)
+            for i in range(n)]
+
+
+def rows_of(g):
+    return {r["k"]: r["v"] for r in g.bus.nodes[g.leader()].rows()}
+
+
+def ops_for(g, rows):
+    rep = g.bus.nodes[g.leader()]
+    out = []
+    for k, v in rows:
+        row = {"k": k, "v": v}
+        out.append((0, rep.table.key_codec.encode_one(row),
+                    rep.table.row_codec.encode(row)))
+    return out
+
+
+def test_commit_both_regions():
+    g1, g2 = make_groups(2)
+    co = TwoPhaseCoordinator([g1, g2])
+    co.write({1: ops_for(g1, [(1, "a")]), 2: ops_for(g2, [(9, "z")])})
+    assert rows_of(g1) == {1: "a"} and rows_of(g2) == {9: "z"}
+    # prepared state drained everywhere
+    for g in (g1, g2):
+        assert not g.bus.nodes[g.leader()].prepared
+
+
+def test_crash_before_decision_rolls_back():
+    """Coordinator dies after prepare fan-out: no decision on the primary ->
+    recovery aborts everywhere, neither region shows the write."""
+    g1, g2 = make_groups(2)
+    co = TwoPhaseCoordinator([g1, g2])
+    txn = co.write({1: ops_for(g1, [(1, "torn")]),
+                    2: ops_for(g2, [(2, "torn")])}, crash_after="prepare")
+    # both prepared, nothing applied
+    assert txn in g1.bus.nodes[g1.leader()].prepared
+    assert txn in g2.bus.nodes[g2.leader()].prepared
+    assert rows_of(g1) == {} and rows_of(g2) == {}
+    out = recover_all([g1, g2], primary=g1)
+    assert out[txn] == "rolled_back"
+    assert rows_of(g1) == {} and rows_of(g2) == {}
+    assert not g1.bus.nodes[g1.leader()].prepared
+    assert not g2.bus.nodes[g2.leader()].prepared
+
+
+def test_crash_after_primary_commit_completes():
+    """Coordinator dies after the primary committed: the decision record is
+    the source of truth -> recovery COMPLETES the secondary. No torn state."""
+    g1, g2 = make_groups(2)
+    co = TwoPhaseCoordinator([g1, g2])
+    txn = co.write({1: ops_for(g1, [(1, "done")]),
+                    2: ops_for(g2, [(2, "done")])}, crash_after="primary")
+    assert rows_of(g1) == {1: "done"}           # primary applied
+    assert rows_of(g2) == {}                    # secondary in doubt
+    assert resolve_in_doubt(g2, g1, txn) == "committed"
+    assert rows_of(g2) == {2: "done"}
+
+
+def test_prepare_failure_aborts_all():
+    g1, g2 = make_groups(2)
+    ops1 = ops_for(g1, [(1, "x")])
+    ops2 = ops_for(g2, [(2, "x")])
+    # take region 2's quorum down: prepare there cannot commit
+    for nid in list(g2.bus.nodes)[1:]:
+        g2.bus.kill(nid)
+    co = TwoPhaseCoordinator([g1, g2])
+    with pytest.raises(TwoPhaseError):
+        co.write({1: ops1, 2: ops2})
+    assert rows_of(g1) == {}
+    assert not g1.bus.nodes[g1.leader()].prepared
+
+
+def test_in_doubt_survives_secondary_leader_change():
+    """The prepared txn is raft state: a leader change on the in-doubt
+    secondary must not lose it, and recovery still completes it."""
+    g1, g2 = make_groups(2)
+    co = TwoPhaseCoordinator([g1, g2])
+    txn = co.write({1: ops_for(g1, [(1, "v")]), 2: ops_for(g2, [(2, "v")])},
+                   crash_after="primary")
+    old = g2.leader()
+    g2.bus.kill(old)
+    new = g2.bus.elect()
+    assert new != old
+    assert txn in g2.bus.nodes[new].prepared    # replicated, not lost
+    assert resolve_in_doubt(g2, g1, txn) == "committed"
+    assert rows_of(g2) == {2: "v"}
