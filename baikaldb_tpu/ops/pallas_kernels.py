@@ -115,3 +115,148 @@ def _xla_fallback(codes, values, mask, num_groups: int):
     sums = jax.ops.segment_sum(values.astype(jnp.float32), gid,
                                num_segments=num_groups + 1)[:num_groups]
     return counts, sums
+
+
+# ---------------------------------------------------------------------------
+# full fused aggregate: COUNT / SUM / MIN / MAX in one VMEM pass
+
+_BIG = 3.4e38      # python float: a jnp constant would be captured by the
+#                    kernel closure, which pallas_call rejects
+
+
+def _agg_kernel(g_ref, v_ref, m_ref, out_ref, *, ng_pad: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[0:2, :] = jnp.zeros_like(out_ref[0:2, :])
+        out_ref[2:3, :] = jnp.full_like(out_ref[2:3, :], _BIG)
+        out_ref[3:4, :] = jnp.full_like(out_ref[3:4, :], -_BIG)
+
+    g = g_ref[:, :].reshape(-1)
+    v = v_ref[:, :].reshape(-1)
+    m = m_ref[:, :].reshape(-1)
+    b = g.shape[0]
+    groups = jax.lax.broadcasted_iota(jnp.int32, (b, ng_pad), 1)
+    hit = (g[:, None] == groups) & m[:, None]
+    onehot = hit.astype(jnp.float32)
+    counts = jnp.dot(jnp.ones((1, b), jnp.float32), onehot,
+                     preferred_element_type=jnp.float32)
+    sums = jnp.dot(v.reshape(1, b), onehot,
+                   preferred_element_type=jnp.float32)
+    # min/max: masked broadcast + reduce along the row axis (VPU); the
+    # accumulator row stays pinned in VMEM like the sums
+    vb = v[:, None]
+    mins = jnp.min(jnp.where(hit, vb, _BIG), axis=0, keepdims=True)
+    maxs = jnp.max(jnp.where(hit, vb, -_BIG), axis=0, keepdims=True)
+    out_ref[0:1, :] += counts
+    out_ref[1:2, :] += sums
+    out_ref[2:3, :] = jnp.minimum(out_ref[2:3, :], mins)
+    out_ref[3:4, :] = jnp.maximum(out_ref[3:4, :], maxs)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "block_rows",
+                                             "interpret"))
+def fused_group_aggregate(codes, values, mask, num_groups: int,
+                          block_rows: int = 512, interpret: bool = False):
+    """Fused filter + dense group-by COUNT/SUM/MIN/MAX in ONE HBM pass
+    (SURVEY §7 hard part #4: the MIN/MAX-capable sibling of
+    filtered_group_sum).  -> (counts, sums, mins, maxs) [num_groups] f32;
+    min/max lanes of empty groups hold +/-3.4e38 (count==0 marks them)."""
+    if not PALLAS_AVAILABLE:
+        return _xla_agg_fallback(codes, values, mask, num_groups)
+    ng_pad = -(-num_groups // LANE) * LANE
+    rows = block_rows
+    flat = rows * LANE
+    g = _pad_to(codes.astype(jnp.int32), flat, jnp.int32(-1))
+    v = _pad_to(values.astype(jnp.float32), flat, jnp.float32(0))
+    m = _pad_to(mask, flat, False)
+    m = m & (g >= 0) & (g < num_groups)
+    steps = g.shape[0] // flat
+    g2 = g.reshape(steps * rows, LANE)
+    v2 = v.reshape(steps * rows, LANE)
+    m2 = m.reshape(steps * rows, LANE)
+    out = pl.pallas_call(
+        functools.partial(_agg_kernel, ng_pad=ng_pad),
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
+            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
+            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, ng_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, ng_pad), jnp.float32),
+        interpret=interpret,
+    )(g2, v2, m2)
+    return (out[0, :num_groups], out[1, :num_groups],
+            out[2, :num_groups], out[3, :num_groups])
+
+
+def _xla_agg_fallback(codes, values, mask, num_groups: int):
+    live = mask & (codes >= 0) & (codes < num_groups)
+    gid = jnp.where(live, codes, num_groups)
+    v = values.astype(jnp.float32)
+    counts = jax.ops.segment_sum(jnp.ones_like(v), gid,
+                                 num_segments=num_groups + 1)[:num_groups]
+    sums = jax.ops.segment_sum(v, gid,
+                               num_segments=num_groups + 1)[:num_groups]
+    mins = jax.ops.segment_min(jnp.where(live, v, _BIG), gid,
+                               num_segments=num_groups + 1)[:num_groups]
+    maxs = jax.ops.segment_max(jnp.where(live, v, -_BIG), gid,
+                               num_segments=num_groups + 1)[:num_groups]
+    return counts, sums, mins, maxs
+
+
+# ---------------------------------------------------------------------------
+# radix-partition histogram (the shuffle-sizing building block)
+
+
+def _hist_kernel(d_ref, m_ref, out_ref, *, np_pad: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:, :] = jnp.zeros_like(out_ref)
+
+    d = d_ref[:, :].reshape(-1)
+    m = m_ref[:, :].reshape(-1)
+    b = d.shape[0]
+    parts = jax.lax.broadcasted_iota(jnp.int32, (b, np_pad), 1)
+    onehot = ((d[:, None] == parts) & m[:, None]).astype(jnp.float32)
+    out_ref[0:1, :] += jnp.dot(jnp.ones((1, b), jnp.float32), onehot,
+                               preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions", "block_rows",
+                                             "interpret"))
+def partition_histogram(dest, mask, num_partitions: int,
+                        block_rows: int = 512, interpret: bool = False):
+    """Per-destination row counts for a hash shuffle, as one MXU pass
+    (SURVEY §7 hard part #2: the counting phase of radix partition — XLA's
+    sort does the reorder, this sizes exchange capacities exactly so the
+    repartition compiles with the right cap on the FIRST attempt)."""
+    if not PALLAS_AVAILABLE:
+        gid = jnp.where(mask & (dest >= 0) & (dest < num_partitions),
+                        dest, num_partitions)
+        return jax.ops.segment_sum(
+            jnp.ones(dest.shape[0], jnp.float32), gid,
+            num_segments=num_partitions + 1)[:num_partitions]
+    np_pad = -(-num_partitions // LANE) * LANE
+    rows = block_rows
+    flat = rows * LANE
+    d = _pad_to(dest.astype(jnp.int32), flat, jnp.int32(-1))
+    m = _pad_to(mask, flat, False)
+    m = m & (d >= 0) & (d < num_partitions)
+    steps = d.shape[0] // flat
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, np_pad=np_pad),
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
+            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((8, np_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, np_pad), jnp.float32),
+        interpret=interpret,
+    )(d.reshape(steps * rows, LANE), m.reshape(steps * rows, LANE))
+    return out[0, :num_partitions]

@@ -183,6 +183,7 @@ class Planner:
 
         # FROM clause
         if stmt.table is not None:
+            self._reorder_comma_joins(stmt)
             plan = self._plan_table_ref(stmt.table, scope)
             for j in stmt.joins:
                 plan = self._plan_join(plan, j, scope, stmt)
@@ -365,6 +366,59 @@ class Planner:
         return plan
 
     # ------------------------------------------------------------------
+    def _reorder_comma_joins(self, stmt: SelectStmt):
+        """Greedy left-deep ordering of comma-FROM tables so every join step
+        has an equality link to what's already placed (the JoinReorder
+        analog, src/physical_plan/join_reorder.cpp:155 — inner joins only).
+        Without this, `FROM part, supplier, partsupp ...` materializes a
+        part x supplier cross product before partsupp links them."""
+        if not stmt.joins or stmt.where is None or stmt.table is None:
+            return
+        if stmt.table.subquery is not None or any(
+                j.kind not in ("cross", "inner") or j.on is not None or
+                j.table.subquery is not None for j in stmt.joins):
+            return
+        # label -> set of column names (via catalog)
+        cols: dict[str, set] = {}
+        try:
+            for ref in [stmt.table] + [j.table for j in stmt.joins]:
+                db = ref.database or self.default_db
+                info = self.catalog.get_table(db, ref.name)
+                cols[ref.label] = {f.name for f in info.schema.fields}
+        except Exception:
+            return                    # unknown table: let planning report it
+        if len(cols) != len(stmt.joins) + 1:
+            return                    # duplicate labels: keep original order
+
+        def owner(name, table):
+            if table is not None:
+                return table if table in cols else None
+            hits = [lbl for lbl, cs in cols.items() if name in cs]
+            return hits[0] if len(hits) == 1 else None
+
+        links: list[tuple[str, str]] = []
+        for c in _conjuncts(stmt.where):
+            if isinstance(c, Call) and c.op == "eq" and len(c.args) == 2 and \
+                    all(isinstance(a, ColRef) for a in c.args):
+                a, b = c.args
+                la, lb = owner(a.name, a.table), owner(b.name, b.table)
+                if la and lb and la != lb:
+                    links.append((la, lb))
+        placed = {stmt.table.label}
+        remaining = list(stmt.joins)
+        ordered = []
+        while remaining:
+            pick = next((j for j in remaining
+                         if any((x in placed) != (y in placed) and
+                                j.table.label in (x, y)
+                                for x, y in links)), None)
+            if pick is None:
+                pick = remaining[0]   # no link joins anything placed yet
+            remaining.remove(pick)
+            ordered.append(pick)
+            placed.add(pick.table.label)
+        stmt.joins = ordered
+
     def _plan_table_ref(self, ref: TableRef, scope: Scope) -> PlanNode:
         if ref.subquery is None and ref.database is None and \
                 ref.name in self._ctes:

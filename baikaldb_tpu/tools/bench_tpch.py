@@ -1,0 +1,64 @@
+"""TPC-H wall-clock harness: all 22 queries end-to-end through Session.
+
+Usage:  python -m baikaldb_tpu.tools.bench_tpch [--scale 0.05] [--mesh N]
+Prints per-query first-run (compile incl.) and warm times plus a JSON
+summary line (BASELINE config #5's measurement shape)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scale", type=float, default=0.05)
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="run distributed over an N-device mesh")
+    ap.add_argument("--repeat", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    from ..exec.session import Session
+    from ..models import tpch
+
+    mesh = None
+    if args.mesh:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(args.mesh)
+    s = Session(mesh=mesh)
+    t0 = time.perf_counter()
+    tpch.load_into(s, scale=args.scale, seed=42)
+    load_s = time.perf_counter() - t0
+    platform = jax.devices()[0].platform
+    n_li = s.db.stores["default.lineitem"].num_rows
+    print(f"# scale={args.scale} lineitem={n_li} platform={platform} "
+          f"mesh={args.mesh or 1} load={load_s:.1f}s")
+
+    results = {}
+    total_warm = 0.0
+    for name in sorted(tpch.QUERIES, key=lambda q: int(q[1:])):
+        sql = tpch.QUERIES[name]
+        t0 = time.perf_counter()
+        s.query(sql)
+        first = time.perf_counter() - t0
+        warm = []
+        for _ in range(args.repeat):
+            t0 = time.perf_counter()
+            s.query(sql)
+            warm.append(time.perf_counter() - t0)
+        w = min(warm)
+        total_warm += w
+        results[name] = round(w * 1e3, 2)
+        print(f"{name:>4}: first {first * 1e3:8.1f} ms   warm {w * 1e3:8.1f} ms")
+    print(json.dumps({"metric": f"tpch-22 warm total (SF{args.scale}, "
+                                f"{platform}, mesh={args.mesh or 1})",
+                      "value": round(total_warm * 1e3, 1), "unit": "ms",
+                      "per_query_ms": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,3 +47,37 @@ def test_padding_rows_not_counted():
                               interpret=True)
     assert np.asarray(c).sum() == 100
     assert np.asarray(s).tolist() == np.asarray(c).tolist()
+
+
+def test_fused_group_aggregate_interpret():
+    from baikaldb_tpu.ops.pallas_kernels import fused_group_aggregate
+
+    rng = np.random.default_rng(9)
+    n, ng = 5000, 37
+    codes = rng.integers(0, ng, n).astype(np.int32)
+    vals = rng.normal(size=n).astype(np.float32)
+    mask = rng.random(n) < 0.7
+    c, s, mn, mx = fused_group_aggregate(jnp.asarray(codes), jnp.asarray(vals),
+                                         jnp.asarray(mask), ng,
+                                         interpret=True)
+    c, s, mn, mx = map(np.asarray, (c, s, mn, mx))
+    for g in range(ng):
+        live = vals[(codes == g) & mask]
+        assert c[g] == len(live)
+        assert abs(s[g] - live.sum()) < 1e-2
+        if len(live):
+            assert mn[g] == pytest.approx(live.min(), rel=1e-6)
+            assert mx[g] == pytest.approx(live.max(), rel=1e-6)
+
+
+def test_partition_histogram_interpret():
+    from baikaldb_tpu.ops.pallas_kernels import partition_histogram
+
+    rng = np.random.default_rng(4)
+    n, p = 4000, 16
+    dest = rng.integers(0, p, n).astype(np.int32)
+    mask = rng.random(n) < 0.6
+    h = np.asarray(partition_histogram(jnp.asarray(dest), jnp.asarray(mask),
+                                       p, interpret=True))
+    want = np.bincount(dest[mask], minlength=p)
+    assert np.array_equal(h.astype(np.int64), want)
