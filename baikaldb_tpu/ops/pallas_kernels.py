@@ -66,6 +66,34 @@ except Exception:  # pragma: no cover
     PALLAS_AVAILABLE = False
 
 
+def _launch_reduction(kernel, codes, mask, num_out: int, block_rows: int,
+                      interpret: bool, values=None):
+    """Shared launch scaffolding for the tiled one-hot reductions: pad rows
+    to full tiles, range-mask out-of-domain codes, reshape to (rows, LANE)
+    blocks, and run with a pinned (8, padded) f32 accumulator block."""
+    n_pad = -(-num_out // LANE) * LANE
+    rows = block_rows
+    flat = rows * LANE
+    g = _pad_to(codes.astype(jnp.int32), flat, jnp.int32(-1))
+    m = _pad_to(mask, flat, False) & (g >= 0) & (g < num_out)
+    steps = g.shape[0] // flat
+    args = [g.reshape(steps * rows, LANE)]
+    if values is not None:
+        v = _pad_to(values.astype(jnp.float32), flat, jnp.float32(0))
+        args.append(v.reshape(steps * rows, LANE))
+    args.append(m.reshape(steps * rows, LANE))
+    out = pl.pallas_call(
+        functools.partial(kernel, ng_pad=n_pad),
+        grid=(steps,),
+        in_specs=[pl.BlockSpec((rows, LANE), lambda i: (i, 0))
+                  for _ in args],
+        out_specs=pl.BlockSpec((8, n_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, n_pad), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("num_groups", "block_rows",
                                              "interpret"))
 def filtered_group_sum(codes, values, mask, num_groups: int,
@@ -78,32 +106,8 @@ def filtered_group_sum(codes, values, mask, num_groups: int,
     """
     if not PALLAS_AVAILABLE:
         return _xla_fallback(codes, values, mask, num_groups)
-    n = codes.shape[0]
-    ng_pad = -(-num_groups // LANE) * LANE
-    rows = block_rows
-    flat = rows * LANE
-    g = _pad_to(codes.astype(jnp.int32), flat, jnp.int32(-1))
-    v = _pad_to(values.astype(jnp.float32), flat, jnp.float32(0))
-    m = _pad_to(mask, flat, False)
-    m = m & (g >= 0) & (g < num_groups)
-    total = g.shape[0]
-    steps = total // flat
-    g2 = g.reshape(steps * rows, LANE)
-    v2 = v.reshape(steps * rows, LANE)
-    m2 = m.reshape(steps * rows, LANE)
-
-    out = pl.pallas_call(
-        functools.partial(_kernel, ng_pad=ng_pad),
-        grid=(steps,),
-        in_specs=[
-            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
-            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
-            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((8, ng_pad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((8, ng_pad), jnp.float32),
-        interpret=interpret,
-    )(g2, v2, m2)
+    out = _launch_reduction(_kernel, codes, mask, num_groups, block_rows,
+                            interpret, values=values)
     return out[0, :num_groups], out[1, :num_groups]
 
 
@@ -165,29 +169,8 @@ def fused_group_aggregate(codes, values, mask, num_groups: int,
     min/max lanes of empty groups hold +/-3.4e38 (count==0 marks them)."""
     if not PALLAS_AVAILABLE:
         return _xla_agg_fallback(codes, values, mask, num_groups)
-    ng_pad = -(-num_groups // LANE) * LANE
-    rows = block_rows
-    flat = rows * LANE
-    g = _pad_to(codes.astype(jnp.int32), flat, jnp.int32(-1))
-    v = _pad_to(values.astype(jnp.float32), flat, jnp.float32(0))
-    m = _pad_to(mask, flat, False)
-    m = m & (g >= 0) & (g < num_groups)
-    steps = g.shape[0] // flat
-    g2 = g.reshape(steps * rows, LANE)
-    v2 = v.reshape(steps * rows, LANE)
-    m2 = m.reshape(steps * rows, LANE)
-    out = pl.pallas_call(
-        functools.partial(_agg_kernel, ng_pad=ng_pad),
-        grid=(steps,),
-        in_specs=[
-            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
-            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
-            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((8, ng_pad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((8, ng_pad), jnp.float32),
-        interpret=interpret,
-    )(g2, v2, m2)
+    out = _launch_reduction(_agg_kernel, codes, mask, num_groups, block_rows,
+                            interpret, values=values)
     return (out[0, :num_groups], out[1, :num_groups],
             out[2, :num_groups], out[3, :num_groups])
 
@@ -200,10 +183,14 @@ def _xla_agg_fallback(codes, values, mask, num_groups: int):
                                  num_segments=num_groups + 1)[:num_groups]
     sums = jax.ops.segment_sum(v, gid,
                                num_segments=num_groups + 1)[:num_groups]
-    mins = jax.ops.segment_min(jnp.where(live, v, _BIG), gid,
-                               num_segments=num_groups + 1)[:num_groups]
-    maxs = jax.ops.segment_max(jnp.where(live, v, -_BIG), gid,
-                               num_segments=num_groups + 1)[:num_groups]
+    # clamp the +/-inf identities of empty segments to the documented
+    # sentinel so both paths agree (and results stay JSON-serializable)
+    mins = jnp.minimum(jax.ops.segment_min(
+        jnp.where(live, v, _BIG), gid,
+        num_segments=num_groups + 1)[:num_groups], _BIG)
+    maxs = jnp.maximum(jax.ops.segment_max(
+        jnp.where(live, v, -_BIG), gid,
+        num_segments=num_groups + 1)[:num_groups], -_BIG)
     return counts, sums, mins, maxs
 
 
@@ -211,7 +198,7 @@ def _xla_agg_fallback(codes, values, mask, num_groups: int):
 # radix-partition histogram (the shuffle-sizing building block)
 
 
-def _hist_kernel(d_ref, m_ref, out_ref, *, np_pad: int):
+def _hist_kernel(d_ref, m_ref, out_ref, *, ng_pad: int):
     i = pl.program_id(0)
 
     @pl.when(i == 0)
@@ -221,7 +208,7 @@ def _hist_kernel(d_ref, m_ref, out_ref, *, np_pad: int):
     d = d_ref[:, :].reshape(-1)
     m = m_ref[:, :].reshape(-1)
     b = d.shape[0]
-    parts = jax.lax.broadcasted_iota(jnp.int32, (b, np_pad), 1)
+    parts = jax.lax.broadcasted_iota(jnp.int32, (b, ng_pad), 1)
     onehot = ((d[:, None] == parts) & m[:, None]).astype(jnp.float32)
     out_ref[0:1, :] += jnp.dot(jnp.ones((1, b), jnp.float32), onehot,
                                preferred_element_type=jnp.float32)
@@ -241,22 +228,6 @@ def partition_histogram(dest, mask, num_partitions: int,
         return jax.ops.segment_sum(
             jnp.ones(dest.shape[0], jnp.float32), gid,
             num_segments=num_partitions + 1)[:num_partitions]
-    np_pad = -(-num_partitions // LANE) * LANE
-    rows = block_rows
-    flat = rows * LANE
-    d = _pad_to(dest.astype(jnp.int32), flat, jnp.int32(-1))
-    m = _pad_to(mask, flat, False)
-    m = m & (d >= 0) & (d < num_partitions)
-    steps = d.shape[0] // flat
-    out = pl.pallas_call(
-        functools.partial(_hist_kernel, np_pad=np_pad),
-        grid=(steps,),
-        in_specs=[
-            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
-            pl.BlockSpec((rows, LANE), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((8, np_pad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((8, np_pad), jnp.float32),
-        interpret=interpret,
-    )(d.reshape(steps * rows, LANE), m.reshape(steps * rows, LANE))
+    out = _launch_reduction(_hist_kernel, dest, mask, num_partitions,
+                            block_rows, interpret)
     return out[0, :num_partitions]

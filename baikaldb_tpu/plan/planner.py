@@ -376,8 +376,9 @@ class Planner:
             return
         if stmt.table.subquery is not None or any(
                 j.kind not in ("cross", "inner") or j.on is not None or
-                j.table.subquery is not None for j in stmt.joins):
-            return
+                j.using or j.table.subquery is not None
+                for j in stmt.joins):
+            return   # USING resolves against the left scope: order matters
         # label -> set of column names (via catalog)
         cols: dict[str, set] = {}
         try:

@@ -351,3 +351,20 @@ def test_update_set_string_literal():
     s.execute("INSERT INTO usl VALUES (1, 'a'), (2, 'b')")
     assert s.execute("UPDATE usl SET tag = 'zz' WHERE id = 2").affected_rows == 1
     assert s.query("SELECT tag FROM usl ORDER BY id") == [{"tag": "a"}, {"tag": "zz"}]
+
+
+def test_comma_join_reorder_preserves_using():
+    """Reorder must not move a USING join away from the table its column
+    resolves against (caught in round-2 review)."""
+    from baikaldb_tpu.exec.session import Session
+
+    s = Session()
+    s.execute("CREATE TABLE ra (k BIGINT)")
+    s.execute("CREATE TABLE rb (id BIGINT, x BIGINT)")
+    s.execute("CREATE TABLE rc (k BIGINT, x BIGINT)")
+    s.execute("INSERT INTO ra VALUES (1)")
+    s.execute("INSERT INTO rb VALUES (7, 5)")
+    s.execute("INSERT INTO rc VALUES (1, 5)")
+    r = s.query("SELECT ra.k, rb.id FROM ra, rb JOIN rc USING(x) "
+                "WHERE ra.k = rc.k")
+    assert r == [{"k": 1, "id": 7}]
