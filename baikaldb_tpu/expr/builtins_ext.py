@@ -24,12 +24,14 @@ import hashlib
 import math
 import zlib
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..column.batch import Column
 from ..types import LType
 from ..utils import datetime_kernels as dtk
+from ..utils.hashing import split64
 from .ast import Lit
 from .compile import (ExprError, HostStr, _dict_scalar, _dict_transform,
                       _eval, _num, _raw, _reg, _str_fn, _TYPE_RULES)
@@ -64,16 +66,13 @@ _reg("tanh", _unary(jnp.tanh), LType.FLOAT64)
 _reg("pi", lambda: Column(jnp.asarray(math.pi), None, LType.FLOAT64),
      LType.FLOAT64)
 _reg("bit_count", lambda a: Column(
-    _popcount64(_num(a, LType.INT64).view(jnp.uint64)), None, LType.INT32),
+    _popcount64(_num(a, LType.INT64)), None, LType.INT32),
     LType.INT32)
 
 
-def _popcount64(u):
-    u = u - ((u >> jnp.uint64(1)) & jnp.uint64(0x5555555555555555))
-    u = (u & jnp.uint64(0x3333333333333333)) + \
-        ((u >> jnp.uint64(2)) & jnp.uint64(0x3333333333333333))
-    u = (u + (u >> jnp.uint64(4))) & jnp.uint64(0x0F0F0F0F0F0F0F0F)
-    return ((u * jnp.uint64(0x0101010101010101)) >> jnp.uint64(56)) \
+def _popcount64(x):
+    lo, hi = split64(x)
+    return (jax.lax.population_count(lo) + jax.lax.population_count(hi)) \
         .astype(jnp.int32)
 
 

@@ -10,7 +10,25 @@ an xor-mix fold.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+
+
+def split64(x):
+    """Bitcast a 64-bit lane array to (lo, hi) uint32 halves, never touching u64.
+
+    TPU's X64-elimination pass cannot rewrite ``bitcast_convert`` to/from
+    64-bit element types, so we bitcast to a trailing pair of u32 lanes
+    (supported: the itemsize change adds a minor dimension).
+    """
+    u = jax.lax.bitcast_convert_type(x, jnp.uint32)  # shape (..., 2), [0]=lo
+    return u[..., 0], u[..., 1]
+
+
+def _fold64(x):
+    """Fold a 64-bit lane array to uint32 via split64 + xor-mix."""
+    lo, hi = split64(x)
+    return lo ^ hi * jnp.uint32(0x9E3779B9)
 
 
 def _as_u32(x):
@@ -21,14 +39,10 @@ def _as_u32(x):
     if x.dtype.kind == "f":
         x = jnp.where(x == 0, jnp.zeros_like(x), x)  # -0.0 == 0.0
         if x.dtype.itemsize == 8:
-            u = x.view(jnp.uint64)
-            return (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) ^ \
-                   (u >> jnp.uint64(32)).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+            return _fold64(x)
         return x.view(jnp.uint32)
     if x.dtype.itemsize == 8:
-        u = x.view(jnp.uint64) if x.dtype.kind == "u" else x.astype(jnp.int64).view(jnp.uint64)
-        return (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) ^ \
-               (u >> jnp.uint64(32)).astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+        return _fold64(x)
     return x.astype(jnp.uint32)
 
 
