@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from ..column.batch import Column, ColumnBatch
+from .segments import seg_max, seg_min, seg_sum
 from ..types import LType
 
 
@@ -154,7 +155,7 @@ def _hll_registers(c: Column, live, gid, ng: int):
     nz = 32 - jnp.ceil(jnp.log2(h2.astype(jnp.float64) + 1.0)).astype(jnp.int32)
     rho = jnp.clip(nz + 1, 1, 33)
     slot = jnp.where(live, gid * m + reg, ng * m)
-    regs = jax.ops.segment_max(jnp.where(live, rho, 0), slot,
+    regs = seg_max(jnp.where(live, rho, 0), slot,
                                num_segments=ng * m + 1)[:ng * m]
     return jnp.maximum(regs, 0).reshape(ng, m)
 
@@ -181,7 +182,7 @@ def _segment_percentile(c: Column, gid_v, ng: int, p: float):
     order = order[jnp.argsort(gid_v[order], stable=True)]
     g = gid_v[order]
     v = x[order]
-    counts = jax.ops.segment_sum(jnp.ones_like(gid_v, jnp.int32), gid_v,
+    counts = seg_sum(jnp.ones_like(gid_v, jnp.int32), gid_v,
                                  num_segments=ng + 1)[:ng]
     starts = jnp.cumsum(counts) - counts
     tpos = starts.astype(jnp.float64) + p * jnp.maximum(counts - 1, 0)
@@ -248,8 +249,8 @@ def group_aggregate_dense(batch: ColumnBatch, key_names: list[str],
     gid = combined_dense_id(key_cols, domains)
     sel = batch.sel_mask()
     gid_live = jnp.where(sel, gid, ng)  # dead rows -> overflow bucket
-    present = jax.ops.segment_sum(jnp.ones_like(gid_live, dtype=jnp.int32),
-                                  gid_live, num_segments=ng + 1)[:ng] > 0
+    present = seg_sum(jnp.ones_like(gid_live, dtype=jnp.int32), gid_live,
+                      num_segments=ng + 1)[:ng] > 0
     # reconstruct key columns from slot index
     out_names, out_cols = [], []
     slot = jnp.arange(ng, dtype=jnp.int32)
@@ -275,7 +276,7 @@ def group_aggregate_dense(batch: ColumnBatch, key_names: list[str],
 def _segment_one(batch: ColumnBatch, s: AggSpec, gid, ng: int, sel) -> Column:
     """One aggregate via segment reduction; gid==ng is the dead-row bucket."""
     if s.op == "count_star":
-        v = jax.ops.segment_sum(jnp.ones_like(gid, jnp.int64), gid, num_segments=ng + 1)[:ng]
+        v = seg_sum(jnp.ones_like(gid, jnp.int64), gid, num_segments=ng + 1)[:ng]
         return Column(v, None, LType.INT64)
     c = batch.column(s.input)
     live = c.valid_mask() & sel
@@ -283,37 +284,37 @@ def _segment_one(batch: ColumnBatch, s: AggSpec, gid, ng: int, sel) -> Column:
     if s.distinct:
         return _segment_distinct(c, gid_v, ng, s)
     if s.op == "count":
-        v = jax.ops.segment_sum(jnp.ones_like(gid, jnp.int64), gid_v, num_segments=ng + 1)[:ng]
+        v = seg_sum(jnp.ones_like(gid, jnp.int64), gid_v, num_segments=ng + 1)[:ng]
         return Column(v, None, LType.INT64)
     if s.op == "sum":
         dt = _sum_dtype(c)
-        v = jax.ops.segment_sum(c.data.astype(dt), gid_v, num_segments=ng + 1)[:ng]
-        ct = jax.ops.segment_sum(jnp.ones_like(gid, jnp.int32), gid_v, num_segments=ng + 1)[:ng]
+        v = seg_sum(c.data.astype(dt), gid_v, num_segments=ng + 1)[:ng]
+        ct = seg_sum(jnp.ones_like(gid, jnp.int32), gid_v, num_segments=ng + 1)[:ng]
         return Column(v, ct > 0, agg_result_type("sum", c.ltype))
     if s.op == "avg":
-        sm = jax.ops.segment_sum(c.data.astype(jnp.float64), gid_v, num_segments=ng + 1)[:ng]
-        ct = jax.ops.segment_sum(jnp.ones_like(gid, jnp.int32), gid_v, num_segments=ng + 1)[:ng]
+        sm = seg_sum(c.data.astype(jnp.float64), gid_v, num_segments=ng + 1)[:ng]
+        ct = seg_sum(jnp.ones_like(gid, jnp.int32), gid_v, num_segments=ng + 1)[:ng]
         return Column(sm / jnp.maximum(ct, 1), ct > 0, LType.FLOAT64)
     if s.op == "min":
-        v = jax.ops.segment_min(jnp.where(live, c.data, _minmax_identity(c, True)),
+        v = seg_min(jnp.where(live, c.data, _minmax_identity(c, True)),
                                 jnp.where(live, gid, ng), num_segments=ng + 1)[:ng]
-        ct = jax.ops.segment_sum(jnp.where(live, 1, 0), gid_v, num_segments=ng + 1)[:ng]
+        ct = seg_sum(jnp.where(live, 1, 0), gid_v, num_segments=ng + 1)[:ng]
         return Column(v, ct > 0, c.ltype, c.dictionary)
     if s.op == "max":
-        v = jax.ops.segment_max(jnp.where(live, c.data, _minmax_identity(c, False)),
+        v = seg_max(jnp.where(live, c.data, _minmax_identity(c, False)),
                                 jnp.where(live, gid, ng), num_segments=ng + 1)[:ng]
-        ct = jax.ops.segment_sum(jnp.where(live, 1, 0), gid_v, num_segments=ng + 1)[:ng]
+        ct = seg_sum(jnp.where(live, 1, 0), gid_v, num_segments=ng + 1)[:ng]
         return Column(v, ct > 0, c.ltype, c.dictionary)
     if s.op == "sumsq":
         x = c.data.astype(jnp.float64)
-        v = jax.ops.segment_sum(jnp.where(live, x * x, 0.0), gid_v, num_segments=ng + 1)[:ng]
-        ct = jax.ops.segment_sum(jnp.where(live, 1, 0), gid_v, num_segments=ng + 1)[:ng]
+        v = seg_sum(jnp.where(live, x * x, 0.0), gid_v, num_segments=ng + 1)[:ng]
+        ct = seg_sum(jnp.where(live, 1, 0), gid_v, num_segments=ng + 1)[:ng]
         return Column(v, ct > 0, LType.FLOAT64)
     if s.op in ("stddev", "stddev_samp", "variance", "var_samp"):
         x = c.data.astype(jnp.float64)
-        sm = jax.ops.segment_sum(jnp.where(live, x, 0.0), gid_v, num_segments=ng + 1)[:ng]
-        s2 = jax.ops.segment_sum(jnp.where(live, x * x, 0.0), gid_v, num_segments=ng + 1)[:ng]
-        n = jax.ops.segment_sum(jnp.where(live, 1.0, 0.0), gid_v, num_segments=ng + 1)[:ng]
+        sm = seg_sum(jnp.where(live, x, 0.0), gid_v, num_segments=ng + 1)[:ng]
+        s2 = seg_sum(jnp.where(live, x * x, 0.0), gid_v, num_segments=ng + 1)[:ng]
+        n = seg_sum(jnp.where(live, 1.0, 0.0), gid_v, num_segments=ng + 1)[:ng]
         n1 = jnp.maximum(n, 1.0)
         var = s2 / n1 - (sm / n1) ** 2
         denom_n = n1 if s.op in ("stddev", "variance") else jnp.maximum(n - 1.0, 1.0)
@@ -340,17 +341,17 @@ def _segment_distinct(c: Column, gid, ng: int, s: AggSpec) -> Column:
     live = g < ng
     w = new & live
     if s.op == "count":
-        out = jax.ops.segment_sum(w.astype(jnp.int64), jnp.where(live, g, ng),
+        out = seg_sum(w.astype(jnp.int64), jnp.where(live, g, ng),
                                   num_segments=ng + 1)[:ng]
         return Column(out, None, LType.INT64)
     dt = _sum_dtype(c)
-    sm = jax.ops.segment_sum(jnp.where(w, v.astype(dt), 0), jnp.where(live, g, ng),
+    sm = seg_sum(jnp.where(w, v.astype(dt), 0), jnp.where(live, g, ng),
                              num_segments=ng + 1)[:ng]
     if s.op == "sum":
-        ct = jax.ops.segment_sum(w.astype(jnp.int32), jnp.where(live, g, ng),
+        ct = seg_sum(w.astype(jnp.int32), jnp.where(live, g, ng),
                                  num_segments=ng + 1)[:ng]
         return Column(sm, ct > 0, agg_result_type("sum", c.ltype))
-    ct = jax.ops.segment_sum(w.astype(jnp.int32), jnp.where(live, g, ng),
+    ct = seg_sum(w.astype(jnp.int32), jnp.where(live, g, ng),
                              num_segments=ng + 1)[:ng]
     return Column(sm.astype(jnp.float64) / jnp.maximum(ct, 1), ct > 0, LType.FLOAT64)
 

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .segments import seg_sum
+
 
 def _scores(queries, base, metric: str, precision: str):
     q = queries
@@ -89,8 +91,8 @@ def kmeans(vectors: np.ndarray, n_clusters: int, iters: int = 10,
     def step(c):
         d = _scores(x, c, "l2", "f32")                # [n, cclusters] (neg dist)
         a = jnp.argmax(d, axis=1)
-        sums = jax.ops.segment_sum(x, a, num_segments=n_clusters)
-        cnt = jax.ops.segment_sum(jnp.ones((x.shape[0],)), a,
+        sums = seg_sum(x, a, num_segments=n_clusters)
+        cnt = seg_sum(jnp.ones((x.shape[0],)), a,
                                   num_segments=n_clusters)
         newc = sums / jnp.maximum(cnt[:, None], 1.0)
         # keep old centroid for empty clusters

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from ..column.batch import Column, ColumnBatch
 from ..types import LType
+from .segments import seg_max, seg_min, seg_sum
 from .sort import SortKey
 
 
@@ -87,9 +88,9 @@ def window_compute(batch: ColumnBatch, partition_names: list[str],
     nseg = n + 1
     import jax
 
-    seg_size = jax.ops.segment_sum(sel_s.astype(jnp.int64),
-                                   jnp.where(sel_s, sid, n),
-                                   num_segments=nseg)[:n]
+    seg_size = seg_sum(sel_s.astype(jnp.int64),
+                       jnp.where(sel_s, sid, n),
+                       num_segments=nseg)[:n]
     size_here = jnp.take(seg_size, jnp.clip(sid, 0, n - 1))
     end_idx = start_idx + jnp.maximum(size_here, 1) - 1
 
@@ -228,17 +229,17 @@ def _one(s: WinSpec, batch, perm, idx, sel_s, flags, tie, sid, start_idx,
     # partition-wide
     gid = jnp.where(sel_s, sid, n)
     if s.op == "count":
-        t = jax.ops.segment_sum(ones, gid, num_segments=nseg)[:n]
+        t = seg_sum(ones, gid, num_segments=nseg)[:n]
         return jnp.take(t, jnp.clip(sid, 0, n - 1)), None, LType.INT64
     if s.op == "sum":
-        t = jax.ops.segment_sum(xa, gid, num_segments=nseg)[:n]
-        tc = jax.ops.segment_sum(ones, gid, num_segments=nseg)[:n]
+        t = seg_sum(xa, gid, num_segments=nseg)[:n]
+        tc = seg_sum(ones, gid, num_segments=nseg)[:n]
         sd = jnp.take(t, jnp.clip(sid, 0, n - 1))
         vc = jnp.take(tc, jnp.clip(sid, 0, n - 1)) > 0
         return sd, vc, LType.INT64 if dt == jnp.int64 else LType.FLOAT64
     if s.op == "avg":
-        t = jax.ops.segment_sum(xa.astype(jnp.float64), gid, num_segments=nseg)[:n]
-        tc = jax.ops.segment_sum(ones, gid, num_segments=nseg)[:n]
+        t = seg_sum(xa.astype(jnp.float64), gid, num_segments=nseg)[:n]
+        tc = seg_sum(ones, gid, num_segments=nseg)[:n]
         sd = jnp.take(t, jnp.clip(sid, 0, n - 1))
         cd = jnp.take(tc, jnp.clip(sid, 0, n - 1))
         return sd / jnp.maximum(cd, 1), cd > 0, LType.FLOAT64
@@ -246,9 +247,9 @@ def _one(s: WinSpec, batch, perm, idx, sel_s, flags, tie, sid, start_idx,
         big = (jnp.iinfo if x.dtype.kind in "iu" else jnp.finfo)(x.dtype)
         ident = big.max if s.op == "min" else big.min
         xm = jnp.where(xv, x, ident)
-        f = jax.ops.segment_min if s.op == "min" else jax.ops.segment_max
+        f = seg_min if s.op == "min" else seg_max
         t = f(xm, gid, num_segments=nseg)[:n]
-        tc = jax.ops.segment_sum(ones, gid, num_segments=nseg)[:n]
+        tc = seg_sum(ones, gid, num_segments=nseg)[:n]
         sd = jnp.take(t, jnp.clip(sid, 0, n - 1))
         vc = jnp.take(tc, jnp.clip(sid, 0, n - 1)) > 0
         return sd, vc, c.ltype

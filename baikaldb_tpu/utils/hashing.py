@@ -15,14 +15,29 @@ import jax.numpy as jnp
 
 
 def split64(x):
-    """Bitcast a 64-bit lane array to (lo, hi) uint32 halves, never touching u64.
+    """Split a 64-bit lane array to (lo, hi) uint32 halves, never touching a
+    64-bit ``bitcast_convert``.
 
-    TPU's X64-elimination pass cannot rewrite ``bitcast_convert`` to/from
-    64-bit element types, so we bitcast to a trailing pair of u32 lanes
-    (supported: the itemsize change adds a minor dimension).
+    TPU's X64-elimination pass cannot rewrite ``bitcast_convert`` involving
+    64-bit element types AT ALL (it aborts compilation), so integers split
+    arithmetically (mask + shift — ops the eliminator does rewrite) and
+    float64 decomposes via frexp into an exact (sign, exponent, 53-bit
+    mantissa) -> two u32 words.  For integers the result is bit-identical to
+    the old bitcast; for floats it is a different (still deterministic,
+    collision-free) 64-bit image, which is all hashing needs.
     """
-    u = jax.lax.bitcast_convert_type(x, jnp.uint32)  # shape (..., 2), [0]=lo
-    return u[..., 0], u[..., 1]
+    x = jnp.asarray(x)
+    if x.dtype.kind == "f":
+        neg = jnp.signbit(x)
+        m, e = jnp.frexp(jnp.abs(x))
+        m53 = m * (2.0 ** 53)               # integer-valued f64 < 2**53
+        lo = (m53 % 4294967296.0).astype(jnp.uint32)
+        hi = (m53 // 4294967296.0).astype(jnp.uint32)      # < 2**21
+        hi = hi ^ (e.astype(jnp.uint32) << 21) ^ (neg.astype(jnp.uint32) << 31)
+        return lo, hi
+    lo = (x & jnp.asarray(0xFFFFFFFF, x.dtype)).astype(jnp.uint32)
+    hi = ((x >> 32) & jnp.asarray(0xFFFFFFFF, x.dtype)).astype(jnp.uint32)
+    return lo, hi
 
 
 def _fold64(x):

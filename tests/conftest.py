@@ -13,3 +13,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon site hook pins the platform with jax.config.update("jax_platforms",
+# "axon,cpu") at register() time, which OVERRIDES the env var above — so when
+# the tunnel is alive, tests silently compile on the real chip.  Re-pin to cpu
+# through the same config channel (jax is already imported by sitecustomize,
+# so this import is free and no backend has initialized yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

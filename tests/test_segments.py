@@ -1,0 +1,131 @@
+"""Backend-adaptive segment reductions (ops/segments.py): the select+reduce
+path must agree with jax.ops.segment_* bit-for-bit on counts and integer
+sums, and to f64 rounding on float sums, for every dtype the aggregate layer
+feeds it.  The one-hot path is forced on (it normally only
+triggers on TPU) so CPU CI covers the TPU lowering's math."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from baikaldb_tpu.ops import segments
+from baikaldb_tpu.ops.segments import seg_max, seg_min, seg_sum
+
+
+@pytest.fixture
+def force_onehot(monkeypatch):
+    monkeypatch.setattr(segments, "_onehot_backend", lambda: True)
+
+
+def _ids(n, ns, rng):
+    gid = rng.integers(0, ns, n).astype(np.int32)
+    gid[rng.random(n) < 0.1] = ns  # dead bucket, must drop
+    return gid
+
+
+@pytest.mark.parametrize("n,ns", [(1, 1), (7, 3), (1000, 16), (5000, 130)])
+def test_counts_exact(force_onehot, n, ns):
+    rng = np.random.default_rng(n)
+    gid = jnp.asarray(_ids(n, ns, rng))
+    ones = jnp.ones(n, jnp.int64)
+    got = seg_sum(ones, gid, num_segments=ns + 1)
+    want = np.bincount(np.asarray(gid), minlength=ns + 1)
+    assert got.dtype == jnp.int64
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64])
+def test_int_sums_exact_with_negatives(force_onehot, dtype):
+    rng = np.random.default_rng(0)
+    n, ns = 4000, 20
+    gid = _ids(n, ns, rng)
+    lo, hi = (np.iinfo(dtype).min // 2, np.iinfo(dtype).max // 2)
+    x = rng.integers(lo, hi, n).astype(dtype)
+    got = seg_sum(jnp.asarray(x), jnp.asarray(gid), num_segments=ns + 1)
+    want = np.zeros(ns + 1, dtype)
+    np.add.at(want, gid, x)          # numpy wraps like two's complement
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_int64_wraparound_exact(force_onehot):
+    # sums that overflow int64 must wrap exactly like the scatter path
+    x = jnp.asarray([2**62, 2**62, 2**62, -5], jnp.int64)
+    gid = jnp.asarray([0, 0, 0, 1], jnp.int32)
+    got = np.asarray(seg_sum(x, gid, num_segments=3))
+    want = np.zeros(3, np.int64)
+    np.add.at(want, [0, 0, 0, 1], np.asarray(x))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_float_sums_tolerance(force_onehot, dtype):
+    rng = np.random.default_rng(1)
+    n, ns = 20000, 16
+    gid = _ids(n, ns, rng)
+    x = (rng.normal(size=n) * 1e3).astype(dtype)
+    got = np.asarray(seg_sum(jnp.asarray(x), jnp.asarray(gid),
+                             num_segments=ns + 1))
+    want = np.zeros(ns + 1, np.float64)
+    np.add.at(want, gid, x.astype(np.float64))
+    # accumulation is f64 either way; an f32 input only rounds once on output
+    rtol = 1e-9 if dtype == np.float64 else 2e-7
+    np.testing.assert_allclose(got, want, rtol=rtol, atol=1e-9)
+
+
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.float32, np.float64])
+def test_min_max(force_onehot, dtype):
+    rng = np.random.default_rng(2)
+    n, ns = 3000, 40
+    gid = _ids(n, ns, rng)
+    if np.issubdtype(dtype, np.integer):
+        x = rng.integers(-10**6, 10**6, n).astype(dtype)
+    else:
+        x = rng.normal(size=n).astype(dtype)
+    jx, jg = jnp.asarray(x), jnp.asarray(gid)
+    got_min = np.asarray(seg_min(jx, jg, num_segments=ns + 1))
+    got_max = np.asarray(seg_max(jx, jg, num_segments=ns + 1))
+    for k in range(ns):
+        vals = x[gid == k]
+        if len(vals):
+            assert got_min[k] == vals.min()
+            assert got_max[k] == vals.max()
+        else:
+            ident = (np.iinfo(dtype).max if np.issubdtype(dtype, np.integer)
+                     else np.inf)
+            assert got_min[k] == ident
+
+
+def test_large_segments_fall_back(force_onehot):
+    # above the threshold the scatter path must be chosen (and still work)
+    n, ns = 100, segments.ONEHOT_MAX_SEGMENTS + 1
+    gid = jnp.asarray(np.arange(n, dtype=np.int32))
+    got = np.asarray(seg_sum(jnp.ones(n, jnp.int64), gid, num_segments=ns))
+    assert got[:n].sum() == n
+
+
+def test_group_aggregate_dense_onehot_matches(force_onehot):
+    """End-to-end: the dense group-by produces identical results whichever
+    segment lowering is active."""
+    from baikaldb_tpu.column.batch import Column, ColumnBatch
+    from baikaldb_tpu.ops.hashagg import AggSpec, group_aggregate_dense
+    from baikaldb_tpu.types import LType
+
+    rng = np.random.default_rng(3)
+    n = 2500
+    g = rng.integers(0, 9, n).astype(np.int32)
+    v = rng.normal(size=n).astype(np.float64)
+    batch = ColumnBatch(("g", "v"),
+                        [Column(jnp.asarray(g), None, LType.INT32),
+                         Column(jnp.asarray(v), None, LType.FLOAT64)])
+    specs = [AggSpec("count_star", None, "n"), AggSpec("sum", "v", "s"),
+             AggSpec("min", "v", "mn"), AggSpec("max", "v", "mx")]
+    out = group_aggregate_dense(batch, ["g"], [9], specs)
+    live = np.asarray(out.sel)
+    for k in range(9):
+        rows = v[g == k]
+        assert live[k]
+        assert int(np.asarray(out.column("n").data)[k]) == len(rows)
+        np.testing.assert_allclose(np.asarray(out.column("s").data)[k],
+                                   rows.sum(), rtol=1e-9)
+        assert np.asarray(out.column("mn").data)[k] == rows.min()
+        assert np.asarray(out.column("mx").data)[k] == rows.max()
