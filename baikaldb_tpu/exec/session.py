@@ -31,17 +31,19 @@ from ..sql.parser import parse_sql
 from ..sql.stmt import (AlterTableStmt, CreateDatabaseStmt, CreateTableStmt, DeleteStmt,
                         DescribeStmt, DropDatabaseStmt, DropTableStmt,
                         ExplainStmt, InsertStmt, SelectStmt, ShowStmt,
-                        TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
+                        SetStmt, TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
 from ..meta.privileges import READ, WRITE, AccessError, PrivilegeManager
 from ..sql.stmt import (CreateUserStmt, DropUserStmt, GrantStmt, HandleStmt,
                         LoadDataStmt, RevokeStmt)
 from ..storage.column_store import TableStore, schema_to_arrow
 from ..types import Field, LType, Schema
+from ..utils import metrics
+from ..utils.flags import FLAGS
 from .executor import compile_plan
 
-# overflow retries settle at most one operator per re-trace, so a chain of
-# N joins can need N rounds in the worst case (each is a recompile)
-MAX_JOIN_RETRIES = 10
+# join overflow retry budget lives in FLAGS.join_retry_max: retries settle
+# at most one operator per re-trace, so a chain of N joins can need N rounds
+# in the worst case (each is a recompile)
 # INSERT..SELECT at or below this lands in the hot (WAL-durable) row tier;
 # above it, the bulk cold path (durable at the next checkpoint)
 HOT_INSERT_ROWS = 100_000
@@ -217,7 +219,9 @@ class Database:
                     "database": db, "name": t,
                     "fields": [[f.name, f.ltype.value, f.nullable]
                                for f in info.schema.fields],
-                    "indexes": [[ix.name, ix.kind, list(ix.columns)]
+                    "indexes": [[ix.name, ix.kind, list(ix.columns),
+                                 {k: v for k, v in ix.params.items()
+                                  if k != "fresh_at"}]   # refresh on restart
                                 for ix in info.indexes],
                     "options": dict(info.options or {}),
                 })
@@ -240,7 +244,9 @@ class Database:
         for t in saved["tables"]:
             fields = tuple(Field(n, LType(v), nullable)
                            for n, v, nullable in t["fields"])
-            indexes = [IndexInfo(n, k, cols) for n, k, cols in t["indexes"]]
+            indexes = [IndexInfo(ix[0], ix[1], ix[2],
+                                 ix[3] if len(ix) > 3 else {})
+                       for ix in t["indexes"]]
             info = self.catalog.create_table(
                 t["database"], t["name"], Schema(fields), indexes,
                 options=t["options"], if_not_exists=True)
@@ -278,6 +284,8 @@ class Session:
         # locks + buffered WAL writes + zero-copy region pre-images; the
         # reference's Transaction, src/engine/transaction.cpp:98-396)
         self._sql_txn: Optional[dict] = None
+        # session variables (@vars + per-session system vars via SET)
+        self.session_vars: dict = {}
         # binlog events buffered until COMMIT (discarded on ROLLBACK) so CDC
         # subscribers never see uncommitted changes
         self._txn_binlog: list = []
@@ -398,6 +406,25 @@ class Session:
 
     # -- public API -------------------------------------------------------
     def execute(self, sql: str) -> Result:
+        metrics.queries_total.add(1)
+        t0 = time.perf_counter()
+        try:
+            res = self._execute(sql)
+        except Exception:
+            metrics.queries_failed.add(1)
+            raise
+        finally:
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            metrics.query_latency.observe(dur_ms)
+            if dur_ms > FLAGS.slow_query_ms:
+                metrics.slow_queries.add(1)
+        if res.arrow is not None:
+            metrics.rows_returned.add(res.arrow.num_rows)
+        if res.affected_rows:
+            metrics.dml_rows.add(res.affected_rows)
+        return res
+
+    def _execute(self, sql: str) -> Result:
         stmts = parse_sql(sql)
         if self.db.qos is not None:
             # COMMIT/ROLLBACK are exempt: shedding load must never pin open
@@ -419,6 +446,22 @@ class Session:
     def query(self, sql: str) -> list[dict]:
         return self.execute(sql).to_pylist()
 
+    def _set_stmt(self, s: SetStmt) -> Result:
+        """SET (reference: setkv_planner.cpp): GLOBAL names update the flag
+        registry (and fire its listeners); @vars and unknown session names
+        (autocommit, sql_mode, ...) are stored per-session — MySQL clients
+        set those on connect and expect silent success."""
+        from ..utils.flags import FlagError
+        for name, value in [(s.name, s.value)] + list(s.more):
+            if s.scope == "global":
+                try:
+                    FLAGS.set_flag(name, value)
+                except FlagError as e:
+                    raise SqlError(str(e)) from None
+            else:
+                self.session_vars[name] = value
+        return Result()
+
     # -- dispatch -----------------------------------------------------------
     def _execute_stmt(self, s) -> Result:
         # DDL implicitly commits any open transaction (MySQL semantics);
@@ -431,7 +474,11 @@ class Session:
         if isinstance(s, ExplainStmt):
             if s.fmt == "analyze":
                 return self._explain_analyze(s.stmt)
-            plan = self._plan_select(s.stmt)
+            stmt_x = s.stmt
+            rw = self._try_rollup(stmt_x, refresh=False)
+            if rw is not None:
+                stmt_x = rw
+            plan = self._plan_select(stmt_x)
             return Result(columns=["plan"], plan_text=plan.tree_repr(),
                           arrow=pa.table({"plan": plan.tree_repr().split("\n")}))
         if isinstance(s, InsertStmt):
@@ -445,10 +492,21 @@ class Session:
         if isinstance(s, AlterTableStmt):
             return self._alter_table(s)
         if isinstance(s, DropTableStmt):
+            from ..index.rollup import rollup_table_name
             db = s.table.database or self.current_db
+            rollups = []
+            if self.db.catalog.has_table(db, s.table.name):
+                info = self.db.catalog.get_table(db, s.table.name)
+                rollups = [ix.name for ix in info.indexes
+                           if ix.kind == "rollup"]
             self.db.catalog.drop_table(db, s.table.name, s.if_exists)
             st = self.db.stores.pop(f"{db}.{s.table.name}", None)
             self._drop_durable(f"{db}.{s.table.name}", st)
+            for rn in rollups:
+                rt = rollup_table_name(s.table.name, rn)
+                self.db.catalog.drop_table(db, rt, if_exists=True)
+                self._drop_durable(f"{db}.{rt}",
+                                   self.db.stores.pop(f"{db}.{rt}", None))
             self.db.save_catalog()
             return Result()
         if isinstance(s, TruncateStmt):
@@ -469,6 +527,8 @@ class Session:
                 raise PlanError(f"unknown database {s.database!r}")
             self.current_db = s.database
             return Result()
+        if isinstance(s, SetStmt):
+            return self._set_stmt(s)
         if isinstance(s, TxnStmt):
             return self._txn_stmt(s)
         if isinstance(s, ShowStmt):
@@ -525,8 +585,9 @@ class Session:
             return Result(columns=["Database"],
                           arrow=pa.table({"Database": names}))
         if s.what == "tables":
+            from ..index.rollup import is_rollup_table
             db = s.database or self.current_db
-            names = cat.tables(db)
+            names = [n for n in cat.tables(db) if not is_rollup_table(n)]
             return Result(columns=[f"Tables_in_{db}"],
                           arrow=pa.table({f"Tables_in_{db}": names}))
         if s.what == "create_table":
@@ -576,18 +637,29 @@ class Session:
                     "Column_name": [r[4] for r in rows],
                 }))
         if s.what in ("variables", "status"):
-            vals = {
-                "version": "8.0.0-baikaldb-tpu",
-                "version_comment": "baikaldb_tpu (JAX/XLA)",
-                "lower_case_table_names": "0",
-                "max_allowed_packet": str(1 << 24),
-                "character_set_server": "utf8mb4",
-                "autocommit": "ON",
-            } if s.what == "variables" else {
-                "Threads_connected": str(len(self.db.processlist)),
-                "Queries": str(len(self.db.query_log)),
-                "Uptime": "0",
-            }
+            if s.what == "variables":
+                vals = {
+                    "version": "8.0.0-baikaldb-tpu",
+                    "version_comment": "baikaldb_tpu (JAX/XLA)",
+                    "lower_case_table_names": "0",
+                    "max_allowed_packet": str(1 << 24),
+                    "character_set_server": "utf8mb4",
+                    "autocommit": "ON",
+                }
+                # live flag table (gflags analog — SHOW VARIABLES is how
+                # MySQL clients inspect server config)
+                vals.update({k: str(v).lower() if isinstance(v, bool)
+                             else str(v)
+                             for k, v in FLAGS.snapshot().items()})
+            else:
+                vals = {
+                    "Threads_connected": str(len(self.db.processlist)),
+                    "Uptime": "0",
+                }
+                # flattened engine counters (bvar analog)
+                for name, st in metrics.REGISTRY.expose().items():
+                    for k, v in st.items():
+                        vals[f"{name}.{k}"] = str(v)
             items = sorted(vals.items())
             if s.pattern:
                 items = [(k, v) for k, v in items
@@ -855,11 +927,104 @@ class Session:
         self.db.save_catalog()
         return Result()
 
+    # -- rollup index (reference: I_ROLLUP, region_olap.cpp:530-651) -------
+    def _try_rollup(self, stmt: SelectStmt, refresh: bool = True):
+        """If a rollup covers this SELECT, refresh it (lazily, on base
+        version change) and return the rewritten statement.  ``refresh=False``
+        (EXPLAIN) only rewrites — plan display must stay side-effect-free."""
+        from ..index.rollup import try_rewrite
+        if getattr(self, "_in_rollup_refresh", False):
+            return None      # the refresh GROUP BY must hit the base table
+        if self._sql_txn is not None:
+            # inside a transaction the rollup can't see this txn's buffered
+            # writes (and refresh would write under the user's locks): scan
+            # the base table for read-your-writes semantics
+            return None
+        if stmt.table is None or stmt.joins or stmt.ctes or stmt.union:
+            return None
+        db = stmt.table.database or self.current_db
+        try:
+            info = self.db.catalog.get_table(db, stmt.table.name)
+        except ValueError:
+            return None
+        for ix in info.indexes:
+            if ix.kind != "rollup":
+                continue
+            keys = list(ix.columns)
+            measures = list(ix.params.get("measures", ()))
+            rw = try_rewrite(stmt, stmt.table.name, ix.name, keys, measures,
+                             db)
+            if rw is None:
+                continue
+            if refresh:
+                self._refresh_rollup(db, info, ix)
+            return rw
+        return None
+
+    def _refresh_rollup(self, db: str, info, ix) -> None:
+        """Rematerialize iff the base version moved (one GROUP BY program)."""
+        from ..index.rollup import refresh_sql, rollup_table_name
+        base_key = f"{db}.{info.name}"
+        base = self.db.stores[base_key]
+        if ix.params.get("fresh_at") == base.version:
+            return
+        rt = rollup_table_name(info.name, ix.name)
+        sql = refresh_sql(f"{db}.{info.name}", rt, list(ix.columns),
+                          list(ix.params.get("measures", ())))
+        self._in_rollup_refresh = True
+        try:
+            table = self._execute(sql).arrow
+        finally:
+            self._in_rollup_refresh = False
+        store = self.db.stores[f"{db}.{rt}"]
+        store.truncate()
+        if table is not None and table.num_rows:
+            rinfo = self.db.catalog.get_table(db, rt)
+            cast = pa.table({f.name: table.column(f.name).cast(
+                schema_to_arrow(rinfo.schema).field(f.name).type)
+                for f in rinfo.schema.fields})
+            store.insert_arrow(cast, self._tctx(store))
+        ix.params["fresh_at"] = base.version
+
+    def _alter_rollup(self, s: AlterTableStmt, db: str, info) -> Result:
+        from ..index.rollup import rollup_schema, rollup_table_name
+        if s.action == "add_rollup":
+            if any(ix.name == s.rollup_name for ix in info.indexes):
+                raise PlanError(f"index {s.rollup_name!r} exists")
+            for c in s.rollup_keys + s.rollup_aggs:
+                if c not in info.schema:
+                    raise PlanError(f"unknown column {c!r}")
+            if not s.rollup_keys:
+                raise PlanError("rollup needs at least one key column")
+            sch = rollup_schema(info.schema, s.rollup_keys, s.rollup_aggs)
+            rt = rollup_table_name(info.name, s.rollup_name)
+            rinfo = self.db.catalog.create_table(db, rt, sch, [])
+            self.db.stores[f"{db}.{rt}"] = self.db.make_store(rinfo)
+            info.indexes.append(IndexInfo(
+                s.rollup_name, "rollup", list(s.rollup_keys),
+                {"measures": list(s.rollup_aggs), "fresh_at": -1}))
+            self.db.save_catalog()
+            return Result()
+        # drop_rollup
+        kept = [ix for ix in info.indexes
+                if not (ix.kind == "rollup" and ix.name == s.rollup_name)]
+        if len(kept) == len(info.indexes):
+            raise PlanError(f"unknown rollup {s.rollup_name!r}")
+        info.indexes = kept
+        rt = rollup_table_name(info.name, s.rollup_name)
+        self.db.catalog.drop_table(db, rt, if_exists=True)
+        st = self.db.stores.pop(f"{db}.{rt}", None)
+        self._drop_durable(f"{db}.{rt}", st)
+        self.db.save_catalog()
+        return Result()
+
     def _alter_table(self, s: AlterTableStmt) -> Result:
         """ALTER TABLE ADD/DROP COLUMN (reference: online column DDL via the
         meta DDLManager; single-node: immediate schema rewrite)."""
         db = s.table.database or self.current_db
         info = self.db.catalog.get_table(db, s.table.name)
+        if s.action in ("add_rollup", "drop_rollup"):
+            return self._alter_rollup(s, db, info)
         fields = list(info.schema.fields)
         store = self._store(s.table)
         if s.action == "add_column":
@@ -1206,6 +1371,14 @@ class Session:
         per SQL text, one compiled executable per (table versions, shapes)."""
         from ..expr.ast import AggCall
 
+        rewritten = self._try_rollup(stmt)
+        if rewritten is not None:
+            # re-enter with the rollup statement; versions in the cache key
+            # come from the rollup store, which refresh just bumped
+            stmt = rewritten
+            cache_key = None if cache_key is None else \
+                (cache_key[0] + " /*rollup*/", cache_key[1])
+
         def _has_gc(e):
             if e is None:
                 return False
@@ -1225,6 +1398,8 @@ class Session:
                         for tk, v in entry["versions"].items())
             if stale:
                 entry = None
+        (metrics.plan_cache_hits if entry is not None
+         else metrics.plan_cache_misses).add(1)
         if entry is None:
             plan = self._plan_select(stmt)
             entry = {"plan": plan, "compiled": {}, "versions": {}}
@@ -1368,6 +1543,23 @@ class Session:
                 "duration_ms": pa.array([m for _, m, _ in log], pa.float64()),
                 "result_rows": pa.array([r for _, _, r in log], pa.int64()),
             }) if log else _empty_info("query_log")
+        if name == "metrics":
+            rows = [(mname, k, float(v))
+                    for mname, st in metrics.REGISTRY.expose().items()
+                    for k, v in st.items() if v is not None]
+            return pa.table({
+                "name": [r[0] for r in rows],
+                "field": [r[1] for r in rows],
+                "value": pa.array([r[2] for r in rows], pa.float64()),
+            }) if rows else _empty_info("metrics")
+        if name == "flags":
+            rows = FLAGS.describe()
+            return pa.table({
+                "name": [r[0] for r in rows],
+                "value": [str(r[1]) for r in rows],
+                "default_value": [str(r[2]) for r in rows],
+                "help": [r[3] for r in rows],
+            }) if rows else _empty_info("flags")
         raise PlanError(f"unknown information_schema table {name!r}")
 
     def _run_plan(self, entry: dict, batches: dict, shape_key) -> ColumnBatch:
@@ -1375,7 +1567,7 @@ class Session:
         # a plan with no scans has no sharded state (distribute leaves it
         # fully replicated) — run it as a plain single-device program
         mesh = self.mesh if batches else None
-        for _ in range(MAX_JOIN_RETRIES + 1):
+        for _ in range(int(FLAGS.join_retry_max) + 1):
             pair = entry["compiled"].get(shape_key)
             if pair is None:
                 raw = compile_plan(plan, mesh=mesh)

@@ -153,6 +153,13 @@ class Catalog:
         "query_log": Schema((Field("query", LType.STRING),
                              Field("duration_ms", LType.FLOAT64),
                              Field("result_rows", LType.INT64))),
+        "metrics": Schema((Field("name", LType.STRING),
+                           Field("field", LType.STRING),
+                           Field("value", LType.FLOAT64))),
+        "flags": Schema((Field("name", LType.STRING),
+                         Field("value", LType.STRING),
+                         Field("default_value", LType.STRING),
+                         Field("help", LType.STRING))),
     }
 
     def get_table(self, database: str, name: str) -> TableInfo:

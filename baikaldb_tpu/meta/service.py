@@ -76,6 +76,10 @@ class BalanceOrder:
 class HeartbeatResponse:
     orders: list[BalanceOrder] = field(default_factory=list)
     schema_version: int = 0
+    # dynamic config pushed to this instance (reference:
+    # update_instance_param, cluster_manager.h:128,141-143 — flags changed
+    # cluster-wide at runtime ride the heartbeat response)
+    param_overrides: dict = field(default_factory=dict)
 
 
 class Tso:
@@ -126,6 +130,8 @@ class MetaService:
         self.tso = Tso()
         self.schema_version = 1
         self._region_ids = itertools.count(1)
+        # address (or "*") -> {flag: value} dynamic overrides
+        self._params: dict[str, dict] = {}
         self._mu = threading.RLock()
 
     # -- cluster ---------------------------------------------------------
@@ -235,7 +241,16 @@ class MetaService:
                     r.leader = req.address
             resp = HeartbeatResponse(schema_version=self.schema_version)
             resp.orders.extend(self._orders_for(req.address))
+            resp.param_overrides = dict(self._params.get("*", {}))
+            resp.param_overrides.update(self._params.get(req.address, {}))
             return resp
+
+    def set_instance_param(self, address: str, name: str, value) -> None:
+        """Stage a dynamic config override for one instance (or "*" for the
+        whole cluster); delivered on every subsequent heartbeat (reference:
+        cluster_manager update_instance_param)."""
+        with self._mu:
+            self._params.setdefault(address, {})[name] = value
 
     def tick(self) -> list[BalanceOrder]:
         """Health check + global balancing (reference: meta background

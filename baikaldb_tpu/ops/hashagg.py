@@ -267,10 +267,92 @@ def group_aggregate_dense(batch: ColumnBatch, key_names: list[str],
         code = jnp.where(code >= dom, 0, code)
         out_names.append(name)
         out_cols.append(Column(code.astype(c.data.dtype), validity, c.ltype, c.dictionary))
-    for s in specs:
-        out_names.append(s.out_name)
-        out_cols.append(_segment_one(batch, s, gid_live, ng, sel))
+    pallas_cols = _pallas_dense_cols(batch, specs, gid, ng, sel)
+    if pallas_cols is not None:
+        out_names.extend(s.out_name for s in specs)
+        out_cols.extend(pallas_cols)
+    else:
+        for s in specs:
+            out_names.append(s.out_name)
+            out_cols.append(_segment_one(batch, s, gid_live, ng, sel))
     return ColumnBatch(tuple(out_names), out_cols, present, None)
+
+
+def _pallas_dense_cols(batch, specs, gid, ng: int, sel):
+    """Mid-cardinality dense group-by through the Pallas MXU kernels
+    (ops/pallas_kernels.py), when they're exact enough for the spec list:
+
+    - only COUNT/COUNT(*)/SUM/AVG/MIN/MAX, no DISTINCT;
+    - value columns must be floats (counts are exact; float sums carry the
+      kernel's ~1e-7 relative error); MIN/MAX additionally need FLOAT32
+      columns (f64 values would be rounded by the f32 pipeline);
+    - group count in (select+reduce crossover, PALLAS_MAX_GROUPS];
+    - TPU backend + FLAGS.pallas_group_kernels.
+
+    Returns the aggregate Columns (spec order), or None to use segments."""
+    import jax as _jax
+
+    from ..utils.flags import FLAGS
+    from . import segments
+    from .pallas_kernels import (PALLAS_AVAILABLE, PALLAS_MAX_GROUPS,
+                                 filtered_group_sum, fused_group_aggregate,
+                                 partition_histogram)
+
+    try:
+        enabled = bool(FLAGS.pallas_group_kernels)
+    except Exception:
+        enabled = False
+    if not (enabled and PALLAS_AVAILABLE
+            and _jax.default_backend() not in ("cpu",)
+            and segments._max_segments() < ng + 1 <= PALLAS_MAX_GROUPS):
+        return None
+    for s in specs:
+        if s.distinct or s.op not in ("count_star", "count", "sum", "avg",
+                                      "min", "max"):
+            return None
+        if s.op != "count_star":
+            lt = batch.column(s.input).ltype
+            if lt not in (LType.FLOAT32, LType.FLOAT64):
+                return None
+            if s.op in ("min", "max") and lt is not LType.FLOAT32:
+                return None
+    fused: dict = {}          # input name -> (cnt, sm, mn, mx)
+    star_counts = None
+    cols = []
+    for s in specs:
+        if s.op == "count_star":
+            if star_counts is None:
+                star_counts = partition_histogram(gid, sel, ng)
+            cols.append(Column(star_counts.astype(jnp.int64), None,
+                               LType.INT64))
+            continue
+        c = batch.column(s.input)
+        if s.input not in fused:
+            live = c.valid_mask() & sel
+            # min/max lanes cost extra VPU work per group: only the full
+            # kernel when some spec on this column asks for them
+            if any(x.op in ("min", "max") and x.input == s.input
+                   for x in specs):
+                fused[s.input] = fused_group_aggregate(gid, c.data, live, ng)
+            else:
+                cnt_, sm_ = filtered_group_sum(gid, c.data, live, ng)
+                fused[s.input] = (cnt_, sm_, None, None)
+        cnt, sm, mn, mx = fused[s.input]
+        nonempty = cnt > 0
+        if s.op == "count":
+            cols.append(Column(cnt.astype(jnp.int64), None, LType.INT64))
+        elif s.op == "sum":
+            cols.append(Column(sm.astype(jnp.float64), nonempty,
+                               agg_result_type("sum", c.ltype)))
+        elif s.op == "avg":
+            cols.append(Column(sm.astype(jnp.float64)
+                               / jnp.maximum(cnt, 1).astype(jnp.float64),
+                               nonempty, LType.FLOAT64))
+        elif s.op == "min":
+            cols.append(Column(mn.astype(c.data.dtype), nonempty, c.ltype))
+        else:
+            cols.append(Column(mx.astype(c.data.dtype), nonempty, c.ltype))
+    return cols
 
 
 def _segment_one(batch: ColumnBatch, s: AggSpec, gid, ng: int, sel) -> Column:

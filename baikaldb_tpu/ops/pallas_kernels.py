@@ -1,22 +1,33 @@
-"""Hand-written Pallas TPU kernels for the hottest query path.
+"""Hand-written Pallas TPU kernels for mid-cardinality dense group-by.
 
-XLA's generic lowering handles most relational kernels well (fused
-elementwise + segment_sum), but the single hottest OLAP loop — scan ->
-filter -> dense group-by partial aggregation (BASELINE configs #1/#2) — can
-be expressed as one VMEM-resident pass that turns the per-row scatter of
-``segment_sum`` into an MXU matmul against a one-hot group matrix:
+Three lowerings cover the dense group-by (measured on v5e, 100M rows):
 
-    per row-tile:  onehot[B, G] = (codes == iota(G)) & pred
-                   counts[G]  += ones[B]  @ onehot      (MXU)
-                   sums[G]    += values[B] @ onehot     (MXU)
+- ``num_groups <= 512``: XLA fused select+reduce (ops/segments.py) — one
+  bandwidth-bound pass, ~1.5ms per segment.
+- ``512 < num_groups <= PALLAS_MAX_GROUPS``: THESE kernels — the one-hot
+  lives in VMEM as an MXU operand, so cost grows ~4x slower with group count
+  than the select+reduce (~200ms at 512 groups where select+reduce takes
+  ~850ms).
+- beyond: scatter / sort strategies.
 
-The grid walks row tiles; the accumulator block stays pinned in VMEM across
-grid steps (same output block for every i, initialized at i == 0) — the
-standard Pallas reduction pattern.  For small group counts this keeps the
-whole reduction on-chip: one HBM read of the data, zero scatter traffic.
+Mosaic constraints discovered on real hardware (every one of these failed
+the remote compile until restructured):
+- no 1-D intermediates: a ``(R,128)`` tile cannot reshape/broadcast through
+  a flat ``(R*128,)`` vector; the one-hot is built per sublane-row from a
+  ``(128, R)`` transpose instead, and each row's partials go to a distinct
+  out_ref sublane.
+- no 64-bit types anywhere in the traced kernel — the enclosing program
+  runs in jax x64 mode, so the launcher traces under ``enable_x64(False)``.
+- ``precision=HIGHEST`` is IGNORED by the Mosaic dot: f32 operands truncate
+  to bf16 (relative error ~2^-8 per product).  Values are split into three
+  bf16-exact components (8+8+8 significand bits) and contracted separately
+  — products against a 0/1 one-hot are then exact; a Kahan accumulator row
+  in VMEM scratch compensates the cross-step f32 adds.
 
-Falls back to the XLA segment_sum path when Pallas is unavailable; tests run
-in interpret mode on CPU.
+The public entry points pad rows to full blocks with out-of-range codes
+(their one-hot rows are all zero) and fall back to the XLA lowering off-TPU
+or when Pallas is unavailable; ``interpret=True`` runs the same kernels on
+CPU for tests.
 """
 
 from __future__ import annotations
@@ -26,89 +37,219 @@ import functools
 import jax
 import jax.numpy as jnp
 
-LANE = 128
-
-
-def _pad_to(x, multiple, fill):
-    n = x.shape[0]
-    target = max(multiple, -(-n // multiple) * multiple)
-    if target == n:
-        return x
-    return jnp.concatenate([x, jnp.full((target - n,), fill, x.dtype)])
-
-
-def _kernel(g_ref, v_ref, m_ref, out_ref, *, ng_pad: int):
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        out_ref[:, :] = jnp.zeros_like(out_ref)
-
-    g = g_ref[:, :].reshape(-1)                      # [B]
-    v = v_ref[:, :].reshape(-1)
-    m = m_ref[:, :].reshape(-1)
-    b = g.shape[0]
-    groups = jax.lax.broadcasted_iota(jnp.int32, (b, ng_pad), 1)
-    onehot = ((g[:, None] == groups) & m[:, None]).astype(jnp.float32)
-    counts = jnp.dot(jnp.ones((1, b), jnp.float32), onehot,
-                     preferred_element_type=jnp.float32)       # [1, G]
-    sums = jnp.dot(v.reshape(1, b), onehot,
-                   preferred_element_type=jnp.float32)         # [1, G]
-    out_ref[0:1, :] += counts
-    out_ref[1:2, :] += sums
-
-
 try:  # Pallas is part of jax; guard for stripped builds
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+    from jax.experimental.pallas import tpu as pltpu
     PALLAS_AVAILABLE = True
 except Exception:  # pragma: no cover
     PALLAS_AVAILABLE = False
 
+try:
+    from jax._src.config import enable_x64 as _x64_scope  # context manager
+except Exception:  # pragma: no cover
+    import contextlib
 
-def _launch_reduction(kernel, codes, mask, num_out: int, block_rows: int,
-                      interpret: bool, values=None):
-    """Shared launch scaffolding for the tiled one-hot reductions: pad rows
-    to full tiles, range-mask out-of-domain codes, reshape to (rows, LANE)
-    blocks, and run with a pinned (8, padded) f32 accumulator block."""
-    n_pad = -(-num_out // LANE) * LANE
-    rows = block_rows
-    flat = rows * LANE
-    g = _pad_to(codes.astype(jnp.int32), flat, jnp.int32(-1))
-    m = _pad_to(mask, flat, False) & (g >= 0) & (g < num_out)
-    steps = g.shape[0] // flat
-    args = [g.reshape(steps * rows, LANE)]
+    def _x64_scope(_):
+        return contextlib.nullcontext()
+
+LANE = 128
+R_BLOCK = 8                  # sublane rows per grid step = out_ref sublanes
+PALLAS_MAX_GROUPS = 4096
+
+_BIG = 3.4e38                # python float (a jnp constant would be captured
+#                              by the kernel closure, which pallas_call rejects)
+
+
+def _bf16_split3(v):
+    """Split f32 lanes into three bf16-exact f32 components (v = a+b+c).
+
+    The Mosaic dot truncates f32 operands to bf16; contracting each
+    component separately keeps every product against a 0/1 one-hot exact."""
+    a = v.astype(jnp.bfloat16).astype(jnp.float32)
+    r = v - a
+    b = r.astype(jnp.bfloat16).astype(jnp.float32)
+    c = r - b
+    return a, b, c
+
+
+def _kahan_add(o_ref, comp_ref, row, crow, delta):
+    """out[row] += delta, compensation tracked in scratch row ``crow``."""
+    y = delta - comp_ref[crow:crow + 1, :]
+    t = o_ref[row:row + 1, :] + y
+    comp_ref[crow:crow + 1, :] = (t - o_ref[row:row + 1, :]) - y
+    o_ref[row:row + 1, :] = t
+
+
+def _sum_kernel(g_ref, v_ref, o_ref, comp_ref, *, ng: int):
+    """counts -> o[0:8], sums -> o[8:16] (one sublane per block row)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[:, :] = jnp.zeros_like(o_ref)
+        comp_ref[:, :] = jnp.zeros_like(comp_ref)
+
+    it = jax.lax.broadcasted_iota(jnp.int32, (LANE, ng), 1)
+    gt = jnp.transpose(g_ref[:, :])                    # (LANE, R)
+    ones = jnp.ones((1, LANE), jnp.float32)
+    for r in range(R_BLOCK):
+        oh = (gt[:, r:r + 1] == it).astype(jnp.float32)   # (LANE, ng)
+        o_ref[r:r + 1, :] += jnp.dot(ones, oh,
+                                     preferred_element_type=jnp.float32)
+        va, vb, vc = _bf16_split3(v_ref[r:r + 1, :])
+        sm = (jnp.dot(va, oh, preferred_element_type=jnp.float32)
+              + jnp.dot(vb, oh, preferred_element_type=jnp.float32)
+              + jnp.dot(vc, oh, preferred_element_type=jnp.float32))
+        _kahan_add(o_ref, comp_ref, 8 + r, r, sm)
+
+
+def _agg_kernel(g_ref, v_ref, o_ref, comp_ref, *, ng: int):
+    """counts/sums as _sum_kernel, plus mins -> o[16:24], maxs -> o[24:32]."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[0:16, :] = jnp.zeros_like(o_ref[0:16, :])
+        o_ref[16:24, :] = jnp.full_like(o_ref[16:24, :], _BIG)
+        o_ref[24:32, :] = jnp.full_like(o_ref[24:32, :], -_BIG)
+        comp_ref[:, :] = jnp.zeros_like(comp_ref)
+
+    it = jax.lax.broadcasted_iota(jnp.int32, (LANE, ng), 1)
+    gt = jnp.transpose(g_ref[:, :])
+    vt = jnp.transpose(v_ref[:, :])
+    ones = jnp.ones((1, LANE), jnp.float32)
+    for r in range(R_BLOCK):
+        hit = gt[:, r:r + 1] == it                        # (LANE, ng)
+        oh = hit.astype(jnp.float32)
+        o_ref[r:r + 1, :] += jnp.dot(ones, oh,
+                                     preferred_element_type=jnp.float32)
+        va, vb, vc = _bf16_split3(v_ref[r:r + 1, :])
+        sm = (jnp.dot(va, oh, preferred_element_type=jnp.float32)
+              + jnp.dot(vb, oh, preferred_element_type=jnp.float32)
+              + jnp.dot(vc, oh, preferred_element_type=jnp.float32))
+        _kahan_add(o_ref, comp_ref, 8 + r, r, sm)
+        vcol = vt[:, r:r + 1]                             # (LANE, 1)
+        mins = jnp.min(jnp.where(hit, vcol, _BIG), axis=0, keepdims=True)
+        maxs = jnp.max(jnp.where(hit, vcol, -_BIG), axis=0, keepdims=True)
+        o_ref[16 + r:17 + r, :] = jnp.minimum(o_ref[16 + r:17 + r, :], mins)
+        o_ref[24 + r:25 + r, :] = jnp.maximum(o_ref[24 + r:25 + r, :], maxs)
+
+
+def _hist_kernel(g_ref, o_ref, *, ng: int):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[:, :] = jnp.zeros_like(o_ref)
+
+    it = jax.lax.broadcasted_iota(jnp.int32, (LANE, ng), 1)
+    gt = jnp.transpose(g_ref[:, :])
+    ones = jnp.ones((1, LANE), jnp.float32)
+    for r in range(R_BLOCK):
+        oh = (gt[:, r:r + 1] == it).astype(jnp.float32)
+        o_ref[r:r + 1, :] += jnp.dot(ones, oh,
+                                     preferred_element_type=jnp.float32)
+
+
+def _prep(codes, mask, num_groups, values=None):
+    """Mask/pad to (steps*R_BLOCK, LANE) blocks; dead rows get code ng_pad
+    (matches no one-hot lane, incl. the padding lanes we slice off)."""
+    ng_pad = -(-num_groups // LANE) * LANE
+    flat = R_BLOCK * LANE
+    n = codes.shape[0]
+    target = max(flat, -(-n // flat) * flat)
+    g = codes.astype(jnp.int32)
+    live = mask & (g >= 0) & (g < num_groups)
+    g = jnp.where(live, g, ng_pad)
+    if target != n:
+        g = jnp.concatenate([g, jnp.full((target - n,), ng_pad, jnp.int32)])
+    rows = target // LANE
+    out = [g.reshape(rows, LANE)]
     if values is not None:
-        v = _pad_to(values.astype(jnp.float32), flat, jnp.float32(0))
-        args.append(v.reshape(steps * rows, LANE))
-    args.append(m.reshape(steps * rows, LANE))
-    out = pl.pallas_call(
-        functools.partial(kernel, ng_pad=n_pad),
-        grid=(steps,),
-        in_specs=[pl.BlockSpec((rows, LANE), lambda i: (i, 0))
-                  for _ in args],
-        out_specs=pl.BlockSpec((8, n_pad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((8, n_pad), jnp.float32),
-        interpret=interpret,
-    )(*args)
-    return out
+        v = jnp.where(live, values.astype(jnp.float32), 0.0)
+        if target != n:
+            v = jnp.concatenate([v, jnp.zeros((target - n,), jnp.float32)])
+        out.append(v.reshape(rows, LANE))
+    return out, rows // R_BLOCK, ng_pad
 
 
-@functools.partial(jax.jit, static_argnames=("num_groups", "block_rows",
-                                             "interpret"))
+@functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
 def filtered_group_sum(codes, values, mask, num_groups: int,
-                       block_rows: int = 512, interpret: bool = False):
+                       interpret: bool = False):
     """Fused filter + dense group-by COUNT/SUM.
 
-    codes: int32 [N] in [0, num_groups); values: [N] (cast to f32);
-    mask: bool [N] live-row predicate.  -> (counts [num_groups] f32,
-    sums [num_groups] f32).  Rows with out-of-range codes are dropped.
-    """
+    codes: int [N]; values: [N] (contracted as f32); mask: bool [N].
+    -> (counts [num_groups] f32, sums [num_groups] f32).  Rows failing the
+    mask or with out-of-range codes drop."""
     if not PALLAS_AVAILABLE:
         return _xla_fallback(codes, values, mask, num_groups)
-    out = _launch_reduction(_kernel, codes, mask, num_groups, block_rows,
-                            interpret, values=values)
-    return out[0, :num_groups], out[1, :num_groups]
+    with _x64_scope(False):
+        (g2, v2), steps, ng_pad = _prep(codes, mask, num_groups, values)
+        out = pl.pallas_call(
+            functools.partial(_sum_kernel, ng=ng_pad),
+            grid=(steps,),
+            in_specs=[pl.BlockSpec((R_BLOCK, LANE), lambda i: (i, 0))] * 2,
+            out_specs=pl.BlockSpec((16, ng_pad), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((16, ng_pad), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((8, ng_pad), jnp.float32)],
+            interpret=interpret,
+        )(g2, v2)
+    counts = out[0:8].astype(jnp.float64).sum(axis=0).astype(jnp.float32)
+    sums = out[8:16].astype(jnp.float64).sum(axis=0).astype(jnp.float32)
+    return counts[:num_groups], sums[:num_groups]
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "interpret"))
+def fused_group_aggregate(codes, values, mask, num_groups: int,
+                          interpret: bool = False):
+    """Fused filter + dense group-by COUNT/SUM/MIN/MAX in ONE VMEM pass.
+
+    -> (counts, sums, mins, maxs) [num_groups] f32; min/max lanes of empty
+    groups hold +/-3.4e38 (count==0 marks them)."""
+    if not PALLAS_AVAILABLE:
+        return _xla_agg_fallback(codes, values, mask, num_groups)
+    with _x64_scope(False):
+        (g2, v2), steps, ng_pad = _prep(codes, mask, num_groups, values)
+        out = pl.pallas_call(
+            functools.partial(_agg_kernel, ng=ng_pad),
+            grid=(steps,),
+            in_specs=[pl.BlockSpec((R_BLOCK, LANE), lambda i: (i, 0))] * 2,
+            out_specs=pl.BlockSpec((32, ng_pad), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((32, ng_pad), jnp.float32),
+            scratch_shapes=[pltpu.VMEM((8, ng_pad), jnp.float32)],
+            interpret=interpret,
+        )(g2, v2)
+    counts = out[0:8].astype(jnp.float64).sum(axis=0).astype(jnp.float32)
+    sums = out[8:16].astype(jnp.float64).sum(axis=0).astype(jnp.float32)
+    mins = jnp.minimum(out[16:24].min(axis=0), _BIG)
+    maxs = jnp.maximum(out[24:32].max(axis=0), -_BIG)
+    return (counts[:num_groups], sums[:num_groups],
+            mins[:num_groups], maxs[:num_groups])
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions", "interpret"))
+def partition_histogram(dest, mask, num_partitions: int,
+                        interpret: bool = False):
+    """Per-destination row counts for a hash shuffle, as one MXU pass (sizes
+    exchange capacities exactly so the repartition compiles with the right
+    cap on the FIRST attempt)."""
+    if not PALLAS_AVAILABLE:
+        gid = jnp.where(mask & (dest >= 0) & (dest < num_partitions),
+                        dest, num_partitions)
+        return jax.ops.segment_sum(
+            jnp.ones(dest.shape[0], jnp.float32), gid,
+            num_segments=num_partitions + 1)[:num_partitions]
+    with _x64_scope(False):
+        (g2,), steps, ng_pad = _prep(dest, mask, num_partitions)
+        out = pl.pallas_call(
+            functools.partial(_hist_kernel, ng=ng_pad),
+            grid=(steps,),
+            in_specs=[pl.BlockSpec((R_BLOCK, LANE), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, ng_pad), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((8, ng_pad), jnp.float32),
+            interpret=interpret,
+        )(g2)
+    return out.astype(jnp.float64).sum(axis=0).astype(jnp.float32)[:num_partitions]
 
 
 def _xla_fallback(codes, values, mask, num_groups: int):
@@ -121,60 +262,6 @@ def _xla_fallback(codes, values, mask, num_groups: int):
     return counts, sums
 
 
-# ---------------------------------------------------------------------------
-# full fused aggregate: COUNT / SUM / MIN / MAX in one VMEM pass
-
-_BIG = 3.4e38      # python float: a jnp constant would be captured by the
-#                    kernel closure, which pallas_call rejects
-
-
-def _agg_kernel(g_ref, v_ref, m_ref, out_ref, *, ng_pad: int):
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        out_ref[0:2, :] = jnp.zeros_like(out_ref[0:2, :])
-        out_ref[2:3, :] = jnp.full_like(out_ref[2:3, :], _BIG)
-        out_ref[3:4, :] = jnp.full_like(out_ref[3:4, :], -_BIG)
-
-    g = g_ref[:, :].reshape(-1)
-    v = v_ref[:, :].reshape(-1)
-    m = m_ref[:, :].reshape(-1)
-    b = g.shape[0]
-    groups = jax.lax.broadcasted_iota(jnp.int32, (b, ng_pad), 1)
-    hit = (g[:, None] == groups) & m[:, None]
-    onehot = hit.astype(jnp.float32)
-    counts = jnp.dot(jnp.ones((1, b), jnp.float32), onehot,
-                     preferred_element_type=jnp.float32)
-    sums = jnp.dot(v.reshape(1, b), onehot,
-                   preferred_element_type=jnp.float32)
-    # min/max: masked broadcast + reduce along the row axis (VPU); the
-    # accumulator row stays pinned in VMEM like the sums
-    vb = v[:, None]
-    mins = jnp.min(jnp.where(hit, vb, _BIG), axis=0, keepdims=True)
-    maxs = jnp.max(jnp.where(hit, vb, -_BIG), axis=0, keepdims=True)
-    out_ref[0:1, :] += counts
-    out_ref[1:2, :] += sums
-    out_ref[2:3, :] = jnp.minimum(out_ref[2:3, :], mins)
-    out_ref[3:4, :] = jnp.maximum(out_ref[3:4, :], maxs)
-
-
-@functools.partial(jax.jit, static_argnames=("num_groups", "block_rows",
-                                             "interpret"))
-def fused_group_aggregate(codes, values, mask, num_groups: int,
-                          block_rows: int = 512, interpret: bool = False):
-    """Fused filter + dense group-by COUNT/SUM/MIN/MAX in ONE HBM pass
-    (SURVEY §7 hard part #4: the MIN/MAX-capable sibling of
-    filtered_group_sum).  -> (counts, sums, mins, maxs) [num_groups] f32;
-    min/max lanes of empty groups hold +/-3.4e38 (count==0 marks them)."""
-    if not PALLAS_AVAILABLE:
-        return _xla_agg_fallback(codes, values, mask, num_groups)
-    out = _launch_reduction(_agg_kernel, codes, mask, num_groups, block_rows,
-                            interpret, values=values)
-    return (out[0, :num_groups], out[1, :num_groups],
-            out[2, :num_groups], out[3, :num_groups])
-
-
 def _xla_agg_fallback(codes, values, mask, num_groups: int):
     live = mask & (codes >= 0) & (codes < num_groups)
     gid = jnp.where(live, codes, num_groups)
@@ -183,8 +270,6 @@ def _xla_agg_fallback(codes, values, mask, num_groups: int):
                                  num_segments=num_groups + 1)[:num_groups]
     sums = jax.ops.segment_sum(v, gid,
                                num_segments=num_groups + 1)[:num_groups]
-    # clamp the +/-inf identities of empty segments to the documented
-    # sentinel so both paths agree (and results stay JSON-serializable)
     mins = jnp.minimum(jax.ops.segment_min(
         jnp.where(live, v, _BIG), gid,
         num_segments=num_groups + 1)[:num_groups], _BIG)
@@ -192,42 +277,3 @@ def _xla_agg_fallback(codes, values, mask, num_groups: int):
         jnp.where(live, v, -_BIG), gid,
         num_segments=num_groups + 1)[:num_groups], -_BIG)
     return counts, sums, mins, maxs
-
-
-# ---------------------------------------------------------------------------
-# radix-partition histogram (the shuffle-sizing building block)
-
-
-def _hist_kernel(d_ref, m_ref, out_ref, *, ng_pad: int):
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        out_ref[:, :] = jnp.zeros_like(out_ref)
-
-    d = d_ref[:, :].reshape(-1)
-    m = m_ref[:, :].reshape(-1)
-    b = d.shape[0]
-    parts = jax.lax.broadcasted_iota(jnp.int32, (b, ng_pad), 1)
-    onehot = ((d[:, None] == parts) & m[:, None]).astype(jnp.float32)
-    out_ref[0:1, :] += jnp.dot(jnp.ones((1, b), jnp.float32), onehot,
-                               preferred_element_type=jnp.float32)
-
-
-@functools.partial(jax.jit, static_argnames=("num_partitions", "block_rows",
-                                             "interpret"))
-def partition_histogram(dest, mask, num_partitions: int,
-                        block_rows: int = 512, interpret: bool = False):
-    """Per-destination row counts for a hash shuffle, as one MXU pass
-    (SURVEY §7 hard part #2: the counting phase of radix partition — XLA's
-    sort does the reorder, this sizes exchange capacities exactly so the
-    repartition compiles with the right cap on the FIRST attempt)."""
-    if not PALLAS_AVAILABLE:
-        gid = jnp.where(mask & (dest >= 0) & (dest < num_partitions),
-                        dest, num_partitions)
-        return jax.ops.segment_sum(
-            jnp.ones(dest.shape[0], jnp.float32), gid,
-            num_segments=num_partitions + 1)[:num_partitions]
-    out = _launch_reduction(_hist_kernel, dest, mask, num_partitions,
-                            block_rows, interpret)
-    return out[0, :num_partitions]

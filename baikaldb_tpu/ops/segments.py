@@ -34,8 +34,17 @@ def _onehot_backend() -> bool:
     return jax.default_backend() not in ("cpu",)
 
 
+def _max_segments() -> int:
+    """Flag-tunable crossover (utils/flags.py: onehot_max_segments)."""
+    try:
+        from ..utils.flags import FLAGS
+        return int(FLAGS.onehot_max_segments)
+    except Exception:
+        return ONEHOT_MAX_SEGMENTS
+
+
 def _use_onehot(num_segments: int) -> bool:
-    return _onehot_backend() and num_segments <= ONEHOT_MAX_SEGMENTS
+    return _onehot_backend() and num_segments <= _max_segments()
 
 
 def seg_sum(x, gid, num_segments: int):

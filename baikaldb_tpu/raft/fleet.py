@@ -84,7 +84,20 @@ class StoreFleet:
                 if node.core.role == LEADER:
                     leader_ids.append(rid)
             if not dead:
-                self.meta.heartbeat(HeartbeatRequest(a, regions, leader_ids))
+                resp = self.meta.heartbeat(
+                    HeartbeatRequest(a, regions, leader_ids))
+                self._apply_params(resp.param_overrides)
+
+    def _apply_params(self, overrides: dict):
+        """Apply meta-pushed dynamic config (reference: stores applying
+        update_instance_param from heartbeat responses).  Unknown names are
+        ignored — meta may be newer than this store."""
+        from ..utils.flags import FLAGS, FlagError
+        for name, value in overrides.items():
+            try:
+                FLAGS.set_flag(name, value)   # no-op (no listeners) when
+            except FlagError:                  # the value is unchanged
+                pass
 
     def kill_store(self, address: str):
         """Hard-fail one store node across every region it hosts."""

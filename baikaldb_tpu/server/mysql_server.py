@@ -151,6 +151,8 @@ class MySQLServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            from ..utils import metrics
+            metrics.connections_total.add(1)
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
             t.start()
             self._threads.append(t)

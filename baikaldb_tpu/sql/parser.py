@@ -20,7 +20,7 @@ from .stmt import (AlterTableStmt, ColumnDef, CreateDatabaseStmt,
                    DropDatabaseStmt, DropTableStmt, DropUserStmt, ExplainStmt,
                    GrantStmt, HandleStmt, InsertStmt, JoinClause,
                    LoadDataStmt, OrderItem, RevokeStmt, SelectItem,
-                   SelectStmt, ShowStmt, TableRef, TruncateStmt, TxnStmt,
+                   SelectStmt, SetStmt, ShowStmt, TableRef, TruncateStmt, TxnStmt,
                    UpdateStmt, UseStmt)
 
 _AGG_FUNCS = {"count", "sum", "avg", "min", "max", "stddev", "std",
@@ -152,6 +152,8 @@ class Parser:
         if t.value == "use":
             self.advance()
             return UseStmt(self.ident())
+        if t.value == "set":
+            return self.set_stmt()
         if t.value == "begin":
             self.advance()
             return TxnStmt("begin")
@@ -385,6 +387,48 @@ class Parser:
             return -self.literal_value()
         raise SqlError(f"expected literal in VALUES, got {t.value!r} at {t.pos}")
 
+    def set_stmt(self) -> "SetStmt":
+        """SET [GLOBAL|SESSION] name = literal [, name = literal ...] and
+        SET NAMES charset [COLLATE c] (what MySQL connectors send on
+        connect); @vars keep their @.  Multi-assignments fold into one
+        SetStmt carrying `more` pairs."""
+        self.expect_kw("set")
+        # SET NAMES utf8mb4 [COLLATE ...]: charset handshake, store as a
+        # session var
+        t = self.peek()
+        if t.kind == "IDENT" and t.value.lower() == "names":
+            self.advance()
+            cs = self.advance().value
+            if self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "collate":
+                self.advance()
+                self.advance()
+            return SetStmt("names", cs, "session")
+        scope = "session"
+        if t.kind in ("IDENT", "KW") and t.value.lower() in ("global",
+                                                            "session"):
+            # scope word only when an assignment target follows (a flag may
+            # not be literally named "global"/"session")
+            nxt = self.peek(1)
+            if not (nxt.kind == "OP" and nxt.value == "="):
+                scope = t.value.lower()
+                self.advance()
+        assigns = [self._set_assignment()]
+        while self.try_op(","):
+            assigns.append(self._set_assignment())
+        name, value = assigns[0]
+        return SetStmt(name, value, scope, more=assigns[1:])
+
+    def _set_assignment(self) -> tuple:
+        t = self.peek()
+        if t.kind == "OP" and t.value == "@":
+            self.advance()
+            name = "@" + self.ident()
+        else:
+            name = self.ident()
+        self.expect_op("=")
+        return name, self.literal_value()
+
     def update_stmt(self) -> UpdateStmt:
         self.expect_kw("update")
         table = self.table_name()
@@ -541,6 +585,31 @@ class Parser:
                                                                   "unique",
                                                                   "fulltext"):
                 raise SqlError("ALTER TABLE ADD INDEX is not supported yet")
+            if self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "rollup":
+                # ADD ROLLUP name (key, ..., AGGREGATE(vcol, ...))
+                # keys are plain columns; AGGREGATE lists the measure columns
+                # (each gets mergeable COUNT/SUM/MIN/MAX partials)
+                self.advance()
+                rname = self.ident()
+                self.expect_op("(")
+                keys, aggs = [], []
+                while True:
+                    if self.peek().kind == "IDENT" and \
+                            self.peek().value.lower() == "aggregate":
+                        self.advance()
+                        self.expect_op("(")
+                        aggs.append(self.ident())
+                        while self.try_op(","):
+                            aggs.append(self.ident())
+                        self.expect_op(")")
+                    else:
+                        keys.append(self.ident())
+                    if not self.try_op(","):
+                        break
+                self.expect_op(")")
+                return AlterTableStmt(table, "add_rollup", rollup_name=rname,
+                                      rollup_keys=keys, rollup_aggs=aggs)
             # ADD [COLUMN] name type
             if self.peek().kind == "IDENT" and self.peek().value.lower() == "column":
                 self.advance()
@@ -554,6 +623,11 @@ class Parser:
             return AlterTableStmt(table, "add_column",
                                   ColumnDef(name, tname, nullable))
         if self.try_kw("drop"):
+            if self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "rollup":
+                self.advance()
+                return AlterTableStmt(table, "drop_rollup",
+                                      rollup_name=self.ident())
             if self.peek().kind == "IDENT" and self.peek().value.lower() == "column":
                 self.advance()
             return AlterTableStmt(table, "drop_column", column_name=self.ident())

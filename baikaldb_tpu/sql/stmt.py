@@ -102,9 +102,12 @@ class CreateTableStmt:
 @dataclass
 class AlterTableStmt:
     table: TableRef
-    action: str                         # add_column | drop_column
+    action: str       # add_column | drop_column | add_rollup | drop_rollup
     column: Optional[ColumnDef] = None
     column_name: str = ""
+    rollup_name: str = ""
+    rollup_keys: list = field(default_factory=list)
+    rollup_aggs: list = field(default_factory=list)   # column names
 
 
 @dataclass
@@ -159,6 +162,18 @@ class ExplainStmt:
 @dataclass
 class TxnStmt:
     kind: str      # begin | commit | rollback
+
+
+@dataclass
+class SetStmt:
+    """SET [GLOBAL|SESSION] name = value (reference: setkv_planner.cpp).
+
+    GLOBAL names hit the process flag registry (utils/flags.py); session
+    names (incl. @user variables) live on the Session."""
+    name: str
+    value: object
+    scope: str = "session"      # session | global
+    more: list = field(default_factory=list)    # extra (name, value) pairs
 
 
 @dataclass

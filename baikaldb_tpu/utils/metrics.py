@@ -1,0 +1,187 @@
+"""Metrics counters — the bvar analog (SURVEY §5.5).
+
+The reference instruments everything with brpc bvars (Adder /
+LatencyRecorder / PerSecond, e.g. include/protocol/state_machine.h:149-152,
+include/exec/fetcher_store.h:189-192) and dumps them to files / the brpc
+HTTP port.  Same shapes here, host-side and dependency-free:
+
+- ``Counter``: monotonically growing adder (+ per-second rate derived from
+  a sliding window).
+- ``LatencyRecorder``: ring of recent observations -> count/avg/p50/p95/
+  p99/max.
+- ``Gauge``: callable sampled at dump time (queue depths, cache sizes).
+
+All instruments register in the process-wide ``registry``; surfaced through
+``SHOW STATUS``, the ``information_schema.metrics`` virtual table, and
+``registry.dump()`` text lines (the bvar-dump-file analog).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Counter:
+    def __init__(self, name: str, registry: Optional["Registry"] = None):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+        self._window: list[tuple[float, int]] = []   # (ts, cumulative)
+        (registry or REGISTRY)._register(self)
+
+    def add(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+            now = time.monotonic()
+            self._window.append((now, self._value))
+            cutoff = now - 60.0
+            while len(self._window) > 2 and self._window[0][0] < cutoff:
+                self._window.pop(0)
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+    def per_second(self, window_s: float = 10.0) -> float:
+        with self._lock:
+            if len(self._window) < 2:
+                return 0.0
+            now = time.monotonic()
+            old = None
+            for ts, v in self._window:
+                if ts >= now - window_s:
+                    break
+                old = (ts, v)
+            first = old or self._window[0]
+            dt = now - first[0]
+            return (self._value - first[1]) / dt if dt > 0 else 0.0
+
+    def stats(self) -> dict:
+        return {"value": self.value,
+                "per_second": round(self.per_second(), 3)}
+
+
+class LatencyRecorder:
+    def __init__(self, name: str, capacity: int = 4096,
+                 registry: Optional["Registry"] = None):
+        self.name = name
+        self.capacity = capacity
+        self._ring: list[float] = []
+        self._idx = 0
+        self._count = 0
+        self._total = 0.0
+        self._max = 0.0
+        self._lock = threading.Lock()
+        (registry or REGISTRY)._register(self)
+
+    def observe(self, ms: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += ms
+            self._max = max(self._max, ms)
+            if len(self._ring) < self.capacity:
+                self._ring.append(ms)
+            else:
+                self._ring[self._idx] = ms
+                self._idx = (self._idx + 1) % self.capacity
+    def time(self):
+        """Context manager: records elapsed milliseconds."""
+        rec = self
+
+        class _T:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                rec.observe((time.perf_counter() - self.t0) * 1e3)
+                return False
+        return _T()
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = self._count
+            if n == 0:
+                return {"count": 0, "avg_ms": 0.0, "p50_ms": 0.0,
+                        "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
+            s = sorted(self._ring)
+
+            def q(p):
+                return s[min(len(s) - 1, int(p * (len(s) - 1) + 0.5))]
+            return {"count": n, "avg_ms": round(self._total / n, 3),
+                    "p50_ms": round(q(0.50), 3), "p95_ms": round(q(0.95), 3),
+                    "p99_ms": round(q(0.99), 3), "max_ms": round(self._max, 3)}
+
+
+class Gauge:
+    def __init__(self, name: str, fn: Callable[[], float],
+                 registry: Optional["Registry"] = None):
+        self.name = name
+        self.fn = fn
+        (registry or REGISTRY)._register(self)
+
+    def stats(self) -> dict:
+        try:
+            return {"value": self.fn()}
+        except Exception:  # sampled best-effort at dump time
+            return {"value": None}
+
+
+class Registry:
+    def __init__(self):
+        self._by_name: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, inst) -> None:
+        with self._lock:
+            self._by_name[inst.name] = inst
+
+    def get(self, name: str):
+        with self._lock:
+            return self._by_name.get(name)
+
+    def expose(self) -> dict[str, dict]:
+        """{metric -> stats dict}; the SHOW STATUS / info_schema source."""
+        with self._lock:
+            items = sorted(self._by_name.items())
+        return {name: inst.stats() for name, inst in items}
+
+    def dump(self) -> str:
+        """bvar-dump-style text: one ``name.field : value`` per line."""
+        lines = []
+        for name, stats in self.expose().items():
+            for k, v in stats.items():
+                lines.append(f"{name}.{k} : {v}")
+        return "\n".join(lines)
+
+    def counter(self, name: str) -> Counter:
+        inst = self.get(name)
+        if inst is None:
+            inst = Counter(name, registry=self)
+        return inst
+
+    def latency(self, name: str) -> LatencyRecorder:
+        inst = self.get(name)
+        if inst is None:
+            inst = LatencyRecorder(name, registry=self)
+        return inst
+
+
+REGISTRY = Registry()
+
+# -- engine-wide instruments (the reference's always-on bvars) -------------
+queries_total = Counter("queries_total")
+queries_failed = Counter("queries_failed")
+slow_queries = Counter("slow_queries")
+rows_returned = Counter("rows_returned")
+dml_rows = Counter("dml_rows")
+query_latency = LatencyRecorder("query_latency")
+plan_cache_hits = Counter("plan_cache_hits")
+plan_cache_misses = Counter("plan_cache_misses")
+txn_commits = Counter("txn_commits")
+txn_rollbacks = Counter("txn_rollbacks")
+wal_appends = Counter("wal_appends")
+connections_total = Counter("connections_total")

@@ -20,7 +20,7 @@ def test_filtered_group_sum_matches_xla():
     values = rng.normal(size=n).astype(np.float32)
     mask = rng.random(n) > 0.4
     c1, s1 = filtered_group_sum(jnp.asarray(codes), jnp.asarray(values),
-                                jnp.asarray(mask), ng, block_rows=8,
+                                jnp.asarray(mask), ng,
                                 interpret=True)
     c2, s2 = _xla_fallback(jnp.asarray(codes), jnp.asarray(values),
                            jnp.asarray(mask), ng)
@@ -33,7 +33,7 @@ def test_all_filtered_and_empty_groups():
     codes = jnp.asarray(np.zeros(100, np.int32))
     values = jnp.asarray(np.ones(100, np.float32))
     mask = jnp.asarray(np.zeros(100, bool))
-    c, s = filtered_group_sum(codes, values, mask, 4, block_rows=8,
+    c, s = filtered_group_sum(codes, values, mask, 4,
                               interpret=True)
     assert np.asarray(c).sum() == 0 and np.asarray(s).sum() == 0
 
@@ -43,7 +43,7 @@ def test_padding_rows_not_counted():
     codes = jnp.asarray(np.arange(100, dtype=np.int32) % 3)
     values = jnp.asarray(np.ones(100, np.float32))
     mask = jnp.asarray(np.ones(100, bool))
-    c, s = filtered_group_sum(codes, values, mask, 3, block_rows=8,
+    c, s = filtered_group_sum(codes, values, mask, 3,
                               interpret=True)
     assert np.asarray(c).sum() == 100
     assert np.asarray(s).tolist() == np.asarray(c).tolist()
