@@ -49,6 +49,7 @@ class Connection:
     def __init__(self, host: str = "127.0.0.1", port: int = 3306,
                  user: str = "root", database: str = "", password: str = ""):
         self.sock = socket.create_connection((host, port), timeout=30)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.p = Packets(self.sock)
         self._handshake(user, database, password)
 

@@ -479,6 +479,7 @@ class Session:
             if rw is not None:
                 stmt_x = rw
             plan = self._plan_select(stmt_x)
+            self._annotate_access(plan)
             return Result(columns=["plan"], plan_text=plan.tree_repr(),
                           arrow=pa.table({"plan": plan.tree_repr().split("\n")}))
         if isinstance(s, InsertStmt):
@@ -927,6 +928,68 @@ class Session:
         self.db.save_catalog()
         return Result()
 
+    # -- OLTP point-read fast path (reference: primary-index point SELECT
+    # through the row path, region.cpp select_normal) ----------------------
+    def _try_point_lookup(self, stmt: SelectStmt) -> Optional[Result]:
+        """WHERE fixes the whole primary key by equality and the statement
+        is a plain row fetch: serve from the host tier — no device program,
+        no compile, microsecond-class latency (the OLTP path)."""
+        from ..expr.ast import ColRef
+        from ..index.selector import is_point_statement, point_key
+
+        if not is_point_statement(stmt):
+            return None
+        db = stmt.table.database or self.current_db
+        key = f"{db}.{stmt.table.name}"
+        store = self.db.stores.get(key)
+        if store is None or store._pk_cols is None:
+            return None
+        pk = point_key(stmt, store._pk_cols)
+        if pk is None:
+            return None
+        if stmt.offset or stmt.limit == 0:
+            return None         # row-skipping edge cases: normal path
+        # output must be plain columns (or *); expressions fall through to
+        # the normal path rather than re-implementing eval host-side
+        names = []
+        for it in stmt.items:
+            if it.expr is None:
+                names.extend(f.name for f in store.info.schema.fields)
+            elif isinstance(it.expr, ColRef):
+                cname = it.expr.name.split(".")[-1]
+                if cname not in store.info.schema:
+                    return None
+                names.append(it.alias or cname)
+            else:
+                return None
+        if len(set(names)) != len(names):
+            return None     # duplicate output names: the device path's
+            #                 rename-dedup behavior must not change shape
+        try:
+            row = store.point_lookup(pk)
+        except Exception:
+            return None         # any host-index hiccup: run the full path
+        metrics.point_lookups.add(1)
+        sch = schema_to_arrow(store.info.schema)
+        cols: dict = {}
+        for it, out_name in zip(self._expand_items(stmt.items, store), names):
+            cname = it
+            cols[out_name] = pa.array(
+                [None if row is None else row.get(cname)],
+                sch.field(cname).type)
+        t = pa.table(cols) if row is not None else \
+            pa.table({n: c.slice(0, 0) for n, c in cols.items()})
+        return Result(columns=names, arrow=t)
+
+    def _expand_items(self, items, store):
+        out = []
+        for it in items:
+            if it.expr is None:
+                out.extend(f.name for f in store.info.schema.fields)
+            else:
+                out.append(it.expr.name.split(".")[-1])
+        return out
+
     # -- rollup index (reference: I_ROLLUP, region_olap.cpp:530-651) -------
     def _try_rollup(self, stmt: SelectStmt, refresh: bool = True):
         """If a rollup covers this SELECT, refresh it (lazily, on base
@@ -1371,6 +1434,9 @@ class Session:
         per SQL text, one compiled executable per (table versions, shapes)."""
         from ..expr.ast import AggCall
 
+        point = self._try_point_lookup(stmt)
+        if point is not None:
+            return point
         rewritten = self._try_rollup(stmt)
         if rewritten is not None:
             # re-enter with the rollup statement; versions in the cache key
@@ -1460,6 +1526,14 @@ class Session:
 
         batches: dict[str, ColumnBatch] = {}
         key_parts = []
+        scan_count: dict[str, int] = {}
+
+        def count(n: PlanNode):
+            if isinstance(n, ScanNode):
+                scan_count[n.table_key] = scan_count.get(n.table_key, 0) + 1
+            for c in n.children:
+                count(c)
+        count(plan)
 
         def walk_plan(n: PlanNode):
             if isinstance(n, ScanNode) and n.table_key not in batches:
@@ -1478,10 +1552,15 @@ class Session:
                 if store is None:
                     info = self.db.catalog.get_table(db, name)
                     store = self.db.stores[n.table_key] = self.db.make_store(info)
-                if self.mesh is not None:
-                    batches[n.table_key] = self._sharded_batch(n.table_key, store)
-                else:
-                    batches[n.table_key] = store.device_table_batch()
+                b = None
+                if self.mesh is None and scan_count[n.table_key] == 1:
+                    b = self._access_path_batch(n, db, name, store)
+                if b is None:
+                    if self.mesh is not None:
+                        b = self._sharded_batch(n.table_key, store)
+                    else:
+                        b = store.device_table_batch()
+                batches[n.table_key] = b
                 key_parts.append((n.table_key, store.version,
                                   len(batches[n.table_key])))
             for c in n.children:
@@ -1489,6 +1568,97 @@ class Session:
 
         walk_plan(plan)
         return batches, tuple(sorted(key_parts))
+
+    def _access_path_batch(self, n, db: str, name: str, store):
+        """IndexSelector-driven scan input (index/selector.py): a secondary
+        equality gathers just the matching rows; zone maps drop whole
+        regions.  Returns None for a full scan (the default batch).  The
+        device program's own filter still runs — these are conservative row
+        supersets, so correctness never depends on the index choice."""
+        from ..index.selector import analyze_conjuncts, choose_access
+
+        if n.pushed_filter is None:
+            return None
+        try:
+            info = self.db.catalog.get_table(db, name)
+            pred = analyze_conjuncts(n.pushed_filter)
+            access = choose_access(info, store, pred)
+        except Exception:
+            return None
+        cache = getattr(self, "_access_batches", None)
+        if cache is None:
+            cache = self._access_batches = {}
+        if access[0] == "secondary":
+            _, ix_name, col, value = access
+            n.access_desc = f"index({ix_name}:{col})"
+            ck = (n.table_key, store.version, "sec", col, value)
+            b = cache.get(ck)
+            if b is None:
+                b = ColumnBatch.from_arrow(store.secondary_scan(col, value))
+                self._evict_access(n.table_key, store.version)
+                cache[ck] = b
+            metrics.index_scans.add(1)
+            return b
+        if access[0] == "zonemap":
+            keep, total = store.prune_regions(access[1])
+            if len(keep) == total:
+                n.access_desc = "full"
+                return None
+            n.access_desc = f"zonemap({total - len(keep)}/{total} " \
+                            f"regions pruned)"
+            ck = (n.table_key, store.version, "zone", tuple(keep))
+            b = cache.get(ck)
+            if b is None:
+                b = ColumnBatch.from_arrow(store.regions_table(keep))
+                self._evict_access(n.table_key, store.version)
+                cache[ck] = b
+            metrics.regions_pruned.add(total - len(keep))
+            return b
+        n.access_desc = "full"
+        return None
+
+    _ACCESS_CACHE_MAX = 16
+
+    def _evict_access(self, table_key: str, version: int):
+        """Drop access-path batches of older versions of this table, and
+        cap the cache (distinct predicate literals each pin device arrays —
+        unbounded growth would OOM a long-lived session)."""
+        self._access_batches = {
+            k: v for k, v in self._access_batches.items()
+            if not (k[0] == table_key and k[1] != version)}
+        while len(self._access_batches) >= self._ACCESS_CACHE_MAX:
+            self._access_batches.pop(next(iter(self._access_batches)))
+
+    def _annotate_access(self, plan: PlanNode):
+        """EXPLAIN display: run IndexSelector per scan without building
+        batches, so the shown choice flips with the predicates."""
+        from ..index.selector import analyze_conjuncts, choose_access
+        from ..plan.nodes import ScanNode
+
+        def walk(n):
+            if isinstance(n, ScanNode) and "." in n.table_key:
+                db, name = n.table_key.split(".", 1)
+                store = self.db.stores.get(n.table_key)
+                if store is not None and db != "information_schema":
+                    try:
+                        info = self.db.catalog.get_table(db, name)
+                        pred = analyze_conjuncts(n.pushed_filter)
+                        access = choose_access(info, store, pred)
+                        if access[0] == "secondary":
+                            n.access_desc = f"index({access[1]}:{access[2]})"
+                        elif access[0] == "zonemap":
+                            keep, total = store.prune_regions(access[1])
+                            n.access_desc = (
+                                "full" if len(keep) == total else
+                                f"zonemap({total - len(keep)}/{total} "
+                                f"regions pruned)")
+                        else:
+                            n.access_desc = "full"
+                    except Exception:
+                        pass
+            for c in n.children:
+                walk(c)
+        walk(plan)
 
     def _sharded_batch(self, table_key: str, store: TableStore) -> ColumnBatch:
         """Row-shard a table across the mesh (cached per table version) —

@@ -52,10 +52,13 @@ class ScanNode(PlanNode):
     label: str = ""            # alias in the query
     columns: list[str] = field(default_factory=list)   # pruned physical columns
     pushed_filter: Optional[Expr] = None               # PredicatePushDown result
+    access_desc: str = ""      # IndexSelector choice (EXPLAIN display)
 
     def _label(self):
         f = f" filter={self.pushed_filter!r}" if self.pushed_filter else ""
-        return f"Scan({self.table_key} as {self.label} cols={self.columns}{f})"
+        a = f" access={self.access_desc}" if self.access_desc else ""
+        return (f"Scan({self.table_key} as {self.label} "
+                f"cols={self.columns}{f}{a})")
 
 
 @dataclass

@@ -151,6 +151,9 @@ class MySQLServer:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
+            # disable Nagle: request/response protocol, every packet small —
+            # without this each query stalls ~40ms on delayed ACKs
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             from ..utils import metrics
             metrics.connections_total.add(1)
             t = threading.Thread(target=self._serve, args=(conn,), daemon=True)

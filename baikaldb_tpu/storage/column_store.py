@@ -44,6 +44,39 @@ DEFAULT_REGION_ROWS = 1 << 20  # split threshold on the row axis
 ROWID = "__rowid"              # hidden parquet column carrying row identity
 
 
+def _zone_scalar(x, ltype):
+    """Normalize a zone-map bound or predicate literal to one comparable
+    number in the COLUMN's unit (DATE: epoch days; DATETIME/TIMESTAMP: epoch
+    seconds; numerics: as-is).  None = unbounded/incomparable — pruning
+    treats it as 'keep the region'."""
+    import datetime
+    if x is None:
+        return None
+    if isinstance(x, str):
+        try:
+            if ltype is LType.DATE:
+                d = datetime.date.fromisoformat(x[:10])
+                return (d - datetime.date(1970, 1, 1)).days
+            if ltype.is_temporal:
+                dt = datetime.datetime.fromisoformat(x)
+                return dt.replace(tzinfo=datetime.timezone.utc).timestamp()
+        except ValueError:
+            return None
+        return None
+    if isinstance(x, datetime.datetime):
+        return x.replace(tzinfo=datetime.timezone.utc).timestamp()
+    if isinstance(x, datetime.date):
+        if ltype.is_temporal and ltype is not LType.DATE:
+            return datetime.datetime(x.year, x.month, x.day,
+                                     tzinfo=datetime.timezone.utc).timestamp()
+        return (x - datetime.date(1970, 1, 1)).days
+    if isinstance(x, bool) or isinstance(x, (int, float)):
+        if ltype is LType.DATE and isinstance(x, int):
+            return x                       # already epoch days
+        return x
+    return None
+
+
 def schema_to_arrow(schema: Schema) -> pa.Schema:
     m = {
         LType.BOOL: pa.bool_(), LType.INT8: pa.int8(), LType.INT16: pa.int16(),
@@ -368,6 +401,156 @@ class TableStore:
             start = self._auto_incr + 1
             self._auto_incr += n
             return list(range(start, start + n))
+
+    # -- access paths (reference: index_selector.cpp feeding scan ranges) --
+
+    _ZONE_TYPES = "int/float/date/ts"   # doc anchor; see zone_map_column
+
+    def zone_map_column(self, column: str):
+        """Per-region (min, max, has_null) for numeric/temporal columns, or
+        None when the type can't range-prune.  Cached per table version —
+        the column tier's statistics-pruning analog."""
+        import pyarrow.compute as pc
+
+        f = self.info.schema.field(column)
+        if not (f.ltype.is_integer or f.ltype.is_float
+                or f.ltype is LType.DATE or f.ltype.is_temporal):
+            return None
+        with self._lock:
+            v = self.version
+            cache = getattr(self, "_zone_cache", None)
+            if cache is None or cache[0] != v:
+                cache = (v, {})
+                self._zone_cache = cache
+            if column in cache[1]:
+                return cache[1][column]
+            zones = []
+            for r in self.regions:
+                if not r.num_rows:
+                    zones.append(None)        # empty region: always prunable
+                    continue
+                col = r.data.column(column)
+                if col.null_count == col.length():
+                    zones.append((None, None, True))
+                    continue
+                mm = pc.min_max(col).as_py()
+                zones.append((_zone_scalar(mm["min"], f.ltype),
+                              _zone_scalar(mm["max"], f.ltype),
+                              col.null_count > 0))
+            cache[1][column] = zones
+            return zones
+
+    def prune_regions(self, ranges: dict):
+        """Regions whose zone maps can satisfy every [lo, hi] constraint.
+        -> (list of region indexes kept, total regions).  Conservative: any
+        uncertainty keeps the region."""
+        with self._lock:
+            keep = []
+            for i, r in enumerate(self.regions):
+                if not r.num_rows:
+                    continue
+                alive = True
+                for col, (lo, hi) in ranges.items():
+                    zones = self.zone_map_column(col)
+                    if zones is None or zones[i] is None:
+                        continue
+                    zmin, zmax, _ = zones[i]
+                    if zmin is None:              # all-NULL region: no row
+                        alive = False             # can match a comparison
+                        break
+                    lt = self.info.schema.field(col).ltype
+                    lo_c = _zone_scalar(lo, lt)
+                    hi_c = _zone_scalar(hi, lt)
+                    if lo_c is not None and zmax < lo_c:
+                        alive = False
+                        break
+                    if hi_c is not None and zmin > hi_c:
+                        alive = False
+                        break
+                if alive:
+                    keep.append(i)
+            return keep, sum(1 for r in self.regions if r.num_rows)
+
+    def regions_table(self, keep: list[int]) -> pa.Table:
+        with self._lock:
+            tabs = [self.regions[i].data for i in keep]
+            return pa.concat_tables(tabs) if tabs \
+                else self.arrow_schema.empty_table()
+
+    def _secondary_order(self, column: str):
+        """(sorted values ndarray, row positions ndarray) over the snapshot,
+        NULLs excluded; cached per version."""
+        with self._lock:
+            v = self.version
+            cache = getattr(self, "_sec_cache", None)
+            if cache is None or cache[0] != v:
+                cache = (v, {})
+                self._sec_cache = cache
+            if column in cache[1]:
+                return cache[1][column]
+            snap = self.snapshot()
+            col = snap.column(column)
+            f = self.info.schema.field(column)
+            if f.ltype is LType.STRING:
+                vals = np.asarray(col.to_pylist(), dtype=object)
+            else:
+                vals = col.to_numpy(zero_copy_only=False)
+            if col.null_count:
+                mask = ~np.asarray(col.is_null())
+                pos = np.nonzero(mask)[0]
+                vals = vals[mask]
+            else:
+                pos = np.arange(len(vals))
+            order = np.argsort(vals, kind="stable")
+            entry = (vals[order], pos[order])
+            cache[1][column] = entry
+            return entry
+
+    def secondary_count(self, column: str, value):
+        """How many rows match column = value (None if unindexable)."""
+        try:
+            svals, _ = self._secondary_order(column)
+        except Exception:
+            return None
+        lo = np.searchsorted(svals, value, "left")
+        hi = np.searchsorted(svals, value, "right")
+        return int(hi - lo)
+
+    def secondary_positions(self, column: str, value) -> np.ndarray:
+        """Snapshot row positions with column = value (sorted ascending)."""
+        svals, spos = self._secondary_order(column)
+        lo = np.searchsorted(svals, value, "left")
+        hi = np.searchsorted(svals, value, "right")
+        return np.sort(spos[lo:hi])
+
+    def secondary_scan(self, column: str, value) -> pa.Table:
+        """Rows with column = value, positions and snapshot taken under ONE
+        lock acquisition (a concurrent write between them would make the
+        gather index a different table)."""
+        with self._lock:
+            pos = self.secondary_positions(column, value)
+            return self.snapshot().take(pos)
+
+    def point_lookup(self, values: dict):
+        """Primary-key point read from the host tier (no device program).
+        -> row dict or None.  ``values``: pk column -> python literal."""
+        if self._pk_codec is None:
+            return None
+        one = {}
+        for name in self._pk_cols:
+            f = self.arrow_schema.field(name)
+            one[name] = pa.array([values[name]]).cast(f.type)
+        key = self._encode_pk_table(pa.table(one))[0]
+        idx = self._ensure_pk_index()
+        rid = idx.get(key)
+        if rid is None:
+            return None
+        with self._lock:
+            for r in self.regions:
+                hit = np.nonzero(r.rowids == rid)[0]
+                if hit.size:
+                    return r.data.slice(int(hit[0]), 1).to_pylist()[0]
+        return None
 
     # -- primary-key index -----------------------------------------------
     def _ensure_pk_index(self):

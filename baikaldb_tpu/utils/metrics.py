@@ -185,3 +185,6 @@ txn_commits = Counter("txn_commits")
 txn_rollbacks = Counter("txn_rollbacks")
 wal_appends = Counter("wal_appends")
 connections_total = Counter("connections_total")
+point_lookups = Counter("point_lookups")
+index_scans = Counter("index_scans")
+regions_pruned = Counter("regions_pruned")
