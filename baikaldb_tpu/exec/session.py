@@ -35,6 +35,7 @@ from ..sql.stmt import (AlterTableStmt, CreateDatabaseStmt, CreateTableStmt, Del
 from ..meta.privileges import READ, WRITE, AccessError, PrivilegeManager
 from ..sql.stmt import (CreateUserStmt, DropUserStmt, GrantStmt, HandleStmt,
                         LoadDataStmt, RevokeStmt)
+from ..storage.column_store import ROWID as ROWID_COL
 from ..storage.column_store import TableStore, schema_to_arrow
 from ..types import Field, LType, Schema
 from ..utils import metrics
@@ -167,8 +168,15 @@ class Database:
     Database over the same directory recovers committed state — the analog
     of baikalStore restart recovery (SURVEY §3.4)."""
 
-    def __init__(self, data_dir: Optional[str] = None):
+    def __init__(self, data_dir: Optional[str] = None, fleet=None):
+        """``fleet``: a raft.fleet.StoreFleet — when set, every table's hot
+        row tier is raft-replicated across the fleet's store nodes (DML
+        quorum-commits through region raft groups; a new Database over the
+        same fleet recovers committed state from the replicas).  The
+        reference's always-on mode: every DML is a raft apply on a Region
+        (src/store/region.cpp:1961,2301)."""
         self.catalog = Catalog()
+        self.fleet = fleet
         self.stores: dict[str, TableStore] = {}
         # query statistics ring (reference: slow-SQL collection + print_agg_sql,
         # network_server.h:82-107) — feeds information_schema.query_log
@@ -190,8 +198,17 @@ class Database:
         return self.stores[key]
 
     def make_store(self, info) -> TableStore:
-        """Create a table's store; durable (WAL-attached) under data_dir."""
+        """Create a table's store; durable (WAL-attached) under data_dir,
+        raft-replicated when the Database is fleet-bound."""
         key = f"{info.database}.{info.name}"
+        if self.fleet is not None:
+            from ..storage.replicated import ReplicatedRowTier
+            st = TableStore(info)
+            tier = ReplicatedRowTier.get_or_create(
+                self.fleet, info.table_id, key, st._row_schema(),
+                [ROWID_COL])
+            st.attach_replicated(tier)
+            return st
         if not self.data_dir:
             return TableStore(info)
         import os
@@ -761,7 +778,12 @@ class Session:
         raise SqlError(f"unsupported HANDLE command {s.command!r}")
 
     def _drop_durable(self, key: str, store):
-        """Remove a dropped table's WAL + Parquet from data_dir."""
+        """Remove a dropped table's WAL + Parquet from data_dir (and its
+        replicated tier from a fleet-bound Database)."""
+        if self.db.fleet is not None:
+            tier = self.db.fleet.row_tiers.pop(key, None)
+            if tier is not None:
+                tier.release_regions()   # no ghost raft groups in the fleet
         if not self.db.data_dir:
             return
         import os

@@ -35,6 +35,9 @@ class StoreFleet:
         self._ids = {a: i + 1 for i, a in enumerate(addresses)}
         self._addr = {i: a for a, i in self._ids.items()}
         self.groups: dict[int, RaftGroup] = {}     # region_id -> group
+        # table_key -> storage.replicated.ReplicatedRowTier: SQL-visible
+        # replicated tables survive Database restarts through this registry
+        self.row_tiers: dict = {}
         for a in addresses:
             meta.add_instance(a)
 
@@ -46,14 +49,19 @@ class StoreFleet:
         return self._ids[address]
 
     # -- region lifecycle -------------------------------------------------
-    def create_table_regions(self, table_id: int, n_regions: int = 1):
+    def create_table_regions(self, table_id: int, n_regions: int = 1,
+                             schema: Optional[Schema] = None,
+                             key_columns: Optional[list[str]] = None):
         """Meta assigns placement; the fleet materializes raft groups on the
-        chosen peers (init_region fan-out, store.interface.proto:425)."""
+        chosen peers (init_region fan-out, store.interface.proto:425).
+        ``schema``/``key_columns`` override the fleet defaults so each SQL
+        table's regions replicate rows in that table's own row encoding."""
         metas = self.meta.create_regions(table_id, n_regions)
         for rm in metas:
             peer_ids = [self._id_of(a) for a in rm.peers]
             g = RaftGroup(rm.region_id, peer_ids, seed=self.seed,
-                          schema=self.schema, key_columns=self.key_columns)
+                          schema=schema or self.schema,
+                          key_columns=key_columns or self.key_columns)
             self.groups[rm.region_id] = g
             ldr = g.leader()
             rm.leader = self._addr[ldr]

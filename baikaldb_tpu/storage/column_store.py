@@ -137,7 +137,22 @@ class TxnContext:
 
     def commit(self):
         try:
-            if self.store.wal_path is not None:
+            if self.store.replicated is not None:
+                # SQL COMMIT on a replicated table: the buffered write set
+                # becomes raft proposals (1PC single-region, primary-first
+                # 2PC across regions — fetcher_store.cpp:1848-1904); the
+                # local buffer only ever held the row LOCKS
+                ops = self.row_txn.pending_ops()
+                self.row_txn.rollback()
+                try:
+                    self.store.replicated.write_ops(ops)
+                except Exception:
+                    # quorum lost at COMMIT: the columnar cache already
+                    # applied this txn's statements — restore the pre-image
+                    # or SELECTs would show rows that never replicated
+                    self._restore_preimage()
+                    raise
+            elif self.store.wal_path is not None:
                 self.row_txn.commit()   # one atomic WAL batch + fsync
             else:
                 # non-durable store: the buffered rows would never be read —
@@ -148,8 +163,7 @@ class TxnContext:
             # later statement on this table would conflict forever
             self.store._end_txn(self)
 
-    def rollback(self):
-        self.row_txn.rollback()
+    def _restore_preimage(self):
         st = self.store
         with st._lock:
             if self._snap is not None:
@@ -163,7 +177,11 @@ class TxnContext:
                     r.version = max(r.version, version) + 1
                 st._mutations += 1
                 st._pk_stale = True
-        st._end_txn(self)
+
+    def rollback(self):
+        self.row_txn.rollback()
+        self._restore_preimage()
+        self.store._end_txn(self)
 
 
 class TableStore:
@@ -186,6 +204,9 @@ class TableStore:
                                              self.arrow_schema.empty_table())]
         self.wal_path = None
         self.durable_dir: Optional[str] = None   # Parquet checkpoint home
+        # raft-replicated hot tier (storage/replicated.py); when set, DML
+        # replicates through region raft groups instead of the local WAL
+        self.replicated = None
         self._writer: Optional[TxnContext] = None
         # AUTO_INCREMENT high-water mark, lazily seeded from max(col)+1 (the
         # reference allocates ranges from meta's auto_incr_state_machine;
@@ -217,7 +238,19 @@ class TableStore:
         checkpoint apply over the current cold state (reference: restart
         recovery from applied_index + log replay, include/store/region.h:644)."""
         self._build_row_tier(path)
-        rows = self.row_table.scan_rows()
+        self._replay_hot(self.row_table.scan_rows())
+
+    def attach_replicated(self, tier):
+        """Bind this table to its raft-replicated hot tier and recover: the
+        replicas' committed row state replays over the cold state, exactly
+        like a WAL replay — but the log here survives any single node (the
+        on_snapshot_load_for_restart analog, include/store/region.h:644)."""
+        self.replicated = tier
+        self._replay_hot(tier.scan_rows())
+
+    def _replay_hot(self, rows: list[dict]):
+        """Apply recovered hot-tier rows over cold state, advancing the
+        rowid watermark (shared by WAL and replicated recovery)."""
         if rows:
             self._apply_deltas(rows)
         for r in rows:
@@ -645,9 +678,17 @@ class TableStore:
             self._writer_check(tctx)
             if check_dups:
                 self._check_duplicates(table)
+            rowids = self._alloc_rowids(table.num_rows)
+            if self.replicated is not None:
+                # replicated tables have no "cold only" ingest: a rebuild
+                # from the raft tier is THE recovery path, so the bulk batch
+                # replicates as one write (the reference's fast importer
+                # likewise lands SSTs in regions through raft ingest)
+                recs = [dict(row, **{ROWID: int(rid)})
+                        for row, rid in zip(table.to_pylist(), rowids)]
+                self._write_hot(recs, tctx)
             self._mutations += 1
             self._pk_stale = True
-            rowids = self._alloc_rowids(table.num_rows)
             self._append_table(table, rowids)
 
     def insert_rows(self, rows: list[dict], tctx: Optional[TxnContext] = None):
@@ -675,7 +716,10 @@ class TableStore:
         markers: list[dict] = []
         with self._lock:
             self._writer_check(tctx)
-            self._mutations += 1
+            # phase 1: evaluate masks only (no mutation) so the hot-tier
+            # write — a raft quorum commit on replicated tables — can fail
+            # without leaving the columnar cache ahead of the durable state
+            masks: list[tuple[Region, np.ndarray]] = []
             # a fresh PK index maintains itself incrementally: we know the
             # exact keys leaving the table (no O(n) rebuild on next insert)
             fresh = (self._pk_codec is not None and
@@ -691,16 +735,22 @@ class TableStore:
                             self._encode_pk_table(r.data.filter(pa.array(mask))))
                     markers.extend({ROWID: int(rid), "__del": True}
                                    for rid in r.rowids[mask])
-                    r.data = r.data.filter(pa.array(~mask))
-                    r.rowids = r.rowids[~mask]
-                    r.version += 1
+                    masks.append((r, mask))
                     deleted += int(mask.sum())
+            if not markers:
+                return 0
+            self._write_hot(markers, tctx)
+            # phase 2: the delete is durable/replicated — apply to columns
+            self._mutations += 1
+            for r, mask in masks:
+                r.data = r.data.filter(pa.array(~mask))
+                r.rowids = r.rowids[~mask]
+                r.version += 1
             if fresh:
                 for k in dead_keys:
                     self._pk_index.pop(k, None)
             else:
                 self._pk_stale = True
-            self._write_hot(markers, tctx)
         return deleted
 
     def update_where(self, host_mask_fn, assign_fn,
@@ -714,23 +764,34 @@ class TableStore:
         hot: list[dict] = []
         with self._lock:
             self._writer_check(tctx)
-            self._mutations += 1
-            if self._pk_cols is not None and (
-                    changed_cols is None or
-                    any(c in self._pk_cols for c in changed_cols)):
-                self._pk_stale = True
+            # phase 1: compute the new region tables without installing them,
+            # so a failed hot-tier write (raft no-quorum on replicated
+            # tables) leaves the columnar cache consistent
+            staged: list[tuple[Region, pa.Table]] = []
             for r in self.regions:
                 if not r.num_rows:
                     continue
                 mask = np.asarray(host_mask_fn(r.data), dtype=bool)
                 if mask.any():
-                    r.data = _coerce(assign_fn(r.data, mask), self.arrow_schema)
-                    r.version += 1
+                    new_data = _coerce(assign_fn(r.data, mask),
+                                       self.arrow_schema)
+                    staged.append((r, new_data))
                     updated += int(mask.sum())
-                    new_rows = r.data.filter(pa.array(mask)).to_pylist()
+                    new_rows = new_data.filter(pa.array(mask)).to_pylist()
                     hot.extend(dict(row, **{ROWID: int(rid)})
                                for row, rid in zip(new_rows, r.rowids[mask]))
+            if not staged:
+                return 0
             self._write_hot(hot, tctx)
+            # phase 2: durable/replicated — install the new region tables
+            self._mutations += 1
+            if self._pk_cols is not None and (
+                    changed_cols is None or
+                    any(c in self._pk_cols for c in changed_cols)):
+                self._pk_stale = True
+            for r, new_data in staged:
+                r.data = new_data
+                r.version += 1
         return updated
 
     def _write_hot(self, recs: list[dict], tctx: Optional[TxnContext]):
@@ -741,6 +802,14 @@ class TableStore:
             # TxnContext.commit drops the buffer for non-durable stores
             for rec in recs:
                 tctx.row_txn.put_row(rec)
+            return
+        if self.replicated is not None:
+            # autocommit DML on a replicated table: quorum-commit the batch
+            # through raft BEFORE the column tier reflects it (the dml_1pc
+            # path, region.cpp:2301); no quorum -> the statement fails
+            kc, rc = self.row_table.key_codec, self.row_table.row_codec
+            self.replicated.write_ops(
+                [(0, kc.encode_one(rec), rc.encode(rec)) for rec in recs])
             return
         if self.wal_path is None:
             return      # non-durable autocommit: nothing would ever read it
@@ -756,6 +825,14 @@ class TableStore:
         with self._lock:
             if self._writer is not None:
                 raise ConflictError("TRUNCATE while a transaction is open")
+            if self.replicated is not None:
+                # the wipe must replicate, or a rebuild from the raft tier
+                # would resurrect the rows: __del markers for every live id
+                kc, rc = self.row_table.key_codec, self.row_table.row_codec
+                self.replicated.write_ops(
+                    [(0, kc.encode_one({ROWID: int(rid)}),
+                      rc.encode({ROWID: int(rid), "__del": True}))
+                     for r in self.regions for rid in r.rowids])
             self._mutations += 1
             self._pk_stale = True
             self.regions = [Region(self._alloc_region_id(),
@@ -809,6 +886,16 @@ class TableStore:
             if self.durable_dir:
                 self.save_parquet(self.durable_dir)
             self._reset_wal()
+            if self.replicated is not None:
+                # the replicated row encoding is schema-bound too: retire
+                # the old-encoding regions and re-replicate the rewritten
+                # rows, or recovery would decode bytes with the wrong codec
+                kc, rc = self.row_table.key_codec, self.row_table.row_codec
+                ops = [(0, kc.encode_one({ROWID: int(rid)}),
+                        rc.encode(dict(row, **{ROWID: int(rid)})))
+                       for r in self.regions
+                       for row, rid in zip(r.data.to_pylist(), r.rowids)]
+                self.replicated.reset_schema(self._row_schema(), ops)
             if self._pk_cols:
                 missing = [c for c in self._pk_cols if c not in new_schema]
                 if missing:

@@ -1,0 +1,174 @@
+"""Raft-replicated hot row tier, reachable from SQL.
+
+In the reference every DML is a raft apply on a Region
+(/root/reference/src/store/region.cpp:2301 dml_1pc, :1961 dml_2pc; on_apply
+include/store/region.h:626) and COMMIT is primary-first 2PC driven from the
+frontend (/root/reference/src/exec/fetcher_store.cpp:1848-1904).  This module
+puts the same discipline under the Session's DML path:
+
+- each replicated table owns N raft region groups (3 replicas each) hosted by
+  a ``raft.fleet.StoreFleet`` whose placement came from the meta service,
+- a single-region statement commits as ONE replicated write batch — the 1PC
+  path — acked only after quorum commit,
+- a statement or SQL transaction spanning regions runs through
+  ``raft.twopc.TwoPhaseCoordinator`` (PREPARE everywhere, decision record +
+  COMMIT on the primary first),
+- reads consult the meta routing table for the leader replica (the
+  fetcher_store choose_opt_instance analog) and fall back to a live election.
+
+The authoritative state is the raft groups' row tables: a new Database over
+the same fleet rebuilds its columnar cache from the replicas (the restart
+recovery path, include/store/region.h:644), so killing a leader mid-workload
+loses nothing committed.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+from ..raft.cluster import RaftGroup
+from ..raft.core import LEADER
+from ..raft.twopc import TwoPhaseCoordinator, TwoPhaseError, next_txn_id
+from ..types import Schema
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..raft.fleet import StoreFleet
+
+
+class ReplicationError(RuntimeError):
+    """A replicated write could not reach quorum (region unavailable)."""
+
+
+def _fnv64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+class ReplicatedRowTier:
+    """One table's raft-replicated row tier: key-routed region groups."""
+
+    def __init__(self, fleet: "StoreFleet", table_id: int, table_key: str,
+                 row_schema: Schema, key_columns: list[str],
+                 n_regions: int = 2):
+        self.fleet = fleet
+        self.table_id = table_id
+        self.table_key = table_key
+        self.row_schema = row_schema
+        self.key_columns = list(key_columns)
+        self.metas = fleet.create_table_regions(
+            table_id, n_regions, schema=row_schema, key_columns=key_columns)
+        self.groups: list[RaftGroup] = [fleet.group(m.region_id)
+                                        for m in self.metas]
+
+    @classmethod
+    def get_or_create(cls, fleet: "StoreFleet", table_id: int, table_key: str,
+                      row_schema: Schema, key_columns: list[str],
+                      n_regions: int = 2) -> "ReplicatedRowTier":
+        """The fleet keeps one tier per table so a NEW Database over the same
+        fleet recovers the existing replicated state instead of allocating
+        fresh (empty) regions."""
+        tier = fleet.row_tiers.get(table_key)
+        if tier is None:
+            tier = cls(fleet, table_id, table_key, row_schema, key_columns,
+                       n_regions)
+            fleet.row_tiers[table_key] = tier
+        return tier
+
+    # -- routing ----------------------------------------------------------
+    def _route(self, key: bytes) -> int:
+        return _fnv64(key) % len(self.groups)
+
+    def _split_ops(self, ops: list[tuple[int, bytes, bytes]]):
+        per: dict[int, list] = {}
+        for op in ops:
+            per.setdefault(self.groups[self._route(op[1])].region_id,
+                           []).append(op)
+        return per
+
+    # -- writes -----------------------------------------------------------
+    def write_ops(self, ops: list[tuple[int, bytes, bytes]]) -> None:
+        """Replicate a write batch.  Single region -> 1PC (one CMD_WRITE in
+        that group's log); multiple regions -> 2PC with the first touched
+        group as primary.  Raises ReplicationError when quorum is gone."""
+        if not ops:
+            return
+        per = self._split_ops(ops)
+        if len(per) == 1:
+            rid, batch = next(iter(per.items()))
+            g = next(g for g in self.groups if g.region_id == rid)
+            if not g.write(batch):
+                raise ReplicationError(
+                    f"region {rid} of {self.table_key} has no quorum")
+            return
+        groups = [g for g in self.groups if g.region_id in per]
+        try:
+            TwoPhaseCoordinator(groups).write(per, txn_id=next_txn_id())
+        except TwoPhaseError as e:
+            raise ReplicationError(str(e)) from None
+
+    # -- reads ------------------------------------------------------------
+    def _leader_node(self, meta, group: RaftGroup):
+        """Leader replica for one region, meta routing consulted first
+        (reference: frontend replica selection, fetcher_store.cpp:351)."""
+        addr = self.fleet.meta.regions[meta.region_id].leader
+        nid = self.fleet._ids.get(addr)
+        if nid is not None and nid in group.bus.nodes and \
+                nid not in group.bus.down and \
+                group.bus.nodes[nid].core.role == LEADER:
+            return group.bus.nodes[nid]
+        return group.bus.nodes[group.leader()]
+
+    def scan_rows(self) -> list[dict]:
+        """Latest committed row versions across all regions (leader reads).
+        Includes ``__del`` marker rows — recovery replay needs them; callers
+        counting LIVE rows use num_rows()."""
+        out: list[dict] = []
+        for m, g in zip(self.metas, self.groups):
+            node = self._leader_node(m, g)
+            out.extend(node.rows())
+        return out
+
+    def num_rows(self) -> int:
+        """Live (non-deleted) replicated rows."""
+        return sum(1 for r in self.scan_rows() if not r.get("__del"))
+
+    # -- maintenance -------------------------------------------------------
+    def reset_schema(self, row_schema: Schema,
+                     ops: list[tuple[int, bytes, bytes]]) -> None:
+        """ALTER TABLE boundary: the replicated row encoding is schema-bound
+        (like the WAL), so the old-encoding regions retire and fresh groups
+        replicate the rewritten rows in the new encoding.  Mirrors the
+        reference where column DDL rewrites region state through raft
+        (ddl_manager.cpp + region apply)."""
+        self.release_regions()
+        self.row_schema = row_schema
+        self.metas = self.fleet.create_table_regions(
+            self.table_id, max(1, len(self.groups)), schema=row_schema,
+            key_columns=self.key_columns)
+        self.groups = [self.fleet.group(m.region_id) for m in self.metas]
+        if ops:
+            self.write_ops(ops)
+
+    def release_regions(self) -> None:
+        """Retire this tier's raft groups from the fleet and the meta
+        routing table (DROP TABLE / schema reset — without this, dropped
+        tables' replicas would heartbeat and balance forever)."""
+        for m in self.metas:
+            self.fleet.groups.pop(m.region_id, None)
+            self.fleet.meta.regions.pop(m.region_id, None)
+
+    def compact_all(self) -> None:
+        """Snapshot every replica's state into its core, truncating logs."""
+        for g in self.groups:
+            for node in g.bus.nodes.values():
+                node.compact()
+
+    def available(self) -> bool:
+        try:
+            for g in self.groups:
+                g.leader()
+        except RuntimeError:
+            return False
+        return True

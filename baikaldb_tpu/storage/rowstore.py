@@ -417,6 +417,13 @@ class Txn:
         self._order = list(order)
         del self._savepoints[sp:]
 
+    def pending_ops(self) -> list[tuple[int, bytes, bytes]]:
+        """The buffered write set in first-write order — what commit() would
+        apply.  Used by the replicated tier to turn a SQL COMMIT into raft
+        proposals instead of a local WAL batch."""
+        return [(op, k, v) for k in self._order
+                for op, v in (self._writes[k],)]
+
     def commit(self) -> int:
         if not self.active:
             raise RuntimeError("txn not active")

@@ -31,7 +31,7 @@ import numpy as np
 _FORCED_FLAG = "BENCH_FORCED_CPU"
 
 
-def _probe_backend(timeout_s: float) -> str | None:
+def _probe_backend_once(timeout_s: float) -> str | None:
     """Initialise the JAX backend in a THROWAWAY subprocess; return the
     platform name, or None if init fails or hangs (wedged tunnel)."""
     code = "import jax; print(jax.devices()[0].platform)"
@@ -46,12 +46,40 @@ def _probe_backend(timeout_s: float) -> str | None:
     return out[-1] if out else None
 
 
-def _reexec_cpu():
-    """Replace this process with a forced-CPU run of the same benchmark."""
+def _probe_backend() -> str | None:
+    """Retry the backend probe across a window: the axon tunnel recovers on
+    its own after transient wedges, and a single 180 s shot recorded a CPU
+    number for a whole round (VERDICT r02 weak #2).  Knobs:
+    BENCH_PROBE_WINDOW (total s, default 300), BENCH_PROBE_TIMEOUT (per
+    attempt, default 75)."""
+    window = float(os.environ.get("BENCH_PROBE_WINDOW", 300))
+    per_try = float(os.environ.get("BENCH_PROBE_TIMEOUT", 75))
+    deadline = time.monotonic() + window
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.monotonic()
+        platform = _probe_backend_once(min(per_try, max(5.0, deadline - t0)))
+        if platform is not None:
+            return platform
+        print(f"bench: backend probe attempt {attempt} failed "
+              f"({time.monotonic() - t0:.0f}s)", file=sys.stderr)
+        if time.monotonic() + 10 >= deadline:
+            return None
+        time.sleep(10)
+
+
+def _reexec_cpu(reason: str):
+    """Replace this process with a forced-CPU run of the same benchmark.
+
+    ``reason`` is carried through the environment into the JSON line's
+    ``error`` field so a CPU fallback can never masquerade as the TPU
+    result (VERDICT r02 weak #2)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = ""          # bypass accelerator site hooks entirely
     env[_FORCED_FLAG] = "1"
+    env["BENCH_FALLBACK_REASON"] = reason
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
@@ -177,23 +205,29 @@ def run_bench() -> dict:
     got_n = np.asarray(out[1])[:n_groups]   # slot n_groups is the NULL-key slot
     assert np.array_equal(want_n, got_n), "benchmark kernel wrong"
 
-    return {
+    result = {
         "metric": f"filter+GROUP BY rows/sec ({n_rows / 1e6:.0f}M rows, "
                   f"{platform})",
         "value": round(dev_rps, 1),
         "unit": "rows/sec",
         "vs_baseline": round(dev_rps / bas_rps, 3),
+        "platform": platform,
+        "rows": n_rows,
     }
+    reason = os.environ.get("BENCH_FALLBACK_REASON")
+    if reason:
+        result["error"] = reason
+    return result
 
 
 def main():
     forced = os.environ.get(_FORCED_FLAG) == "1"
     if not forced:
-        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
-        platform = _probe_backend(probe_timeout)
+        platform = _probe_backend()
         if platform is None:
             # backend init failed or hung: never touch it from this process
-            _reexec_cpu()
+            _reexec_cpu("accelerator probe failed across retry window; "
+                        "CPU fallback")
     try:
         result = run_bench()
     except Exception as e:                          # noqa: BLE001
@@ -202,9 +236,10 @@ def main():
             # accelerator-side failure, then retry once on CPU
             print(f"bench: accelerator run failed, retrying on CPU: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
-            _reexec_cpu()
+            _reexec_cpu(f"accelerator run failed ({type(e).__name__}); "
+                        "CPU fallback")
         result = {"metric": "filter+GROUP BY rows/sec (failed)", "value": 0,
-                  "unit": "rows/sec", "vs_baseline": 0.0,
+                  "unit": "rows/sec", "vs_baseline": 0.0, "platform": "none",
                   "error": f"{type(e).__name__}: {e}"}
     print(json.dumps(result))
     return 0

@@ -22,3 +22,10 @@ if "--xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache shared with __graft_entry__.dryrun_multichip:
+# the suite compiles the same cpu/8-device programs the driver's multichip
+# check runs, so warming the cache here makes that check finish in seconds.
+from baikaldb_tpu.utils import compilecache  # noqa: E402
+
+compilecache.enable()

@@ -1,0 +1,240 @@
+"""SQL on the raft-replicated store tier (VERDICT r02 missing #1).
+
+Reference behavior being matched: every DML is a raft apply on a Region
+(/root/reference/src/store/region.cpp:2301 dml_1pc, :1961 dml_2pc), COMMIT is
+primary-first 2PC from the frontend (fetcher_store.cpp:1848-1904), and a
+store restart recovers committed state from the replicated log
+(include/store/region.h:644).  These tests drive all of it through SQL:
+
+- differential: the same workload on a 3-store fleet-bound Session and on a
+  plain single-node Session produces identical query results,
+- a leader SIGKILL mid-workload loses nothing committed (writes keep
+  succeeding after re-election; a fresh Database rebuilt from the replicas
+  sees every committed row),
+- a SQL transaction spanning regions commits atomically through 2PC, and a
+  rolled-back transaction leaves no trace in the replicas.
+"""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Database, Session
+from baikaldb_tpu.meta.service import MetaService
+from baikaldb_tpu.raft.core import raft_available
+from baikaldb_tpu.raft.fleet import StoreFleet
+from baikaldb_tpu.storage.replicated import ReplicationError
+
+pytestmark = pytest.mark.skipif(not raft_available(),
+                                reason="native raft core unavailable")
+
+STORES = ["store1:8110", "store2:8110", "store3:8110"]
+
+
+def make_fleet():
+    meta = MetaService(peer_count=3)
+    return StoreFleet(meta, STORES, seed=11)
+
+
+def fleet_session():
+    fleet = make_fleet()
+    db = Database(fleet=fleet)
+    return Session(db), fleet
+
+
+WORKLOAD = [
+    "CREATE TABLE t (id BIGINT, name VARCHAR(32), score DOUBLE, "
+    "PRIMARY KEY (id))",
+    "INSERT INTO t VALUES (1, 'ada', 9.5), (2, 'bob', 7.25), (3, 'cyd', 8.0)",
+    "UPDATE t SET score = score + 1 WHERE id <= 2",
+    "DELETE FROM t WHERE name = 'cyd'",
+    "INSERT INTO t VALUES (4, 'dee', 5.0)",
+    "BEGIN",
+    "INSERT INTO t VALUES (5, 'eve', 6.5)",
+    "UPDATE t SET score = 0 WHERE id = 4",
+    "COMMIT",
+    "BEGIN",
+    "INSERT INTO t VALUES (6, 'fox', 1.0)",
+    "ROLLBACK",
+]
+
+CHECKS = [
+    "SELECT id, name, score FROM t ORDER BY id",
+    "SELECT COUNT(*) n, SUM(score) s FROM t",
+    "SELECT name FROM t WHERE score > 6 ORDER BY name",
+]
+
+
+def test_differential_vs_single_node():
+    rep, _ = fleet_session()
+    plain = Session(Database())
+    for sql in WORKLOAD:
+        rep.execute(sql)
+        plain.execute(sql)
+    for q in CHECKS:
+        assert rep.query(q) == plain.query(q), q
+
+
+def test_dml_lands_in_raft_replicas():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 1.0), (2, 2.0)")
+    tier = fleet.row_tiers["default.t"]
+    # every region group's LEADER has the rows raft-committed; so do
+    # followers (same log)
+    rows = tier.scan_rows()
+    live = [r for r in rows if not r.get("__del")]
+    assert len(live) == 2
+    for g in tier.groups:
+        ldr = g.bus.nodes[g.leader()]
+        for nid, node in g.bus.nodes.items():
+            assert node.core.commit_index == ldr.core.commit_index, \
+                f"replica {nid} lags in region {g.region_id}"
+
+
+def test_leader_kill_mid_workload_loses_nothing_committed():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    for i in range(10):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+
+    # find the store currently leading the most regions and SIGKILL it
+    tier = fleet.row_tiers["default.t"]
+    leaders = [g.leader() for g in tier.groups]
+    victim_nid = max(set(leaders), key=leaders.count)
+    victim_addr = fleet._addr[victim_nid]
+    fleet.kill_store(victim_addr)
+
+    # writes continue: groups re-elect among the surviving 2/3 quorum
+    for i in range(10, 20):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    assert s.query("SELECT COUNT(*) n FROM t") == [{"n": 20}]
+
+    # a FRESH frontend rebuilt from the replicas sees every committed row:
+    # nothing the killed leader acked is lost
+    db2 = Database(fleet=fleet)
+    s2 = Session(db2)
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    got = s2.query("SELECT COUNT(*) n, SUM(v) s FROM t")
+    assert got == [{"n": 20, "s": float(sum(range(20)))}]
+
+
+def test_txn_commit_spans_regions_via_2pc():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    s.execute("BEGIN")
+    # enough rows that fnv routing crosses both region groups
+    for i in range(16):
+        s.execute(f"INSERT INTO t VALUES ({i}, 1.0)")
+    s.execute("COMMIT")
+    tier = fleet.row_tiers["default.t"]
+    per_region = [len(g.bus.nodes[g.leader()].rows()) for g in tier.groups]
+    assert sum(per_region) == 16
+    assert all(n > 0 for n in per_region), \
+        f"txn should span regions, got {per_region}"
+    # no prepared (in-doubt) txns remain anywhere after a clean commit
+    for g in tier.groups:
+        for node in g.bus.nodes.values():
+            assert not node.prepared
+
+
+def test_rollback_leaves_no_trace_in_replicas():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    s.execute("BEGIN")
+    for i in range(8):
+        s.execute(f"INSERT INTO t VALUES ({i}, 1.0)")
+    s.execute("ROLLBACK")
+    tier = fleet.row_tiers["default.t"]
+    assert tier.num_rows() == 0
+    assert s.query("SELECT COUNT(*) n FROM t") == [{"n": 0}]
+
+
+def test_no_quorum_fails_statement_and_keeps_cache_consistent():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 1.0)")
+    # kill two of three stores: no region group can reach quorum
+    fleet.kill_store(STORES[0])
+    fleet.kill_store(STORES[1])
+    with pytest.raises(ReplicationError):
+        s.execute("INSERT INTO t VALUES (2, 2.0)")
+    # the columnar cache did NOT apply the failed write
+    assert s.query("SELECT COUNT(*) n FROM t") == [{"n": 1}]
+    with pytest.raises(ReplicationError):
+        s.execute("DELETE FROM t WHERE id = 1")
+    assert s.query("SELECT COUNT(*) n FROM t") == [{"n": 1}]
+
+
+def test_truncate_replicates():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    for i in range(6):
+        s.execute(f"INSERT INTO t VALUES ({i}, 1.0)")
+    s.execute("TRUNCATE TABLE t")
+    # a rebuild from the replicas must not resurrect truncated rows
+    db2 = Database(fleet=fleet)
+    s2 = Session(db2)
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    assert s2.query("SELECT COUNT(*) n FROM t") == [{"n": 0}]
+
+
+def test_alter_table_rebuilds_replicated_encoding():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 1.5), (2, 2.5)")
+    s.execute("ALTER TABLE t ADD COLUMN note VARCHAR(16)")
+    s.execute("INSERT INTO t VALUES (3, 3.5, 'new')")
+    # recovery decodes every replicated row with the NEW codec.  (The
+    # catalog is recovered separately — here by recreating the post-ALTER
+    # schema; the fleet replicates DATA.  Folding the catalog into the
+    # raft-replicated meta service removes this step.)
+    db2 = Database(fleet=fleet)
+    s2 = Session(db2)
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, note VARCHAR(16), "
+               "PRIMARY KEY (id))")
+    assert s2.query("SELECT id, v, note FROM t ORDER BY id") == [
+        {"id": 1, "v": 1.5, "note": None},
+        {"id": 2, "v": 2.5, "note": None},
+        {"id": 3, "v": 3.5, "note": "new"},
+    ]
+
+
+def test_commit_no_quorum_restores_columnar_preimage():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 1.0)")
+    s.execute("BEGIN")
+    s.execute("INSERT INTO t VALUES (2, 2.0)")
+    s.execute("UPDATE t SET v = 9.0 WHERE id = 1")
+    fleet.kill_store(STORES[0])
+    fleet.kill_store(STORES[1])
+    with pytest.raises(ReplicationError):
+        s.execute("COMMIT")
+    # the columnar cache rolled back to the pre-transaction image
+    assert s.query("SELECT id, v FROM t ORDER BY id") == [{"id": 1, "v": 1.0}]
+
+
+def test_drop_table_releases_raft_groups():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 1.0)")
+    n_groups = len(fleet.groups)
+    n_regions = len(fleet.meta.regions)
+    assert n_groups > 0
+    s.execute("DROP TABLE t")
+    assert "default.t" not in fleet.row_tiers
+    assert len(fleet.groups) < n_groups
+    assert len(fleet.meta.regions) < n_regions
+
+
+def test_bulk_ingest_replicates():
+    import pyarrow as pa
+
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    n = 500
+    s.load_arrow("t", pa.table({"id": list(range(n)),
+                                "v": [float(i) for i in range(n)]}))
+    db2 = Database(fleet=fleet)
+    s2 = Session(db2)
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    assert s2.query("SELECT COUNT(*) n FROM t") == [{"n": n}]
