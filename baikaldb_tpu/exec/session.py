@@ -168,15 +168,32 @@ class Database:
     Database over the same directory recovers committed state — the analog
     of baikalStore restart recovery (SURVEY §3.4)."""
 
-    def __init__(self, data_dir: Optional[str] = None, fleet=None):
+    def __init__(self, data_dir: Optional[str] = None, fleet=None,
+                 cluster=None):
         """``fleet``: a raft.fleet.StoreFleet — when set, every table's hot
         row tier is raft-replicated across the fleet's store nodes (DML
         quorum-commits through region raft groups; a new Database over the
         same fleet recovers committed state from the replicas).  The
         reference's always-on mode: every DML is a raft apply on a Region
-        (src/store/region.cpp:1961,2301)."""
+        (src/store/region.cpp:1961,2301).
+
+        ``cluster``: a storage.remote_tier.ClusterClient (or "host:port" of
+        the meta daemon) — the multi-process variant of ``fleet``: the same
+        replication discipline, but regions live in real store daemon
+        processes reached over TCP (the three-binary deployment,
+        src/protocol/main.cpp + store/main.cpp + meta_server/main.cpp)."""
         self.catalog = Catalog()
         self.fleet = fleet
+        if isinstance(cluster, str):
+            from ..storage.remote_tier import ClusterClient
+            cluster = ClusterClient(cluster)
+        self.cluster = cluster
+        if data_dir and (fleet is not None or cluster is not None):
+            # the replicated tier IS the durability story in fleet/cluster
+            # mode; silently skipping the requested WAL would be worse than
+            # refusing (the operator asked for local durability)
+            raise ValueError("data_dir cannot combine with fleet/cluster "
+                             "mode: durability lives in the replicated tier")
         self.stores: dict[str, TableStore] = {}
         # query statistics ring (reference: slow-SQL collection + print_agg_sql,
         # network_server.h:82-107) — feeds information_schema.query_log
@@ -207,6 +224,13 @@ class Database:
             tier = ReplicatedRowTier.get_or_create(
                 self.fleet, info.table_id, key, st._row_schema(),
                 [ROWID_COL])
+            st.attach_replicated(tier)
+            return st
+        if self.cluster is not None:
+            from ..storage.remote_tier import RemoteRowTier
+            st = TableStore(info)
+            tier = RemoteRowTier.get_or_create(
+                self.cluster, key, st._row_schema(), [ROWID_COL])
             st.attach_replicated(tier)
             return st
         if not self.data_dir:
@@ -784,6 +808,10 @@ class Session:
             tier = self.db.fleet.row_tiers.pop(key, None)
             if tier is not None:
                 tier.release_regions()   # no ghost raft groups in the fleet
+        if self.db.cluster is not None:
+            tier = self.db.cluster.tiers.pop(key, None)
+            if tier is not None:
+                tier.release_regions()
         if not self.db.data_dir:
             return
         import os

@@ -66,8 +66,19 @@ class TwoPhaseCoordinator:
         if crash_after == "prepare":
             return txn                    # coordinator dies here
         # decision record + commit on the PRIMARY first: once this is in the
-        # primary's log the txn is globally COMMITTED
-        self.primary.propose_cmd(CMD_DECIDE, txn, bytes([CMD_COMMIT]))
+        # primary's log the txn is globally COMMITTED.  The decision propose
+        # MUST be verified — acking a txn whose decision never reached
+        # quorum would lose it (recovery would roll the prepares back).
+        if not self.primary.propose_cmd(CMD_DECIDE, txn,
+                                        bytes([CMD_COMMIT])):
+            for p in prepared:
+                p.propose_cmd(CMD_ROLLBACK, txn)
+            raise TwoPhaseError(
+                f"commit decision failed on primary region "
+                f"{self.primary.region_id}")
+        # past the decision point the txn is committed; the remaining
+        # proposals are completion, not consensus — a failure here leaves an
+        # in-doubt prepare that resolve_in_doubt finishes from the decision
         self.primary.propose_cmd(CMD_COMMIT, txn)
         if crash_after == "primary":
             return txn                    # coordinator dies here

@@ -11,8 +11,14 @@ def main():
     ap.add_argument("--port", type=int, default=28000)
     ap.add_argument("--qos-rate", type=float, default=0.0,
                     help="global queries/sec admission limit (0 = off)")
+    ap.add_argument("--meta", default="",
+                    help="meta daemon host:port — DML replicates to the "
+                         "store daemon cluster it places")
+    ap.add_argument("--data-dir", default="",
+                    help="durable single-node mode (WAL + Parquet)")
     args = ap.parse_args()
 
+    from ..exec.session import Database
     from .mysql_server import MySQLServer
 
     qos = None
@@ -23,7 +29,9 @@ def main():
                          global_burst=2 * args.qos_rate,
                          sign_rate=args.qos_rate / 4,
                          sign_burst=args.qos_rate / 2)
-    srv = MySQLServer(host=args.host, port=args.port, qos=qos).start()
+    db = Database(data_dir=args.data_dir or None,
+                  cluster=args.meta or None)
+    srv = MySQLServer(db, host=args.host, port=args.port, qos=qos).start()
     print(f"baikaldb_tpu listening on {args.host}:{srv.port}", flush=True)
     try:
         while True:

@@ -1,0 +1,109 @@
+"""baikalMeta-analog daemon: the meta service behind the TCP RPC plane.
+
+Wraps ``meta.service.MetaService`` (topology, region registry, heartbeats,
+TSO) the way src/meta_server/main.cpp:38 serves MetaService RPCs over brpc.
+Region placement, health transitions and the balance loop are the in-process
+service's — this daemon only adds the process boundary and the stable
+store-id registry the raft transport needs.
+
+Run: python -m baikaldb_tpu.server.meta_server --address 127.0.0.1:9100
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+from ..meta.service import HeartbeatRequest, MetaService
+from ..utils.net import RpcServer
+
+
+class MetaServer:
+    def __init__(self, address: str, peer_count: int = 3):
+        host, port = address.rsplit(":", 1)
+        self.rpc = RpcServer(host, int(port))
+        self.service = MetaService(peer_count=peer_count)
+        self._store_ids: dict[str, int] = {}        # address -> store_id
+        self._mu = threading.Lock()
+        for name in ("register_store", "create_regions", "table_regions",
+                     "drop_regions", "heartbeat", "tso", "instances", "ping"):
+            self.rpc.register(name, getattr(self, "rpc_" + name))
+
+    def start(self) -> None:
+        self.rpc.start()
+
+    def stop(self) -> None:
+        self.rpc.stop()
+
+    # -- RPC surface ------------------------------------------------------
+    def rpc_ping(self):
+        return {}
+
+    def rpc_register_store(self, address: str, store_id: int):
+        with self._mu:
+            self._store_ids[address] = int(store_id)
+            if address not in self.service.instances:
+                self.service.add_instance(address)
+        return {}
+
+    def rpc_instances(self):
+        with self._mu:
+            return {a: {"store_id": sid,
+                        "status": self.service.instances[a].status}
+                    for a, sid in self._store_ids.items()
+                    if a in self.service.instances}
+
+    def _region_wire(self, r):
+        with self._mu:
+            return {"region_id": r.region_id, "table_id": r.table_id,
+                    "leader": r.leader,
+                    "peers": [[self._store_ids.get(p, 0), p]
+                              for p in r.peers]}
+
+    def rpc_create_regions(self, table_id: int, n_regions: int):
+        metas = self.service.create_regions(int(table_id), int(n_regions))
+        return [self._region_wire(r) for r in metas]
+
+    def rpc_table_regions(self, table_id: int):
+        with self._mu:
+            regions = [r for r in self.service.regions.values()
+                       if r.table_id == int(table_id)]
+        return [self._region_wire(r) for r in sorted(regions,
+                                                     key=lambda r: r.region_id)]
+
+    def rpc_drop_regions(self, region_ids: list):
+        with self._mu:
+            for rid in region_ids:
+                self.service.regions.pop(int(rid), None)
+        return {}
+
+    def rpc_heartbeat(self, address: str, regions: dict, leader_ids: list):
+        req = HeartbeatRequest(
+            address,
+            {int(rid): (int(v), int(n)) for rid, (v, n) in regions.items()},
+            [int(x) for x in leader_ids])
+        resp = self.service.heartbeat(req)
+        return {"orders": len(resp.orders)}
+
+    def rpc_tso(self, count: int = 1):
+        return {"ts": self.service.tso.gen(int(count))}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--address", required=True)
+    ap.add_argument("--peer-count", type=int, default=3)
+    args = ap.parse_args()
+    srv = MetaServer(args.address, peer_count=args.peer_count)
+    srv.start()
+    print(f"meta serving on {srv.rpc.host}:{srv.rpc.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

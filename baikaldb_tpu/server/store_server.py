@@ -1,0 +1,226 @@
+"""baikalStore-analog daemon: one process hosting raft-replicated regions.
+
+The reference's store binary (src/store/main.cpp:76) hosts many
+Region : braft::StateMachine objects over brpc; here a StoreServer hosts
+``raft.cluster.ReplicatedRegion`` replicas, exchanges raft messages with peer
+stores over the TCP RPC plane (utils/net.py), drives elections/heartbeats
+from a tick thread, and reports region state to the meta daemon
+(store→meta heartbeats, SURVEY §3.5).
+
+All raft-core access is serialized under one lock (the native core is a
+single-threaded deterministic state machine by design); the tick loop is the
+only place messages move, so delivery order stays deterministic per store.
+
+Run: python -m baikaldb_tpu.server.store_server --store-id 1 \
+         --address 127.0.0.1:9101 --meta 127.0.0.1:9100
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Optional
+
+from ..raft.cluster import ReplicatedRegion
+from ..raft.core import LEADER
+from ..types import Field, LType, Schema
+from ..utils.net import RpcClient, RpcServer
+
+
+def schema_to_wire(schema: Schema) -> list:
+    return [[f.name, f.ltype.value, f.nullable] for f in schema.fields]
+
+
+def schema_from_wire(fields: list) -> Schema:
+    return Schema(tuple(Field(n, LType(v), nullable)
+                        for n, v, nullable in fields))
+
+
+class StoreServer:
+    def __init__(self, store_id: int, address: str, meta_address: str = "",
+                 tick_interval: float = 0.05, seed: Optional[int] = None):
+        self.store_id = store_id
+        self.address = address
+        host, port = address.rsplit(":", 1)
+        self.rpc = RpcServer(host, int(port))
+        self.meta = RpcClient(meta_address) if meta_address else None
+        self.tick_interval = tick_interval
+        self.seed = seed if seed is not None else store_id * 7 + 1
+        self._mu = threading.Lock()          # guards every raft-core touch
+        self.regions: dict[int, ReplicatedRegion] = {}
+        self._peer_addr: dict[int, str] = {}           # store_id -> address
+        self._peer_clients: dict[int, RpcClient] = {}
+        self._stop = threading.Event()
+        for name in ("create_region", "drop_region", "raft_msg", "propose",
+                     "scan_raw", "region_status", "ping"):
+            self.rpc.register(name, getattr(self, "rpc_" + name))
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self.rpc.start()
+        threading.Thread(target=self._tick_loop, daemon=True).start()
+        if self.meta is not None:
+            self.meta.try_call("register_store", address=self.address,
+                               store_id=self.store_id)
+            threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.rpc.stop()
+
+    # -- RPC surface ------------------------------------------------------
+    def rpc_ping(self):
+        return {"store_id": self.store_id}
+
+    def rpc_create_region(self, region_id: int, peers: list, fields: list,
+                          key_columns: list):
+        """peers: [[store_id, address], ...] including this store."""
+        with self._mu:
+            for sid, addr in peers:
+                sid = int(sid)
+                self._peer_addr[sid] = addr
+            if int(region_id) in self.regions:
+                return {"created": False}
+            region = ReplicatedRegion(
+                self.store_id, [int(sid) for sid, _ in peers],
+                seed=self.seed + int(region_id),
+                schema=schema_from_wire(fields),
+                key_columns=list(key_columns))
+            self.regions[int(region_id)] = region
+        return {"created": True}
+
+    def rpc_drop_region(self, region_id: int):
+        with self._mu:
+            self.regions.pop(int(region_id), None)
+        return {}
+
+    def rpc_raft_msg(self, region_id: int, msg: bytes):
+        with self._mu:
+            region = self.regions.get(int(region_id))
+            if region is not None:
+                region.core.receive(msg)
+        return {}
+
+    def rpc_propose(self, region_id: int, payload: bytes,
+                    wait_s: float = 5.0):
+        """Leader-side propose + wait-for-commit (the braft apply + closure
+        ack, store-side of region.cpp:1961/2301).  Non-leaders answer with a
+        redirect hint (the reference's NOT_LEADER + leader_id response)."""
+        region = self.regions.get(int(region_id))
+        if region is None:
+            return {"status": "no_region"}
+        with self._mu:
+            if region.core.role != LEADER:
+                return {"status": "not_leader",
+                        "leader": int(region.core.leader)}
+            idx = region.core.propose(payload)
+            if idx < 0:
+                return {"status": "not_leader",
+                        "leader": int(region.core.leader)}
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            with self._mu:
+                if region.core.commit_index >= idx:
+                    return {"status": "ok", "index": int(idx)}
+                if region.core.role != LEADER:
+                    return {"status": "lost_leadership"}
+            time.sleep(self.tick_interval / 2)
+        return {"status": "timeout"}
+
+    def rpc_scan_raw(self, region_id: int):
+        region = self.regions.get(int(region_id))
+        if region is None:
+            return {"status": "no_region"}
+        with self._mu:
+            if region.core.role != LEADER:
+                return {"status": "not_leader",
+                        "leader": int(region.core.leader)}
+            # propose acks at COMMIT; the tick loop applies on its next
+            # turn — drain here so a read right after a write sees it
+            # (read-your-writes on the leader)
+            region.apply_committed()
+            pairs = region.table.scan_raw()
+        return {"status": "ok", "pairs": [[k, v] for k, v in pairs]}
+
+    def rpc_region_status(self):
+        with self._mu:
+            return {str(rid): {"role": r.core.role,
+                               "term": r.core.term,
+                               "commit": r.core.commit_index,
+                               "rows": len(r.table.scan_raw())}
+                    for rid, r in self.regions.items()}
+
+    # -- background loops -------------------------------------------------
+    def _tick_loop(self) -> None:
+        # the tick thread IS the raft clock: if it dies, elections stop and
+        # every region on this store freezes — so any per-iteration failure
+        # is logged and survived, never fatal (the reference store's
+        # SIGSEGV-handler-keeps-serving discipline, src/store/main.cpp:50)
+        while not self._stop.is_set():
+            try:
+                self._tick_once()
+            except Exception as e:  # noqa: BLE001
+                print(f"store {self.store_id}: tick error "
+                      f"{type(e).__name__}: {e}", flush=True)
+            time.sleep(self.tick_interval)
+
+    def _tick_once(self) -> None:
+        outbound: list[tuple[int, int, bytes]] = []
+        with self._mu:
+            for rid, region in list(self.regions.items()):
+                region.core.tick()
+                for dest, msg in region.core.drain_messages():
+                    outbound.append((rid, dest, msg))
+                region.apply_committed()
+        for rid, dest, msg in outbound:
+            client = self._client_of(dest)
+            if client is not None:
+                client.try_call("raft_msg", region_id=rid, msg=msg)
+
+    def _client_of(self, store_id: int) -> Optional[RpcClient]:
+        if store_id == self.store_id:
+            return None
+        c = self._peer_clients.get(store_id)
+        if c is None:
+            addr = self._peer_addr.get(store_id)
+            if addr is None:
+                return None
+            c = self._peer_clients[store_id] = RpcClient(addr, timeout=2.0)
+        return c
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._mu:
+                regions = {}
+                leaders = []
+                for rid, r in self.regions.items():
+                    regions[str(rid)] = [1, len(r.table.scan_raw())]
+                    if r.core.role == LEADER:
+                        leaders.append(rid)
+            self.meta.try_call("heartbeat", address=self.address,
+                               regions=regions, leader_ids=leaders)
+            time.sleep(1.0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--store-id", type=int, required=True)
+    ap.add_argument("--address", required=True)
+    ap.add_argument("--meta", default="")
+    ap.add_argument("--tick", type=float, default=0.05)
+    args = ap.parse_args()
+    srv = StoreServer(args.store_id, args.address, args.meta,
+                      tick_interval=args.tick)
+    srv.start()
+    print(f"store {args.store_id} serving on {srv.rpc.host}:{srv.rpc.port}",
+          flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

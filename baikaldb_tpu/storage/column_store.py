@@ -827,12 +827,9 @@ class TableStore:
                 raise ConflictError("TRUNCATE while a transaction is open")
             if self.replicated is not None:
                 # the wipe must replicate, or a rebuild from the raft tier
-                # would resurrect the rows: __del markers for every live id
-                kc, rc = self.row_table.key_codec, self.row_table.row_codec
-                self.replicated.write_ops(
-                    [(0, kc.encode_one({ROWID: int(rid)}),
-                      rc.encode({ROWID: int(rid), "__del": True}))
-                     for r in self.regions for rid in r.rowids])
+                # would resurrect the rows; region retirement keeps it
+                # O(regions) instead of per-row tombstones living forever
+                self.replicated.truncate()
             self._mutations += 1
             self._pk_stale = True
             self.regions = [Region(self._alloc_region_id(),

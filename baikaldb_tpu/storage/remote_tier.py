@@ -1,0 +1,282 @@
+"""Frontend side of the multi-process cluster: SQL DML over real store
+daemons.
+
+The reference frontend fans per-region plans out to store processes over
+brpc with leader routing and NOT_LEADER redirect retries
+(/root/reference/src/exec/fetcher_store.cpp:123,351) and commits multi-region
+transactions primary-first (fetcher_store.cpp:1848-1904).  ``RemoteRowTier``
+implements the same tier contract as ``storage.replicated.ReplicatedRowTier``
+— so it plugs into the identical ``TableStore.attach_replicated`` seam — but
+every operation is an RPC to store daemons (server/store_server.py) placed by
+the meta daemon (server/meta_server.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..raft.cluster import (CMD_COMMIT, CMD_DECIDE, CMD_PREPARE, CMD_ROLLBACK,
+                            CMD_WRITE, encode_cmd, encode_ops)
+from ..raft.twopc import next_txn_id
+from ..types import Schema
+from ..utils.net import RpcClient
+from .replicated import ReplicationError, _fnv64
+from .rowstore import RowCodec
+
+
+class ClusterClient:
+    """Frontend handle on one deployment: the meta daemon + store daemons."""
+
+    def __init__(self, meta_address: str):
+        self.meta = RpcClient(meta_address)
+        self._stores: dict[str, RpcClient] = {}
+        self.tiers: dict[str, "RemoteRowTier"] = {}
+
+    def store(self, address: str) -> RpcClient:
+        c = self._stores.get(address)
+        if c is None:
+            c = self._stores[address] = RpcClient(address, timeout=8.0)
+        return c
+
+
+def stable_table_id(table_key: str) -> int:
+    """Frontends come and go; the cluster-wide table id must not depend on a
+    process-local catalog counter."""
+    return _fnv64(table_key.encode()) % (1 << 31)
+
+
+class _RemoteRegion:
+    """One region's routing state: peers as (store_id, address)."""
+
+    def __init__(self, region_id: int, peers: list[tuple[int, str]],
+                 leader: str):
+        self.region_id = region_id
+        self.peers = peers
+        self.leader_addr = leader or (peers[0][1] if peers else "")
+
+    def addr_of(self, store_id: int) -> Optional[str]:
+        for sid, addr in self.peers:
+            if sid == store_id:
+                return addr
+        return None
+
+
+class RemoteRowTier:
+    """Same API as ReplicatedRowTier, over the cluster RPC plane."""
+
+    def __init__(self, cluster: ClusterClient, table_key: str,
+                 row_schema: Schema, key_columns: list[str],
+                 n_regions: int = 2, propose_deadline: float = 12.0):
+        self.cluster = cluster
+        self.table_key = table_key
+        self.table_id = stable_table_id(table_key)
+        self.row_schema = row_schema
+        self.key_columns = list(key_columns)
+        self.row_codec = RowCodec(row_schema)
+        self.propose_deadline = propose_deadline
+        existing = cluster.meta.call("table_regions", table_id=self.table_id)
+        if existing:
+            self.regions = [self._from_wire(w) for w in existing]
+        else:
+            created = cluster.meta.call("create_regions",
+                                        table_id=self.table_id,
+                                        n_regions=n_regions)
+            self.regions = [self._from_wire(w) for w in created]
+            self._materialize()
+
+    @classmethod
+    def get_or_create(cls, cluster: ClusterClient, table_key: str,
+                      row_schema: Schema, key_columns: list[str],
+                      n_regions: int = 2) -> "RemoteRowTier":
+        tier = cluster.tiers.get(table_key)
+        if tier is None:
+            tier = cls(cluster, table_key, row_schema, key_columns, n_regions)
+            cluster.tiers[table_key] = tier
+        elif tier.row_schema != row_schema:
+            raise ValueError(
+                f"table {table_key!r}: requested schema does not match the "
+                f"cluster's replicated row encoding (recover the catalog — "
+                f"post-ALTER schema — before attaching)")
+        return tier
+
+    def _from_wire(self, w: dict) -> _RemoteRegion:
+        return _RemoteRegion(int(w["region_id"]),
+                             [(int(sid), addr) for sid, addr in w["peers"]],
+                             w.get("leader", ""))
+
+    def _materialize(self) -> None:
+        """init_region fan-out (store.interface.proto:425): every peer store
+        instantiates its replica."""
+        from ..server.store_server import schema_to_wire
+
+        fields = schema_to_wire(self.row_schema)
+        for r in self.regions:
+            for _, addr in r.peers:
+                self.cluster.store(addr).try_call(
+                    "create_region", region_id=r.region_id,
+                    peers=[[sid, a] for sid, a in r.peers],
+                    fields=fields, key_columns=self.key_columns)
+
+    # -- leader routing ---------------------------------------------------
+    def _propose(self, region: _RemoteRegion, payload: bytes) -> None:
+        """Propose to the region's leader, following NOT_LEADER hints and
+        riding out elections (fetcher_store's retry loop).  Every round
+        tries the hinted leader first, then EVERY peer — a round-robin that
+        can never starve a replica (a hint pointing at a dead or stale
+        leader must not pin the retry loop to one follower)."""
+        deadline = time.monotonic() + self.propose_deadline
+        hint = region.leader_addr
+        while time.monotonic() < deadline:
+            tried = []
+            for addr in [hint] + [a for _, a in region.peers if a != hint]:
+                if not addr or addr in tried:
+                    continue
+                tried.append(addr)
+                resp = self.cluster.store(addr).try_call(
+                    "propose", region_id=region.region_id, payload=payload,
+                    wait_s=3.0)
+                if resp is None:
+                    continue
+                status = resp.get("status")
+                if status == "ok":
+                    region.leader_addr = addr
+                    return
+                if status == "not_leader":
+                    new_hint = region.addr_of(int(resp.get("leader", -1)))
+                    if new_hint and new_hint not in tried and \
+                            time.monotonic() < deadline:
+                        resp2 = self.cluster.store(new_hint).try_call(
+                            "propose", region_id=region.region_id,
+                            payload=payload, wait_s=3.0)
+                        tried.append(new_hint)
+                        if resp2 is not None and resp2.get("status") == "ok":
+                            region.leader_addr = new_hint
+                            return
+                elif status == "no_region":
+                    self._materialize()
+            hint = region.leader_addr
+            time.sleep(0.15)        # election in progress: next round
+        raise ReplicationError(
+            f"region {region.region_id} of {self.table_key}: no leader "
+            f"accepted the write within {self.propose_deadline}s")
+
+    # -- tier API ----------------------------------------------------------
+    def _route(self, key: bytes) -> _RemoteRegion:
+        return self.regions[_fnv64(key) % len(self.regions)]
+
+    def write_ops(self, ops: list[tuple[int, bytes, bytes]]) -> None:
+        if not ops:
+            return
+        per: dict[int, list] = {}
+        by_id = {r.region_id: r for r in self.regions}
+        for op in ops:
+            per.setdefault(self._route(op[1]).region_id, []).append(op)
+        if len(per) == 1:
+            rid, batch = next(iter(per.items()))
+            self._propose(by_id[rid],
+                          encode_cmd(CMD_WRITE, 0, encode_ops(batch)))
+            return
+        # primary-first 2PC (fetcher_store.cpp:1848-1904): PREPARE all,
+        # decision + COMMIT on the primary, then the secondaries
+        txn = next_txn_id()
+        rids = sorted(per)
+        prepared: list[int] = []
+        try:
+            for rid in rids:
+                self._propose(by_id[rid],
+                              encode_cmd(CMD_PREPARE, txn,
+                                         encode_ops(per[rid])))
+                prepared.append(rid)
+        except ReplicationError:
+            for rid in prepared:
+                try:
+                    self._propose(by_id[rid], encode_cmd(CMD_ROLLBACK, txn))
+                except ReplicationError:
+                    pass        # region will resolve in-doubt via primary
+            raise
+        primary = by_id[rids[0]]
+        # the decision propose is the commit point: it must succeed or the
+        # txn is NOT committed (recovery rolls the prepares back)
+        try:
+            self._propose(primary, encode_cmd(CMD_DECIDE, txn,
+                                              bytes([CMD_COMMIT])))
+        except ReplicationError:
+            for rid in rids:
+                try:
+                    self._propose(by_id[rid], encode_cmd(CMD_ROLLBACK, txn))
+                except ReplicationError:
+                    pass
+            raise
+        # past the decision the txn IS committed: completion failures must
+        # not surface as txn failure (the frontend would roll its cache back
+        # while the replicas hold the commit) — best-effort here, in-doubt
+        # prepares resolve from the primary's decision record
+        for rid in rids:
+            try:
+                self._propose(by_id[rid], encode_cmd(CMD_COMMIT, txn))
+            except ReplicationError:
+                pass
+
+    def _scan_region(self, region: _RemoteRegion) -> list:
+        deadline = time.monotonic() + self.propose_deadline
+        candidates = [region.leader_addr] + \
+            [a for _, a in region.peers if a != region.leader_addr]
+        i = 0
+        while time.monotonic() < deadline:
+            addr = candidates[i % len(candidates)]
+            i += 1
+            resp = self.cluster.store(addr).try_call(
+                "scan_raw", region_id=region.region_id)
+            if resp is None:
+                continue
+            if resp.get("status") == "ok":
+                region.leader_addr = addr
+                return resp["pairs"]
+            time.sleep(0.1)
+        raise ReplicationError(
+            f"region {region.region_id} of {self.table_key}: no leader scan")
+
+    def scan_rows(self) -> list[dict]:
+        out: list[dict] = []
+        for r in self.regions:
+            for _, v in self._scan_region(r):
+                out.append(self.row_codec.decode(v))
+        return out
+
+    def num_rows(self) -> int:
+        return sum(1 for r in self.scan_rows() if not r.get("__del"))
+
+    def available(self) -> bool:
+        try:
+            for r in self.regions:
+                self._scan_region(r)
+        except ReplicationError:
+            return False
+        return True
+
+    # -- maintenance -------------------------------------------------------
+    def truncate(self) -> None:
+        """TRUNCATE by region retirement (see ReplicatedRowTier.truncate)."""
+        self.reset_schema(self.row_schema, [])
+
+    def release_regions(self) -> None:
+        rids = [r.region_id for r in self.regions]
+        for r in self.regions:
+            for _, addr in r.peers:
+                self.cluster.store(addr).try_call("drop_region",
+                                                  region_id=r.region_id)
+        self.cluster.meta.try_call("drop_regions", region_ids=rids)
+
+    def reset_schema(self, row_schema: Schema,
+                     ops: list[tuple[int, bytes, bytes]]) -> None:
+        n = max(1, len(self.regions))
+        self.release_regions()
+        self.row_schema = row_schema
+        self.row_codec = RowCodec(row_schema)
+        created = self.cluster.meta.call("create_regions",
+                                         table_id=self.table_id, n_regions=n)
+        self.regions = [self._from_wire(w) for w in created]
+        self._materialize()
+        if ops:
+            self.write_ops(ops)

@@ -74,6 +74,14 @@ class ReplicatedRowTier:
             tier = cls(fleet, table_id, table_key, row_schema, key_columns,
                        n_regions)
             fleet.row_tiers[table_key] = tier
+        elif tier.row_schema != row_schema:
+            # silent column-by-name replay against a mismatched schema would
+            # corrupt data (extra columns vanish, missing ones read NULL) —
+            # recover the catalog to the tier's schema first
+            raise ValueError(
+                f"table {table_key!r}: requested schema does not match the "
+                f"fleet's replicated row encoding (recover the catalog — "
+                f"post-ALTER schema — before attaching)")
         return tier
 
     # -- routing ----------------------------------------------------------
@@ -135,6 +143,12 @@ class ReplicatedRowTier:
         return sum(1 for r in self.scan_rows() if not r.get("__del"))
 
     # -- maintenance -------------------------------------------------------
+    def truncate(self) -> None:
+        """TRUNCATE: retire the regions and create fresh (empty) ones —
+        O(regions), vs per-row tombstones that would live in every replica
+        and every future recovery scan forever."""
+        self.reset_schema(self.row_schema, [])
+
     def reset_schema(self, row_schema: Schema,
                      ops: list[tuple[int, bytes, bytes]]) -> None:
         """ALTER TABLE boundary: the replicated row encoding is schema-bound

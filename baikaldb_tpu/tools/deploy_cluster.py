@@ -1,0 +1,121 @@
+"""Mini-cluster deploy: 1 meta + N store daemons + 1 MySQL frontend, all
+real processes on one host (the reference deployment shape,
+/root/reference/sysbench/baikaldb_deploy_scripts/init.sh: baikalMeta +
+3 baikalStore + baikaldb).
+
+Usage:
+    python -m baikaldb_tpu.tools.deploy_cluster [--stores 3] \
+        [--base-port 9100] [--mysql-port 28000]
+
+Prints one line per process and stays in the foreground; Ctrl-C tears the
+cluster down.  ``spawn_cluster`` is the library entry the e2e test uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from ..utils.net import RpcClient
+
+_ENV = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _spawn(args: list[str]) -> subprocess.Popen:
+    log_dir = os.environ.get("BK_CLUSTER_LOGS")
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        name = args[0].rsplit(".", 1)[-1] + "_" + \
+            "_".join(a.replace(":", "_").replace("/", "_")
+                     for a in args[1:] if not a.startswith("--"))
+        out = open(os.path.join(log_dir, name + ".log"), "ab")
+    else:
+        out = subprocess.DEVNULL
+    return subprocess.Popen([sys.executable, "-m"] + args, env=_ENV,
+                            cwd=_repo_root(), stdout=out, stderr=out)
+
+
+def _wait_ping(address: str, timeout: float = 15.0) -> None:
+    deadline = time.monotonic() + timeout
+    client = RpcClient(address, timeout=1.0)
+    while time.monotonic() < deadline:
+        if client.try_call("ping") is not None:
+            client.close()
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"no ping from {address}")
+
+
+def spawn_cluster(n_stores: int = 3, base_port: int = 9100,
+                  mysql_port: int = 0):
+    """-> (meta_address, [store processes], meta process, mysql process|None).
+    mysql_port=0 skips the frontend (tests drive Session directly)."""
+    meta_addr = f"127.0.0.1:{base_port}"
+    procs = {"meta": _spawn(["baikaldb_tpu.server.meta_server",
+                             "--address", meta_addr,
+                             "--peer-count", str(n_stores)]),
+             "stores": [], "mysql": None}
+    _wait_ping(meta_addr)
+    for i in range(1, n_stores + 1):
+        addr = f"127.0.0.1:{base_port + i}"
+        procs["stores"].append(_spawn(
+            ["baikaldb_tpu.server.store_server", "--store-id", str(i),
+             "--address", addr, "--meta", meta_addr]))
+        _wait_ping(addr)
+    if mysql_port:
+        procs["mysql"] = _spawn(["baikaldb_tpu.server",
+                                 "--port", str(mysql_port),
+                                 "--meta", meta_addr])
+    return meta_addr, procs
+
+
+def teardown(procs: dict) -> None:
+    victims = [procs.get("meta"), procs.get("mysql")] + procs.get("stores", [])
+    for p in victims:
+        if p is not None and p.poll() is None:
+            p.terminate()
+    for p in victims:
+        if p is not None:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stores", type=int, default=3)
+    ap.add_argument("--base-port", type=int, default=9100)
+    ap.add_argument("--mysql-port", type=int, default=28000)
+    args = ap.parse_args()
+    meta_addr, procs = spawn_cluster(args.stores, args.base_port,
+                                     args.mysql_port)
+    print(f"meta     @ {meta_addr} (pid {procs['meta'].pid})")
+    for i, p in enumerate(procs["stores"], 1):
+        print(f"store {i}  @ 127.0.0.1:{args.base_port + i} (pid {p.pid})")
+    if procs["mysql"] is not None:
+        print(f"mysql    @ 127.0.0.1:{args.mysql_port} "
+              f"(pid {procs['mysql'].pid})")
+    print("cluster up — Ctrl-C to tear down", flush=True)
+
+    def _stop(signum, frame):
+        teardown(procs)
+        sys.exit(0)
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

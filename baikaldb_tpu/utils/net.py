@@ -1,0 +1,190 @@
+"""Cluster RPC plane: length-prefixed JSON messages over TCP.
+
+The reference's inter-process contract is protobuf over brpc (SURVEY §5.8:
+meta control / store data / MPP shuffle planes).  Here the MPP shuffle plane
+is XLA collectives in-program, so the host side only needs a control/data
+RPC for raft messages, heartbeats, and region ops — small, latency-tolerant
+payloads.  JSON with tagged base64 for byte fields keeps the protocol
+language-neutral and safe (no pickle: a store must not execute payloads).
+
+Framing: 4-byte little-endian length + UTF-8 JSON body.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+_BYTES_TAG = "__b64__"
+
+
+def _enc(obj):
+    if isinstance(obj, bytes):
+        return {_BYTES_TAG: base64.b64encode(obj).decode()}
+    if isinstance(obj, dict):
+        return {k: _enc(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_enc(v) for v in obj]
+    return obj
+
+
+def _dec(obj):
+    if isinstance(obj, dict):
+        if set(obj) == {_BYTES_TAG}:
+            return base64.b64decode(obj[_BYTES_TAG])
+        return {k: _dec(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_dec(v) for v in obj]
+    return obj
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    body = json.dumps(_enc(obj)).encode()
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def recv_msg(sock: socket.socket):
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (n,) = struct.unpack("<I", header)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return _dec(json.loads(body.decode()))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+class RpcServer:
+    """Thread-per-connection RPC dispatch (the brpc service analog at test
+    scale; the data plane lives on the TPU, not in this loop)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._handlers: dict[str, Callable] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._handlers[name] = fn
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    req = recv_msg(conn)
+                except OSError:
+                    return
+                if req is None:
+                    return
+                method = req.get("method", "")
+                fn = self._handlers.get(method)
+                try:
+                    if fn is None:
+                        raise RpcError(f"unknown method {method!r}")
+                    resp = {"ok": True,
+                            "result": fn(**req.get("args", {}))}
+                except Exception as e:  # noqa: BLE001 — fault isolation per call
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                try:
+                    send_msg(conn, resp)
+                except OSError:
+                    return
+
+
+class RpcClient:
+    """One persistent connection to a peer; reconnects on failure."""
+
+    def __init__(self, address: str, timeout: float = 5.0):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._mu = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def call(self, method: str, **args):
+        with self._mu:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    send_msg(self._sock, {"method": method, "args": args})
+                    resp = recv_msg(self._sock)
+                    if resp is None:
+                        raise OSError("connection closed")
+                    break
+                except OSError:
+                    self.close_locked()
+                    if attempt:
+                        raise
+            if not resp.get("ok"):
+                raise RpcError(resp.get("error", "rpc failed"))
+            return resp.get("result")
+
+    def try_call(self, method: str, **args):
+        """call() that returns None instead of raising on transport/handler
+        failure (fan-out paths where a dead peer is expected)."""
+        try:
+            return self.call(method, **args)
+        except (OSError, RpcError):
+            return None
+
+    def close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._mu:
+            self.close_locked()
