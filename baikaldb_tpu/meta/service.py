@@ -52,6 +52,10 @@ class RegionMeta:
     leader: str = ""
     version: int = 1
     num_rows: int = 0
+    # memcomparable key-range ownership (hex; "" = unbounded) for the
+    # replicated row tier's split/merge (reference: RegionInfo start/end key)
+    start_key: str = ""
+    end_key: str = ""
 
 
 @dataclass
@@ -97,8 +101,21 @@ class Tso:
 
     def gen(self, count: int = 1) -> int:
         """Returns the FIRST of `count` consecutive timestamps."""
+        return self.gen_at(int(time.time() * 1000), count)
+
+    def restore(self, saved_max: int) -> None:
+        """Failover/restart: resume past the persisted lease so timestamps
+        stay monotonic even across leader changes with clock skew
+        (reference: tso_state_machine snapshot of max physical)."""
         with self._mu:
-            now = int(time.time() * 1000)
+            self._last_physical = max(self._last_physical, saved_max)
+            self._saved_max = max(self._saved_max, saved_max)
+
+    def gen_at(self, now: int, count: int = 1) -> int:
+        """Deterministic allocation at an explicit physical clock reading —
+        what a raft-replicated TSO applies on every replica (the leader's
+        clock rides the command payload)."""
+        with self._mu:
             if now <= self._last_physical:
                 now = self._last_physical
             else:
@@ -130,6 +147,9 @@ class MetaService:
         self.tso = Tso()
         self.schema_version = 1
         self._region_ids = itertools.count(1)
+        # allocation high-water mark: region ids are never reused, even
+        # after drop_regions (a reused id could alias a dead raft group)
+        self._last_region_id = 0
         # address (or "*") -> {flag: value} dynamic overrides
         self._params: dict[str, dict] = {}
         self._mu = threading.RLock()
@@ -183,6 +203,7 @@ class MetaService:
             out = []
             for i in range(n_regions):
                 rid = next(self._region_ids)
+                self._last_region_id = max(self._last_region_id, rid)
                 peers: list[str] = []
                 rooms: set[str] = set()
                 for _ in range(min(self.peer_count, max(1, len(self._healthy(resource_tag))))):
@@ -204,6 +225,7 @@ class MetaService:
         with self._mu:
             old = self.regions[region_id]
             rid = next(self._region_ids)
+            self._last_region_id = max(self._last_region_id, rid)
             new = RegionMeta(rid, old.table_id, split_row, old.end_row,
                              list(old.peers), old.leader)
             old.end_row = split_row
@@ -211,6 +233,40 @@ class MetaService:
             new.version = old.version
             self.regions[rid] = new
             return new
+
+    def split_region_key(self, region_id: int, split_key_hex: str) -> RegionMeta:
+        """Key-range split finalize: the new region inherits the parent's
+        peers (reference: split keeps placement, later balance may move it)
+        and both sides get a bumped version so stale-routed requests can be
+        rejected (region.cpp:4864)."""
+        with self._mu:
+            old = self.regions[region_id]
+            rid = next(self._region_ids)
+            self._last_region_id = max(self._last_region_id, rid)
+            new = RegionMeta(rid, old.table_id, peers=list(old.peers),
+                             leader=old.leader, start_key=split_key_hex,
+                             end_key=old.end_key)
+            old.end_key = split_key_hex
+            old.version += 1
+            new.version = old.version
+            self.regions[rid] = new
+            return new
+
+    def merge_regions_key(self, left_id: int, right_id: int) -> RegionMeta:
+        """Merge the right region into its left neighbor: the survivor
+        absorbs the range; the right retires from routing."""
+        with self._mu:
+            left = self.regions[left_id]
+            right = self.regions.pop(right_id)
+            left.end_key = right.end_key
+            left.version = max(left.version, right.version) + 1
+            return left
+
+    def drop_regions(self, region_ids: list[int]) -> None:
+        """Retire regions from the routing table (DROP TABLE / tier reset)."""
+        with self._mu:
+            for rid in region_ids:
+                self.regions.pop(int(rid), None)
 
     def route(self, table_id: int, row: int) -> Optional[RegionMeta]:
         """Row -> region (reference: SchemaFactory region routing)."""

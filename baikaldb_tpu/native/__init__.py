@@ -74,6 +74,8 @@ def _sig(lib):
     lib.bk_table_gc.argtypes = [c.c_void_p, c.c_uint64]
     lib.bk_table_num_keys.restype = c.c_int64
     lib.bk_table_num_keys.argtypes = [c.c_void_p]
+    lib.bk_table_num_live_keys.restype = c.c_int64
+    lib.bk_table_num_live_keys.argtypes = [c.c_void_p]
     return lib
 
 

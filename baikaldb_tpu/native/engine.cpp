@@ -313,4 +313,15 @@ int64_t bk_table_num_keys(BkTable* t) {
     return (int64_t)t->rows.size();
 }
 
+int64_t bk_table_num_live_keys(BkTable* t) {
+    // keys whose newest version is not a tombstone — the region-size signal
+    // for split/merge policy (tombstoned keys linger until gc, so
+    // num_keys would re-trigger splits on just-trimmed regions)
+    std::lock_guard<std::mutex> g(t->mu);
+    int64_t n = 0;
+    for (auto& kv : t->rows)
+        if (!kv.second.empty() && !kv.second.back().tombstone) ++n;
+    return n;
+}
+
 }  // extern "C"

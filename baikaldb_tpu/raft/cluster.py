@@ -32,6 +32,23 @@ CMD_PREPARE = 1      # buffer ops under txn_id (2PC phase 1)
 CMD_COMMIT = 2       # apply buffered txn_id (2PC phase 2)
 CMD_ROLLBACK = 3     # drop buffered txn_id
 CMD_DECIDE = 4       # primary-region commit decision record
+CMD_SET_RANGE = 5    # split/merge finalize: shrink/grow key range + version
+CMD_TRIM = 6         # drop keys outside the region's range (post-split GC)
+
+
+def encode_range(version: int, start: bytes, end: bytes) -> bytes:
+    return struct.pack("<II", version, len(start)) + start + \
+        struct.pack("<I", len(end)) + end
+
+
+def decode_range(data: bytes) -> tuple[int, bytes, bytes]:
+    version, slen = struct.unpack_from("<II", data, 0)
+    pos = 8
+    start = data[pos:pos + slen]
+    pos += slen
+    (elen,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    return version, start, data[pos:pos + elen]
 
 
 def encode_cmd(cmd: int, txn_id: int, ops_bytes: bytes = b"") -> bytes:
@@ -100,6 +117,13 @@ class ReplicatedRegion:
         # METAINFO_CF, transaction_pool.cpp)
         self.prepared: dict[int, bytes] = {}
         self.decisions: dict[int, int] = {}   # txn -> CMD_COMMIT|CMD_ROLLBACK
+        # key-range ownership: [start_key, end_key) with b"" = unbounded;
+        # range_version bumps at every split/merge finalize (the reference's
+        # region version used to reject stale-routed requests,
+        # region.cpp:4864 add_version)
+        self.start_key: bytes = b""
+        self.end_key: bytes = b""
+        self.range_version: int = 1
 
     def apply_committed(self) -> list[Committed]:
         """Drain the core's committed entries into the row table (the
@@ -109,17 +133,29 @@ class ReplicatedRegion:
             if c.kind == DATA:
                 cmd, txn_id, body = decode_cmd(c.data)
                 if cmd == CMD_WRITE:
-                    self.table.write_batch(decode_ops(body))
+                    self.table.write_batch(self._in_range(decode_ops(body)))
                 elif cmd == CMD_PREPARE:
                     self.prepared[txn_id] = body
                 elif cmd == CMD_COMMIT:
                     ops = self.prepared.pop(txn_id, None)
                     if ops is not None:
-                        self.table.write_batch(decode_ops(ops))
+                        self.table.write_batch(self._in_range(decode_ops(ops)))
                 elif cmd == CMD_ROLLBACK:
                     self.prepared.pop(txn_id, None)
                 elif cmd == CMD_DECIDE:
                     self.decisions[txn_id] = body[0]
+                elif cmd == CMD_SET_RANGE:
+                    v, s, e = decode_range(body)
+                    self.start_key, self.end_key = s, e
+                    self.range_version = max(self.range_version, v)
+                elif cmd == CMD_TRIM:
+                    # drop rows that moved to another region at split
+                    # finalize — deterministic on every replica (the
+                    # split-aware compaction-filter analog)
+                    dead = [(1, k, b"") for k, _ in self.table.scan_raw()
+                            if not self._covers(k)]
+                    if dead:
+                        self.table.write_batch(dead)
                 self.applied_index = c.index
             elif c.kind == SNAPSHOT_KIND:
                 self._install_snapshot(c.data)
@@ -128,10 +164,25 @@ class ReplicatedRegion:
                 self.applied_index = c.index
         return commits
 
+    def _covers(self, key: bytes) -> bool:
+        if self.start_key and key < self.start_key:
+            return False
+        if self.end_key and key >= self.end_key:
+            return False
+        return True
+
+    def _in_range(self, ops: list[tuple[int, bytes, bytes]]):
+        """After a split finalize, writes routed with a stale range must not
+        land here (the reference rejects them with version_old; the router
+        re-resolves and re-sends to the owning region)."""
+        if not self.start_key and not self.end_key:
+            return ops
+        return [op for op in ops if self._covers(op[1])]
+
     # -- snapshots --------------------------------------------------------
     def snapshot_bytes(self) -> bytes:
-        """Full replica state: rows + prepared txns + decisions (install
-        must not lose 2PC state, or an in-doubt txn could resolve wrong)."""
+        """Full replica state: rows + prepared txns + decisions + key range
+        (install must not lose 2PC or ownership state)."""
         pairs = self.table.scan_raw()
         out = [encode_ops([(0, k, v) for k, v in pairs])]
         out.append(struct.pack("<I", len(self.prepared)))
@@ -140,6 +191,8 @@ class ReplicatedRegion:
         out.append(struct.pack("<I", len(self.decisions)))
         for txn, d in sorted(self.decisions.items()):
             out.append(struct.pack("<QB", txn, d))
+        rng = encode_range(self.range_version, self.start_key, self.end_key)
+        out.append(struct.pack("<I", len(rng)) + rng)
         return b"".join(out)
 
     def _install_snapshot(self, data: bytes):
@@ -149,6 +202,9 @@ class ReplicatedRegion:
         pos = _ops_size(data)
         self.prepared = {}
         self.decisions = {}
+        self.start_key = b""
+        self.end_key = b""
+        self.range_version = 1
         if pos >= len(data):
             return                      # pre-2PC snapshot format
         (np_,) = struct.unpack_from("<I", data, pos)
@@ -164,6 +220,11 @@ class ReplicatedRegion:
             txn, d = struct.unpack_from("<QB", data, pos)
             pos += 9
             self.decisions[txn] = d
+        if pos < len(data):
+            (rlen,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            v, s, e = decode_range(data[pos:pos + rlen])
+            self.start_key, self.end_key, self.range_version = s, e, v
 
     def compact(self):
         """Snapshot own state into the core, truncating the log (the
@@ -173,6 +234,14 @@ class ReplicatedRegion:
     # -- reads ------------------------------------------------------------
     def rows(self) -> list[dict]:
         return self.table.scan_rows()
+
+    def rows_in_range(self) -> list[dict]:
+        """Rows this region OWNS.  During split/merge a replica can briefly
+        hold keys outside its committed range (copied but not yet trimmed,
+        or trimmed on another replica first); readers must never see them
+        twice, so ownership — not possession — decides visibility."""
+        return [self.table.row_codec.decode(v)
+                for k, v in self.table.scan_raw() if self._covers(k)]
 
 
 class LocalBus:
@@ -327,6 +396,17 @@ class RaftGroup:
             else:
                 return False
         return False
+
+    def set_range(self, version: int, start: bytes, end: bytes,
+                  max_ticks: int = 400) -> bool:
+        """Replicated range finalize (the add_version analog,
+        region.cpp:4864): after commit, replicas reject out-of-range
+        writes and TRIM drops moved rows."""
+        return self.propose_cmd(CMD_SET_RANGE, 0,
+                                encode_range(version, start, end), max_ticks)
+
+    def trim(self, max_ticks: int = 400) -> bool:
+        return self.propose_cmd(CMD_TRIM, 0, b"", max_ticks)
 
     def put_row(self, region: ReplicatedRegion, row: dict) -> bool:
         key = region.table.key_codec.encode_one(row)

@@ -70,6 +70,20 @@ class StoreFleet:
     def group(self, region_id: int) -> RaftGroup:
         return self.groups[region_id]
 
+    def materialize_region(self, rm, schema: Optional[Schema] = None,
+                           key_columns: Optional[list[str]] = None) -> RaftGroup:
+        """Instantiate a raft group for an already-registered RegionMeta —
+        the split path: meta registered the child region on the parent's
+        peers; the stores now host it (region.cpp:4472 init of the new
+        region on the same instances)."""
+        peer_ids = [self._id_of(a) for a in rm.peers]
+        g = RaftGroup(rm.region_id, peer_ids, seed=self.seed,
+                      schema=schema or self.schema,
+                      key_columns=key_columns or self.key_columns)
+        self.groups[rm.region_id] = g
+        rm.leader = self._addr[g.leader()]
+        return g
+
     def replica(self, region_id: int, address: str) -> ReplicatedRegion:
         return self.groups[region_id].bus.nodes[self._ids[address]]
 

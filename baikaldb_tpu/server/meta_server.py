@@ -73,9 +73,7 @@ class MetaServer:
                                                      key=lambda r: r.region_id)]
 
     def rpc_drop_regions(self, region_ids: list):
-        with self._mu:
-            for rid in region_ids:
-                self.service.regions.pop(int(rid), None)
+        self.service.drop_regions(region_ids)
         return {}
 
     def rpc_heartbeat(self, address: str, regions: dict, leader_ids: list):

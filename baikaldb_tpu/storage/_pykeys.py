@@ -128,3 +128,8 @@ class PyTable:
     def num_keys(self) -> int:
         with self._mu:
             return len(self._rows)
+
+    def num_live_keys(self) -> int:
+        with self._mu:
+            return sum(1 for vs in self._rows.values()
+                       if vs and not vs[-1][1])

@@ -6,8 +6,13 @@ include/store/region.h:626) and COMMIT is primary-first 2PC driven from the
 frontend (/root/reference/src/exec/fetcher_store.cpp:1848-1904).  This module
 puts the same discipline under the Session's DML path:
 
-- each replicated table owns N raft region groups (3 replicas each) hosted by
-  a ``raft.fleet.StoreFleet`` whose placement came from the meta service,
+- each replicated table owns raft region groups (3 replicas each) hosted by
+  a ``raft.fleet.StoreFleet`` whose placement came from the meta service;
+  regions own contiguous [start_key, end_key) slices of the memcomparable
+  keyspace (the reference's RegionInfo ranges) — a new table starts as ONE
+  region spanning everything and SPLITS by size, exactly the reference's
+  lifecycle (region.cpp:4472 split init, :7198 log catch-up, :4864
+  add_version finalize),
 - a single-region statement commits as ONE replicated write batch — the 1PC
   path — acked only after quorum commit,
 - a statement or SQL transaction spanning regions runs through
@@ -24,55 +29,75 @@ loses nothing committed.
 
 from __future__ import annotations
 
+import bisect
 from typing import TYPE_CHECKING, Optional
 
 from ..raft.cluster import RaftGroup
 from ..raft.core import LEADER
 from ..raft.twopc import TwoPhaseCoordinator, TwoPhaseError, next_txn_id
 from ..types import Schema
+from ..utils.flags import FLAGS, define
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..raft.fleet import StoreFleet
 
-
-class ReplicationError(RuntimeError):
-    """A replicated write could not reach quorum (region unavailable)."""
+define("region_split_rows", 200_000,
+       "auto-split a replicated region when it exceeds this many keys "
+       "(reference: region_split_lines)")
 
 
 def _fnv64(data: bytes) -> int:
+    """FNV-1a (storage.remote_tier derives stable table ids from it)."""
     h = 0xCBF29CE484222325
     for b in data:
         h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
     return h
 
 
+class ReplicationError(RuntimeError):
+    """A replicated write could not reach quorum (region unavailable)."""
+
+
+class SplitError(RuntimeError):
+    """A region split/merge could not complete (aborted, state unchanged)."""
+
+
 class ReplicatedRowTier:
-    """One table's raft-replicated row tier: key-routed region groups."""
+    """One table's raft-replicated row tier: range-routed region groups."""
 
     def __init__(self, fleet: "StoreFleet", table_id: int, table_key: str,
                  row_schema: Schema, key_columns: list[str],
-                 n_regions: int = 2):
+                 split_rows: int = 0):
         self.fleet = fleet
         self.table_id = table_id
         self.table_key = table_key
         self.row_schema = row_schema
         self.key_columns = list(key_columns)
+        # 0 = read the live flag at each check (SET GLOBAL takes effect)
+        self.split_rows = split_rows
         self.metas = fleet.create_table_regions(
-            table_id, n_regions, schema=row_schema, key_columns=key_columns)
+            table_id, 1, schema=row_schema, key_columns=key_columns)
         self.groups: list[RaftGroup] = [fleet.group(m.region_id)
                                         for m in self.metas]
+        # range bookkeeping lives in the tier (sorted, parallel to
+        # metas/groups) so routing survives meta leader failover: the lists
+        # of RegionMeta objects above may become stale references after a
+        # meta snapshot install, but region_ids and ranges do not change
+        # except through this tier's own split/merge
+        self._starts: list[bytes] = [b""]
+        self._ends: list[bytes] = [b""]
 
     @classmethod
     def get_or_create(cls, fleet: "StoreFleet", table_id: int, table_key: str,
                       row_schema: Schema, key_columns: list[str],
-                      n_regions: int = 2) -> "ReplicatedRowTier":
+                      split_rows: int = 0) -> "ReplicatedRowTier":
         """The fleet keeps one tier per table so a NEW Database over the same
         fleet recovers the existing replicated state instead of allocating
         fresh (empty) regions."""
         tier = fleet.row_tiers.get(table_key)
         if tier is None:
             tier = cls(fleet, table_id, table_key, row_schema, key_columns,
-                       n_regions)
+                       split_rows)
             fleet.row_tiers[table_key] = tier
         elif tier.row_schema != row_schema:
             # silent column-by-name replay against a mismatched schema would
@@ -86,41 +111,53 @@ class ReplicatedRowTier:
 
     # -- routing ----------------------------------------------------------
     def _route(self, key: bytes) -> int:
-        return _fnv64(key) % len(self.groups)
+        """Key -> index of the owning region (rightmost start <= key —
+        the reference's SchemaFactory range lookup)."""
+        return max(bisect.bisect_right(self._starts, key) - 1, 0)
 
     def _split_ops(self, ops: list[tuple[int, bytes, bytes]]):
         per: dict[int, list] = {}
         for op in ops:
-            per.setdefault(self.groups[self._route(op[1])].region_id,
-                           []).append(op)
+            per.setdefault(self._route(op[1]), []).append(op)
         return per
 
     # -- writes -----------------------------------------------------------
     def write_ops(self, ops: list[tuple[int, bytes, bytes]]) -> None:
         """Replicate a write batch.  Single region -> 1PC (one CMD_WRITE in
         that group's log); multiple regions -> 2PC with the first touched
-        group as primary.  Raises ReplicationError when quorum is gone."""
+        group as primary.  Raises ReplicationError when quorum is gone.
+        After a successful commit, oversized regions split (the store-side
+        size trigger, region.cpp:733-787)."""
         if not ops:
             return
         per = self._split_ops(ops)
         if len(per) == 1:
-            rid, batch = next(iter(per.items()))
-            g = next(g for g in self.groups if g.region_id == rid)
+            idx, batch = next(iter(per.items()))
+            g = self.groups[idx]
             if not g.write(batch):
                 raise ReplicationError(
-                    f"region {rid} of {self.table_key} has no quorum")
-            return
-        groups = [g for g in self.groups if g.region_id in per]
-        try:
-            TwoPhaseCoordinator(groups).write(per, txn_id=next_txn_id())
-        except TwoPhaseError as e:
-            raise ReplicationError(str(e)) from None
+                    f"region {g.region_id} of {self.table_key} has no quorum")
+        else:
+            groups = [self.groups[i] for i in sorted(per)]
+            by_rid = {self.groups[i].region_id: b for i, b in per.items()}
+            try:
+                TwoPhaseCoordinator(groups).write(by_rid,
+                                                  txn_id=next_txn_id())
+            except TwoPhaseError as e:
+                raise ReplicationError(str(e)) from None
+        self.maybe_split()
 
     # -- reads ------------------------------------------------------------
     def _leader_node(self, meta, group: RaftGroup):
         """Leader replica for one region, meta routing consulted first
-        (reference: frontend replica selection, fetcher_store.cpp:351)."""
-        addr = self.fleet.meta.regions[meta.region_id].leader
+        (reference: frontend replica selection, fetcher_store.cpp:351).
+        Falls back to a live election when meta has no entry (e.g. a region
+        mid-retirement after an aborted merge) or its hint is stale."""
+        try:
+            rm = self.fleet.meta.regions.get(meta.region_id)
+        except RuntimeError:       # meta itself quorumless: reads go on
+            rm = None
+        addr = rm.leader if rm is not None else ""
         nid = self.fleet._ids.get(addr)
         if nid is not None and nid in group.bus.nodes and \
                 nid not in group.bus.down and \
@@ -129,18 +166,158 @@ class ReplicatedRowTier:
         return group.bus.nodes[group.leader()]
 
     def scan_rows(self) -> list[dict]:
-        """Latest committed row versions across all regions (leader reads).
-        Includes ``__del`` marker rows — recovery replay needs them; callers
-        counting LIVE rows use num_rows()."""
+        """Latest committed row versions across all regions (leader reads,
+        each filtered to the range the region OWNS so mid-split copies are
+        never read twice).  Includes ``__del`` marker rows — recovery replay
+        needs them; callers counting LIVE rows use num_rows()."""
         out: list[dict] = []
         for m, g in zip(self.metas, self.groups):
             node = self._leader_node(m, g)
-            out.extend(node.rows())
+            out.extend(node.rows_in_range())
         return out
 
     def num_rows(self) -> int:
         """Live (non-deleted) replicated rows."""
         return sum(1 for r in self.scan_rows() if not r.get("__del"))
+
+    # -- split / merge -----------------------------------------------------
+    def _threshold(self) -> int:
+        return self.split_rows or int(FLAGS.region_split_rows)
+
+    def maybe_split(self) -> int:
+        """Split every region exceeding the size threshold (checked after
+        each committed write — the reference's store-side split trigger).
+        Returns how many splits happened."""
+        threshold = self._threshold()
+        done = 0
+        if threshold <= 0:
+            return done
+        i = 0
+        while i < len(self.groups):
+            node = self._leader_node(self.metas[i], self.groups[i])
+            if node.table.num_live_keys() >= threshold:
+                try:
+                    self.split_region(i)
+                    done += 1
+                    continue       # the left half may still be oversized
+                except SplitError:
+                    pass           # e.g. all rows share one key: unsplittable
+            i += 1
+        return done
+
+    def split_region(self, idx: int):
+        """Split one region at its median key, under consensus — the
+        reference's lifecycle (region.cpp:4472 split init, :6573 data copy,
+        :7198 catch-up, :4864 add_version finalize):
+
+        1. meta registers the child on the parent's peers (routing version
+           bumps on both sides),
+        2. the fleet materializes the child raft group and the parent's
+           upper half replicates into it (one committed write = copy +
+           catch-up, which is exact here because the tier serializes writes),
+        3. both sides raft-commit their new range (after this, stale-routed
+           writes are filtered — the version_old rejection analog),
+        4. the parent trims moved rows (the split-aware compaction filter).
+
+        On abort the child retires and the parent's meta range is restored.
+        """
+        g, m = self.groups[idx], self.metas[idx]
+        try:
+            node = self._leader_node(m, g)
+        except RuntimeError:
+            raise SplitError(
+                f"region {m.region_id} has no electable quorum") from None
+        pairs = [(k, v) for k, v in node.table.scan_raw()
+                 if node._covers(k)]
+        if len(pairs) < 2:
+            raise SplitError(f"region {m.region_id} too small to split")
+        mid = pairs[len(pairs) // 2][0]
+        if mid == pairs[0][0]:
+            raise SplitError(f"region {m.region_id} has no usable split key")
+        old_start, old_end = self._starts[idx], self._ends[idx]
+        meta = self.fleet.meta
+        new_m = meta.split_region_key(m.region_id, mid.hex())
+        new_g = self.fleet.materialize_region(
+            new_m, schema=self.row_schema, key_columns=self.key_columns)
+        moved = [(0, k, v) for k, v in pairs if k >= mid]
+        ok = (not moved) or new_g.write(moved)
+        ok = ok and new_g.set_range(new_m.version, mid, old_end)
+        ok = ok and g.set_range(new_m.version, old_start, mid)
+        if ok:
+            # past the point of no return: both sides committed their new
+            # ranges.  Trim is GC, not correctness (reads filter by
+            # ownership) — a quorum blip here must not "abort" a split
+            # that already happened, or the restored meta range would
+            # route writes the parent now rejects.
+            g.trim()
+        if not ok:
+            self.fleet.groups.pop(new_m.region_id, None)
+            try:
+                meta.merge_regions_key(m.region_id, new_m.region_id)
+            except Exception:
+                pass               # meta may itself be quorumless
+            raise SplitError(
+                f"split of region {m.region_id} aborted (no quorum)")
+        self.metas.insert(idx + 1, new_m)
+        self.groups.insert(idx + 1, new_g)
+        self._starts.insert(idx + 1, mid)
+        self._ends[idx] = mid
+        self._ends.insert(idx + 1, old_end)
+        return new_m
+
+    def maybe_merge(self) -> int:
+        """Merge adjacent undersized regions (combined keys under a quarter
+        of the split threshold), so a shrunken table does not keep paying
+        per-region quorum costs forever.  Returns merges performed."""
+        floor = max(2, self._threshold() // 4)
+        done = 0
+        i = 0
+        while i + 1 < len(self.groups):
+            a = self._leader_node(self.metas[i], self.groups[i])
+            b = self._leader_node(self.metas[i + 1], self.groups[i + 1])
+            if a.table.num_live_keys() + b.table.num_live_keys() < floor:
+                try:
+                    self.merge_region(i)
+                    done += 1
+                    continue       # the survivor may absorb further
+                except SplitError:
+                    pass
+            i += 1
+        return done
+
+    def merge_region(self, idx: int):
+        """Merge region idx+1 into its left neighbor, under consensus:
+        meta retires the right region from routing, the left raft-commits
+        the widened range, then the right's rows replicate into it.  Until
+        the copy commits, readers still reach the right's group (local
+        routing is untouched), so no failure window loses or double-reads
+        rows."""
+        if idx + 1 >= len(self.groups):
+            raise SplitError("no right neighbor to merge")
+        left_g, right_g = self.groups[idx], self.groups[idx + 1]
+        left_m, right_m = self.metas[idx], self.metas[idx + 1]
+        try:
+            right_node = self._leader_node(right_m, right_g)
+        except RuntimeError:
+            raise SplitError(
+                f"region {right_m.region_id} has no electable quorum") \
+                from None
+        pairs = [(k, v) for k, v in right_node.table.scan_raw()
+                 if right_node._covers(k)]
+        merged = self.fleet.meta.merge_regions_key(left_m.region_id,
+                                                   right_m.region_id)
+        ok = left_g.set_range(merged.version, self._starts[idx],
+                              self._ends[idx + 1])
+        ok = ok and ((not pairs) or left_g.write([(0, k, v)
+                                                  for k, v in pairs]))
+        if not ok:
+            raise SplitError(
+                f"merge of region {right_m.region_id} aborted (no quorum)")
+        self.fleet.groups.pop(right_m.region_id, None)
+        self._ends[idx] = self._ends[idx + 1]
+        for lst in (self.metas, self.groups, self._starts, self._ends):
+            del lst[idx + 1]
+        return merged
 
     # -- maintenance -------------------------------------------------------
     def truncate(self) -> None:
@@ -159,9 +336,10 @@ class ReplicatedRowTier:
         self.release_regions()
         self.row_schema = row_schema
         self.metas = self.fleet.create_table_regions(
-            self.table_id, max(1, len(self.groups)), schema=row_schema,
+            self.table_id, 1, schema=row_schema,
             key_columns=self.key_columns)
         self.groups = [self.fleet.group(m.region_id) for m in self.metas]
+        self._starts, self._ends = [b""], [b""]
         if ops:
             self.write_ops(ops)
 
@@ -171,7 +349,7 @@ class ReplicatedRowTier:
         tables' replicas would heartbeat and balance forever)."""
         for m in self.metas:
             self.fleet.groups.pop(m.region_id, None)
-            self.fleet.meta.regions.pop(m.region_id, None)
+        self.fleet.meta.drop_regions([m.region_id for m in self.metas])
 
     def compact_all(self) -> None:
         """Snapshot every replica's state into its core, truncating logs."""

@@ -340,6 +340,13 @@ class RowTable:
             return self._t.num_keys()
         return int(self._lib.bk_table_num_keys(self._t))
 
+    def num_live_keys(self) -> int:
+        """Keys whose newest version is live (tombstones excluded) — the
+        size signal region split/merge policy keys off."""
+        if self._lib is None:
+            return self._t.num_live_keys()
+        return int(self._lib.bk_table_num_live_keys(self._t))
+
     def gc(self, keep: int):
         if self._lib is None:
             self._t.gc(keep)
