@@ -12,7 +12,7 @@ compiled XLA executable.
 from __future__ import annotations
 
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -318,9 +318,13 @@ class Session:
         self.current_db = database
         self.user = user
         self.mesh = mesh
-        # sharded device batches, keyed (table_key, version)
+        # sharded device batches, keyed (table_key, version); stale versions
+        # of a table are dropped on re-shard, so this is bounded by #tables
         self._mesh_batches: dict = {}
-        self._plan_cache: dict = {}
+        # SQL-text-keyed compiled plans, LRU-bounded (FLAGS.plan_cache_size;
+        # a long-lived server must not leak one executable per distinct
+        # query text)
+        self._plan_cache: OrderedDict = OrderedDict()
         # active SQL transaction: table_key -> storage TxnContext (row-tier
         # locks + buffered WAL writes + zero-copy region pre-images; the
         # reference's Transaction, src/engine/transaction.cpp:98-396)
@@ -1290,6 +1294,54 @@ class Session:
 
         return fn
 
+    def _pk_mask_fn(self, store: TableStore, key: dict):
+        """Host mask for a full-PK-equality WHERE: pyarrow compute only —
+        no ColumnBatch encode, no device program (the OLTP write path's
+        analog of the point-select fast path; reference: primary-index
+        point DML through the row path, region.cpp dml_1pc)."""
+        import pyarrow.compute as pc
+
+        sch = store.arrow_schema
+        # cast literals NOW, so a type-mismatched literal (id = 2.5 on a
+        # BIGINT pk) rejects the fast path here — inside the caller's
+        # try/except — instead of aborting the statement mid-region-scan
+        # (the compiled predicate evaluates such comparisons numerically)
+        scalars = {col: pa.scalar(v).cast(sch.field(col).type)
+                   for col, v in key.items()}
+        for col, v in key.items():
+            if scalars[col].as_py() != v:
+                raise ValueError("lossy literal cast")    # e.g. 2.5 -> 2
+
+        def fn(region_table: pa.Table):
+            m = None
+            for col, sc in scalars.items():
+                c = pc.equal(region_table.column(col), sc)
+                m = c if m is None else pc.and_(m, c)
+            return np.asarray(pc.fill_null(m, False))
+
+        return fn
+
+    def _point_write_mask(self, store: TableStore, where):
+        """The cheap PK mask when WHERE fixes the whole primary key by
+        equality; None otherwise (fall back to the compiled predicate)."""
+        from ..index.selector import point_key
+
+        if store._pk_cols is None or where is None:
+            return None
+
+        class _W:                    # point_key reads .where only
+            pass
+
+        w = _W()
+        w.where = where
+        try:
+            key = point_key(w, store._pk_cols)
+            if key is None:
+                return None
+            return self._pk_mask_fn(store, key)
+        except Exception:
+            return None              # odd literal/type: compiled path
+
     def _update(self, s: UpdateStmt) -> Result:
         store = self._store(s.table)
         schema = store.info.schema
@@ -1300,34 +1352,79 @@ class Session:
                 raise PlanError(f"unknown column {name!r}")
 
         def assign_fn(region_table: pa.Table, mask: np.ndarray) -> pa.Table:
+            # columnar merge (if_else over the WHERE mask) — no per-row
+            # Python; this is the write-path hot loop the reference keeps
+            # in C++ (UpdateNode row mutation, src/exec/update_node.cpp)
             b = ColumnBatch.from_arrow(region_table)
             out = region_table
+            n = region_table.num_rows
+            cond = pa.array(np.asarray(mask, bool))
             for name, e in assigns:
                 c = eval_output(_qualify_free(e), b)
                 data, valid = c.to_numpy()
                 f = arrow_schema.field(name)
                 if np.ndim(data) == 0:
-                    data = np.broadcast_to(data, (region_table.num_rows,))
+                    data = np.broadcast_to(data, (n,))
                 if c.ltype is LType.STRING and c.dictionary is not None:
                     vals = c.dictionary.decode(np.asarray(data, np.int32))
                 else:
-                    vals = data
-                old = out.column(name).to_pylist()
-                newcol = []
-                vl = vals.tolist() if hasattr(vals, "tolist") else list(vals)
-                for i in range(region_table.num_rows):
-                    if mask[i]:
-                        dead = valid is not None and (np.ndim(valid) == 0 and not valid
-                                                      or np.ndim(valid) > 0 and not valid[i])
-                        newcol.append(None if dead else
-                                      vl[i])
-                    else:
-                        newcol.append(old[i])
+                    vals = np.asarray(data)
+                if valid is None:
+                    nulls = None
+                else:
+                    v = np.asarray(valid, bool)
+                    nulls = ~(np.broadcast_to(v, (n,)) if v.ndim == 0 else v)
+                new_arr = pa.array(vals, mask=nulls)
+                if new_arr.type != f.type:
+                    new_arr = new_arr.cast(f.type)
                 idx = out.column_names.index(name)
-                out = out.set_column(idx, f, pa.array(newcol, type=f.type))
+                merged = pa.compute.if_else(cond, new_arr, out.column(name))
+                out = out.set_column(idx, f, merged)
             return out
 
-        n = store.update_where(self._host_mask(store, s.where), assign_fn,
+        mask_fn = self._point_write_mask(store, s.where)
+        if mask_fn is not None:
+            # point update: evaluate assignments on the ONE matched row,
+            # restricted to the columns the assignments actually touch
+            # (encoding untouched VARCHARs into device dictionaries is the
+            # dominant cost otherwise), then scalar-merge into the region
+            from ..expr.ast import ColRef as _CRef
+
+            needed = {store._pk_cols[0]}
+            for name, e in assigns:
+                needed.add(name)
+                stack = [_qualify_free(e)]
+                while stack:
+                    x = stack.pop()
+                    if isinstance(x, _CRef):
+                        needed.add(x.name.split(".")[-1])
+                    stack.extend(getattr(x, "args", ()) or ())
+            full_assign = assign_fn
+
+            def assign_fn(region_table, mask, _full=full_assign):
+                cond = pa.array(np.asarray(mask, bool))
+                rows = region_table.filter(cond)
+                if rows.num_rows != 1:      # PK dup (shouldn't happen):
+                    return _full(region_table, np.asarray(mask, bool))
+                rows = rows.select([c for c in region_table.column_names
+                                    if c in needed])
+                try:
+                    small = _full(rows, np.ones(1, dtype=bool))
+                except Exception:
+                    # a 1-row slice can hit shapes the full path never sees
+                    # (e.g. empty dictionaries); semantics win over speed
+                    return _full(region_table, np.asarray(mask, bool))
+                out = region_table
+                for name, _ in assigns:
+                    f = arrow_schema.field(name)
+                    idx = out.column_names.index(name)
+                    merged = pa.compute.if_else(cond, small.column(name)[0],
+                                                out.column(name))
+                    out = out.set_column(idx, f, merged)
+                return out
+        else:
+            mask_fn = self._host_mask(store, s.where)
+        n = store.update_where(mask_fn, assign_fn,
                                self._tctx(store),
                                changed_cols=[name for name, _ in assigns])
         if n:
@@ -1338,8 +1435,9 @@ class Session:
 
     def _delete(self, s: DeleteStmt) -> Result:
         store = self._store(s.table)
-        n = store.delete_where(self._host_mask(store, s.where),
-                               self._tctx(store))
+        mask_fn = self._point_write_mask(store, s.where) or \
+            self._host_mask(store, s.where)
+        n = store.delete_where(mask_fn, self._tctx(store))
         if n:
             self._log_binlog("delete", s.table.database or self.current_db,
                              s.table.name,
@@ -1507,6 +1605,7 @@ class Session:
             return self._select_group_concat(stmt)
         entry = self._plan_cache.get(cache_key) if cache_key else None
         if entry is not None:
+            self._plan_cache.move_to_end(cache_key)
             # stats-derived plan choices (dense group-by domains, key shifts)
             # go stale when data changes: replan on any version bump
             stale = any(self.db.stores.get(tk) is None or
@@ -1519,8 +1618,11 @@ class Session:
         if entry is None:
             plan = self._plan_select(stmt)
             entry = {"plan": plan, "compiled": {}, "versions": {}}
-            if cache_key:
+            cap = int(FLAGS.plan_cache_size)
+            if cache_key and cap > 0:
                 self._plan_cache[cache_key] = entry
+                while len(self._plan_cache) > cap:
+                    self._plan_cache.popitem(last=False)
         plan = entry["plan"]
         batches, shape_key = self._collect_batches(plan)
         entry["versions"] = {tk: v for tk, v, _ in shape_key}
@@ -1792,7 +1894,13 @@ class Session:
             if pair is None:
                 raw = compile_plan(plan, mesh=mesh)
                 pair = (jax.jit(raw), raw)
-                entry["compiled"][shape_key] = pair
+                comp = entry["compiled"]
+                # growing tables produce a new shape_key per version bump;
+                # without a cap one hot query would pin every executable it
+                # ever compiled
+                while len(comp) >= max(1, int(FLAGS.plan_cache_shapes)):
+                    comp.pop(next(iter(comp)))
+                comp[shape_key] = pair
             fn, raw = pair
             out, flags = fn(batches)
             grew = False

@@ -880,6 +880,11 @@ def _dict_transform(c: Column, fn) -> Column:
     """Apply a host string->string fn over distinct values; re-sort + remap."""
     if c.dictionary is None:
         raise ExprError("string function requires dictionary")
+    if len(c.dictionary.values) == 0:
+        # every row is NULL (e.g. a 1-row slice whose value is NULL):
+        # nothing to transform, and jnp.take on an empty axis would throw
+        return Column(jnp.full_like(c.data, NULL_CODE), c.validity,
+                      LType.STRING, Dictionary(np.asarray([], dtype=str)))
     new_vals = np.asarray([fn(v) for v in c.dictionary.values], dtype=str)
     uniq, inv = np.unique(new_vals, return_inverse=True)
     remap = jnp.asarray(inv.astype(np.int32))
@@ -892,6 +897,8 @@ def _dict_transform(c: Column, fn) -> Column:
 def _dict_scalar(c: Column, fn, lt: LType) -> Column:
     if c.dictionary is None:
         raise ExprError("string function requires dictionary")
+    if len(c.dictionary.values) == 0:
+        return Column(jnp.zeros(c.data.shape, lt.np_dtype), c.validity, lt)
     table = jnp.asarray(c.dictionary.map_values(fn, lt.np_dtype))
     data = jnp.take(table, jnp.clip(c.data, 0, None), mode="clip")
     return Column(data, c.validity, lt)

@@ -1,0 +1,194 @@
+"""sysbench-analog OLTP harness (VERDICT r02 missing #6 / next #8).
+
+The reference publishes one OLTP number: 92,287 QPS point-select (avg 2.77 ms,
+p95 6.21 ms) from the patched sysbench lua suite over a 1-meta + 3-store +
+N-frontend deploy (/root/reference/sysbench/sysbench.md:29-56,
+sysbench/lua/oltp_common_baikaldb.lua).  This harness drives the same
+workload shapes against this engine so the two can sit side by side:
+
+- ``point_select`` — ``SELECT c FROM sbtest1 WHERE id = ?`` with uniformly
+  random ids (the OLTP fast path: host-tier point lookup, no device program)
+- ``insert``       — single-row autocommit INSERTs with fresh ids
+- ``update``       — ``UPDATE sbtest1 SET k = k + 1 WHERE id = ?`` (the
+  write path through the columnar merge + row tier)
+
+Modes:
+- ``--wire``  (default): a real MySQLServer on a loopback socket, N client
+  threads speaking the binary protocol with prepared statements — the
+  apples-to-apples sysbench topology, protocol cost included.
+- ``--inproc``: N threads calling Session.execute directly — engine cost
+  only (what the wire tax subtracts from).
+
+Prints ONE JSON line: qps, latency avg/p95/p99 (ms), thread count, mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import string
+import threading
+import time
+
+import pyarrow as pa
+
+TABLE = "sbtest1"
+
+
+def _pad(rng: random.Random, n: int) -> str:
+    return "".join(rng.choices(string.ascii_lowercase, k=n))
+
+
+def load(session, rows: int, seed: int = 7) -> None:
+    """sysbench prepare: id PK, secondary-ish k, payload c/pad columns."""
+    rng = random.Random(seed)
+    session.execute(
+        f"CREATE TABLE {TABLE} (id BIGINT, k BIGINT, c VARCHAR(120), "
+        f"pad VARCHAR(60), PRIMARY KEY (id))")
+    session.load_arrow(TABLE, pa.table({
+        "id": list(range(1, rows + 1)),
+        "k": [rng.randrange(1, rows + 1) for _ in range(rows)],
+        "c": [_pad(rng, 32) for _ in range(rows)],
+        "pad": [_pad(rng, 16) for _ in range(rows)],
+    }))
+
+
+def _percentile(sorted_ms: list[float], p: float) -> float:
+    if not sorted_ms:
+        return 0.0
+    i = min(len(sorted_ms) - 1, int(p * len(sorted_ms)))
+    return sorted_ms[i]
+
+
+class _Worker(threading.Thread):
+    def __init__(self, op, deadline: float):
+        super().__init__(daemon=True)
+        self.op = op
+        self.deadline = deadline
+        self.lat_ms: list[float] = []
+        self.errors = 0
+
+    def run(self):
+        while time.perf_counter() < self.deadline:
+            t0 = time.perf_counter()
+            try:
+                self.op()
+            except Exception:
+                self.errors += 1
+                continue
+            self.lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+
+def _run_threads(make_op, threads: int, seconds: float):
+    deadline = time.perf_counter() + seconds
+    ws = [_Worker(make_op(i), deadline) for i in range(threads)]
+    t0 = time.perf_counter()
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join()
+    wall = time.perf_counter() - t0
+    lats = sorted(x for w in ws for x in w.lat_ms)
+    n = len(lats)
+    return {
+        "queries": n,
+        "errors": sum(w.errors for w in ws),
+        "qps": round(n / wall, 1),
+        "avg_ms": round(sum(lats) / n, 3) if n else 0.0,
+        "p95_ms": round(_percentile(lats, 0.95), 3),
+        "p99_ms": round(_percentile(lats, 0.99), 3),
+        "max_ms": round(lats[-1], 3) if n else 0.0,
+    }
+
+
+def bench(mode: str = "point_select", threads: int = 8, seconds: float = 5.0,
+          rows: int = 100_000, wire: bool = True) -> dict:
+    from ..exec.session import Database, Session
+
+    db = Database()
+    setup = Session(db)
+    load(setup, rows)
+    # ids already taken; insert workload allocates above them, sharded by
+    # worker so two threads never collide on a key
+    next_id = [rows + 1 + i * 10_000_000 for i in range(threads)]
+
+    if wire:
+        from ..client.mysql_client import Connection
+        from ..server.mysql_server import MySQLServer
+
+        srv = MySQLServer(db, port=0)
+        srv.start()
+
+        def make_op(i: int):
+            rng = random.Random(100 + i)
+            conn = Connection("127.0.0.1", srv.port)
+            if mode == "point_select":
+                sid = conn.prepare(f"SELECT c FROM {TABLE} WHERE id = ?")
+                return lambda: conn.execute(sid,
+                                            (rng.randrange(1, rows + 1),))
+            if mode == "insert":
+                sid = conn.prepare(
+                    f"INSERT INTO {TABLE} VALUES (?, ?, ?, ?)")
+
+                def op():
+                    next_id[i] += 1
+                    conn.execute(sid, (next_id[i], rng.randrange(1, rows),
+                                       "cccc", "pppp"))
+                return op
+            if mode == "update":
+                sid = conn.prepare(
+                    f"UPDATE {TABLE} SET k = k + 1 WHERE id = ?")
+                return lambda: conn.execute(sid,
+                                            (rng.randrange(1, rows + 1),))
+            raise ValueError(f"unknown mode {mode!r}")
+
+        try:
+            out = _run_threads(make_op, threads, seconds)
+        finally:
+            srv.stop()
+    else:
+        def make_op(i: int):
+            rng = random.Random(100 + i)
+            s = Session(db)
+            if mode == "point_select":
+                return lambda: s.execute(
+                    f"SELECT c FROM {TABLE} WHERE id = "
+                    f"{rng.randrange(1, rows + 1)}")
+            if mode == "insert":
+                def op():
+                    next_id[i] += 1
+                    s.execute(f"INSERT INTO {TABLE} VALUES ({next_id[i]}, "
+                              f"{rng.randrange(1, rows)}, 'cccc', 'pppp')")
+                return op
+            if mode == "update":
+                return lambda: s.execute(
+                    f"UPDATE {TABLE} SET k = k + 1 WHERE id = "
+                    f"{rng.randrange(1, rows + 1)}")
+            raise ValueError(f"unknown mode {mode!r}")
+
+        out = _run_threads(make_op, threads, seconds)
+
+    out.update({"mode": mode, "threads": threads, "rows": rows,
+                "transport": "wire" if wire else "inproc",
+                "ref_qps_point_select": 92287.54})
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", default="point_select",
+                    choices=["point_select", "insert", "update"])
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--rows", type=int, default=100_000)
+    ap.add_argument("--inproc", action="store_true",
+                    help="skip the wire protocol; measure the engine only")
+    args = ap.parse_args(argv)
+    out = bench(args.mode, args.threads, args.seconds, args.rows,
+                wire=not args.inproc)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -191,5 +191,10 @@ define("onehot_max_segments", 512,
 define("pallas_group_kernels", True,
        "use Pallas MXU kernels for mid-cardinality dense group-by on TPU")
 define("join_retry_max", 10, "static-capacity join: recompile-and-double cap")
+define("plan_cache_size", 256,
+       "compiled-plan LRU entries per session (reference: plan cache, "
+       "state_machine.cpp:1984); 0 disables caching")
+define("plan_cache_shapes", 8,
+       "compiled executables kept per cached plan (distinct data shapes)")
 define("ttl_interval_s", 60.0, "background TTL sweep period (store daemons)")
 define("heartbeat_interval_s", 3.0, "store->meta heartbeat period")

@@ -145,3 +145,39 @@ def test_access_cache_bounded(sess):
         sess.query(f"SELECT COUNT(*) c FROM u WHERE name = 'u{i % 50}'")
     assert len(getattr(sess, "_access_batches", {})) <= \
         sess._ACCESS_CACHE_MAX
+
+
+def test_point_write_fast_path_semantics():
+    """Point UPDATE/DELETE (full-PK equality WHERE) take the host mask +
+    narrow-assign path; semantics must match the compiled path exactly."""
+    from baikaldb_tpu.exec.session import Database, Session
+
+    s = Session(Database())
+    s.execute("CREATE TABLE pw (id BIGINT, k BIGINT, c VARCHAR(20), "
+              "PRIMARY KEY (id))")
+    s.execute("INSERT INTO pw VALUES (1, 10, 'a'), (2, 20, 'b'), "
+              "(3, 30, NULL)")
+    # expression assignment referencing another column
+    assert s.execute("UPDATE pw SET k = k + id WHERE id = 2").affected_rows == 1
+    assert s.query("SELECT k FROM pw WHERE id = 2") == [{"k": 22}]
+    # NULL assignment and NULL-input expression
+    s.execute("UPDATE pw SET c = NULL WHERE id = 1")
+    s.execute("UPDATE pw SET c = CONCAT(c, '!') WHERE id = 3")  # NULL stays
+    assert s.query("SELECT c FROM pw WHERE id = 1") == [{"c": None}]
+    assert s.query("SELECT c FROM pw WHERE id = 3") == [{"c": None}]
+    # PK reassignment goes through (index refresh still correct)
+    s.execute("UPDATE pw SET id = 9 WHERE id = 1")
+    assert s.query("SELECT id FROM pw WHERE id = 9") == [{"id": 9}]
+    assert s.query("SELECT id FROM pw WHERE id = 1") == []
+    # no-match update and residual non-pk conjunct (must NOT fast-path)
+    assert s.execute("UPDATE pw SET k = 0 WHERE id = 99").affected_rows == 0
+    assert s.execute("UPDATE pw SET k = 0 WHERE id = 2 AND c = 'ZZZ'") \
+        .affected_rows == 0
+    # point delete
+    assert s.execute("DELETE FROM pw WHERE id = 2").affected_rows == 1
+    assert s.query("SELECT COUNT(*) n FROM pw") == [{"n": 2}]
+    # type-mismatched pk literal: the compiled path evaluates id = 2.5
+    # numerically (0 rows); the fast path must fall back, not abort
+    assert s.execute("UPDATE pw SET k = 0 WHERE id = 2.5").affected_rows == 0
+    assert s.execute("DELETE FROM pw WHERE id = 2.5").affected_rows == 0
+    assert s.query("SELECT COUNT(*) n FROM pw") == [{"n": 2}]
