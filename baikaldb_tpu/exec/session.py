@@ -199,7 +199,6 @@ class Database:
         # network_server.h:82-107) — feeds information_schema.query_log
         self.query_log = deque(maxlen=1000)
         from ..storage.binlog import Binlog
-        self.binlog = Binlog()
         self.qos = None          # optional utils.qos.QosManager
         self.privileges = PrivilegeManager()
         # live connections for SHOW PROCESSLIST (id -> dict), kept by the
@@ -209,7 +208,12 @@ class Database:
         if data_dir:
             import os
             os.makedirs(data_dir, exist_ok=True)
+            # WAL-backed binlog: CDC events + capturer checkpoints survive
+            # kill-9 with the rest of the durable tier (region_binlog analog)
+            self.binlog = Binlog(path=os.path.join(data_dir, "binlog.wal"))
             self._recover()
+        else:
+            self.binlog = Binlog()
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]

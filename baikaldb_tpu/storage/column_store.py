@@ -153,7 +153,7 @@ class TxnContext:
                     self._restore_preimage()
                     raise
             elif self.store.wal_path is not None:
-                self.row_txn.commit()   # one atomic WAL batch + fsync
+                self.row_txn.commit()   # one atomic WAL batch + flush
             else:
                 # non-durable store: the buffered rows would never be read —
                 # just release the row locks

@@ -1,0 +1,145 @@
+"""Durable binlog (VERDICT r02 weak #4 / next #9).
+
+Reference behavior matched: binlog events persist in storage and recover
+after restart (region_binlog.cpp:1670 recover, :449 oldest-ts), the TSO
+never reissues a commit_ts, and the capturer resumes from its checkpoint
+with no gap and no duplicate (baikal_capturer.h).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from baikaldb_tpu.storage.binlog import Binlog
+
+
+def test_events_survive_reopen(tmp_path):
+    p = str(tmp_path / "b.wal")
+    b = Binlog(path=p)
+    ts = [b.append("insert", "d", "t", rows=[{"id": i}]) for i in range(5)]
+    b2 = Binlog(path=p)
+    got = b2.read(0)
+    assert [e.commit_ts for e in got] == ts
+    assert got[3].rows == [{"id": 3}]
+    # TSO monotonic across reopen: a new event sorts after every old one
+    t6 = b2.append("ddl", "d", "t", statement="ALTER ...")
+    assert t6 > ts[-1]
+
+
+def test_capacity_trim_survives_recovery(tmp_path):
+    p = str(tmp_path / "b.wal")
+    b = Binlog(capacity=3, path=p)
+    ts = [b.append("insert", "d", "t") for i in range(6)]
+    b2 = Binlog(capacity=3, path=p)
+    assert [e.commit_ts for e in b2.read(ts[2])] == ts[3:]
+    with pytest.raises(ValueError):
+        b2.read(0)          # GC'd past: same contract as the live log
+
+
+def test_named_capturer_resumes_after_restart(tmp_path):
+    p = str(tmp_path / "b.wal")
+    b = Binlog(path=p)
+    first = [b.append("insert", "d", "t", rows=[{"i": i}]) for i in range(4)]
+    cap = b.subscribe(name="sync")
+    got1 = cap.poll(limit=2)
+    assert [e.commit_ts for e in got1] == first[:2]
+    # "restart": fresh Binlog over the same WAL; the named cursor resumes
+    # exactly after the acknowledged batch — no gap, no duplicate
+    b2 = Binlog(path=p)
+    more = b2.append("delete", "d", "t", affected=1)
+    cap2 = b2.subscribe(name="sync")
+    got2 = cap2.poll()
+    assert [e.commit_ts for e in got2] == first[2:] + [more]
+
+
+def test_log_compaction_bounds_disk_and_recovery(tmp_path):
+    """The backing log compacts once the trimmed backlog reaches capacity:
+    disk and recovery stay O(capacity) under sustained appends."""
+    p = str(tmp_path / "b.wal")
+    b = Binlog(capacity=50, path=p)
+    for i in range(130):          # > 2x capacity: at least one compaction
+        b.append("insert", "d", "t", rows=[{"i": i}])
+    size = os.path.getsize(p)
+    ring = [e.commit_ts for e in b.read(b._oldest_ts)]
+    assert len(ring) == 50
+    # a fresh open replays only the compacted state + tail
+    b2 = Binlog(capacity=50, path=p)
+    assert [e.commit_ts for e in b2.read(b2._oldest_ts)] == ring
+    # keep appending: the file stays bounded (ballpark: < 4x the size at
+    # first compaction, not linear in total appends)
+    for i in range(400):
+        b2.append("insert", "d", "t", rows=[{"i": i}])
+    assert os.path.getsize(p) < max(4 * size, 200_000)
+
+
+def test_lagging_cursor_gets_gap_error_then_resumes(tmp_path):
+    from baikaldb_tpu.storage.binlog import BinlogGapError
+
+    p = str(tmp_path / "b.wal")
+    b = Binlog(capacity=4, path=p)
+    first = [b.append("insert", "d", "t") for _ in range(3)]
+    cap = b.subscribe(name="slow")
+    assert [e.commit_ts for e in cap.poll(limit=1)] == first[:1]
+    for _ in range(10):           # GC runs past the cursor
+        b.append("insert", "d", "t")
+    with pytest.raises(BinlogGapError):
+        cap.poll()
+    got = cap.poll()              # resumes from the oldest retained
+    assert len(got) == 4
+    assert got[0].commit_ts > first[-1]
+    # the post-gap position persisted: a restart does NOT replay the gap
+    b2 = Binlog(capacity=4, path=p)
+    cap2 = b2.subscribe(name="slow")
+    assert cap2.poll() == []
+
+
+def test_kill9_recovery_no_gap_no_dup(tmp_path):
+    """A real SIGKILL'd writer process: everything its capturer acknowledged
+    stays acknowledged; everything appended stays readable."""
+    p = str(tmp_path / "b.wal")
+    out = str(tmp_path / "acked.txt")
+    child = textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+        from baikaldb_tpu.storage.binlog import Binlog
+        b = Binlog(path={p!r})
+        for i in range(10):
+            b.append("insert", "d", "t", rows=[{{"i": i}}])
+        cap = b.subscribe(name="sync")
+        acked = cap.poll(limit=6)
+        with open({out!r}, "w") as f:
+            f.write(",".join(str(e.commit_ts) for e in acked))
+            f.flush(); os.fsync(f.fileno())
+        os.kill(os.getpid(), 9)   # no atexit, no flush: kill-9
+    """)
+    r = subprocess.run([sys.executable, "-c", child],
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == -signal.SIGKILL, r.stderr
+    acked = [int(x) for x in open(out).read().split(",")]
+    assert len(acked) == 6
+    b = Binlog(path=p)
+    all_ts = [e.commit_ts for e in b.read(0)]
+    assert len(all_ts) == 10 and acked == all_ts[:6]   # nothing lost
+    cap = b.subscribe(name="sync")
+    resumed = [e.commit_ts for e in cap.poll()]
+    assert resumed == all_ts[6:]                       # no gap, no dup
+
+
+def test_database_binlog_durable_under_data_dir(tmp_path):
+    from baikaldb_tpu.exec.session import Database, Session
+
+    d = str(tmp_path / "db")
+    s = Session(Database(data_dir=d))
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 1.0), (2, 2.0)")
+    s.execute("DELETE FROM t WHERE id = 2")
+    kinds = [e.event_type for e in s.db.binlog.read(0)]
+    # restart the Database: CDC history intact, subscription resumes
+    s2 = Session(Database(data_dir=d))
+    assert [e.event_type for e in s2.db.binlog.read(0)] == kinds
+    assert any(k == "delete" for k in kinds)
