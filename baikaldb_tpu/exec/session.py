@@ -201,6 +201,8 @@ class Database:
         from ..storage.binlog import Binlog
         self.qos = None          # optional utils.qos.QosManager
         self.privileges = PrivilegeManager()
+        from ..meta.ddl import DdlManager
+        self.ddl = DdlManager(self)   # online-DDL work queue + worker
         # live connections for SHOW PROCESSLIST (id -> dict), kept by the
         # wire server (reference: show processlist over NetworkServer conns)
         self.processlist: dict[int, dict] = {}
@@ -286,6 +288,7 @@ class Database:
         for db in saved["databases"]:
             if db not in self.catalog.databases():
                 self.catalog.create_database(db, if_not_exists=True)
+        resume: list[tuple[str, IndexInfo]] = []
         for t in saved["tables"]:
             fields = tuple(Field(n, LType(v), nullable)
                            for n, v, nullable in t["fields"])
@@ -297,6 +300,14 @@ class Database:
                 options=t["options"], if_not_exists=True)
             key = f"{t['database']}.{t['name']}"
             self.stores[key] = self.make_store(info)
+            for ix in indexes:
+                if ix.params.get("state") == "backfilling":
+                    resume.append((key, ix))
+        # resume interrupted backfills only AFTER every table is loaded:
+        # the worker save_catalog()s at publish, and a snapshot taken
+        # mid-recovery would persist a catalog missing later tables
+        for key, ix in resume:
+            self.ddl.submit(key, ix)
 
     def checkpoint(self):
         """Flush every table's live state to Parquet + reset WALs (the
@@ -807,6 +818,17 @@ class Session:
                     st._maybe_split(r)
                 st._mutations += 1
             return Result()
+        if s.command == "ddl" and s.args:
+            # handle ddl suspend|resume (reference: DDL suspend/restart
+            # operator commands, handle_helper.cpp)
+            op = s.args[0]
+            if op == "suspend":
+                self.db.ddl.suspend()
+                return Result()
+            if op in ("resume", "restart"):
+                self.db.ddl.resume()
+                return Result()
+            raise SqlError(f"unsupported HANDLE ddl {op!r}")
         raise SqlError(f"unsupported HANDLE command {s.command!r}")
 
     def _drop_durable(self, key: str, store):
@@ -1107,6 +1129,57 @@ class Session:
             store.insert_arrow(cast, self._tctx(store))
         ix.params["fresh_at"] = base.version
 
+    def _alter_index(self, s: AlterTableStmt, db: str, info) -> Result:
+        """Online ADD INDEX: the statement returns once the work is queued
+        (reference: DDL accepted by meta's DDLManager, ddl_manager.cpp);
+        a background worker backfills region by region and PUBLISHES the
+        index, at which point the IndexSelector starts choosing it.  DROP
+        INDEX is immediate (the artifact is derived state)."""
+        if s.action == "drop_index":
+            # only secondary-index kinds: rollups own a hidden backing
+            # table and must go through DROP ROLLUP (vector columns are
+            # schema-bound); dropping them here would orphan state
+            kept = [ix for ix in info.indexes
+                    if not (ix.name == s.index_name and
+                            ix.kind in ("key", "unique", "fulltext"))]
+            if len(kept) == len(info.indexes):
+                raise PlanError(f"unknown index {s.index_name!r}")
+            info.indexes = kept
+            info.version += 1
+            # cached plans compiled WITH the index must re-plan
+            self._store(s.table)._mutations += 1
+            self.db.save_catalog()
+            return Result()
+        self._validate_index_cols(s, info)
+        prefix = "ft" if s.index_kind == "fulltext" else "idx"
+        name = s.index_name or f"{prefix}_{'_'.join(s.index_cols)}"
+        if any(ix.name == name for ix in info.indexes):
+            raise PlanError(f"index {name!r} exists")
+        if s.index_kind == "fulltext":
+            # fulltext is dictionary-side (built lazily per dictionary
+            # version, index/fulltext.py) — no backfill artifact: declare
+            # it public immediately
+            info.indexes.append(IndexInfo(name, "fulltext",
+                                          list(s.index_cols)))
+            info.version += 1
+            self.db.save_catalog()
+            return Result()
+        ix = IndexInfo(name, s.index_kind, list(s.index_cols),
+                       {"state": "backfilling"})
+        info.indexes.append(ix)
+        self.db.save_catalog()
+        work = self.db.ddl.submit(f"{db}.{s.table.name}", ix)
+        return Result(affected_rows=0,
+                      columns=["work_id"],
+                      arrow=pa.table({"work_id": [work.work_id]}))
+
+    def _validate_index_cols(self, s: AlterTableStmt, info) -> None:
+        if not s.index_cols:
+            raise PlanError("index needs at least one column")
+        for c in s.index_cols:
+            if c not in info.schema:
+                raise PlanError(f"unknown column {c!r}")
+
     def _alter_rollup(self, s: AlterTableStmt, db: str, info) -> Result:
         from ..index.rollup import rollup_schema, rollup_table_name
         if s.action == "add_rollup":
@@ -1146,6 +1219,8 @@ class Session:
         info = self.db.catalog.get_table(db, s.table.name)
         if s.action in ("add_rollup", "drop_rollup"):
             return self._alter_rollup(s, db, info)
+        if s.action in ("add_index", "drop_index"):
+            return self._alter_index(s, db, info)
         fields = list(info.schema.fields)
         store = self._store(s.table)
         if s.action == "add_column":
@@ -1886,6 +1961,18 @@ class Session:
                 "default_value": [str(r[2]) for r in rows],
                 "help": [r[3] for r in rows],
             }) if rows else _empty_info("flags")
+        if name == "ddl_work":
+            ws = list(self.db.ddl.works.values())
+            return pa.table({
+                "work_id": [w.work_id for w in ws],
+                "table_name": [w.table_key for w in ws],
+                "index_name": [w.index_name for w in ws],
+                "kind": [w.kind for w in ws],
+                "state": [w.state for w in ws],
+                "regions_done": [w.regions_done for w in ws],
+                "regions_total": [w.regions_total for w in ws],
+                "error": [w.error for w in ws],
+            }) if ws else _empty_info("ddl_work")
         raise PlanError(f"unknown information_schema table {name!r}")
 
     def _run_plan(self, entry: dict, batches: dict, shape_key) -> ColumnBatch:

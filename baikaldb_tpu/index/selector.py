@@ -161,6 +161,8 @@ def choose_access(info, store, pred: ScanPredicates,
     for ix in info.indexes:
         if ix.kind not in ("key", "unique"):
             continue
+        if ix.params.get("state", "public") != "public":
+            continue    # backfilling/failed: not yet (or never) choosable
         col = ix.columns[0]
         if col in pred.eq:
             n = max(store.num_rows, 1)

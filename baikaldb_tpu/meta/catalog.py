@@ -160,6 +160,14 @@ class Catalog:
                          Field("value", LType.STRING),
                          Field("default_value", LType.STRING),
                          Field("help", LType.STRING))),
+        "ddl_work": Schema((Field("work_id", LType.INT64),
+                            Field("table_name", LType.STRING),
+                            Field("index_name", LType.STRING),
+                            Field("kind", LType.STRING),
+                            Field("state", LType.STRING),
+                            Field("regions_done", LType.INT64),
+                            Field("regions_total", LType.INT64),
+                            Field("error", LType.STRING))),
     }
 
     def get_table(self, database: str, name: str) -> TableInfo:

@@ -584,7 +584,25 @@ class Parser:
             if self.peek().kind == "KW" and self.peek().value in ("index", "key",
                                                                   "unique",
                                                                   "fulltext"):
-                raise SqlError("ALTER TABLE ADD INDEX is not supported yet")
+                # ADD [UNIQUE|FULLTEXT] INDEX|KEY [name] (col, ...)
+                kind = "key"
+                if self.peek().value in ("unique", "fulltext"):
+                    kind = self.advance().value
+                    if self.peek().kind == "KW" and \
+                            self.peek().value in ("index", "key"):
+                        self.advance()
+                else:
+                    self.advance()          # INDEX | KEY
+                iname = ""
+                if self.peek().kind == "IDENT":
+                    iname = self.ident()
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.try_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                return AlterTableStmt(table, "add_index", index_kind=kind,
+                                      index_name=iname, index_cols=cols)
             if self.peek().kind == "IDENT" and \
                     self.peek().value.lower() == "rollup":
                 # ADD ROLLUP name (key, ..., AGGREGATE(vcol, ...))
@@ -623,6 +641,11 @@ class Parser:
             return AlterTableStmt(table, "add_column",
                                   ColumnDef(name, tname, nullable))
         if self.try_kw("drop"):
+            if self.peek().kind == "KW" and self.peek().value in ("index",
+                                                                  "key"):
+                self.advance()
+                return AlterTableStmt(table, "drop_index",
+                                      index_name=self.ident())
             if self.peek().kind == "IDENT" and \
                     self.peek().value.lower() == "rollup":
                 self.advance()

@@ -103,11 +103,15 @@ class CreateTableStmt:
 class AlterTableStmt:
     table: TableRef
     action: str       # add_column | drop_column | add_rollup | drop_rollup
+    #                 # | add_index | drop_index
     column: Optional[ColumnDef] = None
     column_name: str = ""
     rollup_name: str = ""
     rollup_keys: list = field(default_factory=list)
     rollup_aggs: list = field(default_factory=list)   # column names
+    index_kind: str = "key"      # key | unique | fulltext
+    index_name: str = ""
+    index_cols: list = field(default_factory=list)
 
 
 @dataclass
