@@ -140,6 +140,15 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
             if node.cap is None:
                 node.cap = max(1, len(left) * len(right))
             out, ovf = join_ops.cross_join(left, right, cap=node.cap)
+        elif node.strategy == "dense":
+            # unique-build PK-FK join: scatter/gather over the dense key
+            # domain(s), output keeps the probe's shape (no overflow
+            # protocol)
+            out, ovf = join_ops.dense_join(left, node.left_keys, right,
+                                           node.right_keys,
+                                           list(node.dense_lo),
+                                           list(node.dense_span),
+                                           how=node.how)
         else:
             if node.cap is None:
                 # key-FK joins emit at most max(sides) rows; true many-to-many

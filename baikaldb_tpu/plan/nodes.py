@@ -89,10 +89,22 @@ class JoinNode(PlanNode):
     right_keys: list[str] = field(default_factory=list)
     residual: Optional[Expr] = None         # non-equi conjuncts, post-filter
     cap: Optional[int] = None               # static output capacity
+    # dense PK-FK strategy (ops/join.dense_join): build key(s) unique with
+    # stats-bounded [lo, lo+span) integer domains (per key; composite keys
+    # index the product space)
+    strategy: str = "sort"                  # sort | dense
+    dense_lo: list = field(default_factory=list)
+    dense_span: list = field(default_factory=list)
 
     def _label(self):
+        dense = ""
+        if self.strategy == "dense":
+            dense = " dense" + "x".join(
+                f"[{lo},+{sp})" for lo, sp in zip(self.dense_lo,
+                                                  self.dense_span))
         return (f"Join({self.how} on {list(zip(self.left_keys, self.right_keys))}"
-                + (f" residual={self.residual!r}" if self.residual else "") + ")")
+                + (f" residual={self.residual!r}" if self.residual else "")
+                + dense + ")")
 
 
 @dataclass

@@ -30,6 +30,11 @@ from ..ops.hashagg import AggSpec, agg_result_type
 from ..sql.lexer import SqlError
 from ..sql.stmt import JoinClause, SelectStmt, TableRef
 from ..types import Field, LType, Schema
+from ..utils.flags import FLAGS, define
+
+define("dense_join_span_max", 1 << 24,
+       "dense PK-FK join: max key-domain span for the position-table "
+       "strategy (memory: 4 bytes/slot); larger domains use the sort join")
 from .nodes import (AggNode, DistinctNode, FilterNode, JoinNode, LimitNode,
                     MembershipNode, PlanNode, ProjectNode, ScalarSourceNode,
                     ScanNode, SortNode, UnionNode, ValuesNode, WindowNode)
@@ -549,6 +554,24 @@ class Planner:
             return (left.schema.field(lkeys[i]).ltype in safe32 and
                     right.schema.field(rkeys[i]).ltype in safe32)
 
+        composite_dense = len(lkeys) == 2 and (
+            self._dense_key_domain_multi(right, rkeys) is not None or
+            (how == "inner" and
+             self._dense_key_domain_multi(left, lkeys) is not None))
+        if len(lkeys) > 1 and how == "inner" and not composite_dense:
+            # if one pair alone is a unique dense domain on either side,
+            # join on IT and demote the rest to residual equality — a dense
+            # scatter/gather + filter beats a packed 2-key sort join
+            for i in range(len(lkeys)):
+                if (self._dense_key_domain(right, rkeys[i]) is not None or
+                        self._dense_key_domain(left, lkeys[i]) is not None):
+                    for j, (l, r) in enumerate(zip(lkeys, rkeys)):
+                        if j != i:
+                            eq = Call("eq", (ColRef(l), ColRef(r)))
+                            residual = eq if residual is None else \
+                                Call("and", (residual, eq))
+                    lkeys, rkeys = [lkeys[i]], [rkeys[i]]
+                    break
         if len(lkeys) > 1 and not (len(lkeys) == 2 and pair_is_32bit(0)
                                    and pair_is_32bit(1)):
             for l, r in zip(lkeys[1:], rkeys[1:]):
@@ -563,7 +586,9 @@ class Planner:
         if residual is not None:
             node2 = FilterNode(children=[node], pred=residual, schema=node.schema)
             node.residual = None
+            self._maybe_dense_join(node)
             return node2
+        self._maybe_dense_join(node)
         return node
 
     # ------------------------------------------------------------------
@@ -608,6 +633,70 @@ class Planner:
         return plan
 
     # ------------------------------------------------------------------
+    def _spine_dense_joins(self, plan: PlanNode):
+        """Dense (unique-build) inner/left joins anywhere in the join tree
+        below an aggregate: [(probe_key, build_key, build_col_names)].  A
+        dense join's build side is unique per key, so equal key values map
+        to ONE build row — build columns are functions of the key no matter
+        where the join sits (probe spine or inside another build subtree).
+        The walk stops at scope boundaries (aggregates, unions, derived
+        tables) where column identity ends."""
+        out = []
+        stack = [plan]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (FilterNode, ProjectNode)) and node.children:
+                if getattr(node, "derived", False):
+                    continue   # scope boundary: a derived table's aliases
+                    #            may shadow inner names — FDs don't cross
+                stack.append(node.children[0])
+            elif isinstance(node, JoinNode) and len(node.children) == 2:
+                if node.strategy == "dense" and node.how in ("inner", "left"):
+                    out.append((list(node.left_keys), list(node.right_keys),
+                                [f.name for f in
+                                 node.children[1].schema.fields]))
+                    stack.extend(node.children)
+                elif node.how in ("semi", "anti"):
+                    stack.append(node.children[0])
+                else:
+                    stack.extend(node.children)
+        return out
+
+    def _reduce_fd_keys(self, plan: PlanNode, key_names: list[str]):
+        """Functional-dependency reduction of GROUP BY keys: a dense join's
+        build side is UNIQUE per join key, so once a group key fixes that
+        join key, every build-side column is group-uniform — grouping by it
+        is redundant (the classic optimizer FD transform; the reference
+        leans on MySQL semantics here).  Returns (kept, dropped); dropped
+        keys re-emerge as MIN aggregates (any-value over a uniform group).
+        """
+        joins = self._spine_dense_joins(plan)
+        if not joins:
+            return key_names, []
+
+        def closure(base: set[str]) -> set[str]:
+            det = set(base)
+            changed = True
+            while changed:
+                changed = False
+                for lks, rks, build_cols in joins:
+                    if all(k in det for k in lks) or \
+                            all(k in det for k in rks):
+                        new = set(build_cols) - det
+                        if new:
+                            det |= new
+                            changed = True
+            return det
+
+        kept = list(key_names)
+        dropped: list[str] = []
+        for kj in list(key_names):
+            trial = [k for k in kept if k != kj]
+            if trial and kj in closure(set(trial)):
+                kept = trial
+                dropped.append(kj)
+        return kept, dropped
+
     def _plan_aggregate(self, plan, flat, named_items, group_exprs, having,
                         order_items, stmt, scope=None):
         sch = plan.schema
@@ -669,12 +758,42 @@ class Planner:
                                      param=param))
             agg_out.append((a, out))
 
-        if pre_exprs:
-            # keep existing columns + computed ones
-            keep = [f.name for f in sch.fields]
+        # functional-dependency key reduction: group keys pinned by a dense
+        # join's unique build key become MIN aggregates (group-uniform) —
+        # GROUP BY l_orderkey, o_orderdate, o_shippriority collapses to a
+        # single dense l_orderkey domain (the q3/q10/q18 shape)
+        orig_key_names = list(key_names)
+        fd_specs: list[AggSpec] = []
+        if len(key_names) > 1:
+            kept, dropped = self._reduce_fd_keys(plan, key_names)
+            if dropped:
+                key_names = kept
+                fd_specs = [AggSpec("min", kj, kj) for kj in dropped]
+        # pre-agg projection keeps ONLY referenced columns: group keys,
+        # ColRef agg inputs, and anything the select/having/order exprs
+        # still name.  Projecting the full child schema here would mark
+        # every column as used, defeating ColumnsPrune — joins would
+        # gather 30+ columns to feed a 4-column aggregate (the q3 shape)
+        used: set[str] = set(key_names)
+        for spec in specs + fd_specs:
+            if spec.input is not None:
+                used.add(spec.input)
+        for container in ([e for _, e in named_items] + [having] +
+                          [e for e, _ in order_items]):
+            if container is None:
+                continue
+            for x in walk(container):
+                if isinstance(x, ColRef):
+                    used.add(x.name)
+        keep = [f.name for f in sch.fields if f.name in used]
+        if not keep and not pre_exprs and sch.fields:
+            # bare COUNT(*): a zero-column projection would lose the row
+            # count — carry one (any) column through
+            keep = [sch.fields[0].name]
+        if pre_exprs or len(keep) < len(sch.fields):
             exprs = [ColRef(n) for n in keep] + pre_exprs
             names = keep + pre_names
-            psch = Schema(tuple(list(sch.fields) +
+            psch = Schema(tuple([sch.field(n) for n in keep] +
                                 [Field(n, infer_type(e, sch)) for n, e in
                                  zip(pre_names, pre_exprs)]))
             plan = ProjectNode(children=[plan], exprs=exprs, names=names, schema=psch)
@@ -689,7 +808,11 @@ class Planner:
             at = infer_type(a.args[0], sch) if a.args else LType.INT64
             out_fields.append(Field(out, agg_result_type(s.op if s.op != "count_star"
                                                          else "count", at)))
-        agg = AggNode(children=[plan], key_names=key_names, specs=specs,
+        for s in fd_specs:
+            f = sch.field(s.input)
+            out_fields.append(Field(s.out_name, f.ltype, f.nullable))
+        agg = AggNode(children=[plan], key_names=key_names,
+                      specs=specs + fd_specs,
                       strategy=strategy, domains=domains, max_groups=max_groups,
                       schema=Schema(tuple(out_fields)))
         agg.key_shift = key_shift
@@ -700,7 +823,8 @@ class Planner:
         mapping: list[tuple[Expr, Expr]] = []
         for a, out in agg_out:
             mapping.append((a, ColRef(out)))
-        for g, kn in zip(group_exprs, key_names):
+        for g, kn in zip(group_exprs, orig_key_names):
+            # FD-dropped keys still exist as agg outputs under their name
             mapping.append((g, ColRef(kn)))
 
         def rewrite(e: Optional[Expr]) -> Optional[Expr]:
@@ -720,7 +844,7 @@ class Planner:
                     raise PlanError(f"nested aggregate {e!r}")
                 return Call(e.op, new_args)
             if isinstance(e, ColRef):
-                if e.name in key_names:
+                if e.name in orig_key_names:
                     return e
                 raise PlanError(f"column {e.name!r} must appear in GROUP BY "
                                 "or inside an aggregate")
@@ -765,6 +889,7 @@ class Planner:
                           left_keys=[key], right_keys=[rkey],
                           schema=holder[0].schema)
             jn.subquery_right = True
+            self._maybe_dense_join(jn)
             holder[0] = jn
             return True
         # NOT IN must NOT become an anti join: with a NULL in the list the
@@ -840,6 +965,7 @@ class Planner:
                       left_keys=lkeys, right_keys=rkeys,
                       schema=holder[0].schema)
         jn.subquery_right = True
+        self._maybe_dense_join(jn)
         holder[0] = jn
 
     def _plan_exists_residual(self, holder, scope, subscope, subplan,
@@ -868,6 +994,7 @@ class Planner:
             jn = JoinNode(children=[holder[0], subplan], how="inner",
                           left_keys=lkeys, right_keys=rkeys,
                           schema=_join_schema(holder[0], subplan, "inner"))
+            self._maybe_dense_join(jn)
         else:
             jn = JoinNode(children=[holder[0], subplan], how="cross",
                           schema=_join_schema(holder[0], subplan, "cross"))
@@ -1058,6 +1185,7 @@ class Planner:
                       left_keys=okeys, right_keys=knames,
                       schema=_join_schema(holder[0], subplan, "left"))
         jn.subquery_right = True
+        self._maybe_dense_join(jn)
         holder[0] = jn
         scope.extras[vname] = subplan.schema.field(vname).ltype
         if is_bare_count:
@@ -1243,10 +1371,18 @@ class Planner:
     def _sorted_strategy(self, plan, key_names):
         return "sorted", [], 0, {}   # max_groups resolved at exec from batch size
 
-    def _key_stats(self, plan: PlanNode, qualified: str) -> Optional[dict]:
-        """Host-side column stats for group keys, traced back to the scan."""
+    def _key_scan(self, plan: PlanNode, qualified: str,
+                  for_unique: bool = False):
+        """Trace a column through Project/Filter/Join chains to its Scan.
+        -> (table_key, col) or None.
+
+        Value BOUNDS (min/max/dict_size) survive any join: a join output
+        column's values are a subset of its source scan's.  UNIQUENESS only
+        survives chains that preserve probe-row multiplicity — the probe
+        side of a dense (unique-build) or semi/anti join (how the
+        orders⋈customer⋈lineitem chain keeps o_orderkey unique for the
+        next join up); ``for_unique`` selects that stricter walk."""
         node = plan
-        # only look through simple chains (Project/Filter) to a single scan
         while True:
             if isinstance(node, ScanNode):
                 if "." not in qualified:
@@ -1254,11 +1390,39 @@ class Planner:
                 lbl, col = qualified.split(".", 1)
                 if lbl != node.label:
                     return None
-                if self.stats_fn is not None:
-                    return self.stats_fn(node.table_key, col)
-                return None
+                return node.table_key, col
             if isinstance(node, (FilterNode,)) and node.children:
                 node = node.children[0]
+                continue
+            if isinstance(node, AggNode) and node.children:
+                # a group key in the agg OUTPUT: values are a subset of the
+                # input (stats hold); a SINGLE group key is unique per
+                # output row by construction (the q18 IN-subquery shape:
+                # SELECT l_orderkey ... GROUP BY l_orderkey HAVING ...)
+                if qualified not in node.key_names:
+                    return None
+                if for_unique:
+                    # unique by construction, independent of any index
+                    return ("", "__agg_unique__") \
+                        if len(node.key_names) == 1 else None
+                node = node.children[0]
+                continue
+            if isinstance(node, JoinNode) and len(node.children) == 2:
+                if for_unique:
+                    probe = node.children[0]
+                    if (node.strategy == "dense" or
+                            node.how in ("semi", "anti")) and \
+                            any(f.name == qualified
+                                for f in probe.schema.fields):
+                        node = probe
+                        continue
+                    return None
+                side = next((c for c in node.children
+                             if any(f.name == qualified
+                                    for f in c.schema.fields)), None)
+                if side is None:
+                    return None
+                node = side
                 continue
             if isinstance(node, ProjectNode) and node.children:
                 # pass through identity projections of the column
@@ -1266,6 +1430,15 @@ class Planner:
                     if n == qualified and isinstance(e, ColRef):
                         qualified = e.name
                         break
+                    if n == qualified and not for_unique and \
+                            isinstance(e, Call) and e.op == "year" and \
+                            len(e.args) == 1 and isinstance(e.args[0], ColRef):
+                        # YEAR(date) is monotone: bounds derive from the
+                        # date column's (uniqueness does not — not injective)
+                        hit = self._key_scan(node.children[0], e.args[0].name)
+                        if hit is None:
+                            return None
+                        return hit + ("year",)
                 else:
                     if qualified not in node.names:
                         node = node.children[0]
@@ -1274,6 +1447,144 @@ class Planner:
                 node = node.children[0]
                 continue
             return None
+
+    def _key_stats(self, plan: PlanNode, qualified: str) -> Optional[dict]:
+        """Host-side column stats for group keys, traced back to the scan
+        (with YEAR() bounds derived from the underlying date column)."""
+        hit = self._key_scan(plan, qualified)
+        if hit is None or self.stats_fn is None:
+            return None
+        st = self.stats_fn(*hit[:2])
+        if st and len(hit) > 2 and hit[2] == "year":
+            if st.get("min") is None:
+                return None
+            import datetime
+            epoch = datetime.date(1970, 1, 1)
+            d = datetime.timedelta
+            st = {"min": (epoch + d(days=int(st["min"]))).year,
+                  "max": (epoch + d(days=int(st["max"]))).year}
+        return st
+
+    def _key_unique(self, plan: PlanNode, qualified: str) -> bool:
+        """True when the column is a declared single-column PRIMARY/UNIQUE
+        key of its scan's table (reference: JoinTypeAnalyzer consulting
+        index metadata, join_type_analyzer.cpp)."""
+        hit = self._key_scan(plan, qualified, for_unique=True)
+        if hit is None:
+            return False
+        table_key, col = hit[:2]
+        if col == "__agg_unique__":
+            return True        # a single group key is unique per agg row
+        db, _, name = table_key.partition(".")
+        try:
+            info = self.catalog.get_table(db, name)
+        except Exception:
+            return False
+        for ix in info.indexes:
+            if ix.columns == [col] and ix.kind in ("primary", "unique") and \
+                    ix.params.get("state", "public") == "public":
+                return True
+        return False
+
+    def _dense_key_domain(self, side: PlanNode, key: str):
+        """(lo, span) when ``key`` on ``side`` is a unique integer key with
+        a stats-bounded dense domain; None otherwise."""
+        dom = self._dense_key_domain_multi(side, [key])
+        if dom is None:
+            return None
+        return dom[0][0], dom[1][0]
+
+    def _dense_key_domain_multi(self, side: PlanNode, keys: list[str]):
+        """([lo...], [span...]) when ``keys`` on ``side`` are integer
+        columns with stats-bounded domains whose PRODUCT is a small dense
+        space, and the key SET is unique (single-column primary/unique, or
+        the exact composite primary/unique index — partsupp's
+        (ps_partkey, ps_suppkey) shape).  None otherwise."""
+        los: list[int] = []
+        spans: list[int] = []
+        total = 1
+        for key in keys:
+            try:
+                f = side.schema.field(key)
+            except Exception:
+                return None
+            if not (f.ltype.is_integer or f.ltype is LType.DATE):
+                return None
+            st = self._key_stats(side, key)
+            if not st or st.get("min") is None:
+                return None
+            span = int(st["max"]) - int(st["min"]) + 1
+            if span <= 0:
+                return None
+            total *= span
+            if total > int(FLAGS.dense_join_span_max):
+                return None
+            los.append(int(st["min"]))
+            spans.append(span)
+        if len(keys) == 1:
+            if not self._key_unique(side, keys[0]):
+                return None
+            return los, spans
+        # composite: every key must trace (uniqueness-preserving walk) to
+        # the SAME scan, and that table must declare the exact column set
+        # as a primary/unique index
+        hits = [self._key_scan(side, k, for_unique=True) for k in keys]
+        if any(h is None for h in hits):
+            return None
+        tables = {h[0] for h in hits}
+        if len(tables) != 1:
+            return None
+        db, _, name = hits[0][0].partition(".")
+        cols = {h[1] for h in hits}
+        try:
+            info = self.catalog.get_table(db, name)
+        except Exception:
+            return None
+        for ix in info.indexes:
+            if ix.kind in ("primary", "unique") and set(ix.columns) == cols \
+                    and len(ix.columns) == len(keys) and \
+                    ix.params.get("state", "public") == "public":
+                return los, spans
+        return None
+
+    def _maybe_dense_join(self, node: JoinNode) -> None:
+        """Upgrade a sort join to a dense PK-FK join (ops/join.dense_join)
+        when the BUILD (right) side's single key is unique with statistics
+        bounding it to a small dense span.  An INNER join whose PK side
+        landed on the LEFT is swapped first — inner is symmetric, and the
+        FK side is the one that must stay probe-shaped (the reference's
+        JoinTypeAnalyzer picking which side drives the index join).  Baked
+        at plan time; the version-keyed plan cache replans when data (and
+        so stats) change."""
+        if node.how not in ("inner", "left", "semi", "anti"):
+            return
+        if len(node.right_keys) not in (1, 2) or node.residual is not None:
+            return
+        dom = self._dense_key_domain_multi(node.children[1], node.right_keys)
+        if dom is None and node.how == "inner" and \
+                not getattr(node, "subquery_right", False):
+            dom = self._dense_key_domain_multi(node.children[0],
+                                               node.left_keys)
+            if dom is not None:
+                node.children = [node.children[1], node.children[0]]
+                node.left_keys, node.right_keys = (node.right_keys,
+                                                   node.left_keys)
+                node.schema = _join_schema(node.children[0],
+                                           node.children[1], "inner")
+        if dom is None:
+            return
+        # the PROBE side's key types must be integer-exact too: a float FK
+        # would truncate into a slot and "match" rows the sort join's typed
+        # comparison would reject (5.5 = 5)
+        for lk in node.left_keys:
+            try:
+                lf = node.children[0].schema.field(lk)
+            except Exception:
+                return
+            if not (lf.ltype.is_integer or lf.ltype is LType.DATE):
+                return
+        node.strategy = "dense"
+        node.dense_lo, node.dense_span = dom
 
     # ------------------------------------------------------------------
     def _prune_columns(self, plan: PlanNode):

@@ -398,3 +398,32 @@ def test_comma_join_reorder_preserves_using():
     r = s.query("SELECT ra.k, rb.id FROM ra, rb JOIN rc USING(x) "
                 "WHERE ra.k = rc.k")
     assert r == [{"k": 1, "id": 7}]
+
+
+def test_float_fk_never_dense_matches():
+    """A float FK against a dense unique INT key must compare as numbers
+    (5.5 matches nothing), not truncate into the position table."""
+    s = Session()
+    s.execute("CREATE TABLE dimk (id BIGINT, tag VARCHAR(8), PRIMARY KEY (id))")
+    s.execute("CREATE TABLE factf (fk DOUBLE)")
+    s.execute("INSERT INTO dimk VALUES (5, 'five'), (6, 'six')")
+    s.execute("INSERT INTO factf VALUES (5.0), (5.5), (6.0)")
+    rows = s.query("SELECT f.fk, d.tag FROM factf f JOIN dimk d ON f.fk = d.id "
+                   "ORDER BY f.fk")
+    assert rows == [{"fk": 5.0, "tag": "five"}, {"fk": 6.0, "tag": "six"}]
+
+
+def test_fd_reduction_stops_at_derived_scope():
+    """A derived table whose aliases shadow inner join columns must not
+    leak inner functional dependencies into the outer GROUP BY."""
+    s = Session()
+    s.execute("CREATE TABLE it (ik BIGINT, v BIGINT, PRIMARY KEY (ik))")
+    s.execute("CREATE TABLE ot (ok BIGINT, ik BIGINT, w BIGINT, PRIMARY KEY (ok))")
+    s.execute("INSERT INTO it VALUES (1, 10), (2, 20)")
+    s.execute("INSERT INTO ot VALUES (100, 1, 7), (101, 1, 8), (102, 2, 7)")
+    # derived aliases: k is REALLY o.w (not the dense-join key), val is o.ok
+    rows = s.query(
+        "SELECT k, COUNT(*) c FROM "
+        "(SELECT o.w AS k, i.v AS val FROM ot o JOIN it i ON o.ik = i.ik) d "
+        "GROUP BY k ORDER BY k")
+    assert rows == [{"k": 7, "c": 2}, {"k": 8, "c": 1}]
