@@ -140,6 +140,11 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
             if node.cap is None:
                 node.cap = max(1, len(left) * len(right))
             out, ovf = join_ops.cross_join(left, right, cap=node.cap)
+        elif node.neq is not None and node.how in ("semi", "anti"):
+            # EXISTS + one <> residual: range counts, no expansion
+            out, ovf = join_ops.semi_join_neq(left, node.left_keys, right,
+                                              node.right_keys, node.neq[0],
+                                              node.neq[1], how=node.how)
         elif node.strategy == "dense":
             # unique-build PK-FK join: scatter/gather over the dense key
             # domain(s), output keeps the probe's shape (no overflow

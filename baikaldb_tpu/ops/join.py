@@ -88,6 +88,83 @@ def _sentinel_max(dtype):
     return (jnp.iinfo if dtype.kind in "iu" else jnp.finfo)(dtype).max
 
 
+def _build_dead(build: ColumnBatch, bvalid):
+    """Dead mask for the build side: sel-dead or NULL-key rows."""
+    dead = jnp.zeros(len(build), bool)
+    if build.sel is not None:
+        dead = dead | ~build.sel
+    if bvalid is not None:
+        dead = dead | ~bvalid
+    return dead
+
+
+def _probe_dead(probe: ColumnBatch, pvalid):
+    """(sel_dead, dead): sel-dead alone, and sel-dead-or-NULL-key."""
+    sel_dead = ~probe.sel if probe.sel is not None \
+        else jnp.zeros(len(probe), bool)
+    dead = sel_dead
+    if pvalid is not None:
+        dead = dead | ~pvalid
+    return sel_dead, dead
+
+
+def semi_join_neq(probe: ColumnBatch, probe_keys: list[str],
+                  build: ColumnBatch, build_keys: list[str],
+                  neq_probe: str, neq_build: str, how: str = "semi"):
+    """[NOT] EXISTS with equality keys plus ONE ``build_col <> probe_col``
+    residual — the TPC-H q21 shape — WITHOUT expanding the many-to-many
+    match space.  For each probe row the residual-satisfying match count is
+
+        #(key matches with build_col NOT NULL)  -  #(key, build_col=probe_col)
+
+    both computable as range counts over ONE build array sorted by the
+    packed (key, residual column): two extra binary searches instead of an
+    output-cardinality join (the reference runs this as an expanded hash
+    join + dedup, join_node.cpp — this path beats it asymptotically).
+    Returns (out_batch, 0).  Key and residual columns must be 32-bit-safe
+    (the planner checks)."""
+    probe, build = _align_string_keys(probe, probe_keys, build, build_keys)
+    pk, pvalid = _key_array(probe, probe_keys)
+    bk, bvalid = _key_array(build, build_keys)
+    a = probe.column(neq_probe)
+    b = build.column(neq_build)
+
+    bdead = _build_dead(build, bvalid)
+    # rows whose residual column is NULL can never satisfy b <> a (NULL
+    # comparisons are not TRUE): dead for BOTH counts
+    if b.validity is not None:
+        bdead = bdead | ~b.validity
+
+    mask32 = jnp.int64(0xFFFFFFFF)
+    pk2 = (bk.astype(jnp.int64) << 32) | (b.data.astype(jnp.int64) & mask32)
+    order2 = jnp.lexsort((pk2, bdead))
+    n_live = jnp.sum(~bdead).astype(jnp.int32)
+    pk2_sorted = jnp.where(jnp.arange(len(build)) < n_live,
+                           pk2[order2], _sentinel_max(pk2.dtype))
+
+    base = pk.astype(jnp.int64) << 32
+    first_dead = n_live.astype(jnp.int32)
+    clamp = lambda x: jnp.minimum(x.astype(jnp.int32), first_dead)  # noqa: E731
+    key_lo = clamp(jnp.searchsorted(pk2_sorted, base, side="left"))
+    # upper bound via side="right" on the all-ones low word: adding 2^32
+    # would overflow int64 for a key at dtype max (the clamp keeps a live
+    # key whose packed value EQUALS the sentinel correct too)
+    key_hi = clamp(jnp.searchsorted(pk2_sorted, base | mask32, side="right"))
+    pp = base | (a.data.astype(jnp.int64) & mask32)
+    eq_lo = clamp(jnp.searchsorted(pk2_sorted, pp, side="left"))
+    eq_hi = clamp(jnp.searchsorted(pk2_sorted, pp, side="right"))
+
+    psel_dead, pdead = _probe_dead(probe, pvalid)
+    if a.validity is not None:
+        pdead = pdead | ~a.validity      # a NULL: residual never TRUE
+    counts = jnp.where(pdead, 0, (key_hi - key_lo) - (eq_hi - eq_lo))
+    if how == "semi":
+        return probe.and_sel(counts > 0), jnp.int32(0)
+    if how == "anti":
+        return probe.and_sel(counts == 0), jnp.int32(0)
+    raise ValueError(f"semi_join_neq: unsupported how {how!r}")
+
+
 def join(probe: ColumnBatch, probe_keys: list[str],
          build: ColumnBatch, build_keys: list[str],
          how: str = "inner", cap: int | None = None,
@@ -114,11 +191,7 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     # replaces the dead tail's keys to keep the array globally sorted; a LIVE
     # key equal to dtype-max still sorts before every dead row, so the
     # first-dead clamp below is exact for all key values
-    bdead = jnp.zeros(len(build), bool)
-    if build.sel is not None:
-        bdead = bdead | ~build.sel
-    if bvalid is not None:
-        bdead = bdead | ~bvalid
+    bdead = _build_dead(build, bvalid)
     order = jnp.lexsort((bk, bdead))
     n_live = jnp.sum(~bdead).astype(jnp.int32)
     bk_sorted = jnp.where(jnp.arange(len(build)) < n_live,
@@ -126,12 +199,7 @@ def join(probe: ColumnBatch, probe_keys: list[str],
 
     lo = jnp.searchsorted(bk_sorted, pk, side="left")
     hi = jnp.searchsorted(bk_sorted, pk, side="right")
-    psel_dead = jnp.zeros(len(probe), bool)
-    if probe.sel is not None:
-        psel_dead = psel_dead | ~probe.sel
-    pdead = psel_dead
-    if pvalid is not None:
-        pdead = pdead | ~pvalid
+    psel_dead, pdead = _probe_dead(probe, pvalid)
     counts = jnp.where(pdead, 0, hi - lo)
     # drop matches that land in the dead tail (probe key == sentinel value)
     first_dead = n_live.astype(lo.dtype)

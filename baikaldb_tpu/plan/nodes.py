@@ -95,6 +95,9 @@ class JoinNode(PlanNode):
     strategy: str = "sort"                  # sort | dense
     dense_lo: list = field(default_factory=list)
     dense_span: list = field(default_factory=list)
+    # semi/anti with ONE "build_col <> probe_col" residual: range-count
+    # path, no expansion (ops/join.semi_join_neq)
+    neq: Optional[tuple] = None             # (probe_col, build_col)
 
     def _label(self):
         dense = ""
@@ -104,6 +107,7 @@ class JoinNode(PlanNode):
                                                   self.dense_span))
         return (f"Join({self.how} on {list(zip(self.left_keys, self.right_keys))}"
                 + (f" residual={self.residual!r}" if self.residual else "")
+                + (f" neq={self.neq}" if self.neq else "")
                 + dense + ")")
 
 

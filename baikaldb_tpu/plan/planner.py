@@ -39,7 +39,10 @@ from .nodes import (AggNode, DistinctNode, FilterNode, JoinNode, LimitNode,
                     MembershipNode, PlanNode, ProjectNode, ScalarSourceNode,
                     ScanNode, SortNode, UnionNode, ValuesNode, WindowNode)
 
-MAX_DENSE_GROUPS = 1 << 20
+define("dense_group_domain_max", 1 << 23,
+       "dense group-by: max product of key domains for segment-sum "
+       "aggregation (accumulators are domain-sized: 8 bytes/slot/agg); "
+       "larger domains use the sorted strategy")
 
 
 class PlanError(SqlError):
@@ -949,6 +952,18 @@ class Planner:
             subplan = FilterNode(children=[subplan], pred=inner_where,
                                  schema=subplan.schema)
         if residuals:
+            neq = self._try_neq_residual(holder[0], subplan, pairs,
+                                         residuals, outer_resolve,
+                                         inner_resolve)
+            if neq is not None:
+                jn = JoinNode(children=[holder[0], subplan],
+                              how="anti" if anti else "semi",
+                              left_keys=[o for o, _ in pairs],
+                              right_keys=[i for _, i in pairs],
+                              neq=neq, schema=holder[0].schema)
+                jn.subquery_right = True
+                holder[0] = jn
+                return
             self._plan_exists_residual(holder, scope, subscope, subplan,
                                        pairs, residuals, anti)
             return
@@ -967,6 +982,47 @@ class Planner:
         jn.subquery_right = True
         self._maybe_dense_join(jn)
         holder[0] = jn
+
+    _SAFE32 = {LType.BOOL, LType.INT8, LType.INT16, LType.INT32,
+               LType.UINT32, LType.DATE, LType.STRING}
+
+    def _try_neq_residual(self, outer, subplan, pairs, residuals,
+                          outer_resolve, inner_resolve):
+        """(probe_col, build_col) when the EXISTS residual is exactly ONE
+        correlated ``inner <> outer`` over 32-bit-safe columns with
+        single-pair 32-bit-safe equality keys — the no-expansion
+        range-count path (q21's shape).  None = use the general rewrite."""
+        if len(residuals) != 1 or len(pairs) != 1:
+            return None
+        r = residuals[0]
+        if not (isinstance(r, Call) and r.op in ("neq", "ne") and
+                len(r.args) == 2 and
+                all(isinstance(x, ColRef) for x in r.args)):
+            return None
+        for inner_e, outer_e in ((r.args[0], r.args[1]),
+                                 (r.args[1], r.args[0])):
+            try:
+                iq = inner_resolve(inner_e)
+                oq = outer_resolve(outer_e)
+            except PlanError:
+                continue
+            try:
+                keys = [outer.schema.field(pairs[0][0]),
+                        subplan.schema.field(pairs[0][1])]
+                neqs = [outer.schema.field(oq.name),
+                        subplan.schema.field(iq.name)]
+            except Exception:
+                return None
+            # neq columns exclude STRING (dictionaries not aligned in this
+            # path) and mixed signedness (int32 -1 and uint32 4294967295
+            # would alias after 32-bit packing)
+            neq_ok = all(f.ltype in self._SAFE32 and
+                         f.ltype is not LType.STRING for f in neqs) and \
+                len({f.ltype is LType.UINT32 for f in neqs}) == 1
+            if all(f.ltype in self._SAFE32 for f in keys) and neq_ok:
+                return (oq.name, iq.name)
+            return None
+        return None
 
     def _plan_exists_residual(self, holder, scope, subscope, subplan,
                               pairs, residuals, anti: bool):
@@ -1356,7 +1412,7 @@ class Planner:
                 domains.append(st["dict_size"])
             elif f.ltype.is_integer and st is not None and st.get("min") is not None:
                 span = int(st["max"]) - int(st["min"]) + 1
-                if span <= 0 or span > MAX_DENSE_GROUPS:
+                if span <= 0 or span > int(FLAGS.dense_group_domain_max):
                     return self._sorted_strategy(plan, key_names)
                 domains.append(span)
                 if int(st["min"]) != 0:
@@ -1364,7 +1420,7 @@ class Planner:
             else:
                 return self._sorted_strategy(plan, key_names)
             total *= domains[-1] + 1
-            if total > MAX_DENSE_GROUPS:
+            if total > int(FLAGS.dense_group_domain_max):
                 return self._sorted_strategy(plan, key_names)
         return "dense", domains, 0, key_shift
 
@@ -1494,12 +1550,41 @@ class Planner:
             return None
         return dom[0][0], dom[1][0]
 
-    def _dense_key_domain_multi(self, side: PlanNode, keys: list[str]):
+    def _agg_keyset_unique(self, side: PlanNode, keys: list[str]) -> bool:
+        """True when ``side`` is (a Project/Filter chain over) an AggNode
+        whose FULL group-key set maps to ``keys`` — group-key combinations
+        are unique per output row by construction (the decorrelated
+        correlated-aggregate shape: join back on ALL correlation keys)."""
+        names = list(keys)
+        node = side
+        while True:
+            if isinstance(node, AggNode):
+                return set(names) == set(node.key_names)
+            if isinstance(node, FilterNode) and node.children:
+                node = node.children[0]
+                continue
+            if isinstance(node, ProjectNode) and node.children:
+                mapped = []
+                for want in names:
+                    for n, e in zip(node.names, node.exprs):
+                        if n == want and isinstance(e, ColRef):
+                            mapped.append(e.name)
+                            break
+                    else:
+                        return False
+                names = mapped
+                node = node.children[0]
+                continue
+            return False
+
+    def _dense_key_domain_multi(self, side: PlanNode, keys: list[str],
+                                need_unique: bool = True):
         """([lo...], [span...]) when ``keys`` on ``side`` are integer
         columns with stats-bounded domains whose PRODUCT is a small dense
-        space, and the key SET is unique (single-column primary/unique, or
-        the exact composite primary/unique index — partsupp's
-        (ps_partkey, ps_suppkey) shape).  None otherwise."""
+        space, and — unless ``need_unique`` is False (semi/anti existence
+        probes) — the key SET is unique: single-column primary/unique, the
+        exact composite primary/unique index (partsupp's shape), or the
+        full group-key set of an aggregate.  None otherwise."""
         los: list[int] = []
         spans: list[int] = []
         total = 1
@@ -1521,6 +1606,8 @@ class Planner:
                 return None
             los.append(int(st["min"]))
             spans.append(span)
+        if not need_unique or self._agg_keyset_unique(side, keys):
+            return los, spans
         if len(keys) == 1:
             if not self._key_unique(side, keys[0]):
                 return None
@@ -1560,7 +1647,10 @@ class Planner:
             return
         if len(node.right_keys) not in (1, 2) or node.residual is not None:
             return
-        dom = self._dense_key_domain_multi(node.children[1], node.right_keys)
+        dom = self._dense_key_domain_multi(
+            node.children[1], node.right_keys,
+            # semi/anti probe EXISTENCE: duplicate build keys are fine
+            need_unique=node.how not in ("semi", "anti"))
         if dom is None and node.how == "inner" and \
                 not getattr(node, "subquery_right", False):
             dom = self._dense_key_domain_multi(node.children[0],
@@ -1606,6 +1696,8 @@ class Planner:
             elif isinstance(node, JoinNode):
                 used.update(node.left_keys)
                 used.update(node.right_keys)
+                if node.neq is not None:
+                    used.update(node.neq)
                 if node.residual is not None:
                     used.update(r.name for r in walk(node.residual)
                                 if isinstance(r, ColRef))

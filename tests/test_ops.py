@@ -279,3 +279,30 @@ def test_join_live_key_equal_to_dtype_max():
     s.execute("DELETE FROM jr WHERE w = 99")
     rows = s.query("SELECT jl.v, jr.w FROM jl JOIN jr ON jl.k = jr.k")
     assert rows == [{"v": 1, "w": 10}]
+
+
+def test_semi_join_neq_dtype_max_key():
+    """Regression: a join key at int32 max must not overflow the packed
+    range bound (base + 2^32 would wrap); and mixed NULLs follow EXISTS
+    semantics."""
+    import pyarrow as pa
+
+    from baikaldb_tpu.column.batch import ColumnBatch
+    from baikaldb_tpu.ops.join import semi_join_neq
+
+    m = 2**31 - 1
+    probe = ColumnBatch.from_arrow(pa.table({
+        "k": pa.array([m, m, 7, None], pa.int32()),
+        "a": pa.array([1, 2, 1, 1], pa.int32())}))
+    build = ColumnBatch.from_arrow(pa.table({
+        "k": pa.array([m, 7, 7], pa.int32()),
+        "b": pa.array([2, 1, None], pa.int32())}))
+    semi, _ = semi_join_neq(probe, ["k"], build, ["k"], "a", "b", how="semi")
+    anti, _ = semi_join_neq(probe, ["k"], build, ["k"], "a", "b", how="anti")
+    import numpy as np
+    # probe 0 (k=max, a=1): build (max, 2) differs -> exists
+    # probe 1 (k=max, a=2): only build b=2 equals a -> no exists
+    # probe 2 (k=7, a=1): build (7,1) equal, (7,NULL) never TRUE -> none
+    # probe 3 (k NULL): no match -> anti keeps (NOT EXISTS true)
+    assert list(np.asarray(semi.sel_mask())) == [True, False, False, False]
+    assert list(np.asarray(anti.sel_mask())) == [False, True, True, True]
