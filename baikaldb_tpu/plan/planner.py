@@ -238,7 +238,12 @@ class Planner:
         items: list[tuple[str, Expr]] = []
         for i, item in enumerate(stmt.items):
             if item.expr is None:
-                labels = [item.star_table] if item.star_table else scope.order
+                # SELECT * follows the WRITTEN from-order, not the
+                # cost-reordered plan order (positional clients depend on
+                # stable columns; the reorder must be invisible)
+                written = getattr(stmt, "from_written", None)
+                labels = [item.star_table] if item.star_table else \
+                    (written or scope.order)
                 for lbl in labels:
                     if lbl not in scope.tables:
                         raise PlanError(f"unknown table {lbl!r} in {lbl}.*")
@@ -375,15 +380,22 @@ class Planner:
 
     # ------------------------------------------------------------------
     def _reorder_comma_joins(self, stmt: SelectStmt):
-        """Greedy left-deep ordering of comma-FROM tables so every join step
-        has an equality link to what's already placed (the JoinReorder
-        analog, src/physical_plan/join_reorder.cpp:155 — inner joins only).
-        Without this, `FROM part, supplier, partsupp ...` materializes a
-        part x supplier cross product before partsupp links them."""
-        if not stmt.joins or stmt.where is None or stmt.table is None:
+        """Cost-based left-deep ordering of inner-join chains (the
+        JoinReorder + JoinTypeAnalyzer analog,
+        src/physical_plan/join_reorder.cpp, join_type_analyzer.cpp).
+
+        Explicit INNER JOIN ... ON chains first flatten into comma form —
+        for inner joins, ON conjuncts are semantically WHERE conjuncts —
+        so `A JOIN B ON .. JOIN C ON ..` reorders exactly like
+        `FROM A, B, C WHERE ..`.  The greedy then places, at each step,
+        the EQUALITY-LINKED table with the smallest estimated surviving
+        row count (table rows discounted by its single-table conjuncts),
+        keeping intermediate results small; an unlinked table is placed
+        only when nothing links (the cross-product last resort)."""
+        if not stmt.joins or stmt.table is None:
             return
         if stmt.table.subquery is not None or any(
-                j.kind not in ("cross", "inner") or j.on is not None or
+                j.kind not in ("cross", "inner") or
                 j.using or j.table.subquery is not None
                 for j in stmt.joins):
             return   # USING resolves against the left scope: order matters
@@ -399,30 +411,137 @@ class Planner:
         if len(cols) != len(stmt.joins) + 1:
             return                    # duplicate labels: keep original order
 
+        def qualify(e, prefix: list[str]):
+            """Rebind bare ColRefs to their unique owner WITHIN THE WRITTEN
+            JOIN PREFIX (the scope the ON originally resolved against) —
+            moving an ON into WHERE must not re-bind a name that a
+            later-joined table would make ambiguous.  None = cannot
+            qualify: leave the statement untouched."""
+            if isinstance(e, ColRef):
+                if e.table is not None:
+                    return e if e.table in prefix else None
+                hits = [lbl for lbl in prefix if e.name in cols[lbl]]
+                return ColRef(e.name, table=hits[0]) if len(hits) == 1 \
+                    else None
+            if isinstance(e, Subquery):
+                return None          # scope too subtle to relocate
+            args = []
+            for x in getattr(e, "args", ()) or ():
+                qx = qualify(x, prefix)
+                if qx is None:
+                    return None
+                args.append(qx)
+            if isinstance(e, Call):
+                return Call(e.op, tuple(args))
+            return e if not args else None
+
+        qualified: list = []
+        for i, j in enumerate(stmt.joins):
+            if j.on is None:
+                qualified.append(None)
+                continue
+            prefix = [stmt.table.label] + \
+                [jj.table.label for jj in stmt.joins[:i + 1]]
+            q = qualify(j.on, prefix)
+            if q is None:
+                return               # bail BEFORE any mutation
+            qualified.append(q)
+        # SELECT * must keep the WRITTEN from-order even after reorder
+        stmt.from_written = [stmt.table.label] + \
+            [j.table.label for j in stmt.joins]
+        for j, q in zip(stmt.joins, qualified):
+            if q is not None:
+                stmt.where = q if stmt.where is None else \
+                    Call("and", (stmt.where, q))
+                j.on = None
+                j.kind = "cross"
+        if stmt.where is None:
+            return
+
         def owner(name, table):
             if table is not None:
                 return table if table in cols else None
             hits = [lbl for lbl, cs in cols.items() if name in cs]
             return hits[0] if len(hits) == 1 else None
 
-        links: list[tuple[str, str]] = []
+        refs = {stmt.table.label: stmt.table}
+        for j in stmt.joins:
+            refs[j.table.label] = j.table
+        # links keep the column on EACH side: fanout estimation needs the
+        # incoming table's key distinctness
+        links: list[tuple[str, str, str, str]] = []   # (la, cola, lb, colb)
+        single: dict[str, list] = {}   # label -> its single-table conjuncts
         for c in _conjuncts(stmt.where):
             if isinstance(c, Call) and c.op == "eq" and len(c.args) == 2 and \
                     all(isinstance(a, ColRef) for a in c.args):
                 a, b = c.args
                 la, lb = owner(a.name, a.table), owner(b.name, b.table)
                 if la and lb and la != lb:
-                    links.append((la, lb))
+                    links.append((la, a.name.split(".")[-1],
+                                  lb, b.name.split(".")[-1]))
+                    continue
+            owners = {owner(r.name, r.table) for r in walk(c)
+                      if isinstance(r, ColRef)}
+            if len(owners) == 1 and None not in owners:
+                single.setdefault(next(iter(owners)), []).append(c)
+
+        def raw_rows(ref) -> float:
+            db = ref.database or self.default_db
+            st = self.stores.get(f"{db}.{ref.name}")
+            return float(st.num_rows) if st is not None else 1.0
+
+        def est(ref) -> float:
+            """Surviving rows: table size discounted per conjunct (the
+            reference's statistics-adjusted sizing, mpp_analyzer.cpp:723)."""
+            n = raw_rows(ref)
+            for c in single.get(ref.label, []):
+                n *= 0.1 if isinstance(c, Call) and c.op == "eq" else 0.3
+            return max(n, 1.0)
+
+        def distinct(ref, col) -> float:
+            """Distinct-value proxy for a join column: stats span or
+            dictionary size; sqrt(rows) when unknown."""
+            db = ref.database or self.default_db
+            st = self.stats_fn(f"{db}.{ref.name}", col) \
+                if self.stats_fn is not None else None
+            if st:
+                if st.get("min") is not None:
+                    # span caps at the row count: a sparse key space does
+                    # not mean more distinct values than rows
+                    return max(1.0, min(
+                        float(int(st["max"]) - int(st["min"]) + 1),
+                        raw_rows(ref)))
+                if st.get("dict_size"):
+                    return float(st["dict_size"])
+            return max(1.0, raw_rows(ref) ** 0.5)
+
+        def fanout(t_label: str) -> float:
+            """Result growth of joining t to the placed set: est(t) over
+            its best link column's distinct count (a unique key gives
+            fanout <= 1: the index-join shape; an m:n low-cardinality link
+            like nationkey=nationkey reports its true blowup)."""
+            best = float("inf")
+            ref = refs[t_label]
+            for la, ca, lb, cb in links:
+                tcol = None
+                if la == t_label and lb in placed:
+                    tcol = ca
+                elif lb == t_label and la in placed:
+                    tcol = cb
+                if tcol is not None:
+                    best = min(best, est(ref) / distinct(ref, tcol))
+            return best
+
         placed = {stmt.table.label}
         remaining = list(stmt.joins)
         ordered = []
         while remaining:
-            pick = next((j for j in remaining
-                         if any((x in placed) != (y in placed) and
-                                j.table.label in (x, y)
-                                for x, y in links)), None)
-            if pick is None:
-                pick = remaining[0]   # no link joins anything placed yet
+            scored = [(fanout(j.table.label), j) for j in remaining]
+            linked = [(f, j) for f, j in scored if f != float("inf")]
+            if linked:
+                pick = min(linked, key=lambda fj: fj[0])[1]
+            else:
+                pick = min(remaining, key=lambda j: est(j.table))
             remaining.remove(pick)
             ordered.append(pick)
             placed.add(pick.table.label)

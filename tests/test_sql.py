@@ -427,3 +427,50 @@ def test_fd_reduction_stops_at_derived_scope():
         "(SELECT o.w AS k, i.v AS val FROM ot o JOIN it i ON o.ik = i.ik) d "
         "GROUP BY k ORDER BY k")
     assert rows == [{"k": 7, "c": 2}, {"k": 8, "c": 1}]
+
+
+def test_explicit_inner_join_chain_reorders():
+    """Cost-based reorder covers explicit INNER JOIN chains: a pathological
+    written order (two unlinked dimensions first) must not materialize the
+    cross product — the EXPLAIN shows the fact table linking each step."""
+    s = Session()
+    s.execute("CREATE TABLE d1 (k1 BIGINT, v1 BIGINT, PRIMARY KEY (k1))")
+    s.execute("CREATE TABLE d2 (k2 BIGINT, v2 BIGINT, PRIMARY KEY (k2))")
+    s.execute("CREATE TABLE f (k1 BIGINT, k2 BIGINT, x BIGINT)")
+    import pyarrow as pa
+    n = 200
+    s.load_arrow("d1", pa.table({"k1": list(range(n)), "v1": [i % 7 for i in range(n)]}))
+    s.load_arrow("d2", pa.table({"k2": list(range(n)), "v2": [i % 5 for i in range(n)]}))
+    s.load_arrow("f", pa.table({"k1": [i % n for i in range(2000)],
+                                "k2": [(i * 3) % n for i in range(2000)],
+                                "x": list(range(2000))}))
+    # written order joins d1 x d2 first (no cross-table link: the ON is a
+    # tautology): the reorder must place f between them instead of a
+    # 200x200 cross product
+    q = ("SELECT COUNT(*) c, SUM(x) sx FROM d1 JOIN d2 ON d2.k2 = d2.k2 "
+         "JOIN f ON f.k1 = d1.k1 AND f.k2 = d2.k2")
+    txt = s.execute("EXPLAIN " + q).plan_text
+    assert "cross" not in txt, txt
+    got = s.query(q)
+    assert got == [{"c": 2000, "sx": sum(range(2000))}]
+
+
+def test_reorder_preserves_star_order_and_on_scope():
+    s = Session()
+    s.execute("CREATE TABLE ra (ka BIGINT, x BIGINT, PRIMARY KEY (ka))")
+    s.execute("CREATE TABLE rb (kb BIGINT, y BIGINT, PRIMARY KEY (kb))")
+    s.execute("CREATE TABLE rc (kc BIGINT, ka BIGINT, kb BIGINT, x BIGINT)")
+    s.execute("INSERT INTO ra VALUES (1, 10)")
+    s.execute("INSERT INTO rb VALUES (2, 20)")
+    s.execute("INSERT INTO rc VALUES (5, 1, 2, 99)")
+    # SELECT * column order = written FROM order, whatever the planner picks
+    row = s.query("SELECT * FROM ra JOIN rc ON rc.ka = ra.ka "
+                  "JOIN rb ON rb.kb = rc.kb")[0]
+    assert list(row.keys()) == ["ra.ka", "ra.x", "rc.kc", "rc.ka", "rc.kb",
+                                "rc.x", "rb.kb", "rb.y"]
+    # bare `x` in the first ON resolves against {ra, rc} (ambiguous there is
+    # an error in BOTH orders); bare `y` resolves to rb even though rc is
+    # placed between them by the optimizer
+    got = s.query("SELECT y FROM ra JOIN rb ON y = 20 "
+                  "JOIN rc ON rc.ka = ra.ka AND rc.kb = rb.kb")
+    assert got == [{"y": 20}]
