@@ -27,7 +27,8 @@ class MetaServer:
         self._store_ids: dict[str, int] = {}        # address -> store_id
         self._mu = threading.Lock()
         for name in ("register_store", "create_regions", "table_regions",
-                     "drop_regions", "heartbeat", "tso", "instances", "ping"):
+                     "drop_regions", "heartbeat", "tso", "instances", "ping",
+                     "split_region_key", "merge_regions_key"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
 
     def start(self) -> None:
@@ -57,7 +58,8 @@ class MetaServer:
     def _region_wire(self, r):
         with self._mu:
             return {"region_id": r.region_id, "table_id": r.table_id,
-                    "leader": r.leader,
+                    "leader": r.leader, "version": r.version,
+                    "start_key": r.start_key, "end_key": r.end_key,
                     "peers": [[self._store_ids.get(p, 0), p]
                               for p in r.peers]}
 
@@ -86,6 +88,17 @@ class MetaServer:
 
     def rpc_tso(self, count: int = 1):
         return {"ts": self.service.tso.gen(int(count))}
+
+    def rpc_split_region_key(self, region_id: int, split_key_hex: str):
+        """Key-range split finalize in the routing table: the child
+        inherits the parent's peers, both sides bump version
+        (region.cpp:4864 add_version)."""
+        new = self.service.split_region_key(int(region_id), split_key_hex)
+        return self._region_wire(new)
+
+    def rpc_merge_regions_key(self, left_id: int, right_id: int):
+        merged = self.service.merge_regions_key(int(left_id), int(right_id))
+        return self._region_wire(merged)
 
 
 def main() -> None:

@@ -53,7 +53,7 @@ class StoreServer:
         self._peer_clients: dict[int, RpcClient] = {}
         self._stop = threading.Event()
         for name in ("create_region", "drop_region", "raft_msg", "propose",
-                     "scan_raw", "region_status", "ping"):
+                     "scan_raw", "region_status", "region_size", "ping"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
 
     # -- lifecycle --------------------------------------------------------
@@ -107,6 +107,9 @@ class StoreServer:
         """Leader-side propose + wait-for-commit (the braft apply + closure
         ack, store-side of region.cpp:1961/2301).  Non-leaders answer with a
         redirect hint (the reference's NOT_LEADER + leader_id response)."""
+        from ..raft.cluster import (CMD_PREPARE, CMD_WRITE, decode_cmd,
+                                    decode_ops)
+
         region = self.regions.get(int(region_id))
         if region is None:
             return {"status": "no_region"}
@@ -114,6 +117,19 @@ class StoreServer:
             if region.core.role != LEADER:
                 return {"status": "not_leader",
                         "leader": int(region.core.leader)}
+            # stale-routed writes (a frontend whose cached ranges predate a
+            # split) are REJECTED here, not silently filtered at apply —
+            # the reference's version_old response (region.cpp add_version
+            # check); the frontend refreshes routing and re-sends.  Drain
+            # applies first: a just-committed SET_RANGE must be visible to
+            # this check (the ack races the tick-loop apply otherwise)
+            region.apply_committed()
+            if region.start_key or region.end_key:
+                cmd, _, body = decode_cmd(payload)
+                if cmd in (CMD_WRITE, CMD_PREPARE) and \
+                        any(not region._covers(k)
+                            for _, k, _ in decode_ops(body)):
+                    return {"status": "version_old"}
             idx = region.core.propose(payload)
             if idx < 0:
                 return {"status": "not_leader",
@@ -141,7 +157,26 @@ class StoreServer:
             # (read-your-writes on the leader)
             region.apply_committed()
             pairs = region.table.scan_raw()
-        return {"status": "ok", "pairs": [[k, v] for k, v in pairs]}
+            start, end = region.start_key, region.end_key
+        # the replica's COMMITTED range rides along so readers can filter
+        # by OWNERSHIP (mid-split copies must never be read twice)
+        return {"status": "ok", "pairs": [[k, v] for k, v in pairs],
+                "start": start, "end": end}
+
+    def rpc_region_size(self, region_id: int):
+        """Live-key count + committed range of this region (the split
+        trigger's size signal; leaders only so the count is current)."""
+        region = self.regions.get(int(region_id))
+        if region is None:
+            return {"status": "no_region"}
+        with self._mu:
+            if region.core.role != LEADER:
+                return {"status": "not_leader",
+                        "leader": int(region.core.leader)}
+            region.apply_committed()
+            return {"status": "ok",
+                    "live": int(region.table.num_live_keys()),
+                    "start": region.start_key, "end": region.end_key}
 
     def rpc_region_status(self):
         with self._mu:

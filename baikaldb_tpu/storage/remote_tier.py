@@ -14,15 +14,27 @@ the meta daemon (server/meta_server.py).
 from __future__ import annotations
 
 import time
+from bisect import bisect_right
 from typing import Optional
 
 from ..raft.cluster import (CMD_COMMIT, CMD_DECIDE, CMD_PREPARE, CMD_ROLLBACK,
-                            CMD_WRITE, encode_cmd, encode_ops)
+                            CMD_SET_RANGE, CMD_TRIM, CMD_WRITE, encode_cmd,
+                            encode_ops, encode_range)
 from ..raft.twopc import next_txn_id
 from ..types import Schema
+from ..utils.flags import FLAGS
 from ..utils.net import RpcClient
-from .replicated import ReplicationError, _fnv64
+from .replicated import ReplicationError, SplitError, _fnv64
 from .rowstore import RowCodec
+
+
+class StaleRoutingError(RuntimeError):
+    """A store rejected a write routed with pre-split ranges (the
+    reference's version_old response): refresh routing and re-send."""
+
+    def __init__(self, region_id: int):
+        super().__init__(f"stale routing for region {region_id}")
+        self.region_id = region_id
 
 
 class ClusterClient:
@@ -47,13 +59,18 @@ def stable_table_id(table_key: str) -> int:
 
 
 class _RemoteRegion:
-    """One region's routing state: peers as (store_id, address)."""
+    """One region's routing state: peers as (store_id, address) plus the
+    [start_key, end_key) slice it owns (b"" = unbounded)."""
 
     def __init__(self, region_id: int, peers: list[tuple[int, str]],
-                 leader: str):
+                 leader: str, start_key: bytes = b"", end_key: bytes = b"",
+                 version: int = 1):
         self.region_id = region_id
         self.peers = peers
         self.leader_addr = leader or (peers[0][1] if peers else "")
+        self.start_key = start_key
+        self.end_key = end_key
+        self.version = version
 
     def addr_of(self, store_id: int) -> Optional[str]:
         for sid, addr in self.peers:
@@ -63,11 +80,18 @@ class _RemoteRegion:
 
 
 class RemoteRowTier:
-    """Same API as ReplicatedRowTier, over the cluster RPC plane."""
+    """Same API as ReplicatedRowTier, over the cluster RPC plane.
+
+    Known limitation (single-WRITER deployments assumed, like the bundled
+    mini-cluster): row keys are hidden per-frontend rowids allocated from
+    the frontend's attach-time row count, so two frontends writing the
+    SAME table concurrently can collide on rowids (the reference avoids
+    this by keying on real primary keys).  Readers and failover frontends
+    are safe; a second writer must attach after the first stops."""
 
     def __init__(self, cluster: ClusterClient, table_key: str,
                  row_schema: Schema, key_columns: list[str],
-                 n_regions: int = 2, propose_deadline: float = 12.0):
+                 split_rows: int = 0, propose_deadline: float = 12.0):
         self.cluster = cluster
         self.table_key = table_key
         self.table_id = stable_table_id(table_key)
@@ -75,23 +99,34 @@ class RemoteRowTier:
         self.key_columns = list(key_columns)
         self.row_codec = RowCodec(row_schema)
         self.propose_deadline = propose_deadline
+        # 0 = read the live region_split_rows flag at each check
+        self.split_rows = split_rows
+        self._writes_since_check = 0
         existing = cluster.meta.call("table_regions", table_id=self.table_id)
         if existing:
-            self.regions = [self._from_wire(w) for w in existing]
+            self.regions = sorted((self._from_wire(w) for w in existing),
+                                  key=lambda r: r.start_key)
+            starts = [r.start_key for r in self.regions]
+            if len(starts) != len(set(starts)):
+                # pre-range (hash-routed) layouts have multiple unbounded
+                # regions: range routing over them would double-serve keys
+                raise ValueError(
+                    f"table {table_key!r}: legacy hash-routed region layout "
+                    f"(overlapping ranges); drop and reload the table")
         else:
             created = cluster.meta.call("create_regions",
-                                        table_id=self.table_id,
-                                        n_regions=n_regions)
+                                        table_id=self.table_id, n_regions=1)
             self.regions = [self._from_wire(w) for w in created]
             self._materialize()
 
     @classmethod
     def get_or_create(cls, cluster: ClusterClient, table_key: str,
                       row_schema: Schema, key_columns: list[str],
-                      n_regions: int = 2) -> "RemoteRowTier":
+                      split_rows: int = 0) -> "RemoteRowTier":
         tier = cluster.tiers.get(table_key)
         if tier is None:
-            tier = cls(cluster, table_key, row_schema, key_columns, n_regions)
+            tier = cls(cluster, table_key, row_schema, key_columns,
+                       split_rows)
             cluster.tiers[table_key] = tier
         elif tier.row_schema != row_schema:
             raise ValueError(
@@ -103,15 +138,18 @@ class RemoteRowTier:
     def _from_wire(self, w: dict) -> _RemoteRegion:
         return _RemoteRegion(int(w["region_id"]),
                              [(int(sid), addr) for sid, addr in w["peers"]],
-                             w.get("leader", ""))
+                             w.get("leader", ""),
+                             bytes.fromhex(w.get("start_key", "") or ""),
+                             bytes.fromhex(w.get("end_key", "") or ""),
+                             int(w.get("version", 1)))
 
-    def _materialize(self) -> None:
+    def _materialize(self, regions: Optional[list] = None) -> None:
         """init_region fan-out (store.interface.proto:425): every peer store
         instantiates its replica."""
         from ..server.store_server import schema_to_wire
 
         fields = schema_to_wire(self.row_schema)
-        for r in self.regions:
+        for r in (regions if regions is not None else self.regions):
             for _, addr in r.peers:
                 self.cluster.store(addr).try_call(
                     "create_region", region_id=r.region_id,
@@ -142,6 +180,10 @@ class RemoteRowTier:
                 if status == "ok":
                     region.leader_addr = addr
                     return
+                if status == "version_old":
+                    # this frontend's cached ranges predate a split by
+                    # another frontend: refresh and let the caller re-route
+                    raise StaleRoutingError(region.region_id)
                 if status == "not_leader":
                     new_hint = region.addr_of(int(resp.get("leader", -1)))
                     if new_hint and new_hint not in tried and \
@@ -154,7 +196,17 @@ class RemoteRowTier:
                             region.leader_addr = new_hint
                             return
                 elif status == "no_region":
-                    self._materialize()
+                    # the store lost the replica (daemon restart) OR the
+                    # region was merged/dropped away; meta decides — blind
+                    # re-materialization would resurrect a retired region
+                    # as an unrouted zombie that swallows acked writes
+                    wire = self.cluster.meta.call("table_regions",
+                                                  table_id=self.table_id)
+                    if any(int(w["region_id"]) == region.region_id
+                           for w in wire):
+                        self._materialize([region])
+                    else:
+                        raise StaleRoutingError(region.region_id)
             hint = region.leader_addr
             time.sleep(0.15)        # election in progress: next round
         raise ReplicationError(
@@ -162,16 +214,47 @@ class RemoteRowTier:
             f"accepted the write within {self.propose_deadline}s")
 
     # -- tier API ----------------------------------------------------------
-    def _route(self, key: bytes) -> _RemoteRegion:
-        return self.regions[_fnv64(key) % len(self.regions)]
+
+    def refresh_routing(self) -> None:
+        """Re-pull this table's region ranges from meta (after another
+        frontend split/merged them)."""
+        wire = self.cluster.meta.call("table_regions",
+                                      table_id=self.table_id)
+        self.regions = sorted((self._from_wire(w) for w in wire),
+                              key=lambda r: r.start_key)
 
     def write_ops(self, ops: list[tuple[int, bytes, bytes]]) -> None:
         if not ops:
             return
+        for attempt in range(3):
+            try:
+                self._write_ops_routed(ops)
+                break
+            except StaleRoutingError:
+                if attempt == 2:
+                    raise ReplicationError(
+                        f"{self.table_key}: routing kept going stale")
+                self.refresh_routing()
+        # size check every few batches (an RPC per region — not per write)
+        self._writes_since_check += 1
+        if self._writes_since_check >= 8:
+            self._writes_since_check = 0
+            try:
+                self.maybe_split()
+            except Exception:     # noqa: BLE001
+                pass              # split is maintenance (meta down, quorum
+                #                   loss, anything): the write already ACKed
+
+    def _write_ops_routed(self, ops: list[tuple[int, bytes, bytes]]) -> None:
+        # rightmost start <= key over the sorted range list (the
+        # SchemaFactory range lookup); starts hoisted once per batch
+        starts = [r.start_key for r in self.regions]
         per: dict[int, list] = {}
         by_id = {r.region_id: r for r in self.regions}
         for op in ops:
-            per.setdefault(self._route(op[1]).region_id, []).append(op)
+            rid = self.regions[max(bisect_right(starts, op[1]) - 1,
+                                   0)].region_id
+            per.setdefault(rid, []).append(op)
         if len(per) == 1:
             rid, batch = next(iter(per.items()))
             self._propose(by_id[rid],
@@ -188,7 +271,7 @@ class RemoteRowTier:
                               encode_cmd(CMD_PREPARE, txn,
                                          encode_ops(per[rid])))
                 prepared.append(rid)
-        except ReplicationError:
+        except (ReplicationError, StaleRoutingError):
             for rid in prepared:
                 try:
                     self._propose(by_id[rid], encode_cmd(CMD_ROLLBACK, txn))
@@ -218,7 +301,14 @@ class RemoteRowTier:
             except ReplicationError:
                 pass
 
-    def _scan_region(self, region: _RemoteRegion) -> list:
+    def _scan_region(self, region: _RemoteRegion):
+        """Leader scan, filtered by the INTERSECTION of the replica's
+        committed range and this frontend's routed range: during
+        split/merge a replica can briefly hold (or still claim) keys
+        outside its final range, and either filter alone could double- or
+        under-read.  A replica whose committed range no longer covers what
+        we route to it means OUR routing is stale -> StaleRoutingError
+        (the read-side analog of version_old)."""
         deadline = time.monotonic() + self.propose_deadline
         candidates = [region.leader_addr] + \
             [a for _, a in region.peers if a != region.leader_addr]
@@ -232,17 +322,183 @@ class RemoteRowTier:
                 continue
             if resp.get("status") == "ok":
                 region.leader_addr = addr
-                return resp["pairs"]
+                rs, re_ = resp.get("start", b""), resp.get("end", b"")
+                cs, ce = region.start_key, region.end_key
+                # replica range narrower than what we route here (b"" is
+                # unbounded): rows we think it owns moved in a split we
+                # haven't seen yet
+                below = bool(rs) and (not cs or cs < rs)
+                above = bool(re_) and (not ce or ce > re_)
+                if below or above:
+                    raise StaleRoutingError(region.region_id)
+                s = max(cs, rs)                     # both lower bounds
+                e = ce if not re_ else (re_ if not ce else min(ce, re_))
+                return [(k, v) for k, v in resp["pairs"]
+                        if (not s or k >= s) and (not e or k < e)]
             time.sleep(0.1)
         raise ReplicationError(
             f"region {region.region_id} of {self.table_key}: no leader scan")
 
     def scan_rows(self) -> list[dict]:
-        out: list[dict] = []
-        for r in self.regions:
-            for _, v in self._scan_region(r):
-                out.append(self.row_codec.decode(v))
-        return out
+        for attempt in range(3):
+            try:
+                out: list[dict] = []
+                for r in self.regions:
+                    for _, v in self._scan_region(r):
+                        out.append(self.row_codec.decode(v))
+                return out
+            except StaleRoutingError:
+                if attempt == 2:
+                    raise ReplicationError(
+                        f"{self.table_key}: routing kept going stale")
+                self.refresh_routing()
+        return []
+
+    # -- split / merge -----------------------------------------------------
+    def _threshold(self) -> int:
+        return self.split_rows or int(FLAGS.region_split_rows)
+
+    def _region_size(self, region: _RemoteRegion) -> Optional[int]:
+        for addr in [region.leader_addr] + [a for _, a in region.peers
+                                            if a != region.leader_addr]:
+            resp = self.cluster.store(addr).try_call(
+                "region_size", region_id=region.region_id)
+            if resp is not None and resp.get("status") == "ok":
+                region.leader_addr = addr
+                return int(resp["live"])
+        return None
+
+    def maybe_split(self) -> int:
+        """Split oversized regions (the store-side size trigger run from
+        the frontend — one RPC per region per check)."""
+        threshold = self._threshold()
+        done = 0
+        if threshold <= 0:
+            return done
+        i = 0
+        while i < len(self.regions):
+            size = self._region_size(self.regions[i])
+            if size is not None and size >= threshold:
+                try:
+                    self.split_region(i)
+                    done += 1
+                    continue       # left half may still be oversized
+                except SplitError:
+                    pass
+            i += 1
+        return done
+
+    def split_region(self, idx: int) -> None:
+        """The in-process tier's lifecycle over the RPC plane: meta
+        registers the child on the parent's peers, every peer store
+        materializes it, the upper half replicates in (copy+catch-up as
+        one committed write — the tier serializes writes), both sides
+        raft-commit their range, the parent trims."""
+        parent = self.regions[idx]
+        pairs = self._scan_region(parent)
+        if len(pairs) < 2:
+            raise SplitError(f"region {parent.region_id} too small to split")
+        mid = pairs[len(pairs) // 2][0]
+        if mid == pairs[0][0]:
+            raise SplitError(f"region {parent.region_id} has no usable "
+                             f"split key")
+        w = self.cluster.meta.call("split_region_key",
+                                   region_id=parent.region_id,
+                                   split_key_hex=bytes(mid).hex())
+        child = self._from_wire(w)
+        try:
+            self._materialize([child])
+            moved = [(0, k, v) for k, v in pairs if k >= mid]
+            if moved:
+                self._propose(child,
+                              encode_cmd(CMD_WRITE, 0, encode_ops(moved)))
+            self._propose(child, encode_cmd(
+                CMD_SET_RANGE, 0,
+                encode_range(child.version, mid, parent.end_key)))
+        except Exception:
+            # abort: restore the parent's meta range and retire the child —
+            # a registered-but-empty child would mis-route fresh frontends.
+            # Dropping the child's replicas is decisive even if its
+            # SET_RANGE committed after our timeout (no replica, no serve);
+            # the in-process tier keeps the same invariant
+            try:
+                self.cluster.meta.call("merge_regions_key",
+                                       left_id=parent.region_id,
+                                       right_id=child.region_id)
+            except Exception:
+                pass
+            for _, addr in child.peers:
+                self.cluster.store(addr).try_call(
+                    "drop_region", region_id=child.region_id)
+            raise SplitError(
+                f"split of region {parent.region_id} aborted") from None
+        # past this point the split is NOT abortable: the child owns
+        # [mid, end) in meta and in its committed range.  A parent
+        # SET_RANGE timeout may still commit later — reverting meta then
+        # would permanently hide [mid, end) behind a narrowed parent —
+        # so failures here surface but the split stands (readers filter
+        # by the intersection of routed and committed ranges, so the
+        # not-yet-narrowed parent cannot double-serve)
+        try:
+            self._propose(parent, encode_cmd(
+                CMD_SET_RANGE, 0,
+                encode_range(child.version, parent.start_key, mid)))
+            self._propose(parent, encode_cmd(CMD_TRIM, 0))
+        finally:
+            # local routing honors the split even if the parent narrow
+            # failed to ack — the child is authoritative for [mid, end)
+            parent.end_key = mid
+            parent.version = child.version
+            self.regions.insert(idx + 1, child)
+
+    def merge_region(self, idx: int) -> None:
+        """Merge region idx+1 into its left neighbor.  Ordering keeps every
+        failure window readable and retryable: (1) the left raft-commits
+        the widened range, (2) the right's rows replicate into it, (3) the
+        right commits an EMPTY range — from here it serves nothing and no
+        reader can double-count — then (4) meta retires it from routing and
+        (5) its replicas drop.  A failure between (1) and (2) leaves the
+        right authoritative (left holds nothing in the overlap); retrying
+        re-runs the idempotent steps.  Failures are RAISED, never
+        swallowed — merge is an explicit maintenance operation."""
+        if idx + 1 >= len(self.regions):
+            raise SplitError("no right neighbor to merge")
+        left, right = self.regions[idx], self.regions[idx + 1]
+        pairs = self._scan_region(right)
+        version = max(left.version, right.version) + 1
+        self._propose(left, encode_cmd(
+            CMD_SET_RANGE, 0,
+            encode_range(version, left.start_key, right.end_key)))
+        if pairs:
+            self._propose(left, encode_cmd(
+                CMD_WRITE, 0, encode_ops([(0, k, v) for k, v in pairs])))
+        # (X, X) with non-empty X covers nothing: the right now owns — and
+        # serves — the empty range
+        self._propose(right, encode_cmd(
+            CMD_SET_RANGE, 0, encode_range(version, b"\x00", b"\x00")))
+        self.cluster.meta.call("merge_regions_key",
+                               left_id=left.region_id,
+                               right_id=right.region_id)
+        for _, addr in right.peers:
+            self.cluster.store(addr).try_call("drop_region",
+                                              region_id=right.region_id)
+        left.end_key = right.end_key
+        left.version = version
+        del self.regions[idx + 1]
+
+    def maybe_merge(self) -> int:
+        floor = max(2, self._threshold() // 4)
+        done = 0
+        i = 0
+        while i + 1 < len(self.regions):
+            a = self._region_size(self.regions[i])
+            b = self._region_size(self.regions[i + 1])
+            if a is not None and b is not None and a + b < floor:
+                self.merge_region(i)      # failures surface to the caller
+                done += 1
+                continue
+            i += 1
+        return done
 
     def num_rows(self) -> int:
         return sum(1 for r in self.scan_rows() if not r.get("__del"))
@@ -270,12 +526,11 @@ class RemoteRowTier:
 
     def reset_schema(self, row_schema: Schema,
                      ops: list[tuple[int, bytes, bytes]]) -> None:
-        n = max(1, len(self.regions))
         self.release_regions()
         self.row_schema = row_schema
         self.row_codec = RowCodec(row_schema)
         created = self.cluster.meta.call("create_regions",
-                                         table_id=self.table_id, n_regions=n)
+                                         table_id=self.table_id, n_regions=1)
         self.regions = [self._from_wire(w) for w in created]
         self._materialize()
         if ops:
