@@ -829,7 +829,74 @@ class Session:
                 self.db.ddl.resume()
                 return Result()
             raise SqlError(f"unsupported HANDLE ddl {op!r}")
+        if s.command == "add_privilege" and len(s.args) >= 3:
+            # handle add_privilege <user> <db|*> <read|write|all>
+            self.db.privileges.grant(s.args[0], s.args[2], s.args[1])
+            return Result()
+        if s.command == "drop_privilege" and len(s.args) >= 2:
+            self.db.privileges.revoke(s.args[0], s.args[1])
+            return Result()
+        if s.command == "set_flag" and len(s.args) >= 2:
+            # handle set_flag <name> <value> (reference: modify gflags)
+            FLAGS.set_flag(s.args[0], " ".join(s.args[1:]))
+            return Result()
+        if s.command in ("drop_instance", "migrate") and s.args:
+            # mark a store MIGRATE: balancing drains its peers (reference:
+            # handle migrate -> cluster_manager migrate handling)
+            self._fleet_meta().drop_instance("".join(s.args))
+            return Result()
+        if s.command in ("add_peer", "remove_peer", "trans_leader") and \
+                len(s.args) >= 2:
+            # handle add_peer|remove_peer|trans_leader <region_id> <store>:
+            # validated, executed, and recorded in meta by the fleet (the
+            # raft_control RPC surface); failures RAISE — an operator must
+            # never see success for an op that didn't happen
+            try:
+                self._fleet_required().operator_order(
+                    s.command, int(s.args[0]), "".join(s.args[1:]))
+            except (ValueError, RuntimeError) as e:
+                raise PlanError(str(e)) from None
+            return Result(affected_rows=1)
+        if s.command == "split_region" and s.args:
+            tier, idx = self._find_region(int(s.args[0]))
+            tier.split_region(idx)
+            return Result()
+        if s.command == "merge_region" and s.args:
+            tier, idx = self._find_region(int(s.args[0]))
+            tier.merge_region(idx)
+            return Result()
+        if s.command in ("store_heartbeat", "balance_tick"):
+            # one control-loop turn: heartbeats in, balance orders executed
+            return Result(affected_rows=self._fleet_required().control_tick())
+        if s.command == "compact":
+            # raft log compaction across every replicated tier (the
+            # space-efficient snapshot scheme)
+            fleet = self.db.fleet
+            if fleet is not None:
+                for tier in fleet.row_tiers.values():
+                    tier.compact_all()
+                if hasattr(fleet.meta, "compact_all"):
+                    fleet.meta.compact_all()
+            return Result()
         raise SqlError(f"unsupported HANDLE command {s.command!r}")
+
+    def _fleet_required(self):
+        if self.db.fleet is None:
+            raise PlanError("this HANDLE command needs a fleet-bound "
+                            "Database (store fleet + meta)")
+        return self.db.fleet
+
+    def _fleet_meta(self):
+        return self._fleet_required().meta
+
+    def _find_region(self, region_id: int):
+        """(tier, index) hosting a replicated region (fleet mode)."""
+        fleet = self._fleet_required()
+        for tier in fleet.row_tiers.values():
+            for i, m in enumerate(tier.metas):
+                if m.region_id == region_id:
+                    return tier, i
+        raise PlanError(f"unknown region {region_id}")
 
     def _drop_durable(self, key: str, store):
         """Remove a dropped table's WAL + Parquet from data_dir (and its

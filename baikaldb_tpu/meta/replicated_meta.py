@@ -102,6 +102,11 @@ class MetaReplica:
         if op == "set_instance_param":
             svc.set_instance_param(cmd["address"], cmd["name"], cmd["value"])
             return None
+        if op == "update_region_membership":
+            svc.update_region_membership(cmd["region_id"],
+                                         cmd.get("peers"),
+                                         cmd.get("leader"))
+            return None
         if op == "tick":
             return svc.tick()
         if op == "tso":
@@ -262,6 +267,13 @@ class ReplicatedMeta:
     def set_instance_param(self, address: str, name: str, value) -> None:
         self._propose({"op": "set_instance_param", "address": address,
                        "name": name, "value": value})
+
+    def update_region_membership(self, region_id: int, peers=None,
+                                 leader=None):
+        self._propose({"op": "update_region_membership",
+                       "region_id": int(region_id), "peers": peers,
+                       "leader": leader})
+        return self._svc.regions[int(region_id)]
 
     def tick(self) -> list[BalanceOrder]:
         return self._propose({"op": "tick", "now": self.clock()})

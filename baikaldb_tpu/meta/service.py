@@ -268,6 +268,20 @@ class MetaService:
             for rid in region_ids:
                 self.regions.pop(int(rid), None)
 
+    def update_region_membership(self, region_id: int,
+                                 peers: Optional[list[str]] = None,
+                                 leader: Optional[str] = None) -> RegionMeta:
+        """Record an executed membership change (operator add/remove peer,
+        leadership transfer) so routing and balancing see the real raft
+        state — membership has ONE owner: this registry."""
+        with self._mu:
+            rm = self.regions[region_id]
+            if peers is not None:
+                rm.peers = list(peers)
+            if leader is not None:
+                rm.leader = leader
+            return rm
+
     def route(self, table_id: int, row: int) -> Optional[RegionMeta]:
         """Row -> region (reference: SchemaFactory region routing)."""
         with self._mu:

@@ -159,6 +159,53 @@ class StoreFleet:
                     done += 1      # count only a transfer that took effect
         return done
 
+    def operator_order(self, kind: str, region_id: int,
+                       address: str) -> None:
+        """Operator membership op (reference: raft_control add/remove/
+        transfer-leader RPCs): validates against meta, executes on the raft
+        group, and records the result in meta's region registry — so
+        routing and balancing never drift from real membership.  Raises
+        ValueError on bad input, RuntimeError when the raft op fails."""
+        rm = self.meta.regions.get(region_id)
+        g = self.groups.get(region_id)
+        if rm is None or g is None:
+            raise ValueError(f"unknown region {region_id}")
+        if address not in self.meta.instances:
+            raise ValueError(f"unknown store {address!r}")
+        if kind == "add_peer":
+            if address in rm.peers:
+                raise ValueError(f"{address} is already a peer")
+            if not g.add_peer(self._id_of(address)):
+                raise RuntimeError(f"add_peer {address} did not commit")
+            self.meta.update_region_membership(
+                region_id, peers=list(rm.peers) + [address])
+        elif kind == "remove_peer":
+            if address not in rm.peers:
+                raise ValueError(f"{address} is not a peer")
+            nid = self._ids.get(address)
+            if nid is not None and g.bus.leader() == nid:
+                raise ValueError("transfer leadership away first")
+            if not g.remove_peer(nid):
+                raise RuntimeError(f"remove_peer {address} did not commit")
+            self.meta.update_region_membership(
+                region_id, peers=[p for p in rm.peers if p != address])
+        elif kind == "trans_leader":
+            src = g.leader()
+            tgt = self._ids.get(address)
+            if tgt is None or tgt not in g.bus.nodes:
+                raise ValueError(f"{address} hosts no replica of "
+                                 f"region {region_id}")
+            if src == tgt:
+                return
+            if not g.bus.nodes[src].core.transfer_leader(tgt):
+                raise RuntimeError("current leader rejected the transfer")
+            g.bus.pump()
+            if g.bus.elect() != tgt:
+                raise RuntimeError("leadership transfer did not take effect")
+            self.meta.update_region_membership(region_id, leader=address)
+        else:
+            raise ValueError(f"unknown operator order {kind!r}")
+
     def control_tick(self) -> int:
         """One full control-loop turn: heartbeats in, orders out, orders
         executed.  Returns how many orders were applied."""

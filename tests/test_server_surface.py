@@ -168,3 +168,69 @@ def test_non_super_cannot_grant(srv):
         a.query("GRANT ALL ON *.* TO 'app'")
     assert ei.value.code == 1227
     a.close()
+
+
+def test_handle_operator_surface():
+    """Widened HANDLE command map (reference: handle_helper.cpp operator
+    registry): privileges, flags, fleet region ops, control-loop tick."""
+    import pytest
+
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.core import raft_available
+    from baikaldb_tpu.utils.flags import FLAGS
+
+    if not raft_available():
+        pytest.skip("native raft core unavailable")
+    meta = MetaService(peer_count=3)
+    from baikaldb_tpu.raft.fleet import StoreFleet
+    fleet = StoreFleet(meta, ["a:1", "b:1", "c:1", "d:1"], seed=23)
+    s = Session(Database(fleet=fleet))
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    for i in range(10):
+        s.execute(f"INSERT INTO t VALUES ({i}, 1.0)")
+    tier = fleet.row_tiers["default.t"]
+    rid = tier.metas[0].region_id
+
+    # privileges
+    s.execute("CREATE USER 'ops' IDENTIFIED BY 'pw'")
+    s.execute("HANDLE add_privilege ops default read")
+    assert ("default", "SELECT") in s.db.privileges.grants_of("ops")
+    s.execute("HANDLE drop_privilege ops default")
+    assert ("default", "SELECT") not in s.db.privileges.grants_of("ops")
+
+    # flags
+    s.execute("HANDLE set_flag region_split_rows 123")
+    assert int(FLAGS.region_split_rows) == 123
+    FLAGS.set_flag("region_split_rows", 200_000)
+
+    # region ops: split, transfer leadership, add/remove peer — executed
+    # on the raft group AND recorded in meta (membership has one owner)
+    s.execute(f"HANDLE split_region {rid}")
+    assert len(tier.groups) == 2
+    rm = meta.regions[rid]
+    target = next(a for a in rm.peers if a != rm.leader)
+    assert s.execute(f"HANDLE trans_leader {rid} {target}").affected_rows == 1
+    assert meta.regions[rid].leader == target
+    assert "d:1" not in rm.peers
+    assert s.execute(f"HANDLE add_peer {rid} d:1").affected_rows == 1
+    assert "d:1" in meta.regions[rid].peers
+    assert len(tier.groups[0].peers()) == 4
+    victim = next(a for a in rm.peers if a != meta.regions[rid].leader)
+    assert s.execute(f"HANDLE remove_peer {rid} {victim}").affected_rows == 1
+    assert victim not in meta.regions[rid].peers
+
+    # operator mistakes RAISE — never silent success
+    with pytest.raises(Exception):
+        s.execute("HANDLE add_peer 99999 d:1")          # unknown region
+    with pytest.raises(Exception):
+        s.execute(f"HANDLE add_peer {rid} nosuch:1")    # unknown store
+    with pytest.raises(Exception):                      # leader removal
+        s.execute(f"HANDLE remove_peer {rid} {meta.regions[rid].leader}")
+
+    # control loop + drain + compaction
+    s.execute("HANDLE balance_tick")
+    s.execute("HANDLE drop_instance c:1")
+    assert meta.instances["c:1"].status == "MIGRATE"
+    s.execute("HANDLE compact")
+    assert s.query("SELECT COUNT(*) n FROM t") == [{"n": 10}]
