@@ -99,6 +99,17 @@ def compile_plan(plan: PlanNode, trace: bool = False, mesh=None) -> Callable:
     return run
 
 
+def _presort_order(node, batches: dict, expected_len: int):
+    """The host-precomputed sort permutation fed by the session's
+    walk_presort, or None when absent / the input's positions are not the
+    base table's (access-path gather, shard slice)."""
+    pkey = getattr(node, "presort_input", None)
+    order = batches.get(pkey) if pkey else None
+    if order is not None and len(order) != expected_len:
+        return None
+    return order
+
+
 def _eval_traced(node: PlanNode, batches: dict, ctx):
     overflows, counts, trace_order, n_shards = ctx
     out = _eval(node, batches, overflows, ctx)
@@ -141,10 +152,13 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
                 node.cap = max(1, len(left) * len(right))
             out, ovf = join_ops.cross_join(left, right, cap=node.cap)
         elif node.neq is not None and node.how in ("semi", "anti"):
-            # EXISTS + one <> residual: range counts, no expansion
+            # EXISTS + one <> residual: range counts, no expansion; with a
+            # host-precomputed build permutation, no on-device sort either
             out, ovf = join_ops.semi_join_neq(left, node.left_keys, right,
                                               node.right_keys, node.neq[0],
-                                              node.neq[1], how=node.how)
+                                              node.neq[1], how=node.how,
+                                              order=_presort_order(
+                                                  node, batches, len(right)))
         elif node.strategy == "dense":
             # unique-build PK-FK join: scatter/gather over the dense key
             # domain(s), output keeps the probe's shape (no overflow
@@ -209,7 +223,9 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
                 out = ColumnBatch(out.names, cols, out.sel, out.num_rows)
             return out
         mg = node.max_groups or max(1, len(child))
-        return group_aggregate_sorted(child, node.key_names, node.specs, mg)
+        return group_aggregate_sorted(child, node.key_names, node.specs, mg,
+                                      order=_presort_order(node, batches,
+                                                           len(child)))
 
     if isinstance(node, DistinctNode):
         child = _sub(node.child(), batches, overflows, ctx)

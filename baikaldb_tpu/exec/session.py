@@ -1865,6 +1865,39 @@ class Session:
                 walk_plan(c)
 
         walk_plan(plan)
+
+        captured = {tk: v for tk, v, _ in key_parts}
+
+        def walk_presort(n: PlanNode):
+            spec = getattr(n, "presort", None)
+            if spec is not None and self.mesh is None:
+                n.presort_input = None
+                kind, table_key, cols = spec
+                store = self.db.stores.get(table_key)
+                base = batches.get(table_key)
+                # only when the scan input IS the full base table (an
+                # index-gathered or sharded batch has different positions)
+                # AND the store still sits at the version the batch was
+                # captured at — a permutation computed over newer data
+                # applied to an older batch would be silently unsorted
+                if store is not None and base is not None and \
+                        len(base) == store.num_rows and \
+                        store.version == captured.get(table_key):
+                    pkey = f"__presort__{kind}|{table_key}|{','.join(cols)}"
+                    if pkey not in batches:
+                        import jax.numpy as jnp
+                        fn = store.sort_permutation if kind == "join" \
+                            else store.agg_sort_permutation
+                        perm = fn(tuple(cols))
+                        if store.version != captured.get(table_key):
+                            perm = None     # raced a write mid-build
+                        if perm is not None:
+                            batches[pkey] = jnp.asarray(perm)
+                    if pkey in batches:
+                        n.presort_input = pkey
+            for c in n.children:
+                walk_presort(c)
+        walk_presort(plan)
         return batches, tuple(sorted(key_parts))
 
     def _access_path_batch(self, n, db: str, name: str, store):

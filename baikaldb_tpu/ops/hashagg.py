@@ -444,7 +444,7 @@ def _segment_distinct(c: Column, gid, ng: int, s: AggSpec) -> Column:
 
 def group_aggregate_sorted(batch: ColumnBatch, key_names: list[str],
                            specs: list[AggSpec], max_groups: int,
-                           with_overflow: bool = False):
+                           with_overflow: bool = False, order=None):
     """General GROUP BY: lexicographic stable sort, boundary cumsum group ids,
     segment reductions into a static max_groups-slot table.
 
@@ -464,12 +464,23 @@ def group_aggregate_sorted(batch: ColumnBatch, key_names: list[str],
         if c.validity is not None:
             d = jnp.where(c.validity, d, jnp.zeros((), d.dtype))
         key_data.append(d)
-    perm = jnp.arange(n)
-    for c, d in zip(reversed(key_cols), reversed(key_data)):
-        perm = perm[jnp.argsort(d[perm], stable=True)]
-        if c.validity is not None:
-            perm = perm[jnp.argsort(c.validity[perm], stable=True)]  # NULLs first
-    perm = perm[jnp.argsort(~sel[perm], stable=True)]  # dead rows last
+    if order is not None:
+        # host-precomputed per-version key order (the secondary-index
+        # read): only the query-dependent liveness partition remains, and
+        # a stable boolean partition is O(n) prefix-sum arithmetic — no
+        # on-device sort at all
+        live_o = sel[order]
+        n_live = jnp.sum(live_o)
+        dest = jnp.where(live_o, jnp.cumsum(live_o) - 1,
+                         n_live + jnp.cumsum(~live_o) - 1)
+        perm = jnp.zeros(n, order.dtype).at[dest].set(order)
+    else:
+        perm = jnp.arange(n)
+        for c, d in zip(reversed(key_cols), reversed(key_data)):
+            perm = perm[jnp.argsort(d[perm], stable=True)]
+            if c.validity is not None:
+                perm = perm[jnp.argsort(c.validity[perm], stable=True)]  # NULLs first
+        perm = perm[jnp.argsort(~sel[perm], stable=True)]  # dead rows last
 
     sel_s = sel[perm]
     idx = jnp.arange(n)

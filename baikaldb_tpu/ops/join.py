@@ -110,7 +110,8 @@ def _probe_dead(probe: ColumnBatch, pvalid):
 
 def semi_join_neq(probe: ColumnBatch, probe_keys: list[str],
                   build: ColumnBatch, build_keys: list[str],
-                  neq_probe: str, neq_build: str, how: str = "semi"):
+                  neq_probe: str, neq_build: str, how: str = "semi",
+                  order=None):
     """[NOT] EXISTS with equality keys plus ONE ``build_col <> probe_col``
     residual — the TPC-H q21 shape — WITHOUT expanding the many-to-many
     match space.  For each probe row the residual-satisfying match count is
@@ -137,27 +138,47 @@ def semi_join_neq(probe: ColumnBatch, probe_keys: list[str],
 
     mask32 = jnp.int64(0xFFFFFFFF)
     pk2 = (bk.astype(jnp.int64) << 32) | (b.data.astype(jnp.int64) & mask32)
-    order2 = jnp.lexsort((pk2, bdead))
-    n_live = jnp.sum(~bdead).astype(jnp.int32)
-    pk2_sorted = jnp.where(jnp.arange(len(build)) < n_live,
-                           pk2[order2], _sentinel_max(pk2.dtype))
-
     base = pk.astype(jnp.int64) << 32
-    first_dead = n_live.astype(jnp.int32)
-    clamp = lambda x: jnp.minimum(x.astype(jnp.int32), first_dead)  # noqa: E731
-    key_lo = clamp(jnp.searchsorted(pk2_sorted, base, side="left"))
-    # upper bound via side="right" on the all-ones low word: adding 2^32
-    # would overflow int64 for a key at dtype max (the clamp keeps a live
-    # key whose packed value EQUALS the sentinel correct too)
-    key_hi = clamp(jnp.searchsorted(pk2_sorted, base | mask32, side="right"))
     pp = base | (a.data.astype(jnp.int64) & mask32)
-    eq_lo = clamp(jnp.searchsorted(pk2_sorted, pp, side="left"))
-    eq_hi = clamp(jnp.searchsorted(pk2_sorted, pp, side="right"))
+    if order is not None:
+        # host-precomputed per-version sort of the base table (the
+        # secondary-index read): NO on-device sort.  Dead rows (filtered /
+        # NULL) sit interspersed at their value positions; a prefix sum of
+        # deadness converts value-range counts into LIVE counts
+        pk2_sorted = pk2[order]
+        dead_sorted = bdead[order].astype(jnp.int32)
+        cum = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(dead_sorted)])
+
+        def live_range(lo_v, hi_v, lo_side, hi_side):
+            lo = jnp.searchsorted(pk2_sorted, lo_v, side=lo_side)
+            hi = jnp.searchsorted(pk2_sorted, hi_v, side=hi_side)
+            return (hi - lo) - (cum[hi] - cum[lo])
+
+        key_cnt = live_range(base, base | mask32, "left", "right")
+        eq_cnt = live_range(pp, pp, "left", "right")
+    else:
+        order2 = jnp.lexsort((pk2, bdead))
+        n_live = jnp.sum(~bdead).astype(jnp.int32)
+        pk2_sorted = jnp.where(jnp.arange(len(build)) < n_live,
+                               pk2[order2], _sentinel_max(pk2.dtype))
+        first_dead = n_live.astype(jnp.int32)
+        clamp = lambda x: jnp.minimum(x.astype(jnp.int32), first_dead)  # noqa: E731
+        key_lo = clamp(jnp.searchsorted(pk2_sorted, base, side="left"))
+        # upper bound via side="right" on the all-ones low word: adding
+        # 2^32 would overflow int64 for a key at dtype max (the clamp
+        # keeps a live key whose packed value EQUALS the sentinel correct)
+        key_hi = clamp(jnp.searchsorted(pk2_sorted, base | mask32,
+                                        side="right"))
+        pp_lo = clamp(jnp.searchsorted(pk2_sorted, pp, side="left"))
+        pp_hi = clamp(jnp.searchsorted(pk2_sorted, pp, side="right"))
+        key_cnt = key_hi - key_lo
+        eq_cnt = pp_hi - pp_lo
 
     psel_dead, pdead = _probe_dead(probe, pvalid)
     if a.validity is not None:
         pdead = pdead | ~a.validity      # a NULL: residual never TRUE
-    counts = jnp.where(pdead, 0, (key_hi - key_lo) - (eq_hi - eq_lo))
+    counts = jnp.where(pdead, 0, key_cnt - eq_cnt)
     if how == "semi":
         return probe.and_sel(counts > 0), jnp.int32(0)
     if how == "anti":

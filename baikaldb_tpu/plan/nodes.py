@@ -98,6 +98,10 @@ class JoinNode(PlanNode):
     # semi/anti with ONE "build_col <> probe_col" residual: range-count
     # path, no expansion (ops/join.semi_join_neq)
     neq: Optional[tuple] = None             # (probe_col, build_col)
+    # build side is a position-preserving view of one base table: the
+    # executor feeds store.sort_permutation(cols) so the kernel skips its
+    # on-device sort.  (table_key, (key_col, neq_col))
+    presort: Optional[tuple] = None
 
     def _label(self):
         dense = ""
@@ -124,6 +128,10 @@ class AggNode(PlanNode):
     # "collective": per-shard partials merged in-network (psum/pmin/pmax) —
     # the partial-AggNode + MERGE_AGG_NODE pair as one collective
     merge: str = ""
+    # sorted strategy over base-table keys of one position-preserving scan
+    # chain: the executor feeds store.agg_sort_permutation(cols) so the
+    # kernel skips its multi-key device sort.  (table_key, (col, ...))
+    presort: Optional[tuple] = None
 
     def _label(self):
         s = f"dense{self.domains}" if self.strategy == "dense" else f"sorted<= {self.max_groups}"

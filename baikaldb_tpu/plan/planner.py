@@ -937,6 +937,15 @@ class Planner:
                       specs=specs + fd_specs,
                       strategy=strategy, domains=domains, max_groups=max_groups,
                       schema=Schema(tuple(out_fields)))
+        if strategy == "sorted" and key_names and \
+                self._position_preserving(plan):
+            # all keys are base columns of the one underlying scan: the
+            # executor can feed a host-precomputed per-version sort
+            hits = [self._key_scan(plan, k) for k in key_names]
+            if all(h is not None and len(h) == 2 for h in hits) and \
+                    len({h[0] for h in hits}) == 1:
+                agg.presort = ("agg", hits[0][0],
+                               tuple(h[1] for h in hits))
         agg.key_shift = key_shift
         plan = agg
 
@@ -1081,6 +1090,15 @@ class Planner:
                               right_keys=[i for _, i in pairs],
                               neq=neq, schema=holder[0].schema)
                 jn.subquery_right = True
+                # build side over a position-preserving chain to one scan:
+                # the executor feeds a host-precomputed per-version sort
+                # permutation and the kernel skips its on-device lexsort
+                hk = self._key_scan(subplan, pairs[0][1])
+                hb = self._key_scan(subplan, neq[1])
+                if hk is not None and hb is not None and len(hk) == 2 and \
+                        len(hb) == 2 and hk[0] == hb[0] and \
+                        self._position_preserving(subplan):
+                    jn.presort = ("join", hk[0], (hk[1], hb[1]))
                 holder[0] = jn
                 return
             self._plan_exists_residual(holder, scope, subscope, subplan,
@@ -1104,6 +1122,20 @@ class Planner:
 
     _SAFE32 = {LType.BOOL, LType.INT8, LType.INT16, LType.INT32,
                LType.UINT32, LType.DATE, LType.STRING}
+
+    def _position_preserving(self, plan: PlanNode) -> bool:
+        """True when ``plan`` is a Project/Filter chain over ONE Scan: row
+        positions equal the base table's (filters are sel-masks, not
+        compaction), so a host permutation of the table applies verbatim."""
+        node = plan
+        while True:
+            if isinstance(node, ScanNode):
+                return True
+            if isinstance(node, (FilterNode, ProjectNode)) and \
+                    len(node.children) == 1:
+                node = node.children[0]
+                continue
+            return False
 
     def _try_neq_residual(self, outer, subplan, pairs, residuals,
                           outer_resolve, inner_resolve):

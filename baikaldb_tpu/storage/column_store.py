@@ -539,6 +539,67 @@ class TableStore:
             cache[1][column] = entry
             return entry
 
+    def sort_permutation(self, cols: tuple) -> "np.ndarray":
+        """Host-side permutation sorting the DEVICE-VISIBLE arrays of
+        ``cols`` (last = secondary key), packed the way the join kernels
+        pack them: primary key int64<<32 | secondary&0xFFFFFFFF.  Cached
+        per table version — the 'index build' that lets a static table's
+        joins skip the on-device bitonic sort entirely (the reference
+        reads pre-sorted secondary indexes from RocksDB the same way)."""
+        with self._lock:
+            v = self.version
+            cache = getattr(self, "_perm_cache", None)
+            if cache is None or cache[0] != v:
+                cache = (v, {})
+                self._perm_cache = cache
+            ck = ("join",) + tuple(cols)
+            if ck in cache[1]:
+                return cache[1][ck]
+            batch = self.device_table_batch()
+            arrs = [np.asarray(batch.column(c).data).astype(np.int64)
+                    for c in cols]
+            if len(arrs) == 1:
+                order = np.argsort(arrs[0], kind="stable")
+            else:
+                packed = (arrs[0] << 32) | (arrs[1] & 0xFFFFFFFF)
+                order = np.argsort(packed, kind="stable")
+            order = order.astype(np.int32)
+            cache[1][ck] = order
+            return order
+
+    def agg_sort_permutation(self, cols: tuple) -> "np.ndarray":
+        """Host-side permutation replicating group_aggregate_sorted's key
+        ordering chain EXACTLY (canonical 0 under NULL lanes, stable sort
+        per key, NULLs-first per key): the device kernel then needs only
+        an O(n) liveness partition instead of a multi-key bitonic sort.
+        Cached per table version."""
+        with self._lock:
+            v = self.version
+            cache = getattr(self, "_perm_cache", None)
+            if cache is None or cache[0] != v:
+                cache = (v, {})
+                self._perm_cache = cache
+            ck = ("agg",) + tuple(cols)
+            if ck in cache[1]:
+                return cache[1][ck]
+            batch = self.device_table_batch()
+            perm = np.arange(len(batch))
+            for c in reversed(cols):
+                col = batch.column(c)
+                d = np.asarray(col.data)
+                if d.dtype == np.bool_:
+                    d = d.astype(np.int32)
+                vmask = None if col.validity is None \
+                    else np.asarray(col.validity)
+                if vmask is not None:
+                    d = np.where(vmask, d, np.zeros((), d.dtype))
+                perm = perm[np.argsort(d[perm], kind="stable")]
+                if vmask is not None:
+                    perm = perm[np.argsort(vmask[perm], kind="stable")]
+            perm = perm.astype(np.int32)
+            cache[1][ck] = perm
+            return perm
+
     def secondary_count(self, column: str, value):
         """How many rows match column = value (None if unindexable)."""
         try:

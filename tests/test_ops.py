@@ -306,3 +306,60 @@ def test_semi_join_neq_dtype_max_key():
     # probe 3 (k NULL): no match -> anti keeps (NOT EXISTS true)
     assert list(np.asarray(semi.sel_mask())) == [True, False, False, False]
     assert list(np.asarray(anti.sel_mask())) == [False, True, True, True]
+
+
+def test_presort_paths_match_device_sort():
+    """The host-precomputed sort permutations (store.sort_permutation /
+    agg_sort_permutation) must produce IDENTICAL results to the in-kernel
+    device sorts, with the presort verifiably ENGAGED (not silently
+    gated off)."""
+    import pyarrow as pa
+
+    from baikaldb_tpu.exec.session import Database, Session
+
+    s = Session(Database())
+    # INT keys: the packed (key<<32|residual) EXISTS path is 32-bit-safe
+    s.execute("CREATE TABLE l1 (ok INT, sk INT, qty DOUBLE, flag BIGINT)")
+    import random
+    rng = random.Random(3)
+    n = 2000
+    s.load_arrow("l1", pa.table({
+        # spans force the SORTED agg strategy (product > the dense cap)
+        "ok": [rng.randrange(1, 200_000) for _ in range(n)],
+        "sk": [rng.randrange(1, 1000) for _ in range(n)],
+        "qty": [float(rng.randrange(1, 50)) for _ in range(n)],
+        "flag": [rng.randrange(0, 2) for _ in range(n)],
+    }))
+    q_exists = ("SELECT COUNT(*) c FROM l1 a WHERE flag = 1 AND EXISTS ("
+                "SELECT 1 FROM l1 b WHERE b.ok = a.ok AND b.sk <> a.sk)")
+    q_agg = ("SELECT ok, sk, SUM(qty) s, COUNT(*) c FROM l1 "
+             "WHERE flag = 1 GROUP BY ok, sk ORDER BY ok, sk")
+
+    def engaged(sess, q):
+        plan = sess._plan_select(__import__(
+            "baikaldb_tpu.sql.parser", fromlist=["parse_sql"]
+        ).parse_sql(q)[0])
+        batches, _ = sess._collect_batches(plan)
+        return any(k.startswith("__presort__") for k in batches)
+
+    assert engaged(s, q_exists), "presort not engaged for EXISTS<>"
+    assert engaged(s, q_agg), "presort not engaged for sorted agg"
+    with_presort = (s.query(q_exists), s.query(q_agg))
+
+    # same session, presort force-disabled: results must be identical
+    s2 = Session(s.db)
+    orig = s2._collect_batches
+
+    def no_presort(plan):
+        b, k = orig(plan)
+        return {kk: v for kk, v in b.items()
+                if not kk.startswith("__presort__")}, k
+    s2._collect_batches = no_presort
+    without = (s2.query(q_exists), s2.query(q_agg))
+    assert with_presort == without
+    # a write bumps the version: permutations rebuild, results stay right
+    s.execute("INSERT INTO l1 VALUES (1, 19, 5.0, 1)")
+    s.execute("UPDATE l1 SET sk = 7 WHERE ok = 3")
+    s2._collect_batches = orig
+    assert s.query(q_agg) == s2.query(q_agg)
+    assert s.query(q_exists) == s2.query(q_exists)
