@@ -173,8 +173,10 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
                 # key-FK joins emit at most max(sides) rows; true many-to-many
                 # expansion beyond that reports its exact need via the flag
                 node.cap = max(1, len(left), len(right))
-            out, ovf = join_ops.join(left, node.left_keys, right,
-                                     node.right_keys, how=node.how, cap=node.cap)
+            out, ovf = join_ops.join(
+                left, node.left_keys, right, node.right_keys, how=node.how,
+                cap=node.cap,
+                wide_keys_ok=getattr(node, "pack32_verified", False))
         overflows.append((node, ovf))
         # label-qualified names are globally unique, no suffixing occurs
         return out

@@ -58,8 +58,18 @@ def _align_string_keys(probe: ColumnBatch, probe_keys: list[str],
     return probe, build
 
 
-def _key_array(batch: ColumnBatch, names: list[str]):
-    """Pack 1-2 key columns into a single sortable array + validity."""
+_PACK32_TYPES = (LType.BOOL, LType.INT8, LType.INT16, LType.INT32,
+                 LType.UINT32, LType.DATE, LType.STRING)
+
+
+def _key_array(batch: ColumnBatch, names: list[str],
+               wide_keys_ok: bool = False):
+    """Pack 1-2 key columns into a single sortable array + validity.
+
+    ``wide_keys_ok``: the PLANNER verified (from statistics) that wider
+    integer values fit 32-bit packing; without it, only types whose every
+    value packs losslessly are accepted — an unbounded int64 must fail
+    loudly, not alias silently."""
     cols = [batch.column(n) for n in names]
     valid = None
     for c in cols:
@@ -72,11 +82,13 @@ def _key_array(batch: ColumnBatch, names: list[str]):
         return d, valid
     if len(cols) == 2:
         for c in cols:
-            if c.ltype not in (LType.BOOL, LType.INT8, LType.INT16, LType.INT32,
-                               LType.UINT32, LType.DATE, LType.STRING):
-                raise ValueError("2-key sort-join requires 32-bit-safe key "
-                                 "types; planner must demote wider keys to "
-                                 "residual equality")
+            ok = c.ltype in _PACK32_TYPES or \
+                (wide_keys_ok and c.ltype.is_integer)
+            if not ok:
+                raise ValueError(
+                    "2-key sort-join requires 32-bit-packable keys "
+                    "(or planner-verified bounds); demote to residual "
+                    "equality otherwise")
         a = cols[0].data.astype(jnp.int64)
         b = cols[1].data.astype(jnp.int64)
         return (a << 32) | (b & jnp.int64(0xFFFFFFFF)), valid
@@ -189,7 +201,7 @@ def semi_join_neq(probe: ColumnBatch, probe_keys: list[str],
 def join(probe: ColumnBatch, probe_keys: list[str],
          build: ColumnBatch, build_keys: list[str],
          how: str = "inner", cap: int | None = None,
-         suffix: str = "_r"):
+         suffix: str = "_r", wide_keys_ok: bool = False):
     """Returns (out_batch, needed_rows).
 
     ``needed_rows`` (traced int32) is the true output cardinality; the caller
@@ -204,8 +216,8 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     Column names: probe names keep their own; clashing build names get suffix.
     """
     probe, build = _align_string_keys(probe, probe_keys, build, build_keys)
-    pk, pvalid = _key_array(probe, probe_keys)
-    bk, bvalid = _key_array(build, build_keys)
+    pk, pvalid = _key_array(probe, probe_keys, wide_keys_ok)
+    bk, bvalid = _key_array(build, build_keys, wide_keys_ok)
 
     # build side: order by (is_dead, key) — liveness primary — so live rows
     # form a contiguous sorted prefix of exactly n_live entries.  A sentinel

@@ -669,12 +669,10 @@ class Planner:
         # the sort-join packs at most TWO keys, each into 32 bits: wider/more
         # keys join on the first key exactly and verify the rest as residual
         # equality (superset of matches -> post-filter)
-        safe32 = {LType.BOOL, LType.INT8, LType.INT16, LType.INT32,
-                  LType.UINT32, LType.DATE, LType.STRING}
-
         def pair_is_32bit(i: int) -> bool:
-            return (left.schema.field(lkeys[i]).ltype in safe32 and
-                    right.schema.field(rkeys[i]).ltype in safe32)
+            # 32-bit-safe types (or stats-bounded wider ints), no cross-
+            # signedness aliasing
+            return self._pair_pack_safe(left, lkeys[i], right, rkeys[i])
 
         composite_dense = len(lkeys) == 2 and (
             self._dense_key_domain_multi(right, rkeys) is not None or
@@ -705,6 +703,10 @@ class Planner:
         node = JoinNode(children=[left, right], how=how, left_keys=lkeys,
                         right_keys=rkeys, residual=residual,
                         schema=_join_schema(left, right, how))
+        if len(lkeys) == 2:
+            # both pairs passed _pair_pack_safe above: the kernel may pack
+            # wider integer types (values verified bounded)
+            node.pack32_verified = True
         if residual is not None:
             node2 = FilterNode(children=[node], pred=residual, schema=node.schema)
             node.residual = None
@@ -1123,6 +1125,39 @@ class Planner:
     _SAFE32 = {LType.BOOL, LType.INT8, LType.INT16, LType.INT32,
                LType.UINT32, LType.DATE, LType.STRING}
 
+    def _fits32(self, side: PlanNode, qualified: str) -> bool:
+        """The column's DEVICE values fit 32-bit packing: a 32-bit-safe
+        type, or a wider integer whose host statistics bound it inside
+        int32 (BIGINT keys holding small ids — the plan cache replans on
+        version bump, so the bound stays current)."""
+        try:
+            f = side.schema.field(qualified)
+        except Exception:
+            return False
+        if f.ltype in self._SAFE32:
+            return True
+        if not f.ltype.is_integer:
+            return False
+        st = self._key_stats(side, qualified)
+        return bool(st) and st.get("min") is not None and \
+            int(st["min"]) >= -(1 << 31) and int(st["max"]) < (1 << 31)
+
+    def _pair_pack_safe(self, lside, lq, rside, rq) -> bool:
+        """Both sides of one equality pair pack into 32 bits AND cannot
+        alias across signedness: int32 -1 and uint32 4294967295 share a
+        bit pattern, so a signed/unsigned mix needs the unsigned side
+        stats-bounded inside int32."""
+        if not (self._fits32(lside, lq) and self._fits32(rside, rq)):
+            return False
+        lu = lside.schema.field(lq).ltype is LType.UINT32
+        ru = rside.schema.field(rq).ltype is LType.UINT32
+        if lu == ru:
+            return True
+        uns, q = (lside, lq) if lu else (rside, rq)
+        st = self._key_stats(uns, q)
+        return bool(st) and st.get("max") is not None and \
+            int(st["max"]) < (1 << 31)
+
     def _position_preserving(self, plan: PlanNode) -> bool:
         """True when ``plan`` is a Project/Filter chain over ONE Scan: row
         positions equal the base table's (filters are sel-masks, not
@@ -1158,19 +1193,21 @@ class Planner:
             except PlanError:
                 continue
             try:
-                keys = [outer.schema.field(pairs[0][0]),
-                        subplan.schema.field(pairs[0][1])]
                 neqs = [outer.schema.field(oq.name),
                         subplan.schema.field(iq.name)]
             except Exception:
                 return None
             # neq columns exclude STRING (dictionaries not aligned in this
             # path) and mixed signedness (int32 -1 and uint32 4294967295
-            # would alias after 32-bit packing)
-            neq_ok = all(f.ltype in self._SAFE32 and
-                         f.ltype is not LType.STRING for f in neqs) and \
+            # would alias after 32-bit packing); keys may be wider ints
+            # when statistics bound their values inside int32
+            neq_ok = all(f.ltype is not LType.STRING and
+                         self._fits32(s, q)
+                         for f, s, q in zip(neqs, (outer, subplan),
+                                            (oq.name, iq.name))) and \
                 len({f.ltype is LType.UINT32 for f in neqs}) == 1
-            if all(f.ltype in self._SAFE32 for f in keys) and neq_ok:
+            if self._pair_pack_safe(outer, pairs[0][0],
+                                    subplan, pairs[0][1]) and neq_ok:
                 return (oq.name, iq.name)
             return None
         return None
