@@ -75,14 +75,32 @@ class TableInfo:
 
 
 class Catalog:
-    """namespace -> database -> table registry with versioned schemas."""
+    """namespace -> database -> table registry with versioned schemas.
+
+    Reads are WAIT-FREE: writers (DDL) serialize on the lock, build new
+    registry dicts, and publish them with one atomic attribute swap — the
+    butil::DoublyBufferedData pattern the reference wraps around its
+    SchemaFactory hot state (schema_factory.h:109).  The per-statement
+    get_table path never takes a lock.
+    """
 
     def __init__(self):
         self._lock = threading.RLock()
         self._ids = itertools.count(1)
         self._namespaces: set[str] = {"default"}
-        self._databases: dict[str, set[str]] = {"default": set()}
-        self._tables: dict[str, TableInfo] = {}  # "db.table" -> info
+        # ONE published snapshot (databases, tables) swapped atomically:
+        # readers can never observe the two maps from different
+        # generations.  Treat both dicts as immutable after publish.
+        self._snap: tuple[dict[str, frozenset[str]], dict[str, TableInfo]] \
+            = ({"default": frozenset()}, {})
+
+    @property
+    def _databases(self) -> dict[str, "frozenset[str]"]:
+        return self._snap[0]
+
+    @property
+    def _tables(self) -> dict[str, TableInfo]:
+        return self._snap[1]
 
     # -- namespaces / databases ----------------------------------------
     def create_database(self, name: str, namespace: str = "default",
@@ -94,8 +112,10 @@ class Catalog:
                 if if_not_exists:
                     return
                 raise ValueError(f"database {name!r} exists")
-            self._databases[name] = set()
+            dbs = dict(self._databases)
+            dbs[name] = frozenset()
             self._namespaces.add(namespace)
+            self._snap = (dbs, self._tables)    # atomic publish
 
     def drop_database(self, name: str, if_exists: bool = False):
         with self._lock:
@@ -103,13 +123,15 @@ class Catalog:
                 if if_exists:
                     return
                 raise ValueError(f"database {name!r} does not exist")
-            for t in list(self._databases[name]):
-                self._tables.pop(f"{name}.{t}", None)
-            del self._databases[name]
+            tables = dict(self._tables)
+            for t in self._databases[name]:
+                tables.pop(f"{name}.{t}", None)
+            dbs = dict(self._databases)
+            del dbs[name]
+            self._snap = (dbs, tables)          # atomic publish
 
     def databases(self) -> list[str]:
-        with self._lock:
-            return sorted(set(self._databases) | {"information_schema"})
+        return sorted(set(self._databases) | {"information_schema"})
 
     # -- tables ---------------------------------------------------------
     def create_table(self, database: str, name: str, schema: Schema,
@@ -126,8 +148,11 @@ class Catalog:
                 raise ValueError(f"table {key!r} exists")
             info = TableInfo(next(self._ids), "default", database, name, schema,
                              indexes=indexes or [], options=options or {})
-            self._tables[key] = info
-            self._databases[database].add(name)
+            tables = dict(self._tables)
+            tables[key] = info
+            dbs = dict(self._databases)
+            dbs[database] = self._databases[database] | {name}
+            self._snap = (dbs, tables)          # atomic publish
             return info
 
     def drop_table(self, database: str, name: str, if_exists: bool = False):
@@ -137,8 +162,11 @@ class Catalog:
                 if if_exists:
                     return
                 raise ValueError(f"table {key!r} does not exist")
-            del self._tables[key]
-            self._databases[database].discard(name)
+            tables = dict(self._tables)
+            del tables[key]
+            dbs = dict(self._databases)
+            dbs[database] = self._databases[database] - {name}
+            self._snap = (dbs, tables)          # atomic publish
 
     INFORMATION_SCHEMA = {
         "tables": Schema((Field("table_schema", LType.STRING),
@@ -178,21 +206,19 @@ class Catalog:
             if sch is None:
                 raise ValueError(f"unknown information_schema table {name!r}")
             return TableInfo(0, "default", "information_schema", name, sch)
-        with self._lock:
-            key = f"{database}.{name}"
-            if key not in self._tables:
-                raise ValueError(f"table {key!r} does not exist")
-            return self._tables[key]
+        _, tables = self._snap              # one atomic snapshot read
+        key = f"{database}.{name}"
+        if key not in tables:
+            raise ValueError(f"table {key!r} does not exist")
+        return tables[key]
 
     def has_table(self, database: str, name: str) -> bool:
-        with self._lock:
-            return f"{database}.{name}" in self._tables
+        return f"{database}.{name}" in self._tables
 
     def tables(self, database: str) -> list[str]:
         if database == "information_schema":
             return sorted(self.INFORMATION_SCHEMA)
-        with self._lock:
-            return sorted(self._databases.get(database, ()))
+        return sorted(self._databases.get(database, ()))
 
     def bump_version(self, database: str, name: str):
         with self._lock:
