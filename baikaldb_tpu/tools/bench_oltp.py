@@ -40,12 +40,13 @@ def _pad(rng: random.Random, n: int) -> str:
     return "".join(rng.choices(string.ascii_lowercase, k=n))
 
 
-def load(session, rows: int, seed: int = 7) -> None:
+def load(session, rows: int, seed: int = 7, create: bool = True) -> None:
     """sysbench prepare: id PK, secondary-ish k, payload c/pad columns."""
     rng = random.Random(seed)
-    session.execute(
-        f"CREATE TABLE {TABLE} (id BIGINT, k BIGINT, c VARCHAR(120), "
-        f"pad VARCHAR(60), PRIMARY KEY (id))")
+    if create:
+        session.execute(
+            f"CREATE TABLE {TABLE} (id BIGINT, k BIGINT, c VARCHAR(120), "
+            f"pad VARCHAR(60), PRIMARY KEY (id))")
     session.load_arrow(TABLE, pa.table({
         "id": list(range(1, rows + 1)),
         "k": [rng.randrange(1, rows + 1) for _ in range(rows)],
@@ -81,8 +82,11 @@ class _Worker(threading.Thread):
 
 
 def _run_threads(make_op, threads: int, seconds: float):
+    # build every op FIRST (connections, prepares, table attach): setup
+    # cost must not eat the measured window
+    ops = [make_op(i) for i in range(threads)]
     deadline = time.perf_counter() + seconds
-    ws = [_Worker(make_op(i), deadline) for i in range(threads)]
+    ws = [_Worker(op, deadline) for op in ops]
     t0 = time.perf_counter()
     for w in ws:
         w.start()
@@ -175,6 +179,86 @@ def bench(mode: str = "point_select", threads: int = 8, seconds: float = 5.0,
     return out
 
 
+_DDL = (f"CREATE TABLE IF NOT EXISTS {TABLE} (id BIGINT, k BIGINT, "
+        f"c VARCHAR(120), pad VARCHAR(60), PRIMARY KEY (id))")
+
+
+def _client_proc_main(port: int, threads: int, seconds: float, rows: int):
+    """One CLIENT PROCESS hammering one frontend (sysbench is a native
+    multi-process client; a single CPython client would bottleneck on its
+    own GIL before the servers did)."""
+    from ..client.mysql_client import Connection
+
+    def make_op(i: int):
+        # port*1000 spacing keeps seeds collision-free across processes
+        # for any per-process thread count below 1000
+        rng = random.Random(1000 + port * 1000 + i)
+        conn = Connection("127.0.0.1", port)
+        conn.query(_DDL)       # attach the table in that frontend process
+        sid = conn.prepare(f"SELECT c FROM {TABLE} WHERE id = ?")
+        return lambda: conn.execute(sid, (rng.randrange(1, rows + 1),))
+
+    out = _run_threads(make_op, threads, seconds)
+    print(json.dumps(out), flush=True)
+
+
+def bench_cluster(threads: int, seconds: float, rows: int,
+                  meta_addr: str, ports: list[int]) -> dict:
+    """point_select over N REAL frontend processes (the reference's
+    N-baikaldb deploy), one client PROCESS per frontend.  Reads only —
+    the remote tier is single-writer (rowid allocation; see
+    RemoteRowTier)."""
+    import subprocess
+    import sys as _sys
+
+    from ..exec.session import Database, Session
+    from .deploy_cluster import _ENV, _repo_root
+
+    loader = Session(Database(cluster=meta_addr))
+    loader.execute(_DDL)
+    tier = loader.db.cluster.tiers[f"default.{TABLE}"]
+    existing = sum(1 for r in tier.scan_rows() if not r.get("__del"))
+    if existing == 0:
+        load(loader, rows, create=False)
+    elif existing != rows:
+        raise ValueError(
+            f"cluster already holds {existing} sbtest rows (wanted {rows}):"
+            f" restart the cluster or pass --rows {existing}")
+    # pre-attach the table on every frontend OUTSIDE the timed window
+    # (the first CREATE rebuilds that process's columnar cache)
+    from ..client.mysql_client import Connection
+    for p in ports:
+        c = Connection("127.0.0.1", p)
+        c.query(_DDL)
+        c.close()
+    per = max(1, threads // len(ports))
+    procs = [subprocess.Popen(
+        [_sys.executable, "-c",
+         "from baikaldb_tpu.tools.bench_oltp import _client_proc_main; "
+         f"_client_proc_main({p}, {per}, {seconds}, {rows})"],
+        stdout=subprocess.PIPE, text=True, env=_ENV,
+        cwd=_repo_root()) for p in ports]
+    parts = [json.loads(pr.communicate(timeout=seconds + 120)[0])
+             for pr in procs]
+    lat_w = sum(p["queries"] for p in parts) or 1
+    out = {
+        "queries": sum(p["queries"] for p in parts),
+        "errors": sum(p["errors"] for p in parts),
+        "qps": round(sum(p["qps"] for p in parts), 1),
+        "avg_ms": round(sum(p["avg_ms"] * p["queries"]
+                            for p in parts) / lat_w, 3),
+        # tail latencies are the max over client processes: UPPER BOUNDS
+        # on the combined percentiles, not exact merges
+        "p95_ms": max(p["p95_ms"] for p in parts),
+        "p99_ms": max(p["p99_ms"] for p in parts),
+        "max_ms": max(p["max_ms"] for p in parts),
+        "mode": "point_select", "threads": per * len(ports), "rows": rows,
+        "transport": f"wire x{len(ports)} frontends x{len(ports)} clients",
+        "ref_qps_point_select": 92287.54,
+    }
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", default="point_select",
@@ -184,9 +268,21 @@ def main(argv=None):
     ap.add_argument("--rows", type=int, default=100_000)
     ap.add_argument("--inproc", action="store_true",
                     help="skip the wire protocol; measure the engine only")
+    ap.add_argument("--meta", default="",
+                    help="cluster mode: meta daemon address")
+    ap.add_argument("--ports", default="",
+                    help="cluster mode: comma-separated frontend ports")
     args = ap.parse_args(argv)
-    out = bench(args.mode, args.threads, args.seconds, args.rows,
-                wire=not args.inproc)
+    if args.meta and args.ports:
+        if args.mode != "point_select" or args.inproc:
+            ap.error("cluster mode (--meta/--ports) supports point_select "
+                     "over the wire only (the remote tier is single-writer)")
+        out = bench_cluster(args.threads, args.seconds, args.rows,
+                            args.meta,
+                            [int(p) for p in args.ports.split(",")])
+    else:
+        out = bench(args.mode, args.threads, args.seconds, args.rows,
+                    wire=not args.inproc)
     print(json.dumps(out))
 
 

@@ -56,14 +56,17 @@ def _wait_ping(address: str, timeout: float = 15.0) -> None:
 
 
 def spawn_cluster(n_stores: int = 3, base_port: int = 9100,
-                  mysql_port: int = 0):
-    """-> (meta_address, [store processes], meta process, mysql process|None).
-    mysql_port=0 skips the frontend (tests drive Session directly)."""
+                  mysql_port: int = 0, n_mysql: int = 1):
+    """-> (meta_address, {"meta", "stores", "mysql", "mysqls"}).
+    mysql_port=0 skips frontends (tests drive Session directly);
+    ``n_mysql`` > 1 spawns frontends on consecutive ports — the
+    reference's N-baikaldb deploy (throughput scales per frontend
+    process; see RemoteRowTier's single-WRITER note)."""
     meta_addr = f"127.0.0.1:{base_port}"
     procs = {"meta": _spawn(["baikaldb_tpu.server.meta_server",
                              "--address", meta_addr,
                              "--peer-count", str(n_stores)]),
-             "stores": [], "mysql": None}
+             "stores": [], "mysql": None, "mysqls": []}
     _wait_ping(meta_addr)
     for i in range(1, n_stores + 1):
         addr = f"127.0.0.1:{base_port + i}"
@@ -71,15 +74,21 @@ def spawn_cluster(n_stores: int = 3, base_port: int = 9100,
             ["baikaldb_tpu.server.store_server", "--store-id", str(i),
              "--address", addr, "--meta", meta_addr]))
         _wait_ping(addr)
-    if mysql_port:
-        procs["mysql"] = _spawn(["baikaldb_tpu.server",
-                                 "--port", str(mysql_port),
-                                 "--meta", meta_addr])
+    if mysql_port and n_mysql > 0:
+        for j in range(n_mysql):
+            procs["mysqls"].append(_spawn(["baikaldb_tpu.server",
+                                           "--port", str(mysql_port + j),
+                                           "--meta", meta_addr]))
+        procs["mysql"] = procs["mysqls"][0]
     return meta_addr, procs
 
 
 def teardown(procs: dict) -> None:
-    victims = [procs.get("meta"), procs.get("mysql")] + procs.get("stores", [])
+    victims = [procs.get("meta")] + procs.get("mysqls", []) + \
+        procs.get("stores", [])
+    if procs.get("mysql") is not None and \
+            procs["mysql"] not in procs.get("mysqls", []):
+        victims.append(procs["mysql"])
     for p in victims:
         if p is not None and p.poll() is None:
             p.terminate()
@@ -96,12 +105,17 @@ def main() -> None:
     ap.add_argument("--stores", type=int, default=3)
     ap.add_argument("--base-port", type=int, default=9100)
     ap.add_argument("--mysql-port", type=int, default=28000)
+    ap.add_argument("--frontends", type=int, default=1,
+                    help="MySQL frontends on consecutive ports")
     args = ap.parse_args()
     meta_addr, procs = spawn_cluster(args.stores, args.base_port,
-                                     args.mysql_port)
+                                     args.mysql_port,
+                                     n_mysql=args.frontends)
     print(f"meta     @ {meta_addr} (pid {procs['meta'].pid})")
     for i, p in enumerate(procs["stores"], 1):
         print(f"store {i}  @ 127.0.0.1:{args.base_port + i} (pid {p.pid})")
+    for j, p in enumerate(procs["mysqls"][1:], 1):
+        print(f"mysql+{j}  @ 127.0.0.1:{args.mysql_port + j} (pid {p.pid})")
     if procs["mysql"] is not None:
         print(f"mysql    @ 127.0.0.1:{args.mysql_port} "
               f"(pid {procs['mysql'].pid})")
