@@ -48,7 +48,10 @@ class MetaReplica:
         self.peer_count = peer_count
         self.service = self._fresh_service()
         self._now = 0.0
-        self.last_result = None
+        # command results BY LOG INDEX: under concurrent proposals a
+        # single "last result" slot would hand one caller another
+        # command's answer (e.g. two alloc_ids returning the same range)
+        self.results: dict[int, object] = {}
 
     def _fresh_service(self) -> MetaService:
         svc = MetaService(peer_count=self.peer_count,
@@ -59,7 +62,11 @@ class MetaReplica:
     def apply_committed(self):
         for c in self.core.drain_commits():
             if c.kind == DATA:
-                self.last_result = self._apply(json.loads(c.data.decode()))
+                self.results[c.index] = self._apply(
+                    json.loads(c.data.decode()))
+                if len(self.results) > 256:
+                    for k in sorted(self.results)[:-128]:
+                        del self.results[k]
             elif c.kind == SNAPSHOT_KIND:
                 self._install(json.loads(c.data.decode()))
         return None
@@ -107,6 +114,9 @@ class MetaReplica:
                                          cmd.get("peers"),
                                          cmd.get("leader"))
             return None
+        if op == "alloc_ids":
+            return svc.alloc_ids(cmd["table_id"], cmd["n"],
+                                 cmd.get("floor", 0))
         if op == "tick":
             return svc.tick()
         if op == "tso":
@@ -127,6 +137,7 @@ class MetaReplica:
                         for r in svc.regions.values()],
             "next_region_id": svc._last_region_id + 1,
             "params": svc._params,
+            "id_alloc": {str(k): v for k, v in svc._id_alloc.items()},
             "schema_version": svc.schema_version,
             # TSO high-water mark: the new leader must never re-issue
             "tso_max": max(svc.tso._last_physical, svc.tso._saved_max),
@@ -151,6 +162,8 @@ class MetaReplica:
         svc._region_ids = itertools.count(state["next_region_id"])
         svc._last_region_id = state["next_region_id"] - 1
         svc._params = {k: dict(v) for k, v in state["params"].items()}
+        svc._id_alloc = {int(k): int(v)
+                         for k, v in state.get("id_alloc", {}).items()}
         svc.schema_version = state["schema_version"]
         svc.tso.restore(int(state["tso_max"]))
 
@@ -162,7 +175,14 @@ class ReplicatedMeta:
                  clock=None):
         import time as _time
 
+        import threading
+
         self.clock = clock or _time.monotonic
+        # EVERY bus/core touch serializes here — proposals pump the bus,
+        # and reads (leader lookup, elect) tick the same native cores;
+        # two threads driving them concurrently would interleave
+        # unpredictably.  Reentrant: _propose itself looks up the leader.
+        self._mu = threading.RLock()
         peer_ids = list(range(1, n_replicas + 1))
         self.bus = LocalBus()
         for pid in peer_ids:
@@ -171,38 +191,50 @@ class ReplicatedMeta:
 
     # -- raft plumbing -----------------------------------------------------
     def leader_replica(self) -> MetaReplica:
-        ldr = self.bus.leader()
-        if ldr is None:
-            try:
-                ldr = self.bus.elect()
-            except RuntimeError:
-                raise MetaUnavailable("no meta quorum") from None
-        return self.bus.nodes[ldr]
+        with self._mu:
+            ldr = self.bus.leader()
+            if ldr is None:
+                try:
+                    ldr = self.bus.elect()
+                except RuntimeError:
+                    raise MetaUnavailable("no meta quorum") from None
+            return self.bus.nodes[ldr]
 
     def _propose(self, cmd: dict, max_ticks: int = 400):
         payload = json.dumps(cmd).encode()
-        for _ in range(max_ticks):
-            replica = self.leader_replica()
-            idx = replica.core.propose(payload)
-            if idx < 0:
-                self.bus.advance(1)
-                continue
+        with self._mu:
             for _ in range(max_ticks):
-                self.bus.pump()
-                if replica.core.commit_index >= idx:
-                    return replica.last_result
-                if replica.core.role != LEADER:
-                    break
-                self.bus.advance(1)
-            else:
-                raise MetaUnavailable("meta commit stalled")
-        raise MetaUnavailable("no meta leader accepted the command")
+                replica = self.leader_replica()
+                idx = replica.core.propose(payload)
+                if idx < 0:
+                    self.bus.advance(1)
+                    continue
+                committed = False
+                for _ in range(max_ticks):
+                    self.bus.pump()
+                    if replica.core.commit_index >= idx:
+                        committed = True
+                        break
+                    if replica.core.role != LEADER:
+                        break
+                    self.bus.advance(1)
+                else:
+                    raise MetaUnavailable("meta commit stalled")
+                if committed:
+                    if idx in replica.results:
+                        return replica.results[idx]
+                    # commit_index passed idx but OUR entry isn't there: a
+                    # new leader's no-op superseded it before commit (the
+                    # entry was truncated, never applied) — re-propose
+                    continue
+            raise MetaUnavailable("no meta leader accepted the command")
 
     def kill_leader(self) -> int:
         """Fault injection: SIGKILL-analog on the current meta leader."""
-        ldr = self.bus.leader() or self.bus.elect()
-        self.bus.kill(ldr)
-        return ldr
+        with self._mu:
+            ldr = self.bus.leader() or self.bus.elect()
+            self.bus.kill(ldr)
+            return ldr
 
     # -- MetaService API surface ------------------------------------------
     @property
@@ -275,6 +307,10 @@ class ReplicatedMeta:
                        "leader": leader})
         return self._svc.regions[int(region_id)]
 
+    def alloc_ids(self, table_id: int, n: int, floor: int = 0) -> int:
+        return self._propose({"op": "alloc_ids", "table_id": int(table_id),
+                              "n": int(n), "floor": int(floor)})
+
     def tick(self) -> list[BalanceOrder]:
         return self._propose({"op": "tick", "now": self.clock()})
 
@@ -293,8 +329,9 @@ class ReplicatedMeta:
                               "now_ms": int(_time.time() * 1000)})
 
     def compact_all(self):
-        for replica in self.bus.nodes.values():
-            replica.compact()
+        with self._mu:
+            for replica in self.bus.nodes.values():
+                replica.compact()
 
 
 class _TsoFacade:

@@ -152,6 +152,8 @@ class MetaService:
         self._last_region_id = 0
         # address (or "*") -> {flag: value} dynamic overrides
         self._params: dict[str, dict] = {}
+        # table_id -> next cluster-wide row/auto-incr id (alloc_ids)
+        self._id_alloc: dict[int, int] = {}
         self._mu = threading.RLock()
 
     # -- cluster ---------------------------------------------------------
@@ -267,6 +269,18 @@ class MetaService:
         with self._mu:
             for rid in region_ids:
                 self.regions.pop(int(rid), None)
+
+    def alloc_ids(self, table_id: int, n: int, floor: int = 0) -> int:
+        """Allocate ``n`` cluster-wide monotonic ids for a table (the
+        auto_incr_state_machine shape: range allocation, burned ranges
+        never reused).  ``floor`` lifts the counter past ids already
+        observed in recovered data — a restarted meta must never re-issue
+        below what the stores hold."""
+        with self._mu:
+            cur = self._id_alloc.get(table_id, 1)
+            cur = max(cur, int(floor))
+            self._id_alloc[table_id] = cur + int(n)
+            return cur
 
     def update_region_membership(self, region_id: int,
                                  peers: Optional[list[str]] = None,

@@ -36,8 +36,12 @@ class StoreFleet:
         self._addr = {i: a for a, i in self._ids.items()}
         self.groups: dict[int, RaftGroup] = {}     # region_id -> group
         # table_key -> storage.replicated.ReplicatedRowTier: SQL-visible
-        # replicated tables survive Database restarts through this registry
+        # replicated tables survive Database restarts through this registry;
+        # tier_lock serializes check-then-create so two frontends creating
+        # the same table never mint duplicate region sets
         self.row_tiers: dict = {}
+        import threading
+        self.tier_lock = threading.Lock()
         for a in addresses:
             meta.add_instance(a)
 

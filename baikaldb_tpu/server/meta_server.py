@@ -28,7 +28,7 @@ class MetaServer:
         self._mu = threading.Lock()
         for name in ("register_store", "create_regions", "table_regions",
                      "drop_regions", "heartbeat", "tso", "instances", "ping",
-                     "split_region_key", "merge_regions_key"):
+                     "split_region_key", "merge_regions_key", "alloc_ids"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
 
     def start(self) -> None:
@@ -88,6 +88,10 @@ class MetaServer:
 
     def rpc_tso(self, count: int = 1):
         return {"ts": self.service.tso.gen(int(count))}
+
+    def rpc_alloc_ids(self, table_id: int, n: int, floor: int = 0):
+        return {"start": self.service.alloc_ids(int(table_id), int(n),
+                                                int(floor))}
 
     def rpc_split_region_key(self, region_id: int, split_key_hex: str):
         """Key-range split finalize in the routing table: the child

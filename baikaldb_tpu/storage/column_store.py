@@ -200,6 +200,8 @@ class TableStore:
         self._mutations = 0
         self._next_region = 1
         self._next_rowid = 1
+        self._rowid_pool = 0          # meta-allocated range (replicated)
+        self._rowid_pool_left = 0
         self.regions: list[Region] = [Region(self._alloc_region_id(),
                                              self.arrow_schema.empty_table())]
         self.wal_path = None
@@ -339,6 +341,24 @@ class TableStore:
         return rid
 
     def _alloc_rowids(self, n: int) -> np.ndarray:
+        """Rowid allocation.  Replicated tiers allocate CLUSTER-WIDE ranges
+        from meta (chunked to amortize the round trip; burned remainders
+        are never reused — the auto-incr range discipline), so concurrent
+        frontends over the same fleet/cluster cannot mint colliding keys.
+        Standalone stores use the local watermark counter."""
+        if self.replicated is not None:
+            # no duck-type fallback: a tier without alloc_rowids must fail
+            # loudly, not quietly revert to colliding local counters
+            if self._rowid_pool_left < n:
+                grab = max(n, 512)
+                self._rowid_pool = self.replicated.alloc_rowids(
+                    grab, floor=self._next_rowid)
+                self._rowid_pool_left = grab
+            start = self._rowid_pool
+            self._rowid_pool += n
+            self._rowid_pool_left -= n
+            self._next_rowid = max(self._next_rowid, start + n)
+            return np.arange(start, start + n, dtype=np.int64)
         start = self._next_rowid
         self._next_rowid += n
         return np.arange(start, start + n, dtype=np.int64)

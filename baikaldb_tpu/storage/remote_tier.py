@@ -41,9 +41,11 @@ class ClusterClient:
     """Frontend handle on one deployment: the meta daemon + store daemons."""
 
     def __init__(self, meta_address: str):
+        import threading
         self.meta = RpcClient(meta_address)
         self._stores: dict[str, RpcClient] = {}
         self.tiers: dict[str, "RemoteRowTier"] = {}
+        self.tier_lock = threading.Lock()
 
     def store(self, address: str) -> RpcClient:
         c = self._stores.get(address)
@@ -82,12 +84,12 @@ class _RemoteRegion:
 class RemoteRowTier:
     """Same API as ReplicatedRowTier, over the cluster RPC plane.
 
-    Known limitation (single-WRITER deployments assumed, like the bundled
-    mini-cluster): row keys are hidden per-frontend rowids allocated from
-    the frontend's attach-time row count, so two frontends writing the
-    SAME table concurrently can collide on rowids (the reference avoids
-    this by keying on real primary keys).  Readers and failover frontends
-    are safe; a second writer must attach after the first stops."""
+    Row keys are hidden rowids allocated as CLUSTER-WIDE ranges from the
+    meta daemon (``alloc_rowids`` — the auto-incr range discipline), so
+    concurrent frontends never mint colliding keys.  Concurrent UPDATEs
+    of the same row resolve by raft apply order (last writer wins); each
+    frontend reads its own attach-time columnar image plus its own
+    writes."""
 
     def __init__(self, cluster: ClusterClient, table_key: str,
                  row_schema: Schema, key_columns: list[str],
@@ -123,12 +125,14 @@ class RemoteRowTier:
     def get_or_create(cls, cluster: ClusterClient, table_key: str,
                       row_schema: Schema, key_columns: list[str],
                       split_rows: int = 0) -> "RemoteRowTier":
-        tier = cluster.tiers.get(table_key)
-        if tier is None:
-            tier = cls(cluster, table_key, row_schema, key_columns,
-                       split_rows)
-            cluster.tiers[table_key] = tier
-        elif tier.row_schema != row_schema:
+        with cluster.tier_lock:
+            tier = cluster.tiers.get(table_key)
+            if tier is None:
+                tier = cls(cluster, table_key, row_schema, key_columns,
+                           split_rows)
+                cluster.tiers[table_key] = tier
+                return tier
+        if tier.row_schema != row_schema:
             raise ValueError(
                 f"table {table_key!r}: requested schema does not match the "
                 f"cluster's replicated row encoding (recover the catalog — "
@@ -214,6 +218,17 @@ class RemoteRowTier:
             f"accepted the write within {self.propose_deadline}s")
 
     # -- tier API ----------------------------------------------------------
+
+    def alloc_rowids(self, n: int, floor: int = 0) -> int:
+        """Cluster-wide rowid range from the meta daemon: concurrent
+        frontends never mint colliding keys.  The meta daemon is the
+        allocation root: restarting IT resets counters (and the routing
+        registry) — in this deployment shape a meta restart means a
+        cluster restart; the in-process ReplicatedMeta carries the
+        counters in its raft snapshots instead."""
+        return int(self.cluster.meta.call("alloc_ids",
+                                          table_id=self.table_id, n=n,
+                                          floor=floor)["start"])
 
     def refresh_routing(self) -> None:
         """Re-pull this table's region ranges from meta (after another

@@ -30,6 +30,7 @@ loses nothing committed.
 from __future__ import annotations
 
 import bisect
+import threading
 from typing import TYPE_CHECKING, Optional
 
 from ..raft.cluster import RaftGroup
@@ -86,6 +87,10 @@ class ReplicatedRowTier:
         # except through this tier's own split/merge
         self._starts: list[bytes] = [b""]
         self._ends: list[bytes] = [b""]
+        # the tier is SHARED across every Session over this fleet: writes
+        # and split/merge bookkeeping serialize here (two threads mid-split
+        # would interleave the parallel list updates)
+        self._mu = threading.RLock()
 
     @classmethod
     def get_or_create(cls, fleet: "StoreFleet", table_id: int, table_key: str,
@@ -94,12 +99,14 @@ class ReplicatedRowTier:
         """The fleet keeps one tier per table so a NEW Database over the same
         fleet recovers the existing replicated state instead of allocating
         fresh (empty) regions."""
-        tier = fleet.row_tiers.get(table_key)
-        if tier is None:
-            tier = cls(fleet, table_id, table_key, row_schema, key_columns,
-                       split_rows)
-            fleet.row_tiers[table_key] = tier
-        elif tier.row_schema != row_schema:
+        with fleet.tier_lock:
+            tier = fleet.row_tiers.get(table_key)
+            if tier is None:
+                tier = cls(fleet, table_id, table_key, row_schema,
+                           key_columns, split_rows)
+                fleet.row_tiers[table_key] = tier
+                return tier
+        if tier.row_schema != row_schema:
             # silent column-by-name replay against a mismatched schema would
             # corrupt data (extra columns vanish, missing ones read NULL) —
             # recover the catalog to the tier's schema first
@@ -130,22 +137,25 @@ class ReplicatedRowTier:
         size trigger, region.cpp:733-787)."""
         if not ops:
             return
-        per = self._split_ops(ops)
-        if len(per) == 1:
-            idx, batch = next(iter(per.items()))
-            g = self.groups[idx]
-            if not g.write(batch):
-                raise ReplicationError(
-                    f"region {g.region_id} of {self.table_key} has no quorum")
-        else:
-            groups = [self.groups[i] for i in sorted(per)]
-            by_rid = {self.groups[i].region_id: b for i, b in per.items()}
-            try:
-                TwoPhaseCoordinator(groups).write(by_rid,
-                                                  txn_id=next_txn_id())
-            except TwoPhaseError as e:
-                raise ReplicationError(str(e)) from None
-        self.maybe_split()
+        with self._mu:
+            per = self._split_ops(ops)
+            if len(per) == 1:
+                idx, batch = next(iter(per.items()))
+                g = self.groups[idx]
+                if not g.write(batch):
+                    raise ReplicationError(
+                        f"region {g.region_id} of {self.table_key} "
+                        f"has no quorum")
+            else:
+                groups = [self.groups[i] for i in sorted(per)]
+                by_rid = {self.groups[i].region_id: b
+                          for i, b in per.items()}
+                try:
+                    TwoPhaseCoordinator(groups).write(by_rid,
+                                                      txn_id=next_txn_id())
+                except TwoPhaseError as e:
+                    raise ReplicationError(str(e)) from None
+            self.maybe_split()
 
     # -- reads ------------------------------------------------------------
     def _leader_node(self, meta, group: RaftGroup):
@@ -169,12 +179,16 @@ class ReplicatedRowTier:
         """Latest committed row versions across all regions (leader reads,
         each filtered to the range the region OWNS so mid-split copies are
         never read twice).  Includes ``__del`` marker rows — recovery replay
-        needs them; callers counting LIVE rows use num_rows()."""
-        out: list[dict] = []
-        for m, g in zip(self.metas, self.groups):
-            node = self._leader_node(m, g)
-            out.extend(node.rows_in_range())
-        return out
+        needs them; callers counting LIVE rows use num_rows().  Serializes
+        with writes/splits: a recovery scan mid-split would double- or
+        under-read moved rows, and reads can pump a group bus a writer is
+        also pumping."""
+        with self._mu:
+            out: list[dict] = []
+            for m, g in zip(self.metas, self.groups):
+                node = self._leader_node(m, g)
+                out.extend(node.rows_in_range())
+            return out
 
     def num_rows(self) -> int:
         """Live (non-deleted) replicated rows."""
@@ -192,12 +206,17 @@ class ReplicatedRowTier:
         done = 0
         if threshold <= 0:
             return done
+        with self._mu:
+            return self._maybe_split_locked(threshold)
+
+    def _maybe_split_locked(self, threshold: int) -> int:
+        done = 0
         i = 0
         while i < len(self.groups):
             node = self._leader_node(self.metas[i], self.groups[i])
             if node.table.num_live_keys() >= threshold:
                 try:
-                    self.split_region(i)
+                    self._split_region_locked(i)
                     done += 1
                     continue       # the left half may still be oversized
                 except SplitError:
@@ -221,6 +240,10 @@ class ReplicatedRowTier:
 
         On abort the child retires and the parent's meta range is restored.
         """
+        with self._mu:
+            return self._split_region_locked(idx)
+
+    def _split_region_locked(self, idx: int):
         g, m = self.groups[idx], self.metas[idx]
         try:
             node = self._leader_node(m, g)
@@ -270,6 +293,10 @@ class ReplicatedRowTier:
         of the split threshold), so a shrunken table does not keep paying
         per-region quorum costs forever.  Returns merges performed."""
         floor = max(2, self._threshold() // 4)
+        with self._mu:
+            return self._maybe_merge_locked(floor)
+
+    def _maybe_merge_locked(self, floor: int) -> int:
         done = 0
         i = 0
         while i + 1 < len(self.groups):
@@ -277,7 +304,7 @@ class ReplicatedRowTier:
             b = self._leader_node(self.metas[i + 1], self.groups[i + 1])
             if a.table.num_live_keys() + b.table.num_live_keys() < floor:
                 try:
-                    self.merge_region(i)
+                    self._merge_region_locked(i)
                     done += 1
                     continue       # the survivor may absorb further
                 except SplitError:
@@ -292,6 +319,10 @@ class ReplicatedRowTier:
         the copy commits, readers still reach the right's group (local
         routing is untouched), so no failure window loses or double-reads
         rows."""
+        with self._mu:
+            return self._merge_region_locked(idx)
+
+    def _merge_region_locked(self, idx: int):
         if idx + 1 >= len(self.groups):
             raise SplitError("no right neighbor to merge")
         left_g, right_g = self.groups[idx], self.groups[idx + 1]
@@ -351,6 +382,11 @@ class ReplicatedRowTier:
             self.fleet.groups.pop(m.region_id, None)
         self.fleet.meta.drop_regions([m.region_id for m in self.metas])
 
+    def alloc_rowids(self, n: int, floor: int = 0) -> int:
+        """Cluster-wide rowid range from meta (auto-incr FSM shape): two
+        frontends over the same fleet can never mint colliding keys."""
+        return self.fleet.meta.alloc_ids(self.table_id, n, floor)
+
     def compact_all(self) -> None:
         """Snapshot every replica's state into its core, truncating logs."""
         for g in self.groups:
@@ -358,9 +394,10 @@ class ReplicatedRowTier:
                 node.compact()
 
     def available(self) -> bool:
-        try:
-            for g in self.groups:
-                g.leader()
-        except RuntimeError:
-            return False
-        return True
+        with self._mu:
+            try:
+                for g in self.groups:
+                    g.leader()
+            except RuntimeError:
+                return False
+            return True

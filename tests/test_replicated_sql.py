@@ -346,3 +346,34 @@ def test_bulk_ingest_replicates():
     s2 = Session(db2)
     s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
     assert s2.query("SELECT COUNT(*) n FROM t") == [{"n": n}]
+
+
+def test_two_frontends_insert_without_rowid_collision():
+    """Cluster-wide rowid ranges from meta (auto-incr FSM shape): two
+    frontends over the SAME fleet inserting concurrently never overwrite
+    each other's rows."""
+    import threading
+
+    fleet = make_fleet()
+    a = Session(Database(fleet=fleet))
+    a.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    a.execute("INSERT INTO t VALUES (0, 0.0)")
+    b = Session(Database(fleet=fleet))     # second frontend, same fleet
+    b.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+
+    errs = []
+
+    def writer(sess, base):
+        try:
+            for i in range(20):
+                sess.execute(f"INSERT INTO t VALUES ({base + i}, 1.0)")
+        except Exception as e:            # noqa: BLE001
+            errs.append(e)
+    ta = threading.Thread(target=writer, args=(a, 100))
+    tb = threading.Thread(target=writer, args=(b, 200))
+    ta.start(); tb.start(); ta.join(); tb.join()
+    assert not errs, errs
+    # every committed row is in the replicas: a fresh frontend sees 41
+    c = Session(Database(fleet=fleet))
+    c.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    assert c.query("SELECT COUNT(*) n FROM t") == [{"n": 41}]
