@@ -117,6 +117,10 @@ class ReplicatedRegion:
         # METAINFO_CF, transaction_pool.cpp)
         self.prepared: dict[int, bytes] = {}
         self.decisions: dict[int, int] = {}   # txn -> CMD_COMMIT|CMD_ROLLBACK
+        # replica-local wall time a prepare was applied: in-doubt RECOVERY
+        # only rolls back prepares older than a grace window, so it cannot
+        # abort a live coordinator mid-2PC (the reference's txn timeout)
+        self.prepared_at: dict[int, float] = {}
         # key-range ownership: [start_key, end_key) with b"" = unbounded;
         # range_version bumps at every split/merge finalize (the reference's
         # region version used to reject stale-routed requests,
@@ -136,12 +140,16 @@ class ReplicatedRegion:
                     self.table.write_batch(self._in_range(decode_ops(body)))
                 elif cmd == CMD_PREPARE:
                     self.prepared[txn_id] = body
+                    import time as _time
+                    self.prepared_at[txn_id] = _time.time()
                 elif cmd == CMD_COMMIT:
                     ops = self.prepared.pop(txn_id, None)
+                    self.prepared_at.pop(txn_id, None)
                     if ops is not None:
                         self.table.write_batch(self._in_range(decode_ops(ops)))
                 elif cmd == CMD_ROLLBACK:
                     self.prepared.pop(txn_id, None)
+                    self.prepared_at.pop(txn_id, None)
                 elif cmd == CMD_DECIDE:
                     self.decisions[txn_id] = body[0]
                 elif cmd == CMD_SET_RANGE:

@@ -53,7 +53,8 @@ class StoreServer:
         self._peer_clients: dict[int, RpcClient] = {}
         self._stop = threading.Event()
         for name in ("create_region", "drop_region", "raft_msg", "propose",
-                     "scan_raw", "region_status", "region_size", "ping"):
+                     "scan_raw", "region_status", "region_size", "ping",
+                     "txn_status"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
 
     # -- lifecycle --------------------------------------------------------
@@ -162,6 +163,27 @@ class StoreServer:
         # by OWNERSHIP (mid-split copies must never be read twice)
         return {"status": "ok", "pairs": [[k, v] for k, v in pairs],
                 "start": start, "end": end}
+
+    def rpc_txn_status(self, region_id: int):
+        """Prepared (in-doubt) txns + decision records of one region — the
+        reference's in-doubt recovery query (region.cpp:684
+        exec_txn_query_primary_region)."""
+        region = self.regions.get(int(region_id))
+        if region is None:
+            return {"status": "no_region"}
+        with self._mu:
+            if region.core.role != LEADER:
+                return {"status": "not_leader",
+                        "leader": int(region.core.leader)}
+            region.apply_committed()
+            now = time.time()
+            return {"status": "ok",
+                    "prepared": sorted(region.prepared),
+                    "prepared_age": {str(t): now - region.prepared_at.get(t,
+                                                                          now)
+                                     for t in region.prepared},
+                    "decisions": {str(t): int(d)
+                                  for t, d in region.decisions.items()}}
 
     def rpc_region_size(self, region_id: int):
         """Live-key count + committed range of this region (the split

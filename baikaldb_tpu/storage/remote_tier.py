@@ -20,10 +20,9 @@ from typing import Optional
 from ..raft.cluster import (CMD_COMMIT, CMD_DECIDE, CMD_PREPARE, CMD_ROLLBACK,
                             CMD_SET_RANGE, CMD_TRIM, CMD_WRITE, encode_cmd,
                             encode_ops, encode_range)
-from ..raft.twopc import next_txn_id
 from ..types import Schema
 from ..utils.flags import FLAGS
-from ..utils.net import RpcClient
+from ..utils.net import RpcClient, RpcError
 from .replicated import ReplicationError, SplitError, _fnv64
 from .rowstore import RowCodec
 
@@ -120,6 +119,14 @@ class RemoteRowTier:
                                         table_id=self.table_id, n_regions=1)
             self.regions = [self._from_wire(w) for w in created]
             self._materialize()
+            return
+        # attaching to an EXISTING table: resolve any in-doubt 2PC state a
+        # crashed frontend left behind before serving reads from it
+        # (bounded deadline: this runs under the cluster's tier lock)
+        try:
+            self.recover_in_doubt()
+        except (ReplicationError, StaleRoutingError, RpcError, OSError):
+            pass    # daemons unreachable: reads will surface the error
 
     @classmethod
     def get_or_create(cls, cluster: ClusterClient, table_key: str,
@@ -219,6 +226,78 @@ class RemoteRowTier:
 
     # -- tier API ----------------------------------------------------------
 
+    def _leader_call(self, region: _RemoteRegion, method: str,
+                     deadline_s: Optional[float] = None, **kw):
+        """One leader-routed RPC: try the hinted leader, rotate through
+        every peer, update the hint on success.  None on timeout (the
+        shared retry policy of scans / size checks / txn status)."""
+        deadline = time.monotonic() + (deadline_s if deadline_s is not None
+                                       else self.propose_deadline)
+        candidates = [region.leader_addr] + \
+            [a for _, a in region.peers if a != region.leader_addr]
+        i = 0
+        while time.monotonic() < deadline:
+            addr = candidates[i % len(candidates)]
+            i += 1
+            resp = self.cluster.store(addr).try_call(
+                method, region_id=region.region_id, **kw)
+            if resp is not None and resp.get("status") == "ok":
+                region.leader_addr = addr
+                return resp
+            time.sleep(0.1)
+        return None
+
+    # how long a prepare must sit undecided before attach-time recovery may
+    # roll it back: a LIVE coordinator's prepare->decide window is bounded
+    # by its propose deadline, so anything older is a dead coordinator
+    IN_DOUBT_GRACE_S = 60.0
+
+    def recover_in_doubt(self, deadline_s: float = 2.0) -> dict:
+        """Attach-time resolution of prepared-but-undecided transactions a
+        crashed frontend left behind (the reference's in-doubt recovery:
+        secondaries query the primary's decision, region.cpp:598/684;
+        TransactionPool restart recovery).
+
+        A txn COMPLETES as committed iff some region holds its CMD_DECIDE
+        commit record (always safe: the record means the coordinator
+        passed the decision point, and a duplicate COMMIT is a no-op).
+        ROLLBACK requires three safeguards: the prepare is OLDER than the
+        grace window (never abort a live coordinator mid-2PC), txn ids are
+        cluster-allocated (a fresh frontend's counter cannot alias an old
+        decision record), and EVERY region answered (an unreachable
+        primary might hold the commit decision — rolling back a secondary
+        then would split the txn)."""
+        statuses = {r.region_id: self._leader_call(r, "txn_status",
+                                                   deadline_s)
+                    for r in self.regions}
+        all_known = all(st is not None for st in statuses.values())
+        decided: set[int] = set()
+        for st in statuses.values():
+            if st:
+                decided.update(int(t) for t, d in st["decisions"].items()
+                               if d == CMD_COMMIT)
+        out: dict[int, str] = {}
+        for r in self.regions:
+            st = statuses.get(r.region_id)
+            if not st:
+                continue
+            for txn in st["prepared"]:
+                txn = int(txn)
+                try:
+                    if txn in decided:
+                        self._propose(r, encode_cmd(CMD_COMMIT, txn))
+                        out[txn] = "committed"
+                    elif all_known and \
+                            float(st["prepared_age"].get(str(txn), 0.0)) \
+                            > self.IN_DOUBT_GRACE_S:
+                        self._propose(r, encode_cmd(CMD_ROLLBACK, txn))
+                        out.setdefault(txn, "rolled_back")
+                    else:
+                        out.setdefault(txn, "deferred")
+                except (ReplicationError, StaleRoutingError):
+                    out[txn] = "unresolved"   # next attach retries
+        return out
+
     def alloc_rowids(self, n: int, floor: int = 0) -> int:
         """Cluster-wide rowid range from the meta daemon: concurrent
         frontends never mint colliding keys.  The meta daemon is the
@@ -276,8 +355,11 @@ class RemoteRowTier:
                           encode_cmd(CMD_WRITE, 0, encode_ops(batch)))
             return
         # primary-first 2PC (fetcher_store.cpp:1848-1904): PREPARE all,
-        # decision + COMMIT on the primary, then the secondaries
-        txn = next_txn_id()
+        # decision + COMMIT on the primary, then the secondaries.  The txn
+        # id is CLUSTER-allocated: a fresh frontend's local counter could
+        # alias another coordinator's decision record and corrupt in-doubt
+        # recovery
+        txn = self.alloc_rowids(1)
         rids = sorted(per)
         prepared: list[int] = []
         try:
@@ -374,14 +456,8 @@ class RemoteRowTier:
         return self.split_rows or int(FLAGS.region_split_rows)
 
     def _region_size(self, region: _RemoteRegion) -> Optional[int]:
-        for addr in [region.leader_addr] + [a for _, a in region.peers
-                                            if a != region.leader_addr]:
-            resp = self.cluster.store(addr).try_call(
-                "region_size", region_id=region.region_id)
-            if resp is not None and resp.get("status") == "ok":
-                region.leader_addr = addr
-                return int(resp["live"])
-        return None
+        resp = self._leader_call(region, "region_size", deadline_s=2.0)
+        return int(resp["live"]) if resp is not None else None
 
     def maybe_split(self) -> int:
         """Split oversized regions (the store-side size trigger run from

@@ -174,3 +174,49 @@ def test_stale_frontend_routing_refreshes_after_split(cluster):
     a2 = Session(Database(cluster=meta_addr))
     a2.execute("CREATE TABLE sr (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
     assert a2.query("SELECT COUNT(*) n FROM sr") == [{"n": 24}]
+
+
+def test_in_doubt_2pc_recovery_on_attach(cluster):
+    """A frontend that dies between PREPARE and COMMIT leaves prepared txns
+    on the store daemons; the NEXT frontend to attach resolves them from
+    the primary's decision record (region.cpp:598/684 in-doubt recovery):
+    no decision -> rollback everywhere, decision -> commit completes."""
+    meta_addr, procs = cluster
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.raft.cluster import (CMD_COMMIT, CMD_DECIDE,
+                                           CMD_PREPARE, encode_cmd,
+                                           encode_ops)
+
+    s = Session(Database(cluster=meta_addr))
+    s.execute("CREATE TABLE dt (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    s.execute("INSERT INTO dt VALUES (1, 1.0)")
+    tier = s.db.cluster.tiers["default.dt"]
+    region = tier.regions[0]
+    # simulate a coordinator crash mid-2PC: PREPARE lands, no decision
+    ops = [(0, b"\x01\x7f\xff\xff\xff\xff\xff\xff\xff",
+            tier.row_codec.encode({**tier.scan_rows()[0], "__rowid": 999}))]
+    tier._propose(region, encode_cmd(CMD_PREPARE, 777, encode_ops(ops)))
+    # crashed txn WITH a decision record: must complete as committed
+    tier._propose(region, encode_cmd(CMD_PREPARE, 778, encode_ops(
+        [(0, b"\x01\x7f\xff\xff\xff\xff\xff\xff\xfe", ops[0][2])])))
+    tier._propose(region, encode_cmd(CMD_DECIDE, 778, bytes([CMD_COMMIT])))
+
+    # a fresh frontend attaches.  The DECIDED txn completes immediately;
+    # the undecided one is DEFERRED (younger than the grace window — a
+    # live coordinator must not be aborted), then rolls back once the
+    # grace window is treated as elapsed
+    from baikaldb_tpu.storage.remote_tier import RemoteRowTier
+    s2 = Session(Database(cluster=meta_addr))
+    s2.execute("CREATE TABLE dt (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    t2 = s2.db.cluster.tiers["default.dt"]
+    st = t2._leader_call(t2.regions[0], "txn_status")
+    assert st is not None and st["prepared"] == [777], st   # 778 completed
+    t2.IN_DOUBT_GRACE_S = 0.0        # instance override: window elapsed
+    out = t2.recover_in_doubt()
+    assert out.get(777) == "rolled_back", out
+    st = t2._leader_call(t2.regions[0], "txn_status")
+    assert st is not None and st["prepared"] == [], st
+    # txn 778 (decided) applied its row; txn 777 (undecided) did not
+    keys = {k for k, _ in t2._scan_region(t2.regions[0])}
+    assert b"\x01\x7f\xff\xff\xff\xff\xff\xff\xfe" in keys
+    assert b"\x01\x7f\xff\xff\xff\xff\xff\xff\xff" not in keys
