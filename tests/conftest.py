@@ -17,11 +17,12 @@ if "--xla_force_host_platform_device_count" not in _flags:
 # The axon site hook pins the platform with jax.config.update("jax_platforms",
 # "axon,cpu") at register() time, which OVERRIDES the env var above — so when
 # the tunnel is alive, tests silently compile on the real chip.  Re-pin to cpu
-# through the same config channel (jax is already imported by sitecustomize,
-# so this import is free and no backend has initialized yet).
-import jax  # noqa: E402
+# through the same config channel (the one shared implementation of this
+# workaround lives in utils/platformpin.py).
+from baikaldb_tpu.utils.platformpin import honor_cpu_env  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not honor_cpu_env():          # not assert: must survive python -O
+    raise RuntimeError("conftest failed to pin the cpu backend")
 
 # Persistent compilation cache shared with __graft_entry__.dryrun_multichip:
 # the suite compiles the same cpu/8-device programs the driver's multichip

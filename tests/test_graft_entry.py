@@ -28,3 +28,37 @@ def test_dryrun_multichip_8_in_process():
     # the real in-process path (no subprocess respawn)
     assert graft._cpu_env_ready(8)
     graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_under_driver_env():
+    """Reproduce the DRIVER environment in a subprocess: JAX_PLATFORMS=cpu +
+    XLA_FLAGS device count set, but PYTHONPATH with the axon site hook
+    PRESERVED.  The site hook re-pins jax_platforms to the accelerator via
+    jax.config.update, which overrides the env var — without the config
+    re-pin in dryrun_multichip, this hangs on a wedged tunnel (VERDICT r03:
+    three rounds of rc=124 timeouts)."""
+    import os
+    import subprocess
+
+    if not os.path.isdir("/root/.axon_site"):
+        import pytest
+
+        pytest.skip("axon site hook not present on this machine")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    # preserve the axon site hook exactly as the driver does, and make sure
+    # it actually ACTIVATES (sitecustomize only calls axon register() when
+    # PALLAS_AXON_POOL_IPS is set) so the test can't pass vacuously under a
+    # scrubbed environment
+    env["PYTHONPATH"] = "/root/.axon_site"
+    env.setdefault("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    env.setdefault("AXON_LOOPBACK_RELAY", "1")
+    repo = str(Path(__file__).resolve().parent.parent)
+    code = "import __graft_entry__ as g; g.dryrun_multichip(8)"
+    # tight test-local timeout: the fixed path passes warm in ~15 s and cold
+    # in ~2 min; a hang here must not stall the suite for DRYRUN_TIMEOUT=900
+    r = subprocess.run([sys.executable, "-c", code], cwd=repo, env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "dryrun_multichip(8): ok" in r.stdout
