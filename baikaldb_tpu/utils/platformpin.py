@@ -15,6 +15,26 @@ after a backend has initialized succeeds silently with no effect.
 """
 
 import os
+import subprocess
+import sys
+
+
+def probe_backend_once(timeout_s: float) -> str | None:
+    """Initialise the JAX backend in a THROWAWAY subprocess; return the
+    platform name, or None if init fails or hangs (wedged tunnel).  The
+    subprocess is essential: a wedged tunnel hangs the initializing process,
+    and that process must not be the caller.  Shared by bench.py and
+    tools/tpu_watch.py so tunnel-health logic cannot diverge."""
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return None
+    if r.returncode != 0:
+        return None
+    out = r.stdout.strip().splitlines()
+    return out[-1] if out else None
 
 
 def honor_cpu_env() -> bool:
