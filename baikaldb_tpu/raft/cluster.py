@@ -151,7 +151,12 @@ class ReplicatedRegion:
                     self.prepared.pop(txn_id, None)
                     self.prepared_at.pop(txn_id, None)
                 elif cmd == CMD_DECIDE:
-                    self.decisions[txn_id] = body[0]
+                    # first writer wins: a coordinator whose COMMIT decision
+                    # propose timed out (but actually committed) may later
+                    # replicate an ABORT decision — the abort must not
+                    # overwrite a commit some region already resolved from
+                    # (the torn-transaction window, ADVICE r03 medium)
+                    self.decisions.setdefault(txn_id, body[0])
                 elif cmd == CMD_SET_RANGE:
                     v, s, e = decode_range(body)
                     self.start_key, self.end_key = s, e
@@ -209,6 +214,7 @@ class ReplicatedRegion:
         self.table.write_batch(ops)
         pos = _ops_size(data)
         self.prepared = {}
+        self.prepared_at = {}
         self.decisions = {}
         self.start_key = b""
         self.end_key = b""
@@ -217,11 +223,20 @@ class ReplicatedRegion:
             return                      # pre-2PC snapshot format
         (np_,) = struct.unpack_from("<I", data, pos)
         pos += 4
+        import time as _time
+
+        now = _time.time()
         for _ in range(np_):
             txn, ln = struct.unpack_from("<QI", data, pos)
             pos += 12
             self.prepared[txn] = data[pos:pos + ln]
             pos += ln
+            # prepare wall-times are replica-local and not in the snapshot;
+            # stamp install time so the in-doubt grace window RESTARTS
+            # instead of never starting (prepared_age would otherwise read
+            # ~0 forever and recovery would defer the txn indefinitely —
+            # ADVICE r03 low #1)
+            self.prepared_at[txn] = now
         (nd,) = struct.unpack_from("<I", data, pos)
         pos += 4
         for _ in range(nd):

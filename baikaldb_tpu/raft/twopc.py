@@ -71,11 +71,33 @@ class TwoPhaseCoordinator:
         # quorum would lose it (recovery would roll the prepares back).
         if not self.primary.propose_cmd(CMD_DECIDE, txn,
                                         bytes([CMD_COMMIT])):
-            for p in prepared:
-                p.propose_cmd(CMD_ROLLBACK, txn)
-            raise TwoPhaseError(
-                f"commit decision failed on primary region "
-                f"{self.primary.region_id}")
+            # A failed propose does NOT mean the decision failed to commit —
+            # a timeout can lose the ack, not the entry.  Rolling prepares
+            # back here could tear the txn (recovery commits a surviving
+            # prepare from the landed decision while others rolled back —
+            # ADVICE r03 medium).  Replicate an explicit ABORT decision
+            # instead; the apply is first-writer-wins, so reading back the
+            # WINNING decision tells us which outcome is authoritative.
+            if not self.primary.propose_cmd(CMD_DECIDE, txn,
+                                            bytes([CMD_ROLLBACK])):
+                # can't even record the abort: leave every prepare in doubt
+                # for recovery to resolve from whatever decision exists
+                raise TwoPhaseError(
+                    f"commit decision in doubt on primary region "
+                    f"{self.primary.region_id}; prepares left for recovery")
+            winner = self.primary.bus.nodes[
+                self.primary.leader()].decisions.get(txn)
+            if winner != CMD_COMMIT:
+                # abort decision won: rollbacks are now safe (best-effort —
+                # failures leave in-doubt prepares that recovery rolls back
+                # from the abort record)
+                for p in prepared:
+                    p.propose_cmd(CMD_ROLLBACK, txn)
+                raise TwoPhaseError(
+                    f"commit decision failed on primary region "
+                    f"{self.primary.region_id}")
+            # the original commit decision actually landed: fall through —
+            # the txn IS committed
         # past the decision point the txn is committed; the remaining
         # proposals are completion, not consensus — a failure here leaves an
         # in-doubt prepare that resolve_in_doubt finishes from the decision
@@ -91,19 +113,19 @@ class TwoPhaseCoordinator:
 def resolve_in_doubt(group: RaftGroup, primary: RaftGroup, txn_id: int) -> str:
     """Recovery for a prepared-but-undecided txn on ``group``: ask the
     primary (reference: region.cpp:598/684 — in-doubt secondaries query the
-    primary region's txn state).  -> "committed" | "rolled_back" | "none"."""
+    primary region's txn state).  -> "committed" | "rolled_back"."""
     ldr = primary.bus.nodes[primary.leader()]
     decision = ldr.decisions.get(txn_id)
     if decision == CMD_COMMIT:
         group.propose_cmd(CMD_COMMIT, txn_id)
         return "committed"
-    # no decision recorded: the coordinator died before the commit point —
-    # the txn must abort everywhere (the primary's own prepare, if any,
-    # rolls back too)
+    # explicit abort decision, or no decision at all (the coordinator died
+    # before the commit point): the txn must abort everywhere (the
+    # primary's own prepare, if any, rolls back too)
     for g in (group, primary):
         if txn_id in g.bus.nodes[g.leader()].prepared:
             g.propose_cmd(CMD_ROLLBACK, txn_id)
-    return "rolled_back" if decision is None else "none"
+    return "rolled_back"
 
 
 def recover_all(groups: list[RaftGroup], primary: RaftGroup) -> dict[int, str]:

@@ -139,7 +139,8 @@ class RemoteRowTier:
                            split_rows)
                 cluster.tiers[table_key] = tier
                 return tier
-        if tier.row_schema != row_schema:
+        if tier.row_schema != row_schema or \
+                list(tier.key_columns) != list(key_columns):
             raise ValueError(
                 f"table {table_key!r}: requested schema does not match the "
                 f"cluster's replicated row encoding (recover the catalog — "
@@ -272,10 +273,13 @@ class RemoteRowTier:
                     for r in self.regions}
         all_known = all(st is not None for st in statuses.values())
         decided: set[int] = set()
+        aborted: set[int] = set()
         for st in statuses.values():
             if st:
                 decided.update(int(t) for t, d in st["decisions"].items()
                                if d == CMD_COMMIT)
+                aborted.update(int(t) for t, d in st["decisions"].items()
+                               if d == CMD_ROLLBACK)
         out: dict[int, str] = {}
         for r in self.regions:
             st = statuses.get(r.region_id)
@@ -287,6 +291,11 @@ class RemoteRowTier:
                     if txn in decided:
                         self._propose(r, encode_cmd(CMD_COMMIT, txn))
                         out[txn] = "committed"
+                    elif txn in aborted:
+                        # explicit abort record: authoritative — no grace
+                        # window needed
+                        self._propose(r, encode_cmd(CMD_ROLLBACK, txn))
+                        out.setdefault(txn, "rolled_back")
                     elif all_known and \
                             float(st["prepared_age"].get(str(txn), 0.0)) \
                             > self.IN_DOUBT_GRACE_S:
@@ -377,17 +386,42 @@ class RemoteRowTier:
             raise
         primary = by_id[rids[0]]
         # the decision propose is the commit point: it must succeed or the
-        # txn is NOT committed (recovery rolls the prepares back)
+        # txn is NOT committed.  A propose FAILURE is not proof the record
+        # missed the log (a timeout loses the ack, not the entry), so
+        # rolling prepares back directly could tear the txn: recovery would
+        # commit a surviving prepare from the landed decision while others
+        # rolled back (ADVICE r03 medium).  Replicate an explicit ABORT
+        # decision instead (apply is first-writer-wins), then act on the
+        # WINNING decision read back from the primary.
         try:
             self._propose(primary, encode_cmd(CMD_DECIDE, txn,
                                               bytes([CMD_COMMIT])))
         except ReplicationError:
-            for rid in rids:
-                try:
-                    self._propose(by_id[rid], encode_cmd(CMD_ROLLBACK, txn))
-                except ReplicationError:
-                    pass
-            raise
+            try:
+                self._propose(primary, encode_cmd(CMD_DECIDE, txn,
+                                                  bytes([CMD_ROLLBACK])))
+                st = self._leader_call(primary, "txn_status",
+                                       self.propose_deadline)
+                # a missing record is NOT evidence of abort: txn_status may
+                # have been answered by a deposed leader that applied
+                # neither DECIDE entry — treat it as in-doubt
+                w = st["decisions"].get(str(txn)) if st else None
+                winner = int(w) if w is not None else None
+            except ReplicationError:
+                winner = None
+            if winner is None:
+                # abort record unconfirmed: leave prepares in doubt for
+                # recovery to resolve from whatever decision exists
+                raise
+            if winner != CMD_COMMIT:
+                for rid in rids:
+                    try:
+                        self._propose(by_id[rid],
+                                      encode_cmd(CMD_ROLLBACK, txn))
+                    except ReplicationError:
+                        pass    # recovery rolls back from the abort record
+                raise
+            # the commit decision actually landed: fall through — committed
         # past the decision the txn IS committed: completion failures must
         # not surface as txn failure (the frontend would roll its cache back
         # while the replicas hold the commit) — best-effort here, in-doubt

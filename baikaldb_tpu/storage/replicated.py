@@ -106,14 +106,16 @@ class ReplicatedRowTier:
                            key_columns, split_rows)
                 fleet.row_tiers[table_key] = tier
                 return tier
-        if tier.row_schema != row_schema:
+        if tier.row_schema != row_schema or \
+                list(tier.key_columns) != list(key_columns):
             # silent column-by-name replay against a mismatched schema would
-            # corrupt data (extra columns vanish, missing ones read NULL) —
-            # recover the catalog to the tier's schema first
+            # corrupt data (extra columns vanish, missing ones read NULL),
+            # and different key columns would decode keys with the wrong
+            # codec (ADVICE r03 low #5) — recover the catalog first
             raise ValueError(
-                f"table {table_key!r}: requested schema does not match the "
-                f"fleet's replicated row encoding (recover the catalog — "
-                f"post-ALTER schema — before attaching)")
+                f"table {table_key!r}: requested schema/key columns do not "
+                f"match the fleet's replicated row encoding (recover the "
+                f"catalog — post-ALTER schema — before attaching)")
         return tier
 
     # -- routing ----------------------------------------------------------

@@ -150,13 +150,28 @@ class RpcClient:
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
+    # Methods safe to resend after a transport failure mid-call: reads,
+    # health, and protocol-level-idempotent ops (raft messages dedupe by
+    # term/index; drops are no-ops the second time).  Mutating meta ops
+    # (split_region_key, create_regions, propose, ...) are NOT here: the
+    # server may have executed the first request even though the response
+    # was lost, and a duplicated split mints a second child region with an
+    # identical start key, bricking the table layout (ADVICE r03 low #3).
+    _IDEMPOTENT = frozenset({
+        "ping", "scan_raw", "txn_status", "region_size", "region_status",
+        "instances", "table_regions", "heartbeat", "tso", "raft_msg",
+        "drop_region", "drop_regions", "register_store",
+    })
+
     def call(self, method: str, **args):
         with self._mu:
             for attempt in (0, 1):
+                sent = False
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
                     send_msg(self._sock, {"method": method, "args": args})
+                    sent = True
                     resp = recv_msg(self._sock)
                     if resp is None:
                         raise OSError("connection closed")
@@ -164,6 +179,10 @@ class RpcClient:
                 except OSError:
                     self.close_locked()
                     if attempt:
+                        raise
+                    if sent and method not in self._IDEMPOTENT:
+                        # request may have been executed with the response
+                        # lost; a resend could double-execute it
                         raise
             if not resp.get("ok"):
                 raise RpcError(resp.get("error", "rpc failed"))

@@ -140,3 +140,48 @@ def test_sql_on_fleet_with_replicated_meta():
     meta.kill_leader()
     s.execute("INSERT INTO t VALUES (3, 3.0)")
     assert s.query("SELECT COUNT(*) n FROM t") == [{"n": 3}]
+
+
+def test_duplicate_command_uid_applies_once():
+    """A re-proposed copy of an already-applied command must be served from
+    the dedup record, not applied twice: a duplicated alloc_ids would hand
+    two coordinators the same txn-id range shifted, and a duplicated split
+    would mint overlapping regions (ADVICE r03 low #4)."""
+    import json
+
+    m = make_meta()
+    ldr = m.leader_replica()
+    payload = json.dumps({"op": "alloc_ids", "table_id": 9, "n": 5,
+                          "floor": 0, "_uid": "dup-1"}).encode()
+    i1 = ldr.core.propose(payload)
+    i2 = ldr.core.propose(payload)
+    assert i1 >= 0 and i2 >= 0
+    m.bus.pump()
+    assert ldr.results[i1] == ldr.results[i2]       # second = dedup'd
+    # the allocator advanced once, not twice
+    fresh = m.alloc_ids(table_id=9, n=1)
+    assert fresh == ldr.results[i1] + 5
+
+
+def test_dedup_memory_survives_snapshot_install():
+    """The uid dedup set rides the snapshot: a replica that catches up via
+    snapshot must still recognize a late re-proposed copy."""
+    import json
+
+    m = make_meta()
+    ldr = m.leader_replica()
+    payload = json.dumps({"op": "alloc_ids", "table_id": 3, "n": 4,
+                          "floor": 0, "_uid": "snap-dup"}).encode()
+    i1 = ldr.core.propose(payload)
+    assert i1 >= 0
+    m.bus.pump()
+    before = m.alloc_ids(table_id=3, n=1)
+    for node in m.bus.nodes.values():
+        node.compact()
+    m.bus.pump()
+    # replay the same uid AFTER everyone snapshotted
+    i2 = m.leader_replica().core.propose(payload)
+    assert i2 >= 0
+    m.bus.pump()
+    after = m.alloc_ids(table_id=3, n=1)
+    assert after == before + 1                       # no second allocation

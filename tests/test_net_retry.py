@@ -1,0 +1,117 @@
+"""RpcClient retry semantics: a transport failure after the request was sent
+must only trigger a resend for idempotent methods — the server may have
+executed the first copy with the response lost, and a duplicated
+split_region_key mints a second child region with an identical start key,
+bricking the table layout (ADVICE r03 low #3)."""
+
+import socket
+import threading
+
+import pytest
+
+from baikaldb_tpu.utils.net import RpcClient, recv_msg, send_msg
+
+
+class OneShotDropServer:
+    """Processes each request, then closes the connection WITHOUT replying —
+    the worst case: work done, response lost."""
+
+    def __init__(self):
+        self.seen: list[str] = []
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self._srv.settimeout(0.2)
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except TimeoutError:
+                continue
+            with conn:
+                conn.settimeout(1.0)
+                try:
+                    req = recv_msg(conn)
+                except TimeoutError:
+                    continue
+                if req is not None:
+                    self.seen.append(req["method"])
+                # close without replying
+
+    def close(self):
+        self._stop = True
+        self._thread.join()
+        self._srv.close()
+
+
+class CountingServer:
+    """Replies normally but records every request (duplicate detector)."""
+
+    def __init__(self):
+        self.seen: list[str] = []
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self._srv.settimeout(0.2)
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except TimeoutError:
+                continue
+            with conn:
+                conn.settimeout(0.3)    # so close() can always join
+                while not self._stop:
+                    try:
+                        req = recv_msg(conn)
+                    except TimeoutError:
+                        continue
+                    if req is None:
+                        break
+                    self.seen.append(req["method"])
+                    send_msg(conn, {"ok": True, "result": "pong"})
+
+    def close(self):
+        self._stop = True
+        self._thread.join()
+        self._srv.close()
+
+
+def test_non_idempotent_not_resent_after_send():
+    srv = OneShotDropServer()
+    try:
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=2.0)
+        with pytest.raises(OSError):
+            c.call("split_region_key", region_id=1, split_key_hex="00")
+        assert srv.seen.count("split_region_key") == 1   # never resent
+    finally:
+        srv.close()
+
+
+def test_idempotent_is_resent_after_send():
+    srv = OneShotDropServer()
+    try:
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=2.0)
+        with pytest.raises(OSError):
+            c.call("ping")
+        # resent once (two connections each saw the request)
+        assert srv.seen.count("ping") == 2
+    finally:
+        srv.close()
+
+
+def test_normal_call_still_works():
+    srv = CountingServer()
+    try:
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=2.0)
+        assert c.call("ping") == "pong"
+        assert c.call("split_region_key", region_id=1) == "pong"
+        assert srv.seen == ["ping", "split_region_key"]
+    finally:
+        srv.close()

@@ -139,6 +139,116 @@ def test_participant_failover_during_prepare_with_conflicting_txn():
         assert not g.bus.nodes[g.leader()].prepared
 
 
+def test_decision_record_first_writer_wins():
+    """A late ABORT decision must not overwrite a landed COMMIT decision:
+    recovery may already have committed a prepare from it (ADVICE r03
+    medium — the torn-transaction window)."""
+    from baikaldb_tpu.raft.cluster import CMD_DECIDE, CMD_COMMIT, CMD_ROLLBACK
+
+    (g1,) = make_groups(1)
+    assert g1.propose_cmd(CMD_DECIDE, 77, bytes([CMD_COMMIT]))
+    assert g1.propose_cmd(CMD_DECIDE, 77, bytes([CMD_ROLLBACK]))
+    assert g1.bus.nodes[g1.leader()].decisions[77] == CMD_COMMIT
+
+
+def test_lost_decide_ack_still_commits():
+    """The DECIDE propose 'fails' (ack lost) but the record actually
+    committed: the coordinator's abort attempt loses first-writer-wins, it
+    reads back COMMIT, and the txn completes committed — never torn."""
+    from baikaldb_tpu.raft.cluster import CMD_DECIDE, CMD_COMMIT
+
+    g1, g2 = make_groups(2)
+    co = TwoPhaseCoordinator([g1, g2])
+    real = g1.propose_cmd
+
+    def lossy(cmd, txn_id, ops_bytes=b"", max_ticks=400):
+        ok = real(cmd, txn_id, ops_bytes, max_ticks)
+        if cmd == CMD_DECIDE and ops_bytes == bytes([CMD_COMMIT]):
+            return False                      # the ack is lost, not the entry
+        return ok
+
+    g1.propose_cmd = lossy
+    txn = co.write({1: ops_for(g1, [(1, "kept")]),
+                    2: ops_for(g2, [(2, "kept")])})
+    g1.propose_cmd = real
+    assert rows_of(g1) == {1: "kept"} and rows_of(g2) == {2: "kept"}
+    assert resolve_in_doubt(g2, g1, txn) == "committed"  # idempotent
+
+
+def test_failed_decide_aborts_via_explicit_record():
+    """The DECIDE genuinely never commits: the coordinator replicates an
+    explicit ABORT record, rolls prepares back, and recovery agrees."""
+    from baikaldb_tpu.raft.cluster import CMD_DECIDE, CMD_COMMIT, CMD_ROLLBACK
+
+    g1, g2 = make_groups(2)
+    co = TwoPhaseCoordinator([g1, g2])
+    real = g1.propose_cmd
+
+    def drop_commit_decide(cmd, txn_id, ops_bytes=b"", max_ticks=400):
+        if cmd == CMD_DECIDE and ops_bytes == bytes([CMD_COMMIT]):
+            return False                      # entry really dropped
+        return real(cmd, txn_id, ops_bytes, max_ticks)
+
+    g1.propose_cmd = drop_commit_decide
+    with pytest.raises(TwoPhaseError):
+        co.write({1: ops_for(g1, [(1, "no")]), 2: ops_for(g2, [(2, "no")])})
+    g1.propose_cmd = real
+    assert rows_of(g1) == {} and rows_of(g2) == {}
+    assert not g1.bus.nodes[g1.leader()].prepared
+    assert not g2.bus.nodes[g2.leader()].prepared
+    # the abort record is authoritative for any straggler recovery
+    assert g1.bus.nodes[g1.leader()].decisions.get(
+        list(g1.bus.nodes[g1.leader()].decisions)[-1]) == CMD_ROLLBACK
+
+
+def test_in_doubt_decide_leaves_prepares_for_recovery():
+    """Neither the COMMIT nor the ABORT decision can be confirmed: prepares
+    must be LEFT ALONE (rolling them back could tear a txn whose commit
+    decision actually landed)."""
+    from baikaldb_tpu.raft.cluster import CMD_DECIDE
+
+    g1, g2 = make_groups(2)
+    co = TwoPhaseCoordinator([g1, g2])
+    real = g1.propose_cmd
+
+    def no_decides(cmd, txn_id, ops_bytes=b"", max_ticks=400):
+        if cmd == CMD_DECIDE:
+            return False
+        return real(cmd, txn_id, ops_bytes, max_ticks)
+
+    g1.propose_cmd = no_decides
+    with pytest.raises(TwoPhaseError):
+        co.write({1: ops_for(g1, [(1, "?")]), 2: ops_for(g2, [(2, "?")])})
+    g1.propose_cmd = real
+    # prepares intact on both groups, nothing applied
+    t = list(g1.bus.nodes[g1.leader()].prepared)[-1]
+    assert t in g2.bus.nodes[g2.leader()].prepared
+    assert rows_of(g1) == {} and rows_of(g2) == {}
+    # recovery later resolves from the (absent) decision: rollback
+    assert resolve_in_doubt(g2, g1, t) == "rolled_back"
+    assert resolve_in_doubt(g1, g1, t) == "rolled_back"
+    assert not g1.bus.nodes[g1.leader()].prepared
+    assert not g2.bus.nodes[g2.leader()].prepared
+
+
+def test_prepared_at_restarts_after_snapshot_install():
+    """prepare wall-times are not in the snapshot; install must stamp its
+    own time so the in-doubt grace window restarts instead of never
+    starting (ADVICE r03 low #1)."""
+    (g1,) = make_groups(1)
+    co = TwoPhaseCoordinator([g1])
+    txn = co.write({1: ops_for(g1, [(1, "x")])}, crash_after="prepare")
+    ldr = g1.bus.nodes[g1.leader()]
+    assert txn in ldr.prepared and txn in ldr.prepared_at
+    blob = ldr.snapshot_bytes()
+    import copy
+
+    fresh = copy.copy(ldr)
+    fresh._install_snapshot(blob)
+    assert txn in fresh.prepared
+    assert txn in fresh.prepared_at      # stamped at install time
+
+
 def test_decided_txn_wins_over_interleaved_write_deterministically():
     """Decision landed before the participant failover: recovery COMMITS the
     buffered prepare, which applies after an interleaved direct write —
