@@ -553,13 +553,16 @@ class Session:
         if isinstance(s, AlterTableStmt):
             return self._alter_table(s)
         if isinstance(s, DropTableStmt):
+            from ..index.globalindex import backing_table_name
             from ..index.rollup import rollup_table_name
             db = s.table.database or self.current_db
-            rollups = []
+            rollups, globals_ = [], []
             if self.db.catalog.has_table(db, s.table.name):
                 info = self.db.catalog.get_table(db, s.table.name)
                 rollups = [ix.name for ix in info.indexes
                            if ix.kind == "rollup"]
+                globals_ = [ix.name for ix in info.indexes
+                            if ix.kind in ("global", "global_unique")]
             self.db.catalog.drop_table(db, s.table.name, s.if_exists)
             st = self.db.stores.pop(f"{db}.{s.table.name}", None)
             self._drop_durable(f"{db}.{s.table.name}", st)
@@ -568,10 +571,18 @@ class Session:
                 self.db.catalog.drop_table(db, rt, if_exists=True)
                 self._drop_durable(f"{db}.{rt}",
                                    self.db.stores.pop(f"{db}.{rt}", None))
+            for gn in globals_:
+                gt = backing_table_name(s.table.name, gn)
+                self.db.catalog.drop_table(db, gt, if_exists=True)
+                self._drop_durable(f"{db}.{gt}",
+                                   self.db.stores.pop(f"{db}.{gt}", None))
             self.db.save_catalog()
             return Result()
         if isinstance(s, TruncateStmt):
-            self._store(s.table).truncate()
+            store = self._store(s.table)
+            store.truncate()
+            for _ix, bstore in self._coupled_global(store):
+                bstore.truncate()   # global-index entries go with the rows
             return Result()
         if isinstance(s, CreateDatabaseStmt):
             self.db.catalog.create_database(s.name, if_not_exists=s.if_not_exists)
@@ -646,9 +657,11 @@ class Session:
             return Result(columns=["Database"],
                           arrow=pa.table({"Database": names}))
         if s.what == "tables":
+            from ..index.globalindex import is_backing_table
             from ..index.rollup import is_rollup_table
             db = s.database or self.current_db
-            names = [n for n in cat.tables(db) if not is_rollup_table(n)]
+            names = [n for n in cat.tables(db) if not is_rollup_table(n)
+                     and not is_backing_table(n)]
             return Result(columns=[f"Tables_in_{db}"],
                           arrow=pa.table({f"Tables_in_{db}": names}))
         if s.what == "create_table":
@@ -670,7 +683,9 @@ class Session:
             for ix in info.indexes:
                 if ix.kind == "primary":
                     continue
-                kw = {"unique": "UNIQUE KEY", "fulltext": "FULLTEXT KEY"} \
+                kw = {"unique": "UNIQUE KEY", "fulltext": "FULLTEXT KEY",
+                      "global": "GLOBAL KEY",
+                      "global_unique": "GLOBAL UNIQUE KEY"} \
                     .get(ix.kind, "KEY")
                 lines.append(f"  {kw} `{ix.name}` (" +
                              ", ".join(f"`{c}`" for c in ix.columns) + ")")
@@ -784,7 +799,7 @@ class Session:
             null_values=["", "\\N", "NULL"], strings_can_be_null=True)
         table = pacsv.read_csv(s.path, read_options=ropt,
                                parse_options=popt, convert_options=copt)
-        store.insert_arrow(table, self._tctx(store), check_dups=True)
+        self._ingest_arrow(store, table, check_dups=True)
         db_name = s.table.database or self.current_db
         self._log_binlog("insert", db_name, s.table.name,
                          statement=f"LOAD DATA INFILE {s.path!r}",
@@ -984,9 +999,13 @@ class Session:
 
     def _commit_txn(self):
         if self._sql_txn is not None:
+            from ..storage.column_store import commit_group
             try:
-                for tctx in self._sql_txn.values():
-                    tctx.commit()
+                # one atomic commit across every table the transaction
+                # touched: replicated tables group into a single 2PC
+                # spanning all their region groups (global-index writes and
+                # cross-table transactions commit or abort together)
+                commit_group(list(self._sql_txn.values()))
             finally:
                 # even a failed WAL write must not trap the session in the
                 # transaction (the contexts released their leases already)
@@ -1021,7 +1040,7 @@ class Session:
         vcols = (store.info.options or {}).get("vector_cols") or {}
         if vcols:
             table = _expand_vector_arrow(table, vcols)
-        store.insert_arrow(table, self._tctx(store))
+        self._ingest_arrow(store, table)
         return table.num_rows
 
     # -- DDL --------------------------------------------------------------
@@ -1072,8 +1091,33 @@ class Session:
         key = f"{db}.{s.table.name}"
         if key not in self.db.stores:
             self.db.stores[key] = self.db.make_store(info)
+        for ix in info.indexes:
+            if ix.kind in ("global", "global_unique"):
+                self._create_global_backing(db, info, ix)
         self.db.save_catalog()
         return Result()
+
+    def _create_global_backing(self, db: str, info, ix) -> TableStore:
+        """Materialize a global index's hidden backing table: its own
+        catalog entry, its own store — and in fleet/cluster mode its own
+        replicated row tier with its OWN region groups (reference: index
+        data in separate regions, separate.cpp:653)."""
+        from ..index import globalindex as gi
+
+        for c in ix.columns:
+            if c not in info.schema:
+                raise PlanError(f"unknown column {c!r} in global index "
+                                f"{ix.name!r}")
+        bname = gi.backing_table_name(info.name, ix.name)
+        bkey = f"{db}.{bname}"
+        if bkey in self.db.stores:
+            return self.db.stores[bkey]
+        binfo = self.db.catalog.create_table(
+            db, bname, gi.backing_schema(info, ix),
+            [IndexInfo("PRIMARY", "primary", gi.backing_pk(info, ix))],
+            if_not_exists=True)
+        store = self.db.stores[bkey] = self.db.make_store(binfo)
+        return store
 
     # -- OLTP point-read fast path (reference: primary-index point SELECT
     # through the row path, region.cpp select_normal) ----------------------
@@ -1208,20 +1252,39 @@ class Session:
             # schema-bound); dropping them here would orphan state
             kept = [ix for ix in info.indexes
                     if not (ix.name == s.index_name and
-                            ix.kind in ("key", "unique", "fulltext"))]
+                            ix.kind in ("key", "unique", "fulltext",
+                                        "global", "global_unique"))]
             if len(kept) == len(info.indexes):
                 raise PlanError(f"unknown index {s.index_name!r}")
+            dropped = [ix for ix in info.indexes if ix not in kept]
             info.indexes = kept
             info.version += 1
             # cached plans compiled WITH the index must re-plan
             self._store(s.table)._mutations += 1
+            for ix in dropped:
+                if ix.kind in ("global", "global_unique"):
+                    self._drop_global_backing(db, info, ix)
             self.db.save_catalog()
             return Result()
         self._validate_index_cols(s, info)
-        prefix = "ft" if s.index_kind == "fulltext" else "idx"
+        prefix = {"fulltext": "ft", "global": "gidx",
+                  "global_unique": "gidx"}.get(s.index_kind, "idx")
         name = s.index_name or f"{prefix}_{'_'.join(s.index_cols)}"
         if any(ix.name == name for ix in info.indexes):
             raise PlanError(f"index {name!r} exists")
+        if s.index_kind in ("global", "global_unique"):
+            # online ADD GLOBAL INDEX: register backfilling, materialize the
+            # backing table (own regions), hand the fill to the DDL worker;
+            # the index becomes choosable — and DML starts maintaining it —
+            # only at publish
+            ix = IndexInfo(name, s.index_kind, list(s.index_cols),
+                           {"state": "backfilling"})
+            info.indexes.append(ix)
+            self._create_global_backing(db, info, ix)
+            self.db.save_catalog()
+            work = self.db.ddl.submit(f"{db}.{s.table.name}", ix)
+            return Result(affected_rows=0, columns=["work_id"],
+                          arrow=pa.table({"work_id": [work.work_id]}))
         if s.index_kind == "fulltext":
             # fulltext is dictionary-side (built lazily per dictionary
             # version, index/fulltext.py) — no backfill artifact: declare
@@ -1239,6 +1302,14 @@ class Session:
         return Result(affected_rows=0,
                       columns=["work_id"],
                       arrow=pa.table({"work_id": [work.work_id]}))
+
+    def _drop_global_backing(self, db: str, info, ix) -> None:
+        from ..index import globalindex as gi
+
+        bname = gi.backing_table_name(info.name, ix.name)
+        bkey = f"{db}.{bname}"
+        self.db.catalog.drop_table(db, bname, if_exists=True)
+        self._drop_durable(bkey, self.db.stores.pop(bkey, None))
 
     def _validate_index_cols(self, s: AlterTableStmt, info) -> None:
         if not s.index_cols:
@@ -1351,6 +1422,193 @@ class Session:
         return purged
 
     # -- DML --------------------------------------------------------------
+    # -- global secondary indexes (reference: separate.cpp:653 lock nodes,
+    # select_manager_node.cpp:1081 lookup join) --------------------------
+    def _coupled_global(self, store: TableStore) -> list:
+        """[(IndexInfo, backing TableStore)] for this table's PUBLIC global
+        indexes: DML must maintain the backing tables in the same (2PC)
+        transaction as the main table."""
+        from ..index import globalindex as gi
+
+        info = store.info
+        if gi.is_backing_table(info.name):
+            return []
+        out = []
+        for ix in info.indexes:
+            if ix.kind not in ("global", "global_unique") or \
+                    ix.params.get("state", "public") != "public":
+                continue
+            bname = gi.backing_table_name(info.name, ix.name)
+            bkey = f"{info.database}.{bname}"
+            bstore = self.db.stores.get(bkey)
+            if bstore is None:
+                binfo = self.db.catalog.get_table(info.database, bname)
+                bstore = self.db.stores[bkey] = self.db.make_store(binfo)
+            out.append((ix, bstore))
+        return out
+
+    def _run_coupled(self, store: TableStore, coupled: list, fn_main,
+                     fns_backing: list):
+        """Main-table DML + per-index backing maintenance in ONE atomic
+        commit: inside an open transaction they ride the session's per-store
+        contexts (COMMIT groups them); in autocommit they run under internal
+        contexts committed by commit_group — a single primary-first 2PC
+        across every touched region group of every table."""
+        from ..storage.column_store import commit_group
+
+        if self._sql_txn is not None:
+            r = fn_main(self._tctx(store))
+            for (ix, bstore), fb in zip(coupled, fns_backing):
+                fb(self._tctx(bstore), r)
+            return r
+        tctxs = [store.begin_txn()]
+        try:
+            for ix, bstore in coupled:
+                tctxs.append(bstore.begin_txn())
+            r = fn_main(tctxs[0])
+            for (ix, bstore), fb, t in zip(coupled, fns_backing, tctxs[1:]):
+                fb(t, r)
+        except BaseException:
+            for t in tctxs:
+                try:
+                    t.rollback()
+                except Exception:   # noqa: BLE001 — best-effort unwind
+                    pass
+            raise
+        commit_group(tctxs)
+        return r
+
+    def _ingest_arrow(self, store: TableStore, table: "pa.Table",
+                      check_dups: bool = False) -> None:
+        """Bulk ingest honoring global indexes: entry projections land in
+        the backing tables in the same atomic commit (the reference's
+        importer maintains global indexes through the same DML plane)."""
+        with store._lock:   # one critical section vs backfill publish
+            coupled = self._coupled_global(store)
+            if not coupled:
+                store.insert_arrow(table, self._tctx(store),
+                                   check_dups=check_dups)
+                return
+            from ..index import globalindex as gi
+
+            info = store.info
+            if any(ix.kind == "global_unique" for ix, _ in coupled):
+                # rows materialize only when a unique check will use them
+                rows = table.to_pylist()
+                for ix, bstore in coupled:
+                    gi.check_unique(info, ix, bstore, rows)
+
+            def main(t):
+                store.insert_arrow(table, t, check_dups=check_dups)
+
+            fbs = [(lambda t, _r, ix=ix, b=bstore:
+                    b.insert_arrow(gi.entry_table(info, ix, table), t))
+                   for ix, bstore in coupled]
+            self._run_coupled(store, coupled, main, fbs)
+
+    def _insert_with_global(self, store: TableStore, coupled: list,
+                            rows: list[dict]) -> None:
+        from ..index import globalindex as gi
+
+        info = store.info
+        for ix, bstore in coupled:
+            gi.check_unique(info, ix, bstore, rows)
+
+        def main(t):
+            store.insert_rows(rows, t)
+
+        fbs = [(lambda t, _r, ix=ix, b=bstore:
+                b.insert_rows(gi.entry_rows(info, ix, rows), t))
+               for ix, bstore in coupled]
+        self._run_coupled(store, coupled, main, fbs)
+
+    def _delete_with_global(self, store: TableStore, coupled: list,
+                            mask_fn) -> int:
+        from ..index import globalindex as gi
+
+        info = store.info
+        cols = sorted({f.name for ix, _ in coupled
+                       for f in gi.backing_schema(info, ix).fields})
+
+        def main(t):
+            return store.delete_where(mask_fn, t, collect_cols=cols)
+
+        def fb(t, r, ix=None, b=None):
+            _, old = r
+            entries = gi.entry_table(info, ix, old)
+            if entries.num_rows:
+                b.delete_where(self._entry_delete_mask(entries), t)
+
+        fbs = [(lambda t, r, ix=ix, b=bstore: fb(t, r, ix, b))
+               for ix, bstore in coupled]
+        return self._run_coupled(store, coupled, main, fbs)[0]
+
+    def _update_with_global(self, store: TableStore, coupled: list,
+                            mask_fn, assign_fn,
+                            changed_cols: list[str]) -> int:
+        from ..index import globalindex as gi
+
+        info = store.info
+        pk = info.primary_key()
+        pk_cols = list(pk.columns) if pk else []
+        # only indexes whose entries can actually change need maintenance
+        touched = [(ix, b) for ix, b in coupled
+                   if set(changed_cols) & set(list(ix.columns) + pk_cols)]
+        if not touched:
+            return store.update_where(mask_fn, assign_fn, self._tctx(store),
+                                      changed_cols=changed_cols)
+        cols = sorted({f.name for ix, _ in touched
+                       for f in gi.backing_schema(info, ix).fields})
+        # unique check BEFORE any mutation (a failed check mid-statement
+        # would leave main updated but index entries stale): a dry run
+        # computes the would-be old/new rows; the caller holds store._lock,
+        # so the real update below sees the same rows
+        _, dry_old, dry_new = store.update_where(
+            mask_fn, assign_fn, self._tctx(store),
+            changed_cols=changed_cols, collect_cols=cols, dry_run=True)
+        exclude = set(zip(*[dry_old.column(c).to_pylist()
+                            for c in pk_cols])) \
+            if pk_cols and dry_old.num_rows else set()
+        for ix, bstore in touched:
+            gi.check_unique(info, ix, bstore, dry_new.to_pylist(),
+                            exclude_pks=exclude)
+
+        def main(t):
+            return store.update_where(mask_fn, assign_fn, t,
+                                      changed_cols=changed_cols,
+                                      collect_cols=cols)
+
+        def fb(t, r, ix=None, b=None):
+            _, old, new = r
+            old_e = gi.entry_table(info, ix, old)
+            new_e = gi.entry_table(info, ix, new)
+            if old_e.num_rows:
+                b.delete_where(self._entry_delete_mask(old_e), t)
+            if new_e.num_rows:
+                b.insert_rows(new_e.to_pylist(), t)
+
+        fbs = [(lambda t, r, ix=ix, b=bstore: fb(t, r, ix, b))
+               for ix, bstore in touched]
+        return self._run_coupled(store, touched, main, fbs)[0]
+
+    @staticmethod
+    def _entry_delete_mask(entries):
+        """Backing-table mask fn matching rows whose full entry tuple is in
+        ``entries`` (the outgoing index entries of a DELETE/UPDATE)."""
+        import numpy as np
+
+        names = entries.column_names
+        tuples = set(zip(*[entries.column(c).to_pylist() for c in names])) \
+            if entries.num_rows else set()
+
+        def bmask(bt):
+            if not bt.num_rows or not tuples:
+                return np.zeros(bt.num_rows, dtype=bool)
+            vals = zip(*[bt.column(c).to_pylist() for c in names])
+            return np.fromiter((v in tuples for v in vals), dtype=bool,
+                               count=bt.num_rows)
+        return bmask
+
     def _insert(self, s: InsertStmt) -> Result:
         store = self._store(s.table)
         schema = store.info.schema
@@ -1364,9 +1622,15 @@ class Session:
             if t.num_rows <= HOT_INSERT_ROWS:
                 # small INSERT..SELECT takes the hot path: PK-checked and
                 # WAL-durable like INSERT..VALUES
-                store.insert_rows(t.to_pylist(), self._tctx(store))
+                with store._lock:   # vs backfill publish
+                    coupled = self._coupled_global(store)
+                    if coupled:
+                        self._insert_with_global(store, coupled,
+                                                 t.to_pylist())
+                    else:
+                        store.insert_rows(t.to_pylist(), self._tctx(store))
             else:
-                store.insert_arrow(t, self._tctx(store), check_dups=True)
+                self._ingest_arrow(store, t, check_dups=True)
             db_name = s.table.database or self.current_db
             if t.num_rows > 1000:
                 self._log_binlog("insert", db_name, s.table.name,
@@ -1408,7 +1672,16 @@ class Session:
                     else:
                         r[f.name] = datetime.datetime(1970, 1, 1) + \
                             datetime.timedelta(microseconds=v)
-        store.insert_rows(rows, self._tctx(store))
+        # the coupling decision, unique check, and mutation must be ONE
+        # critical section against the backfill worker's publish (which
+        # snapshots + flips the index state under this same lock): deciding
+        # "no maintenance" outside it could lose an entry forever
+        with store._lock:
+            coupled = self._coupled_global(store)
+            if coupled:
+                self._insert_with_global(store, coupled, rows)
+            else:
+                store.insert_rows(rows, self._tctx(store))
         self._log_binlog("insert", db_name, s.table.name, rows=rows,
                          affected=len(rows))
         return Result(affected_rows=len(rows))
@@ -1570,9 +1843,16 @@ class Session:
                 return out
         else:
             mask_fn = self._host_mask(store, s.where)
-        n = store.update_where(mask_fn, assign_fn,
-                               self._tctx(store),
-                               changed_cols=[name for name, _ in assigns])
+        changed = [name for name, _ in assigns]
+        with store._lock:   # one critical section vs backfill publish
+            coupled = self._coupled_global(store)
+            if coupled:
+                n = self._update_with_global(store, coupled, mask_fn,
+                                             assign_fn, changed)
+            else:
+                n = store.update_where(mask_fn, assign_fn,
+                                       self._tctx(store),
+                                       changed_cols=changed)
         if n:
             self._log_binlog("update", s.table.database or self.current_db,
                              s.table.name,
@@ -1583,7 +1863,12 @@ class Session:
         store = self._store(s.table)
         mask_fn = self._point_write_mask(store, s.where) or \
             self._host_mask(store, s.where)
-        n = store.delete_where(mask_fn, self._tctx(store))
+        with store._lock:   # one critical section vs backfill publish
+            coupled = self._coupled_global(store)
+            if coupled:
+                n = self._delete_with_global(store, coupled, mask_fn)
+            else:
+                n = store.delete_where(mask_fn, self._tctx(store))
         if n:
             self._log_binlog("delete", s.table.database or self.current_db,
                              s.table.name,
@@ -1913,12 +2198,29 @@ class Session:
         try:
             info = self.db.catalog.get_table(db, name)
             pred = analyze_conjuncts(n.pushed_filter)
-            access = choose_access(info, store, pred)
+            access = choose_access(info, store, pred, db=self.db)
         except Exception:
             return None
         cache = getattr(self, "_access_batches", None)
         if cache is None:
             cache = self._access_batches = {}
+        if access[0] == "global":
+            from ..index.globalindex import backing_table_name
+            _, ix_name, col, value = access
+            n.access_desc = f"global_index({ix_name}:{col})"
+            ck = (n.table_key, store.version, "gidx", ix_name, col, value)
+            b = cache.get(ck)
+            if b is None:
+                bkey = f"{db}.{backing_table_name(name, ix_name)}"
+                bstore = self.db.stores[bkey]
+                # index-region scan -> pk values -> main-table lookup join
+                # (select_manager_node.cpp:1081)
+                entries = bstore.secondary_scan(col, value)
+                b = ColumnBatch.from_arrow(store.lookup_by_pks(entries))
+                self._evict_access(n.table_key, store.version)
+                cache[ck] = b
+            metrics.index_scans.add(1)
+            return b
         if access[0] == "secondary":
             _, ix_name, col, value = access
             n.access_desc = f"index({ix_name}:{col})"
@@ -1974,9 +2276,12 @@ class Session:
                     try:
                         info = self.db.catalog.get_table(db, name)
                         pred = analyze_conjuncts(n.pushed_filter)
-                        access = choose_access(info, store, pred)
+                        access = choose_access(info, store, pred, db=self.db)
                         if access[0] == "secondary":
                             n.access_desc = f"index({access[1]}:{access[2]})"
+                        elif access[0] == "global":
+                            n.access_desc = \
+                                f"global_index({access[1]}:{access[2]})"
                         elif access[0] == "zonemap":
                             keep, total = store.prune_regions(access[1])
                             n.access_desc = (

@@ -154,9 +154,12 @@ def _collect_eq_terms(e, out: list) -> bool:
 
 
 def choose_access(info, store, pred: ScanPredicates,
-                  secondary_max_fraction: float = 0.2):
-    """-> ("secondary", index_name, col, value) | ("zonemap", ranges) |
-    ("full",).  Point lookups are decided at the statement level, not here."""
+                  secondary_max_fraction: float = 0.2, db=None):
+    """-> ("secondary", index_name, col, value) |
+    ("global", index_name, col, value) | ("zonemap", ranges) | ("full",).
+    Point lookups are decided at the statement level, not here.  ``db``
+    (the Database) resolves global indexes' backing stores; without it the
+    global route is not considered."""
     # secondary equality beats everything when selective enough
     for ix in info.indexes:
         if ix.kind not in ("key", "unique"):
@@ -169,6 +172,29 @@ def choose_access(info, store, pred: ScanPredicates,
             matches = store.secondary_count(col, pred.eq[col])
             if matches is not None and matches / n <= secondary_max_fraction:
                 return ("secondary", ix.name, col, pred.eq[col])
+    # global index: equality on the index prefix routes through the backing
+    # table (its own regions) then joins back by pk (the reference's
+    # global-index lookup join, select_manager_node.cpp:1081)
+    if db is not None:
+        from .globalindex import backing_table_name
+
+        for ix in info.indexes:
+            if ix.kind not in ("global", "global_unique"):
+                continue
+            if ix.params.get("state", "public") != "public":
+                continue
+            col = ix.columns[0]
+            if col not in pred.eq:
+                continue
+            bkey = f"{info.database}." \
+                   f"{backing_table_name(info.name, ix.name)}"
+            bstore = db.stores.get(bkey)
+            if bstore is None:
+                continue
+            n = max(store.num_rows, 1)
+            matches = bstore.secondary_count(col, pred.eq[col])
+            if matches is not None and matches / n <= secondary_max_fraction:
+                return ("global", ix.name, col, pred.eq[col])
     prunable = {c: r for c, r in pred.ranges.items()
                 if store.zone_map_column(c) is not None}
     if prunable:

@@ -130,6 +130,9 @@ class DdlManager:
         w.done.set()
 
     def _backfill(self, w: DdlWork):
+        if w.kind in ("global", "global_unique"):
+            self._backfill_global(w)
+            return
         store = self.db.stores[w.table_key]
         col = w.columns[0]
         # phase 1: region-granular validation walk (the per-region work
@@ -180,4 +183,83 @@ class DdlManager:
             "ddl", db, name,
             statement=f"ADD {'UNIQUE ' if w.kind == 'unique' else ''}INDEX "
                       f"{w.index_name} ({', '.join(w.columns)}) backfilled")
+        w.done.set()
+
+    def _backfill_global(self, w: DdlWork):
+        """Fill a global index's backing table from the main table, then
+        publish (reference: region-granular index backfill driven by
+        DDLManager work items, ddl_manager.cpp; the backing rows land in
+        the index's OWN region groups through the normal replicated write
+        path).  The fill is idempotent — it truncates and re-fills — so a
+        killed/restarted backfill resumes by simply re-running; the catalog
+        keeps state=backfilling until publish, and Database._recover
+        resubmits unfinished works."""
+        from ..index import globalindex as gi
+
+        store = self.db.stores[w.table_key]
+        db, name = w.table_key.split(".", 1)
+        info = self.db.catalog.get_table(db, name)
+        ix = self._index_entry(info, w)
+        if ix is None:
+            raise RuntimeError("index dropped during backfill")
+        bname = gi.backing_table_name(name, ix.name)
+        bkey = f"{db}.{bname}"
+        bstore = self.db.stores.get(bkey)
+        if bstore is None:
+            binfo = self.db.catalog.get_table(db, bname)
+            bstore = self.db.stores[bkey] = self.db.make_store(binfo)
+        with store._lock:
+            regions = list(store.regions)
+        w.regions_total = max(1, len(regions))
+        # phase 1: region-granular validation walk (observability + early
+        # failure before any backing write)
+        for r in regions:
+            with self._cv:
+                while self._suspended:
+                    self._cv.wait(1.0)
+            for c in w.columns:
+                if c not in r.data.column_names:
+                    raise ValueError(f"column {c!r} missing in region")
+            w.regions_done += 1
+            time.sleep(0)
+        # phase 2: fill + publish under the MAIN store's lock so no DML
+        # interleaves between the snapshot and the index becoming live
+        # (DML only maintains PUBLIC indexes)
+        with store._lock:
+            snap = store.snapshot()
+            entries = gi.entry_table(info, ix, snap)
+            if w.kind == "global_unique" and entries.num_rows:
+                import pyarrow.compute as pc
+
+                nn = entries
+                for c in ix.columns:
+                    nn = nn.filter(pc.is_valid(nn.column(c)))
+                if nn.num_rows:
+                    counts = nn.group_by(list(ix.columns)).aggregate(
+                        [(ix.columns[0], "count")])
+                    cname = f"{ix.columns[0]}_count"
+                    dups = counts.filter(
+                        pc.greater(counts.column(cname), 1))
+                    if dups.num_rows:
+                        first = dups.slice(0, 1).to_pylist()[0]
+                        val = tuple(first[c] for c in ix.columns)
+                        raise ValueError(
+                            f"duplicate value {val!r} in columns "
+                            f"{list(ix.columns)}: cannot add global "
+                            f"UNIQUE index")
+            bstore.truncate()
+            if entries.num_rows:
+                bstore.insert_arrow(entries)
+            ix.params["state"] = "public"
+            ix.params.pop("error", None)
+            info.version += 1
+            store._mutations += 1
+        w.state = "public"
+        self.db.save_catalog()
+        self.db.binlog.append(
+            "ddl", db, name,
+            statement=f"ADD GLOBAL "
+                      f"{'UNIQUE ' if w.kind == 'global_unique' else ''}"
+                      f"INDEX {w.index_name} ({', '.join(w.columns)}) "
+                      f"backfilled")
         w.done.set()

@@ -490,6 +490,19 @@ class Parser:
                 self.try_kw("key") or self.try_kw("index")
                 name = self.ident() if self.peek().kind == "IDENT" else ""
                 indexes.append(("fulltext", name, self._paren_name_list()))
+            elif self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "global" and \
+                    self.peek(1).kind == "KW" and \
+                    self.peek(1).value in ("unique", "index", "key"):
+                # GLOBAL [UNIQUE] INDEX|KEY [name] (col, ...) — index data
+                # in its own region groups (reference: global index,
+                # separate.cpp:653).  The lookahead keeps `global` usable
+                # as a column name (MySQL: GLOBAL is non-reserved)
+                self.advance()
+                gkind = "global_unique" if self.try_kw("unique") else "global"
+                self.try_kw("key") or self.try_kw("index")
+                name = self.ident() if self.peek().kind == "IDENT" else ""
+                indexes.append((gkind, name, self._paren_name_list()))
             elif self.try_kw("key") or self.try_kw("index"):
                 name = self.ident() if self.peek().kind == "IDENT" else ""
                 indexes.append(("key", name, self._paren_name_list()))
@@ -581,12 +594,24 @@ class Parser:
         table = self.table_name()
         from .stmt import AlterTableStmt
         if self.try_kw("add"):
-            if self.peek().kind == "KW" and self.peek().value in ("index", "key",
-                                                                  "unique",
-                                                                  "fulltext"):
-                # ADD [UNIQUE|FULLTEXT] INDEX|KEY [name] (col, ...)
+            is_global_ix = (self.peek().kind == "IDENT" and
+                            self.peek().value.lower() == "global" and
+                            self.peek(1).kind == "KW" and
+                            self.peek(1).value in ("unique", "index", "key"))
+            if is_global_ix or (
+                    self.peek().kind == "KW" and
+                    self.peek().value in ("index", "key", "unique",
+                                          "fulltext")):
+                # ADD [GLOBAL] [UNIQUE|FULLTEXT] INDEX|KEY [name] (col, ...)
                 kind = "key"
-                if self.peek().value in ("unique", "fulltext"):
+                if is_global_ix:
+                    self.advance()          # GLOBAL
+                    kind = "global_unique" if self.try_kw("unique") \
+                        else "global"
+                    if self.peek().kind == "KW" and \
+                            self.peek().value in ("index", "key"):
+                        self.advance()
+                elif self.peek().value in ("unique", "fulltext"):
                     kind = self.advance().value
                     if self.peek().kind == "KW" and \
                             self.peek().value in ("index", "key"):

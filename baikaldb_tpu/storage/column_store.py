@@ -184,6 +184,61 @@ class TxnContext:
         self.store._end_txn(self)
 
 
+def commit_group(tctxs: list["TxnContext"]) -> None:
+    """Commit several tables' buffered writes as ONE transaction.
+
+    Replicated stores commit through a single primary-first 2PC spanning
+    every touched region group of every table (the reference's global-index
+    DML: LockPrimaryNode/LockSecondaryNode span main + index regions,
+    separate.cpp:653); either all tables' writes replicate or none do, and
+    every column cache rolls back to its pre-image on failure.  Non-
+    replicated stores fall back to their per-table commit (WAL flush)."""
+    from .remote_tier import RemoteRowTier, write_ops_atomic_remote
+    from .replicated import ReplicatedRowTier, write_ops_atomic
+
+    fleet = [t for t in tctxs
+             if isinstance(t.store.replicated, ReplicatedRowTier)]
+    remote = [t for t in tctxs
+              if isinstance(t.store.replicated, RemoteRowTier)]
+    others = [t for t in tctxs if t not in fleet and t not in remote]
+    groups = [(fleet, write_ops_atomic), (remote, write_ops_atomic_remote)]
+    for g_i, (group, atomic) in enumerate(groups):
+        if len(group) <= 1:
+            others.extend(group)    # nothing to span: per-table commit
+            continue
+        try:
+            pairs = []
+            for t in group:
+                pairs.append((t.store.replicated, t.row_txn.pending_ops()))
+                t.row_txn.rollback()  # buffer only ever held the row locks
+            try:
+                atomic(pairs)
+            except Exception:
+                for t in group:
+                    t._restore_preimage()
+                raise
+        except BaseException:
+            # a failed group must not strand the REMAINING contexts with
+            # their writer leases held and uncommitted column mutations
+            # visible: roll everything not yet committed back
+            for t in group:
+                t.store._end_txn(t)
+            for later_group, _ in groups[g_i + 1:]:
+                if len(later_group) > 1:
+                    for t in later_group:
+                        t.rollback()
+                else:
+                    others.extend(later_group)
+            for t in others:
+                t.rollback()
+            raise
+        else:
+            for t in group:
+                t.store._end_txn(t)
+    for t in others:
+        t.commit()
+
+
 class TableStore:
     """All regions of one table + DML on the host tier.
 
@@ -666,6 +721,31 @@ class TableStore:
                     return r.data.slice(int(hit[0]), 1).to_pylist()[0]
         return None
 
+    def lookup_by_pks(self, pk_table: pa.Table) -> pa.Table:
+        """Gather full rows matching the given primary-key values — the
+        global-index LOOKUP JOIN (reference: select_manager_node.cpp:1081,
+        the frontend joins index-region results back to main-table rows by
+        pk).  Missing keys are silently absent (a concurrent delete)."""
+        with self._lock:
+            if self._pk_codec is None or not pk_table.num_rows:
+                return self.snapshot().slice(0, 0)
+            keys = self._encode_pk_table(pk_table)
+            idx = self._ensure_pk_index()
+            rids = {idx[k] for k in keys if k in idx}
+            if not rids:
+                return self.snapshot().slice(0, 0)
+            wanted = np.fromiter(rids, dtype=np.int64)
+            parts = []
+            for r in self.regions:
+                if not r.num_rows:
+                    continue
+                mask = np.isin(r.rowids, wanted)
+                if mask.any():
+                    parts.append(r.data.filter(pa.array(mask)))
+            if not parts:
+                return self.snapshot().slice(0, 0)
+            return pa.concat_tables(parts).combine_chunks()
+
     # -- primary-key index -----------------------------------------------
     def _ensure_pk_index(self):
         if self._pk_codec is None:
@@ -790,11 +870,16 @@ class TableStore:
                 for k, rid in zip(new_keys, rowids):
                     self._pk_index[k] = int(rid)
 
-    def delete_where(self, host_mask_fn, tctx: Optional[TxnContext] = None) -> int:
+    def delete_where(self, host_mask_fn, tctx: Optional[TxnContext] = None,
+                     collect_cols: Optional[list[str]] = None):
         """Delete rows where host_mask_fn(pa.Table) -> bool np.ndarray.
-        Column tier filters; row tier records __del markers per rowid."""
+        Column tier filters; row tier records __del markers per rowid.
+        With ``collect_cols``, returns (count, deleted-rows projection) —
+        the global-index maintenance path needs the outgoing rows' indexed
+        values to delete the matching index entries."""
         deleted = 0
         markers: list[dict] = []
+        collected: list[pa.Table] = []
         with self._lock:
             self._writer_check(tctx)
             # phase 1: evaluate masks only (no mutation) so the hot-tier
@@ -814,11 +899,16 @@ class TableStore:
                     if fresh:
                         dead_keys.extend(
                             self._encode_pk_table(r.data.filter(pa.array(mask))))
+                    if collect_cols is not None:
+                        collected.append(
+                            r.data.filter(pa.array(mask)).select(collect_cols))
                     markers.extend({ROWID: int(rid), "__del": True}
                                    for rid in r.rowids[mask])
                     masks.append((r, mask))
                     deleted += int(mask.sum())
             if not markers:
+                if collect_cols is not None:
+                    return 0, self.snapshot().slice(0, 0).select(collect_cols)
                 return 0
             self._write_hot(markers, tctx)
             # phase 2: the delete is durable/replicated — apply to columns
@@ -832,17 +922,26 @@ class TableStore:
                     self._pk_index.pop(k, None)
             else:
                 self._pk_stale = True
+        if collect_cols is not None:
+            return deleted, pa.concat_tables(collected).combine_chunks()
         return deleted
 
     def update_where(self, host_mask_fn, assign_fn,
                      tctx: Optional[TxnContext] = None,
-                     changed_cols: Optional[list[str]] = None) -> int:
+                     changed_cols: Optional[list[str]] = None,
+                     collect_cols: Optional[list[str]] = None,
+                     dry_run: bool = False):
         """Update rows in place: assign_fn(pa.Table, mask) -> pa.Table.
         Row tier records the full new row versions under the same rowids.
         ``changed_cols`` (the assignment targets) lets the PK index survive
-        updates that don't touch key columns."""
+        updates that don't touch key columns.  With ``collect_cols``,
+        returns (count, old-rows projection, new-rows projection) — the
+        global-index maintenance path deletes entries for the old values
+        and inserts entries for the new ones."""
         updated = 0
         hot: list[dict] = []
+        old_rows: list[pa.Table] = []
+        new_rows_t: list[pa.Table] = []
         with self._lock:
             self._writer_check(tctx)
             # phase 1: compute the new region tables without installing them,
@@ -858,11 +957,27 @@ class TableStore:
                                        self.arrow_schema)
                     staged.append((r, new_data))
                     updated += int(mask.sum())
+                    if collect_cols is not None:
+                        old_rows.append(r.data.filter(pa.array(mask))
+                                        .select(collect_cols))
+                        new_rows_t.append(new_data.filter(pa.array(mask))
+                                          .select(collect_cols))
                     new_rows = new_data.filter(pa.array(mask)).to_pylist()
                     hot.extend(dict(row, **{ROWID: int(rid)})
                                for row, rid in zip(new_rows, r.rowids[mask]))
-            if not staged:
-                return 0
+            if not staged or dry_run:
+                # dry_run: phase 1 only — the would-be old/new rows for a
+                # pre-mutation constraint check (global UNIQUE), nothing
+                # installed or written
+                if collect_cols is not None:
+                    if staged:
+                        return (updated,
+                                pa.concat_tables(old_rows).combine_chunks(),
+                                pa.concat_tables(new_rows_t)
+                                .combine_chunks())
+                    empty = self.snapshot().slice(0, 0).select(collect_cols)
+                    return 0, empty, empty
+                return updated if dry_run else 0
             self._write_hot(hot, tctx)
             # phase 2: durable/replicated — install the new region tables
             self._mutations += 1
@@ -873,6 +988,10 @@ class TableStore:
             for r, new_data in staged:
                 r.data = new_data
                 r.version += 1
+        if collect_cols is not None:
+            return (updated,
+                    pa.concat_tables(old_rows).combine_chunks(),
+                    pa.concat_tables(new_rows_t).combine_chunks())
         return updated
 
     def _write_hot(self, recs: list[dict], tctx: Optional[TxnContext]):

@@ -59,6 +59,116 @@ def stable_table_id(table_key: str) -> int:
     return _fnv64(table_key.encode()) % (1 << 31)
 
 
+def _twopc_remote(parts: list, txn: int, deadline_s: float) -> None:
+    """Primary-first 2PC over daemon-hosted regions, possibly spanning
+    SEVERAL tiers (the reference's global-index DML: lock nodes across
+    main-table and index regions, separate.cpp:653).  ``parts`` is
+    [(tier, region, op_batch)]; parts[0] is the primary — its region holds
+    the commit-decision record.  After the decision commits, the record is
+    also hinted onto every other participant region so each TIER's in-doubt
+    recovery can resolve locally (recover_in_doubt additionally consults
+    sibling tiers for the uncommon window where the hints never landed)."""
+    prepared: list = []
+    try:
+        for t, r, batch in parts:
+            t._propose(r, encode_cmd(CMD_PREPARE, txn, encode_ops(batch)))
+            prepared.append((t, r))
+    except (ReplicationError, StaleRoutingError):
+        for t, r in prepared:
+            try:
+                t._propose(r, encode_cmd(CMD_ROLLBACK, txn))
+            except (ReplicationError, StaleRoutingError):
+                pass            # region will resolve in-doubt via primary
+        raise
+    pt, pr, _ = parts[0]
+    # the decision propose is the commit point: it must succeed or the
+    # txn is NOT committed.  A propose FAILURE is not proof the record
+    # missed the log (a timeout loses the ack, not the entry), so rolling
+    # prepares back directly could tear the txn.  Replicate an explicit
+    # ABORT decision instead (apply is first-writer-wins), then act on the
+    # WINNING decision read back from the primary (ADVICE r03 medium).
+    try:
+        pt._propose(pr, encode_cmd(CMD_DECIDE, txn, bytes([CMD_COMMIT])))
+    except ReplicationError:
+        try:
+            pt._propose(pr, encode_cmd(CMD_DECIDE, txn,
+                                       bytes([CMD_ROLLBACK])))
+            st = pt._leader_call(pr, "txn_status", deadline_s)
+            # a missing record is NOT evidence of abort: txn_status may
+            # have been answered by a deposed leader that applied neither
+            # DECIDE entry — treat it as in-doubt
+            w = st["decisions"].get(str(txn)) if st else None
+            winner = int(w) if w is not None else None
+        except ReplicationError:
+            winner = None
+        if winner is None:
+            # abort record unconfirmed: leave prepares in doubt for
+            # recovery to resolve from whatever decision exists
+            raise
+        if winner != CMD_COMMIT:
+            for t, r, _ in parts:
+                try:
+                    t._propose(r, encode_cmd(CMD_ROLLBACK, txn))
+                except (ReplicationError, StaleRoutingError):
+                    pass        # recovery rolls back from the abort record
+            raise ReplicationError(f"2PC decision failed for txn {txn}")
+        # the commit decision actually landed: fall through — committed
+    # past the decision the txn IS committed: completion failures must not
+    # surface as txn failure (the frontend would roll its cache back while
+    # the replicas hold the commit) — best-effort from here; in-doubt
+    # prepares resolve from the decision record
+    for t, r, _ in parts[1:]:
+        try:
+            t._propose(r, encode_cmd(CMD_DECIDE, txn, bytes([CMD_COMMIT])))
+        except (ReplicationError, StaleRoutingError):
+            pass                # recovery consults sibling tiers instead
+    for t, r, _ in parts:
+        try:
+            t._propose(r, encode_cmd(CMD_COMMIT, txn))
+        except (ReplicationError, StaleRoutingError):
+            pass
+
+
+def write_ops_atomic_remote(pairs: list) -> None:
+    """Commit several RemoteRowTiers' write batches as ONE daemon-plane
+    transaction (the cross-tier analog of ReplicatedRowTier's
+    write_ops_atomic; reference: global-index DML 2PC).  ``pairs`` is
+    [(tier, ops)]; the first tier with ops holds the primary region."""
+    pairs = [(t, ops) for t, ops in pairs if ops]
+    if not pairs:
+        return
+    if len(pairs) == 1:
+        pairs[0][0].write_ops(pairs[0][1])
+        return
+    tiers = list({t.table_key: t for t, _ in pairs}.values())
+    for attempt in range(3):
+        try:
+            parts: list = []
+            for t, ops in pairs:
+                per = t._route_ops(ops)
+                by_id = {r.region_id: r for r in t.regions}
+                for rid in sorted(per):
+                    parts.append((t, by_id[rid], per[rid]))
+            if len(parts) == 1:
+                t, r, batch = parts[0]
+                t._propose(r, encode_cmd(CMD_WRITE, 0, encode_ops(batch)))
+            else:
+                _twopc_remote(parts, pairs[0][0].alloc_rowids(1),
+                              max(t.propose_deadline for t in tiers))
+            break
+        except StaleRoutingError:
+            if attempt == 2:
+                raise ReplicationError("atomic write: routing kept going "
+                                       "stale")
+            for t in tiers:
+                t.refresh_routing()
+    for t in tiers:
+        try:
+            t.maybe_split()
+        except Exception:       # noqa: BLE001 — split is maintenance
+            pass
+
+
 class _RemoteRegion:
     """One region's routing state: peers as (store_id, address) plus the
     [start_key, end_key) slice it owns (b"" = unbounded)."""
@@ -280,6 +390,34 @@ class RemoteRowTier:
                                if d == CMD_COMMIT)
                 aborted.update(int(t) for t, d in st["decisions"].items()
                                if d == CMD_ROLLBACK)
+        # cross-TIER transactions (global-index DML) record their decision
+        # on the primary region, which may belong to another table's tier:
+        # before treating a prepare as undecided, consult the sibling tiers
+        # attached to this cluster (an RPC per sibling region, but only
+        # when an unresolved prepare actually exists)
+        unresolved = set()
+        for st in statuses.values():
+            if st:
+                unresolved.update(int(t) for t in st["prepared"]
+                                  if int(t) not in decided and
+                                  int(t) not in aborted)
+        if unresolved:
+            for sib in list(getattr(self.cluster, "tiers", {}).values()):
+                if sib is self:
+                    continue
+                for r in sib.regions:
+                    st = sib._leader_call(r, "txn_status", deadline_s)
+                    if not st:
+                        all_known = False   # an unreachable sibling region
+                        continue            # might hold the commit decision
+                    decided.update(int(t) for t, d in
+                                   st["decisions"].items()
+                                   if d == CMD_COMMIT and int(t) in
+                                   unresolved)
+                    aborted.update(int(t) for t, d in
+                                   st["decisions"].items()
+                                   if d == CMD_ROLLBACK and int(t) in
+                                   unresolved)
         out: dict[int, str] = {}
         for r in self.regions:
             st = statuses.get(r.region_id)
@@ -348,89 +486,28 @@ class RemoteRowTier:
                 pass              # split is maintenance (meta down, quorum
                 #                   loss, anything): the write already ACKed
 
-    def _write_ops_routed(self, ops: list[tuple[int, bytes, bytes]]) -> None:
-        # rightmost start <= key over the sorted range list (the
-        # SchemaFactory range lookup); starts hoisted once per batch
+    def _route_ops(self, ops: list[tuple[int, bytes, bytes]]) -> dict:
+        """region_id -> op batch.  Rightmost start <= key over the sorted
+        range list (the SchemaFactory range lookup); starts hoisted once
+        per batch."""
         starts = [r.start_key for r in self.regions]
         per: dict[int, list] = {}
-        by_id = {r.region_id: r for r in self.regions}
         for op in ops:
             rid = self.regions[max(bisect_right(starts, op[1]) - 1,
                                    0)].region_id
             per.setdefault(rid, []).append(op)
+        return per
+
+    def _write_ops_routed(self, ops: list[tuple[int, bytes, bytes]]) -> None:
+        per = self._route_ops(ops)
+        by_id = {r.region_id: r for r in self.regions}
         if len(per) == 1:
             rid, batch = next(iter(per.items()))
             self._propose(by_id[rid],
                           encode_cmd(CMD_WRITE, 0, encode_ops(batch)))
             return
-        # primary-first 2PC (fetcher_store.cpp:1848-1904): PREPARE all,
-        # decision + COMMIT on the primary, then the secondaries.  The txn
-        # id is CLUSTER-allocated: a fresh frontend's local counter could
-        # alias another coordinator's decision record and corrupt in-doubt
-        # recovery
-        txn = self.alloc_rowids(1)
-        rids = sorted(per)
-        prepared: list[int] = []
-        try:
-            for rid in rids:
-                self._propose(by_id[rid],
-                              encode_cmd(CMD_PREPARE, txn,
-                                         encode_ops(per[rid])))
-                prepared.append(rid)
-        except (ReplicationError, StaleRoutingError):
-            for rid in prepared:
-                try:
-                    self._propose(by_id[rid], encode_cmd(CMD_ROLLBACK, txn))
-                except ReplicationError:
-                    pass        # region will resolve in-doubt via primary
-            raise
-        primary = by_id[rids[0]]
-        # the decision propose is the commit point: it must succeed or the
-        # txn is NOT committed.  A propose FAILURE is not proof the record
-        # missed the log (a timeout loses the ack, not the entry), so
-        # rolling prepares back directly could tear the txn: recovery would
-        # commit a surviving prepare from the landed decision while others
-        # rolled back (ADVICE r03 medium).  Replicate an explicit ABORT
-        # decision instead (apply is first-writer-wins), then act on the
-        # WINNING decision read back from the primary.
-        try:
-            self._propose(primary, encode_cmd(CMD_DECIDE, txn,
-                                              bytes([CMD_COMMIT])))
-        except ReplicationError:
-            try:
-                self._propose(primary, encode_cmd(CMD_DECIDE, txn,
-                                                  bytes([CMD_ROLLBACK])))
-                st = self._leader_call(primary, "txn_status",
-                                       self.propose_deadline)
-                # a missing record is NOT evidence of abort: txn_status may
-                # have been answered by a deposed leader that applied
-                # neither DECIDE entry — treat it as in-doubt
-                w = st["decisions"].get(str(txn)) if st else None
-                winner = int(w) if w is not None else None
-            except ReplicationError:
-                winner = None
-            if winner is None:
-                # abort record unconfirmed: leave prepares in doubt for
-                # recovery to resolve from whatever decision exists
-                raise
-            if winner != CMD_COMMIT:
-                for rid in rids:
-                    try:
-                        self._propose(by_id[rid],
-                                      encode_cmd(CMD_ROLLBACK, txn))
-                    except ReplicationError:
-                        pass    # recovery rolls back from the abort record
-                raise
-            # the commit decision actually landed: fall through — committed
-        # past the decision the txn IS committed: completion failures must
-        # not surface as txn failure (the frontend would roll its cache back
-        # while the replicas hold the commit) — best-effort here, in-doubt
-        # prepares resolve from the primary's decision record
-        for rid in rids:
-            try:
-                self._propose(by_id[rid], encode_cmd(CMD_COMMIT, txn))
-            except ReplicationError:
-                pass
+        _twopc_remote([(self, by_id[rid], per[rid]) for rid in sorted(per)],
+                      self.alloc_rowids(1), self.propose_deadline)
 
     def _scan_region(self, region: _RemoteRegion):
         """Leader scan, filtered by the INTERSECTION of the replica's

@@ -63,6 +63,52 @@ class SplitError(RuntimeError):
     """A region split/merge could not complete (aborted, state unchanged)."""
 
 
+def write_ops_atomic(pairs: list[tuple["ReplicatedRowTier", list]]) -> None:
+    """Commit several tiers' write batches as ONE transaction: a single
+    primary-first 2PC across the union of every touched region group (the
+    reference's global-index DML, where LockPrimaryNode/LockSecondaryNode
+    span main-table and index regions — separate.cpp:653).  All tiers must
+    belong to the same fleet (region ids are fleet-unique, allocated by
+    meta).  Raises ReplicationError on quorum loss; nothing applies unless
+    the decision record commits."""
+    pairs = [(t, ops) for t, ops in pairs if ops]
+    if not pairs:
+        return
+    if len(pairs) == 1:
+        pairs[0][0].write_ops(pairs[0][1])
+        return
+    import contextlib
+
+    # lock every tier in table_key order (deadlock-free against concurrent
+    # coupled writes taking the same set)
+    tiers = sorted({t.table_key: t for t, _ in pairs}.values(),
+                   key=lambda t: t.table_key)
+    with contextlib.ExitStack() as stack:
+        for t in tiers:
+            stack.enter_context(t._mu)
+        by_region: dict[int, list] = {}
+        groups: list = []
+        for t, ops in pairs:
+            for i, batch in sorted(t._split_ops(ops).items()):
+                g = t.groups[i]
+                if g.region_id not in by_region:
+                    by_region[g.region_id] = []
+                    groups.append(g)
+                by_region[g.region_id].extend(batch)
+        if len(groups) == 1:
+            if not groups[0].write(by_region[groups[0].region_id]):
+                raise ReplicationError(
+                    f"region {groups[0].region_id} has no quorum")
+        else:
+            try:
+                TwoPhaseCoordinator(groups).write(by_region,
+                                                  txn_id=next_txn_id())
+            except TwoPhaseError as e:
+                raise ReplicationError(str(e)) from None
+        for t in tiers:
+            t.maybe_split()
+
+
 class ReplicatedRowTier:
     """One table's raft-replicated row tier: range-routed region groups."""
 

@@ -1,0 +1,279 @@
+"""Global secondary indexes (VERDICT r03 missing #1 / next #3).
+
+The reference keeps global-index data in its own region groups, writes it
+through 2PC spanning main + index regions (separate.cpp:653,
+lock_primary_node.cpp), and reads it via an index-lookup join
+(select_manager_node.cpp:1081).  These tests drive the same surface:
+cross-region uniqueness on a multi-region fleet table, EXPLAIN showing the
+index route, DML maintenance (insert/update/delete), online backfill with
+kill/resume, and atomicity of the coupled write under quorum loss.
+"""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Database, Session
+from baikaldb_tpu.raft.core import raft_available
+from baikaldb_tpu.storage.rowstore import ConflictError
+
+
+def local_session():
+    return Session(Database())
+
+
+def fleet_session():
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.fleet import StoreFleet
+
+    meta = MetaService(peer_count=3)
+    fleet = StoreFleet(meta, ["a:1", "b:1", "c:1"], seed=17)
+    return Session(Database(fleet=fleet)), fleet
+
+
+# -- declaration + catalog surface ----------------------------------------
+
+def test_create_table_with_global_index_hides_backing():
+    s = local_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), v DOUBLE, "
+              "PRIMARY KEY (id), GLOBAL UNIQUE INDEX g_email (email))")
+    names = [r[f"Tables_in_{s.current_db}"] for r in
+             s.query("SHOW TABLES")]
+    assert "u" in names
+    assert not any(n.startswith("__gidx__") for n in names)
+    ddl = s.query("SHOW CREATE TABLE u")[0]["Create Table"]
+    assert "GLOBAL UNIQUE KEY `g_email` (`email`)" in ddl
+
+
+def test_global_unique_rejects_duplicates_local():
+    s = local_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), "
+              "PRIMARY KEY (id), GLOBAL UNIQUE INDEX g_email (email))")
+    s.execute("INSERT INTO u VALUES (1, 'a@x'), (2, 'b@x')")
+    with pytest.raises(ConflictError):
+        s.execute("INSERT INTO u VALUES (3, 'a@x')")
+    # MySQL semantics: NULLs never conflict in a unique index
+    s.execute("INSERT INTO u VALUES (4, NULL), (5, NULL)")
+    assert s.query("SELECT COUNT(*) n FROM u") == [{"n": 4}]
+    # batch-internal duplicate also rejected
+    with pytest.raises(ConflictError):
+        s.execute("INSERT INTO u VALUES (6, 'z@x'), (7, 'z@x')")
+
+
+def test_global_index_maintained_by_update_delete():
+    s = local_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), v DOUBLE, "
+              "PRIMARY KEY (id), GLOBAL UNIQUE INDEX g_email (email))")
+    s.execute("INSERT INTO u VALUES (1, 'a@x', 1.0), (2, 'b@x', 2.0)")
+    # updating away frees the old value; updating into a taken value fails
+    s.execute("UPDATE u SET email = 'c@x' WHERE id = 1")
+    s.execute("INSERT INTO u VALUES (3, 'a@x', 3.0)")      # 'a@x' free again
+    with pytest.raises(ConflictError):
+        s.execute("UPDATE u SET email = 'b@x' WHERE id = 3")
+    # a no-op update of an unrelated column does not touch the index
+    s.execute("UPDATE u SET v = 9.0 WHERE id = 2")
+    # delete frees the value
+    s.execute("DELETE FROM u WHERE id = 2")
+    s.execute("INSERT INTO u VALUES (9, 'b@x', 0.0)")
+    got = s.query("SELECT id FROM u ORDER BY id")
+    assert [r["id"] for r in got] == [1, 3, 9]
+
+
+def test_select_routes_through_global_index():
+    s = local_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), v DOUBLE, "
+              "PRIMARY KEY (id), GLOBAL INDEX g_email (email))")
+    for i in range(50):
+        s.execute(f"INSERT INTO u VALUES ({i}, 'u{i}@x', {float(i)})")
+    plan = "\n".join(r["plan"] for r in
+                     s.query("EXPLAIN SELECT v FROM u WHERE email = 'u7@x'"))
+    assert "global_index(g_email:email)" in plan
+    got = s.query("SELECT id, v FROM u WHERE email = 'u7@x'")
+    assert got == [{"id": 7, "v": 7.0}]
+    # non-unique: several rows share the indexed value
+    s.execute("INSERT INTO u VALUES (100, 'u7@x', 100.0)")
+    got = s.query("SELECT id FROM u WHERE email = 'u7@x' ORDER BY id")
+    assert [r["id"] for r in got] == [7, 100]
+
+
+def test_online_add_global_index_backfills_and_publishes():
+    s = local_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), "
+              "PRIMARY KEY (id))")
+    for i in range(20):
+        s.execute(f"INSERT INTO u VALUES ({i}, 'e{i}')")
+    r = s.execute("ALTER TABLE u ADD GLOBAL UNIQUE INDEX g_email (email)")
+    work_id = r.arrow.to_pylist()[0]["work_id"]
+    w = s.db.ddl.wait(work_id)
+    assert w.state == "public", w.error
+    with pytest.raises(ConflictError):
+        s.execute("INSERT INTO u VALUES (99, 'e3')")
+    plan = "\n".join(r["plan"] for r in
+                     s.query("EXPLAIN SELECT id FROM u WHERE email = 'e3'"))
+    assert "global_index(g_email:email)" in plan
+
+
+def test_add_global_unique_fails_on_existing_duplicates():
+    s = local_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), "
+              "PRIMARY KEY (id))")
+    s.execute("INSERT INTO u VALUES (1, 'dup'), (2, 'dup')")
+    r = s.execute("ALTER TABLE u ADD GLOBAL UNIQUE INDEX g_email (email)")
+    w = s.db.ddl.wait(r.arrow.to_pylist()[0]["work_id"])
+    assert w.state == "failed"
+    assert "duplicate" in w.error.lower()
+    # failed index is never choosable and DML ignores it
+    s.execute("INSERT INTO u VALUES (3, 'dup')")
+
+
+def test_drop_global_index_drops_backing():
+    s = local_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), "
+              "PRIMARY KEY (id), GLOBAL UNIQUE INDEX g_email (email))")
+    s.execute("INSERT INTO u VALUES (1, 'a')")
+    s.execute("ALTER TABLE u DROP INDEX g_email")
+    # uniqueness no longer enforced; backing table gone from the catalog
+    s.execute("INSERT INTO u VALUES (2, 'a')")
+    assert not any(n.startswith("__gidx__")
+                   for n in s.db.catalog.tables(s.current_db))
+
+
+# -- multi-region fleet: the verdict's done-criterion ----------------------
+
+pytestmark_fleet = pytest.mark.skipif(not raft_available(),
+                                      reason="native raft core unavailable")
+
+
+@pytestmark_fleet
+def test_fleet_cross_region_unique_and_atomicity():
+    """Global UNIQUE on a non-PK column of a MULTI-REGION fleet table:
+    duplicates rejected across regions; index entries land in the index's
+    OWN regions via one 2PC with the main write."""
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), v DOUBLE, "
+              "PRIMARY KEY (id), GLOBAL UNIQUE INDEX g_email (email))")
+    main = fleet.row_tiers["default.u"]
+    gidx = fleet.row_tiers["default.__gidx__u__g_email"]
+    assert main is not gidx                 # own tier -> own region groups
+    main.split_rows = 8
+    gidx.split_rows = 8
+    for i in range(30):
+        s.execute(f"INSERT INTO u VALUES ({i}, 'u{i}@x', {float(i)})")
+    assert len(main.groups) > 1             # main table spans regions
+    assert len(gidx.groups) > 1             # index data spans ITS regions
+    # duplicate on a non-PK column rejected regardless of target region
+    with pytest.raises(ConflictError):
+        s.execute("INSERT INTO u VALUES (777, 'u3@x', 0.0)")
+    # EXPLAIN shows the global route on the fleet table too
+    plan = "\n".join(r["plan"] for r in
+                     s.query("EXPLAIN SELECT v FROM u WHERE email = 'u9@x'"))
+    assert "global_index(g_email:email)" in plan
+    assert s.query("SELECT id FROM u WHERE email = 'u9@x'") == [{"id": 9}]
+    # a fresh frontend rebuilt from the replicated tiers sees consistent
+    # main + index state (the entries replicated with the rows)
+    s2 = Session(Database(fleet=fleet))
+    s2.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), v DOUBLE, "
+               "PRIMARY KEY (id), GLOBAL UNIQUE INDEX g_email (email))")
+    with pytest.raises(ConflictError):
+        s2.execute("INSERT INTO u VALUES (778, 'u3@x', 0.0)")
+    assert s2.query("SELECT COUNT(*) n FROM u") == [{"n": 30}]
+
+
+@pytestmark_fleet
+def test_fleet_coupled_write_aborts_together_on_quorum_loss():
+    """Quorum loss during the coupled (main+index) 2PC: NEITHER table
+    applies — the failure mode global indexes exist to prevent is a main
+    row without its index entry (or vice versa)."""
+    from baikaldb_tpu.storage.replicated import ReplicationError
+
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), "
+              "PRIMARY KEY (id), GLOBAL UNIQUE INDEX g_email (email))")
+    s.execute("INSERT INTO u VALUES (1, 'a@x')")
+    # kill 2 of 3 stores: no region group can reach quorum
+    fleet.kill_store("a:1")
+    fleet.kill_store("b:1")
+    with pytest.raises(ReplicationError):
+        s.execute("INSERT INTO u VALUES (2, 'b@x')")
+    # the column caches did not run ahead of the failed commit
+    assert s.query("SELECT COUNT(*) n FROM u") == [{"n": 1}]
+    bstore = s.db.stores["default.__gidx__u__g_email"]
+    assert bstore.num_rows == 1
+
+
+@pytestmark_fleet
+def test_fleet_online_backfill_under_split():
+    s, fleet = fleet_session()
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), "
+              "PRIMARY KEY (id))")
+    tier = fleet.row_tiers["default.u"]
+    tier.split_rows = 8
+    for i in range(30):
+        s.execute(f"INSERT INTO u VALUES ({i}, 'e{i}')")
+    assert len(tier.groups) > 1
+    r = s.execute("ALTER TABLE u ADD GLOBAL UNIQUE INDEX g_email (email)")
+    w = s.db.ddl.wait(r.arrow.to_pylist()[0]["work_id"])
+    assert w.state == "public", w.error
+    with pytest.raises(ConflictError):
+        s.execute("INSERT INTO u VALUES (99, 'e11')")
+    assert s.query("SELECT id FROM u WHERE email = 'e11'") == [{"id": 11}]
+
+
+# -- daemon plane: real processes, TCP raft, SIGKILL -----------------------
+
+@pytestmark_fleet
+def test_cluster_procs_global_index(tmp_path):
+    """Global index on the multi-process cluster: coupled DML 2PC runs
+    across daemon-hosted main + index regions, survives a SIGKILL'd store,
+    and a fresh frontend sees consistent main+index state."""
+    from baikaldb_tpu.tools.deploy_cluster import spawn_cluster, teardown
+
+    ddl = ("CREATE TABLE u (id BIGINT, email VARCHAR(64), v DOUBLE, "
+           "PRIMARY KEY (id), GLOBAL UNIQUE INDEX g_email (email))")
+    meta_addr, procs = spawn_cluster(n_stores=3, base_port=9610)
+    try:
+        s = Session(Database(cluster=meta_addr))
+        s.execute(ddl)
+        for i in range(12):
+            s.execute(f"INSERT INTO u VALUES ({i}, 'u{i}@x', {float(i)})")
+        with pytest.raises(ConflictError):
+            s.execute("INSERT INTO u VALUES (99, 'u3@x', 0.0)")
+        s.execute("UPDATE u SET email = 'moved@x' WHERE id = 3")
+        s.execute("INSERT INTO u VALUES (99, 'u3@x', 0.0)")  # freed
+        procs["stores"][2].kill()
+        s.execute("INSERT INTO u VALUES (200, 'k@x', 1.0)")  # 2/3 quorum
+        s2 = Session(Database(cluster=meta_addr))
+        s2.execute(ddl)
+        with pytest.raises(ConflictError):
+            s2.execute("INSERT INTO u VALUES (300, 'k@x', 0.0)")
+        assert s2.query("SELECT COUNT(*) n FROM u") == [{"n": 14}]
+    finally:
+        teardown(procs)
+
+
+# -- kill-9 during backfill resumes (data_dir durability plane) ------------
+
+def test_backfill_resumes_after_kill(tmp_path):
+    """Kill the process mid-backfill (simulated: drop the Database with the
+    work still queued/suspended); a fresh Database over the same data_dir
+    resubmits the work from the persisted backfilling state and publishes."""
+    d = str(tmp_path / "db")
+    s = Session(Database(data_dir=d))
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(64), "
+              "PRIMARY KEY (id))")
+    for i in range(10):
+        s.execute(f"INSERT INTO u VALUES ({i}, 'e{i}')")
+    s.db.ddl.suspend()                      # freeze the worker: mid-backfill
+    s.execute("ALTER TABLE u ADD GLOBAL UNIQUE INDEX g_email (email)")
+    s.db.checkpoint() if hasattr(s.db, "checkpoint") else None
+    # "kill -9": abandon the first Database entirely
+    s2 = Session(Database(data_dir=d))
+    info = s2.db.catalog.get_table(s2.current_db, "u")
+    ix = [x for x in info.indexes if x.name == "g_email"][0]
+    for w in s2.db.ddl.works.values():
+        if w.index_name == "g_email":
+            s2.db.ddl.wait(w.work_id)
+    assert ix.params.get("state") == "public"
+    with pytest.raises(ConflictError):
+        s2.execute("INSERT INTO u VALUES (99, 'e3')")
+    plan = "\n".join(r["plan"] for r in
+                     s2.query("EXPLAIN SELECT id FROM u WHERE email='e3'"))
+    assert "global_index(g_email:email)" in plan
