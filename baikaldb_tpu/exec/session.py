@@ -691,6 +691,17 @@ class Session:
                              ", ".join(f"`{c}`" for c in ix.columns) + ")")
             ddl = f"CREATE TABLE `{s.table.name}` (\n" + ",\n".join(lines) + \
                 "\n)"
+            pspec = (info.options or {}).get("partition")
+            if pspec and pspec["kind"] == "hash":
+                ddl += (f"\nPARTITION BY HASH (`{pspec['column']}`) "
+                        f"PARTITIONS {pspec['n']}")
+            elif pspec and pspec["kind"] == "range":
+                parts = ", ".join(
+                    f"PARTITION {nm} VALUES LESS THAN "
+                    + ("MAXVALUE" if u is None else f"({u!r})")
+                    for nm, u in zip(pspec["names"], pspec["uppers"]))
+                ddl += (f"\nPARTITION BY RANGE (`{pspec['column']}`) "
+                        f"({parts})")
             return Result(columns=["Table", "Create Table"], arrow=pa.table(
                 {"Table": [s.table.name], "Create Table": [ddl]}))
         if s.what == "columns":
@@ -1072,6 +1083,21 @@ class Session:
         options = dict(s.options)
         if vector_cols:
             options["vector_cols"] = vector_cols
+        pspec = options.get("partition")
+        if pspec:
+            names = {f.name for f in fields}
+            if pspec["column"] not in names:
+                raise PlanError(f"unknown partition column "
+                                f"{pspec['column']!r}")
+            if pspec["kind"] == "range":
+                if len(set(pspec["names"])) != len(pspec["names"]):
+                    raise PlanError("duplicate partition name")
+                finite = [u for u in pspec["uppers"] if u is not None]
+                if any(b <= a for a, b in zip(finite, finite[1:])):
+                    raise PlanError("partition bounds must be strictly "
+                                    "increasing")
+            elif pspec["kind"] == "hash" and int(pspec["n"]) < 1:
+                raise PlanError("PARTITIONS must be at least 1")
         auto_cols = [c for c in s.columns if c.auto_increment]
         if auto_cols:
             if len(auto_cols) > 1:
@@ -1303,6 +1329,71 @@ class Session:
                       columns=["work_id"],
                       arrow=pa.table({"work_id": [work.work_id]}))
 
+    def _alter_partition(self, s: AlterTableStmt, db: str, info) -> Result:
+        """ADD PARTITION extends a range-partitioned table's bounds (the
+        reference's dynamic-partition management, table_manager.cpp); DROP
+        PARTITION removes a partition's ROWS AND its regions — the
+        partition-grade bulk delete."""
+        spec = (info.options or {}).get("partition")
+        if spec is None:
+            raise PlanError(f"table {info.name!r} is not partitioned")
+        # NOTE: _execute_stmt already implicit-committed any open
+        # transaction before dispatching DDL (MySQL semantics), so a later
+        # ROLLBACK can never resurrect rows across the partition remap
+        store = self._store(s.table)
+        if s.action == "add_partition":
+            if spec["kind"] != "range":
+                raise PlanError("ADD PARTITION applies to RANGE "
+                                "partitioning")
+            if s.partition_name in spec["names"]:
+                raise PlanError(f"partition {s.partition_name!r} exists")
+            if spec["uppers"] and spec["uppers"][-1] is None:
+                raise PlanError("cannot ADD PARTITION after MAXVALUE")
+            f = info.schema.field(spec["column"])
+            if s.partition_upper is not None and spec["uppers"]:
+                new_u = store._norm_part_scalar(s.partition_upper, f)
+                last_u = store._norm_part_scalar(spec["uppers"][-1], f)
+                if new_u <= last_u:
+                    raise PlanError("new partition bound must exceed the "
+                                    "last bound")
+            spec["names"].append(s.partition_name)
+            spec["uppers"].append(s.partition_upper)
+            info.version += 1
+            store._mutations += 1
+            self.db.save_catalog()
+            return Result()
+        # drop_partition
+        if spec["kind"] != "range":
+            raise PlanError("DROP PARTITION applies to RANGE partitioning")
+        if s.partition_name not in spec["names"]:
+            raise PlanError(f"unknown partition {s.partition_name!r}")
+        if len(spec["names"]) == 1:
+            raise PlanError("cannot remove all partitions; use DROP TABLE")
+        pid = spec["names"].index(s.partition_name)
+        with store._lock:
+            coupled = self._coupled_global(store)
+            import numpy as np
+
+            def mask_fn(t, _store=store, _pid=pid, _spec=spec):
+                ids = _store.partition_ids(t)
+                return ids == _pid
+            if coupled:
+                n = self._delete_with_global(store, coupled, mask_fn)
+            else:
+                n = store.delete_where(mask_fn, self._tctx(store))
+            # remap surviving regions' partition tags past the dropped slot
+            spec["names"].pop(pid)
+            spec["uppers"].pop(pid)
+            for r in store.regions:
+                if r.part == pid:
+                    r.part = -1          # now empty; tag cleared
+                elif r.part > pid:
+                    r.part -= 1
+            info.version += 1
+            store._mutations += 1
+        self.db.save_catalog()
+        return Result(affected_rows=n)
+
     def _drop_global_backing(self, db: str, info, ix) -> None:
         from ..index import globalindex as gi
 
@@ -1359,6 +1450,8 @@ class Session:
             return self._alter_rollup(s, db, info)
         if s.action in ("add_index", "drop_index"):
             return self._alter_index(s, db, info)
+        if s.action in ("add_partition", "drop_partition"):
+            return self._alter_partition(s, db, info)
         fields = list(info.schema.fields)
         store = self._store(s.table)
         if s.action == "add_column":
@@ -2232,6 +2325,22 @@ class Session:
                 cache[ck] = b
             metrics.index_scans.add(1)
             return b
+        if access[0] == "partition":
+            _, parts, ptotal = access
+            keep, rtotal = store.prune_parts(parts)
+            if len(keep) == rtotal:
+                n.access_desc = "full"
+                return None         # tags unknown: nothing actually drops
+            n.access_desc = (f"partition({ptotal - len(parts)}/{ptotal} "
+                             f"partitions pruned)")
+            ck = (n.table_key, store.version, "part", tuple(sorted(keep)))
+            b = cache.get(ck)
+            if b is None:
+                b = ColumnBatch.from_arrow(store.regions_table(keep))
+                self._evict_access(n.table_key, store.version)
+                cache[ck] = b
+            metrics.regions_pruned.add(rtotal - len(keep))
+            return b
         if access[0] == "zonemap":
             keep, total = store.prune_regions(access[1])
             if len(keep) == total:
@@ -2282,6 +2391,10 @@ class Session:
                         elif access[0] == "global":
                             n.access_desc = \
                                 f"global_index({access[1]}:{access[2]})"
+                        elif access[0] == "partition":
+                            n.access_desc = (
+                                f"partition({access[2] - len(access[1])}"
+                                f"/{access[2]} partitions pruned)")
                         elif access[0] == "zonemap":
                             keep, total = store.prune_regions(access[1])
                             n.access_desc = (

@@ -195,6 +195,22 @@ def choose_access(info, store, pred: ScanPredicates,
             matches = bstore.secondary_count(col, pred.eq[col])
             if matches is not None and matches / n <= secondary_max_fraction:
                 return ("global", ix.name, col, pred.eq[col])
+    # table-partition pruning (reference: PartitionAnalyze,
+    # physical_planner.cpp:27-120): a predicate on the partition column
+    # drops whole partitions' regions before zone maps even look
+    spec = store.partition_spec() if hasattr(store, "partition_spec") \
+        else None
+    if spec is not None:
+        pc = spec["column"]
+        parts = None
+        if pc in pred.eq:
+            parts = store.partitions_for(eq_value=pred.eq[pc])
+        elif pc in pred.ranges:
+            parts = store.partitions_for(range_=tuple(pred.ranges[pc]))
+        if parts is not None:
+            total = len(spec.get("names") or []) or int(spec.get("n", 0))
+            if len(parts) < total:
+                return ("partition", parts, total)
     prunable = {c: r for c, r in pred.ranges.items()
                 if store.zone_map_column(c) is not None}
     if prunable:

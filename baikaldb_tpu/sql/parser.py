@@ -540,16 +540,89 @@ class Parser:
             if not self.try_op(","):
                 break
         self.expect_op(")")
+        partition = self._partition_by_clause()
         # table options (ENGINE=x, TTL=n, TTL_COLUMN=c, ...) -> options dict
         options: dict = {}
         while not self.at_end() and self.peek().value != ";":
+            if partition is None and self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "partition" and \
+                    self.peek(1).kind == "KW" and self.peek(1).value == "by":
+                # MySQL's standard order puts PARTITION BY after options;
+                # the lenient option loop must not swallow it silently
+                partition = self._partition_by_clause()
+                continue
             t = self.advance()
             if t.kind in ("IDENT", "KW") and self.try_op("="):
                 v = self.advance()
                 options[t.value.lower()] = v.value
+        if partition is not None:
+            options["partition"] = partition
         stmt = CreateTableStmt(table, cols, pk, indexes, ine)
         stmt.options = options
         return stmt
+
+    def _partition_literal(self):
+        """One VALUES LESS THAN bound: (literal) or MAXVALUE -> value|None."""
+        if self.peek().kind == "IDENT" and \
+                self.peek().value.lower() == "maxvalue":
+            self.advance()
+            return None
+        self.expect_op("(")
+        t = self.advance()
+        if t.kind == "NUM":
+            v = float(t.value) if "." in t.value else int(t.value)
+        elif t.kind == "STR":
+            v = t.value
+        else:
+            raise SqlError(f"expected partition bound literal, got "
+                           f"{t.value!r} at {t.pos}")
+        self.expect_op(")")
+        return v
+
+    def _partition_by_clause(self):
+        """PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (v), ...)
+        | PARTITION BY HASH (col) PARTITIONS n    (reference: range/hash
+        table partitions, schema_factory.h:427-533)."""
+        if not (self.peek().kind == "IDENT" and
+                self.peek().value.lower() == "partition"):
+            return None
+        self.advance()
+        self.expect_kw("by")
+        method = self.ident().lower()
+        self.expect_op("(")
+        pcol = self.ident()
+        self.expect_op(")")
+        if method == "hash":
+            w = self.ident()
+            if w.lower() != "partitions":
+                raise SqlError(f"expected PARTITIONS, got {w!r}")
+            t = self.advance()
+            if t.kind != "NUM" or "." in t.value:
+                raise SqlError(f"expected partition count, got {t.value!r}")
+            return {"kind": "hash", "column": pcol, "n": int(t.value)}
+        if method != "range":
+            raise SqlError(f"unsupported PARTITION BY {method!r}")
+        self.expect_op("(")
+        names: list[str] = []
+        uppers: list = []
+        while True:
+            w = self.ident()
+            if w.lower() != "partition":
+                raise SqlError(f"expected PARTITION, got {w!r}")
+            names.append(self.ident())
+            self.expect_kw("values")
+            for word in ("less", "than"):
+                w = self.ident()
+                if w.lower() != word:
+                    raise SqlError(f"expected {word.upper()}, got {w!r}")
+            uppers.append(self._partition_literal())
+            if uppers[-1] is None and self.peek().value == ",":
+                raise SqlError("MAXVALUE must be the last partition")
+            if not self.try_op(","):
+                break
+        self.expect_op(")")
+        return {"kind": "range", "column": pcol, "names": names,
+                "uppers": uppers}
 
     def _type_name(self) -> str:
         base = self.ident()
@@ -594,6 +667,26 @@ class Parser:
         table = self.table_name()
         from .stmt import AlterTableStmt
         if self.try_kw("add"):
+            if self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "partition":
+                # ADD PARTITION (PARTITION name VALUES LESS THAN (v))
+                self.advance()
+                self.expect_op("(")
+                w = self.ident()
+                if w.lower() != "partition":
+                    raise SqlError(f"expected PARTITION, got {w!r}")
+                pname = self.ident()
+                self.expect_kw("values")
+                for word in ("less", "than"):
+                    w = self.ident()
+                    if w.lower() != word:
+                        raise SqlError(f"expected {word.upper()}, "
+                                       f"got {w!r}")
+                upper = self._partition_literal()
+                self.expect_op(")")
+                return AlterTableStmt(table, "add_partition",
+                                      partition_name=pname,
+                                      partition_upper=upper)
             is_global_ix = (self.peek().kind == "IDENT" and
                             self.peek().value.lower() == "global" and
                             self.peek(1).kind == "KW" and
@@ -671,6 +764,11 @@ class Parser:
                 self.advance()
                 return AlterTableStmt(table, "drop_index",
                                       index_name=self.ident())
+            if self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "partition":
+                self.advance()
+                return AlterTableStmt(table, "drop_partition",
+                                      partition_name=self.ident())
             if self.peek().kind == "IDENT" and \
                     self.peek().value.lower() == "rollup":
                 self.advance()

@@ -112,6 +112,8 @@ class AlterTableStmt:
     index_kind: str = "key"      # key | unique | fulltext
     index_name: str = ""
     index_cols: list = field(default_factory=list)
+    partition_name: str = ""     # add_partition | drop_partition
+    partition_upper: object = None   # None = MAXVALUE
 
 
 @dataclass

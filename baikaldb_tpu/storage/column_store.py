@@ -99,6 +99,10 @@ class Region:
     data: pa.Table
     rowids: Optional[np.ndarray] = None      # int64 [num_rows]
     version: int = 1
+    # table-partition id this region belongs to (reference: partitioned
+    # tables place each partition's data in its own regions,
+    # schema_factory.h:427-533); -1 = unpartitioned/unknown
+    part: int = -1
     _device: Optional[ColumnBatch] = None
     _device_version: int = -1
 
@@ -721,6 +725,146 @@ class TableStore:
                     return r.data.slice(int(hit[0]), 1).to_pylist()[0]
         return None
 
+    # -- table partitioning (reference: range/hash partitions in
+    # SchemaInfo, schema_factory.h:427-533; PartitionAnalyze prunes) ------
+    def partition_spec(self) -> Optional[dict]:
+        """{"kind": "range", "column": c, "names": [...], "uppers": [...]}
+        (last upper None = MAXVALUE) or {"kind": "hash", "column": c,
+        "n": N} or None."""
+        return (self.info.options or {}).get("partition")
+
+    def _norm_part_scalar(self, v, f):
+        """One partition-column literal -> comparable numpy-friendly value
+        (temporal to epoch int, everything else as-is)."""
+        if v is None:
+            return None
+        if f.ltype.is_temporal and isinstance(v, str):
+            from ..expr.compile import parse_temporal
+
+            return parse_temporal(v, f.ltype)
+        if f.ltype.is_temporal:
+            import datetime
+
+            if isinstance(v, datetime.datetime):
+                return int((v - datetime.datetime(1970, 1, 1))
+                           .total_seconds() * 1e6)
+            if isinstance(v, datetime.date):
+                return (v - datetime.date(1970, 1, 1)).days
+        return v
+
+    def _norm_part_array(self, arr, f) -> np.ndarray:
+        if f.ltype.is_temporal:
+            if f.ltype is LType.DATE:
+                return np.asarray(arr.cast(pa.int32()).to_numpy(
+                    zero_copy_only=False), np.int64)
+            return np.asarray(arr.cast(pa.timestamp("us"))
+                              .cast(pa.int64()).to_numpy(
+                                  zero_copy_only=False), np.int64)
+        if f.ltype is LType.STRING:
+            return np.asarray(arr.to_pylist(), dtype=object)
+        return arr.to_numpy(zero_copy_only=False)
+
+    def partition_ids(self, table: pa.Table) -> np.ndarray:
+        """Partition id per row (raises when a value falls past the last
+        range bound and there is no MAXVALUE partition — MySQL's 'no
+        partition for value').  NULL keys route to partition 0 (MySQL
+        places NULL in the lowest partition); comparisons never match NULL,
+        so pruning stays correct regardless."""
+        spec = self.partition_spec()
+        f = self.info.schema.field(spec["column"])
+        arr = table.column(spec["column"])
+        null_mask = np.asarray(arr.is_null()) if arr.null_count else None
+        if null_mask is not None:
+            import datetime
+
+            if f.ltype is LType.STRING:
+                fill = ""
+            elif f.ltype is LType.DATE:
+                fill = datetime.date(1970, 1, 1)
+            elif f.ltype.is_temporal:
+                fill = datetime.datetime(1970, 1, 1)
+            else:
+                fill = 0
+            import pyarrow.compute as pc
+
+            arr = pc.fill_null(arr, fill)
+        vals = self._norm_part_array(arr, f)
+        if spec["kind"] == "hash":
+            n = int(spec["n"])
+            if vals.dtype == object:
+                from .replicated import _fnv64
+
+                pids = np.fromiter(
+                    (_fnv64(str(v).encode()) % n for v in vals),
+                    dtype=np.int64, count=len(vals))
+            else:
+                pids = (vals.astype(np.int64) % n + n) % n
+            if null_mask is not None:
+                pids[null_mask] = 0
+            return pids
+        uppers = [self._norm_part_scalar(u, f) for u in spec["uppers"]]
+        has_max = uppers and uppers[-1] is None
+        finite = np.array([u for u in uppers if u is not None],
+                          dtype=object if vals.dtype == object else None)
+        pids = np.searchsorted(finite, vals, side="right")
+        if null_mask is not None:
+            pids[null_mask] = 0
+        if not has_max and len(finite):
+            over = pids >= len(finite)
+            if null_mask is not None:
+                over = over & ~null_mask
+            if over.any():
+                bad = vals[over][0]
+                raise ValueError(
+                    f"table {self.info.name!r} has no partition for value "
+                    f"{bad!r} in column {spec['column']!r}")
+        return pids
+
+    def partitions_for(self, eq_value=None, range_=None) -> Optional[set]:
+        """Partition ids a predicate on the partition column can touch, or
+        None when the predicate cannot prune (e.g. range on hash)."""
+        spec = self.partition_spec()
+        if spec is None:
+            return None
+        f = self.info.schema.field(spec["column"])
+        if eq_value is not None:
+            t = pa.table({spec["column"]:
+                          pa.array([eq_value]).cast(
+                              schema_to_arrow(self.info.schema)
+                              .field(spec["column"]).type)})
+            try:
+                return {int(self.partition_ids(t)[0])}
+            except ValueError:
+                return set()          # value past all bounds: matches none
+        if spec["kind"] != "range" or range_ is None:
+            return None
+        lo, hi = range_
+        uppers = [self._norm_part_scalar(u, f) for u in spec["uppers"]]
+        finite = [u for u in uppers if u is not None]
+        nparts = len(spec["uppers"])
+        lo_n = self._norm_part_scalar(lo, f) if lo is not None else None
+        hi_n = self._norm_part_scalar(hi, f) if hi is not None else None
+        import bisect
+
+        # ScanPredicates ranges are CLOSED ([lo, hi]) — the partition
+        # holding hi itself must stay (side='right' matches partition_ids'
+        # searchsorted routing)
+        first = bisect.bisect_right(finite, lo_n) if lo_n is not None else 0
+        last = bisect.bisect_right(finite, hi_n) if hi_n is not None \
+            else nparts - 1
+        return set(range(first, min(last, nparts - 1) + 1))
+
+    def prune_parts(self, parts: set) -> tuple[list[int], int]:
+        """(kept region INDEXES — regions_table's addressing — and total
+        regions): regions tagged with a pruned partition drop; untagged
+        (part=-1, e.g. reloaded from an old checkpoint) regions always
+        stay — pruning must be conservative."""
+        with self._lock:
+            keep = [i for i, r in enumerate(self.regions)
+                    if r.num_rows and (r.part == -1 or r.part in parts)]
+            total = sum(1 for r in self.regions if r.num_rows)
+            return keep, total
+
     def lookup_by_pks(self, pk_table: pa.Table) -> pa.Table:
         """Gather full rows matching the given primary-key values — the
         global-index LOOKUP JOIN (reference: select_manager_node.cpp:1081,
@@ -821,12 +965,38 @@ class TableStore:
                     self._auto_incr = int(mx)
                 else:
                     self._auto_incr = max(self._auto_incr, int(mx))
-        last = self.regions[-1]
-        last.data = pa.concat_tables([last.data, table]).combine_chunks()
-        last.rowids = np.concatenate([last.rowids, rowids])
-        last.version += 1
-        if split:
-            self._maybe_split(last)
+        spec = self.partition_spec()
+        if spec is None:
+            last = self.regions[-1]
+            last.data = pa.concat_tables([last.data, table]).combine_chunks()
+            last.rowids = np.concatenate([last.rowids, rowids])
+            last.version += 1
+            if split:
+                self._maybe_split(last)
+            return
+        # partitioned table: each partition's rows land in that partition's
+        # OWN regions (reference: per-partition regions,
+        # schema_factory.h:427-533, PartitionAnalyze routing)
+        pids = self.partition_ids(table)
+        for pid in np.unique(pids):
+            m = pids == pid
+            sub = table.filter(pa.array(m))
+            subids = rowids[m]
+            reg = None
+            for r in reversed(self.regions):
+                if r.part == int(pid):
+                    reg = r
+                    break
+            if reg is None:
+                reg = Region(self._alloc_region_id(),
+                             self.arrow_schema.empty_table(),
+                             part=int(pid))
+                self.regions.append(reg)
+            reg.data = pa.concat_tables([reg.data, sub]).combine_chunks()
+            reg.rowids = np.concatenate([reg.rowids, subids])
+            reg.version += 1
+            if split:
+                self._maybe_split(reg)
 
     def insert_arrow(self, table: pa.Table, tctx: Optional[TxnContext] = None,
                      check_dups: bool = False):
@@ -1057,7 +1227,7 @@ class TableStore:
             region.rowids = keep_ids
             region.version += 1
             new = Region(self._alloc_region_id(), rest.combine_chunks(),
-                         rest_ids)
+                         rest_ids, part=region.part)
             self.regions.append(new)
             region = new
 
@@ -1123,8 +1293,9 @@ class TableStore:
                     os.remove(os.path.join(directory, f))
             for r in self.regions:
                 t = r.data.append_column(ROWID, pa.array(r.rowids, pa.int64()))
-                pq.write_table(t, os.path.join(directory,
-                                               f"region_{r.region_id}.parquet"))
+                suffix = f"_p{r.part}" if r.part >= 0 else ""
+                pq.write_table(t, os.path.join(
+                    directory, f"region_{r.region_id}{suffix}.parquet"))
 
     def load_parquet(self, directory: str):
         files = sorted(f for f in os.listdir(directory) if f.endswith(".parquet"))
@@ -1143,9 +1314,16 @@ class TableStore:
                 if len(rowids):
                     self._next_rowid = max(self._next_rowid,
                                            int(rowids.max()) + 1)
+                part = -1
+                stem = f[:-len(".parquet")]
+                if "_p" in stem:
+                    try:
+                        part = int(stem.rsplit("_p", 1)[1])
+                    except ValueError:
+                        part = -1
                 self.regions.append(Region(self._alloc_region_id(),
                                            _coerce(t, self.arrow_schema),
-                                           rowids))
+                                           rowids, part=part))
             if not self.regions:
                 self.regions = [Region(self._alloc_region_id(),
                                        self.arrow_schema.empty_table())]
