@@ -31,10 +31,11 @@ from ..ops.hashagg import (AggSpec, MERGE_OP, finalize_partials,
                            group_aggregate_dense, group_aggregate_sorted,
                            partial_specs, scalar_aggregate)
 from ..ops.sort import SortKey, sort_batch, top_k
+from ..ops.compact import shrink
 from ..plan.nodes import (AggNode, DistinctNode, ExchangeNode, FilterNode,
                           JoinNode, LimitNode, MembershipNode, PlanNode,
-                          ProjectNode, ScalarSourceNode, ScanNode, SortNode,
-                          UnionNode, ValuesNode, WindowNode)
+                          ProjectNode, ScalarSourceNode, ScanNode, ShrinkNode,
+                          SortNode, UnionNode, ValuesNode, WindowNode)
 from ..column.batch import concat_batches
 from ..parallel.mesh import AXIS, shard_map
 from ..types import LType
@@ -134,6 +135,17 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
     if isinstance(node, FilterNode):
         child = _sub(node.child(), batches, overflows, ctx)
         return child.and_sel(eval_predicate(node.pred, child))
+
+    if isinstance(node, ShrinkNode):
+        child = _sub(node.child(), batches, overflows, ctx)
+        if node.cap is None:
+            # first trace: guess a 16x cut; the flag reports the true live
+            # count, so one retry lands exactly when the guess is short
+            node.cap = max(1024, 1 << (max(1, len(child) // 16)
+                                       - 1).bit_length())
+        out, needed = shrink(child, node.cap)
+        overflows.append((node, needed))
+        return out
 
     if isinstance(node, ProjectNode):
         child = _sub(node.child(), batches, overflows, ctx)

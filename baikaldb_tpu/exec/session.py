@@ -1092,10 +1092,18 @@ class Session:
             if pspec["kind"] == "range":
                 if len(set(pspec["names"])) != len(pspec["names"]):
                     raise PlanError("duplicate partition name")
-                finite = [u for u in pspec["uppers"] if u is not None]
-                if any(b <= a for a, b in zip(finite, finite[1:])):
-                    raise PlanError("partition bounds must be strictly "
-                                    "increasing")
+                pf = next(f for f in fields if f.name == pspec["column"])
+                try:
+                    finite = [TableStore._norm_part_scalar(u, pf)
+                              for u in pspec["uppers"] if u is not None]
+                    if any(b <= a for a, b in zip(finite, finite[1:])):
+                        raise PlanError("partition bounds must be strictly "
+                                        "increasing")
+                except (TypeError, ValueError) as e:
+                    if isinstance(e, PlanError):
+                        raise
+                    raise PlanError(f"partition bounds do not match column "
+                                    f"{pspec['column']!r}: {e}") from None
             elif pspec["kind"] == "hash" and int(pspec["n"]) < 1:
                 raise PlanError("PARTITIONS must be at least 1")
         auto_cols = [c for c in s.columns if c.auto_increment]

@@ -31,6 +31,33 @@ def compact(batch: ColumnBatch) -> ColumnBatch:
     return out
 
 
+def shrink(batch: ColumnBatch, cap: int):
+    """Pack live rows into a batch of STATIC capacity ``cap`` (smaller than
+    the input's), returning (packed batch, needed live count).
+
+    The sel-mask architecture never compacts, so a selective join chain
+    drags the base table's full capacity through every downstream operator
+    — a 1.2M-lane gather/searchsorted per op for 10k live rows (the TPC-H
+    q21 profile).  ``shrink`` is the capacity cut: one nonzero+gather pass,
+    then everything above runs at ``cap``.  When the live count exceeds
+    ``cap`` the caller's overflow-retry protocol re-traces with a bigger
+    cap (same contract as the join cap flags).
+    """
+    if cap >= len(batch):
+        return batch, jnp.int32(0)        # no cut possible: pass through
+    sel = batch.sel
+    if sel is None:
+        n = jnp.int32(len(batch)) if batch.num_rows is None \
+            else jnp.asarray(batch.num_rows, jnp.int32)
+        sel = jnp.arange(len(batch)) < n
+    n = jnp.sum(sel).astype(jnp.int32)
+    (idx,) = jnp.nonzero(sel, size=cap, fill_value=0)
+    out = batch.gather(idx)
+    out.sel = jnp.arange(cap) < jnp.minimum(n, cap)
+    out.num_rows = None
+    return out, n
+
+
 def head(batch: ColumnBatch, limit: int, offset: int = 0) -> ColumnBatch:
     """LIMIT/OFFSET over live rows (reference: src/exec/limit_node.cpp)."""
     b = compact(batch)

@@ -33,8 +33,8 @@ from typing import Callable, Optional
 
 from .nodes import (AggNode, DistinctNode, ExchangeNode, FilterNode, JoinNode,
                     LimitNode, MembershipNode, PlanNode, ProjectNode,
-                    ScalarSourceNode, ScanNode, SortNode, UnionNode,
-                    ValuesNode, WindowNode)
+                    ScalarSourceNode, ScanNode, ShrinkNode, SortNode,
+                    UnionNode, ValuesNode, WindowNode)
 
 SHARD = "shard"
 REP = "rep"
@@ -106,6 +106,12 @@ class _Distributor:
             return REP, max(1, len(node.exprs))
 
         if isinstance(node, (FilterNode, ProjectNode)):
+            return self.visit(node.child())
+
+        if isinstance(node, ShrinkNode):
+            # shard-local capacity cut; the needed-capacity flag is pmax'd
+            # across shards by the executor, so every shard re-traces to the
+            # hungriest shard's cap
             return self.visit(node.child())
 
         if isinstance(node, JoinNode):

@@ -154,6 +154,18 @@ class SortNode(PlanNode):
 
 
 @dataclass
+class ShrinkNode(PlanNode):
+    """Adaptive capacity cut: pack live rows into a smaller static batch so
+    downstream operators stop paying the base table's full capacity for a
+    selective subtree (ops/compact.shrink).  ``cap`` settles through the
+    session's overflow-retry loop exactly like join caps."""
+    cap: Optional[int] = None
+
+    def _label(self):
+        return f"Shrink(cap={self.cap})"
+
+
+@dataclass
 class LimitNode(PlanNode):
     limit: int = 0
     offset: int = 0

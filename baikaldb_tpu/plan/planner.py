@@ -111,7 +111,46 @@ class Planner:
     def plan_select(self, stmt: SelectStmt) -> PlanNode:
         plan = self._plan_query(stmt)
         self._prune_columns(plan)
+        plan = self._insert_shrinks(plan)
         return plan
+
+    def _insert_shrinks(self, plan: PlanNode) -> PlanNode:
+        """Adaptive capacity cuts (ops/compact.shrink): a selective probe
+        subtree otherwise drags the base table's full capacity through every
+        operator above it — each a capacity-proportional gather/searchsorted
+        (the q21 profile: 10k live rows riding 1.2M-lane kernels).  Insert a
+        Shrink (a) under the probe side of semi/anti and sort joins when
+        that side has already been filtered by a join, and (b) above the
+        topmost semi/anti join feeding non-join operators.  Never on a
+        build side — that would break the host-presort position contract
+        (_position_preserving)."""
+        from .nodes import ShrinkNode
+
+        def selective(n: PlanNode) -> bool:
+            if isinstance(n, JoinNode):
+                return True
+            return any(selective(c) for c in n.children)
+
+        def walk(n: PlanNode, parent) -> None:
+            if isinstance(n, JoinNode) and n.how in ("semi", "anti") or \
+                    (isinstance(n, JoinNode) and n.strategy != "dense"
+                     and n.how in ("inner", "left")):
+                probe = n.children[0]
+                if not isinstance(probe, ShrinkNode) and selective(probe):
+                    n.children[0] = ShrinkNode(children=[probe],
+                                               schema=probe.schema)
+            if isinstance(parent, (FilterNode, ProjectNode, AggNode,
+                                   SortNode)) and isinstance(n, JoinNode) \
+                    and n.how in ("semi", "anti") and selective(n):
+                i = parent.children.index(n)
+                parent.children[i] = ShrinkNode(children=[n],
+                                                schema=n.schema)
+            for c in list(n.children):
+                walk(c, n)
+
+        root = PlanNode(children=[plan])
+        walk(plan, root)
+        return root.children[0]
 
     def _plan_query(self, stmt: SelectStmt) -> PlanNode:
         # WITH scopes over the WHOLE statement including every union arm

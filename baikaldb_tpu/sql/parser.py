@@ -579,6 +579,20 @@ class Parser:
         self.expect_op(")")
         return v
 
+    def _partition_def(self):
+        """PARTITION <name> VALUES LESS THAN (lit)|MAXVALUE -> (name, upper)
+        — shared by CREATE's partition list and ALTER ADD PARTITION."""
+        w = self.ident()
+        if w.lower() != "partition":
+            raise SqlError(f"expected PARTITION, got {w!r}")
+        name = self.ident()
+        self.expect_kw("values")
+        for word in ("less", "than"):
+            w = self.ident()
+            if w.lower() != word:
+                raise SqlError(f"expected {word.upper()}, got {w!r}")
+        return name, self._partition_literal()
+
     def _partition_by_clause(self):
         """PARTITION BY RANGE (col) (PARTITION p VALUES LESS THAN (v), ...)
         | PARTITION BY HASH (col) PARTITIONS n    (reference: range/hash
@@ -606,17 +620,10 @@ class Parser:
         names: list[str] = []
         uppers: list = []
         while True:
-            w = self.ident()
-            if w.lower() != "partition":
-                raise SqlError(f"expected PARTITION, got {w!r}")
-            names.append(self.ident())
-            self.expect_kw("values")
-            for word in ("less", "than"):
-                w = self.ident()
-                if w.lower() != word:
-                    raise SqlError(f"expected {word.upper()}, got {w!r}")
-            uppers.append(self._partition_literal())
-            if uppers[-1] is None and self.peek().value == ",":
+            name, upper = self._partition_def()
+            names.append(name)
+            uppers.append(upper)
+            if upper is None and self.peek().value == ",":
                 raise SqlError("MAXVALUE must be the last partition")
             if not self.try_op(","):
                 break
@@ -672,17 +679,7 @@ class Parser:
                 # ADD PARTITION (PARTITION name VALUES LESS THAN (v))
                 self.advance()
                 self.expect_op("(")
-                w = self.ident()
-                if w.lower() != "partition":
-                    raise SqlError(f"expected PARTITION, got {w!r}")
-                pname = self.ident()
-                self.expect_kw("values")
-                for word in ("less", "than"):
-                    w = self.ident()
-                    if w.lower() != word:
-                        raise SqlError(f"expected {word.upper()}, "
-                                       f"got {w!r}")
-                upper = self._partition_literal()
+                pname, upper = self._partition_def()
                 self.expect_op(")")
                 return AlterTableStmt(table, "add_partition",
                                       partition_name=pname,

@@ -733,7 +733,8 @@ class TableStore:
         "n": N} or None."""
         return (self.info.options or {}).get("partition")
 
-    def _norm_part_scalar(self, v, f):
+    @staticmethod
+    def _norm_part_scalar(v, f):
         """One partition-column literal -> comparable numpy-friendly value
         (temporal to epoch int, everything else as-is)."""
         if v is None:
@@ -853,6 +854,29 @@ class TableStore:
         last = bisect.bisect_right(finite, hi_n) if hi_n is not None \
             else nparts - 1
         return set(range(first, min(last, nparts - 1) + 1))
+
+    def _rehome_partition_rows(self) -> None:
+        """Move rows whose partition-column value no longer matches their
+        region's tag into the right partition's regions (post-UPDATE; the
+        caller holds self._lock and has already validated routability)."""
+        moved_tabs, moved_ids = [], []
+        for r in self.regions:
+            if r.part < 0 or not r.num_rows:
+                continue
+            ids = self.partition_ids(r.data)
+            wrong = ids != r.part
+            if not wrong.any():
+                continue
+            m = pa.array(wrong)
+            moved_tabs.append(r.data.filter(m))
+            moved_ids.append(r.rowids[wrong])
+            r.data = r.data.filter(pa.array(~wrong))
+            r.rowids = r.rowids[~wrong]
+            r.version += 1
+        if moved_tabs:
+            self._pk_stale = True
+            self._append_table(pa.concat_tables(moved_tabs).combine_chunks(),
+                               np.concatenate(moved_ids))
 
     def prune_parts(self, parts: set) -> tuple[list[int], int]:
         """(kept region INDEXES — regions_table's addressing — and total
@@ -1009,6 +1033,8 @@ class TableStore:
             self._writer_check(tctx)
             if check_dups:
                 self._check_duplicates(table)
+            if self.partition_spec() is not None:
+                self.partition_ids(table)   # reject before durable writes
             rowids = self._alloc_rowids(table.num_rows)
             if self.replicated is not None:
                 # replicated tables have no "cold only" ingest: a rebuild
@@ -1030,6 +1056,10 @@ class TableStore:
         with self._lock:
             self._writer_check(tctx)
             new_keys = self._check_duplicates(table)
+            if self.partition_spec() is not None:
+                self.partition_ids(table)   # reject BEFORE the durable
+                #                             write: WAL/raft replay must
+                #                             never hold an unroutable row
             self._mutations += 1
             rowids = self._alloc_rowids(len(rows))
             recs = [dict(r, **{ROWID: int(rid)})
@@ -1135,6 +1165,15 @@ class TableStore:
                     new_rows = new_data.filter(pa.array(mask)).to_pylist()
                     hot.extend(dict(row, **{ROWID: int(rid)})
                                for row, rid in zip(new_rows, r.rowids[mask]))
+            spec = self.partition_spec()
+            part_moved = spec is not None and staged and (
+                changed_cols is None or spec["column"] in changed_cols)
+            if part_moved and not dry_run:
+                # validate BEFORE any durable write: a new value past the
+                # last range bound must fail the statement, not strand a
+                # WAL/raft row that later replay cannot route
+                for r, new_data in staged:
+                    self.partition_ids(new_data)
             if not staged or dry_run:
                 # dry_run: phase 1 only — the would-be old/new rows for a
                 # pre-mutation constraint check (global UNIQUE), nothing
@@ -1158,6 +1197,11 @@ class TableStore:
             for r, new_data in staged:
                 r.data = new_data
                 r.version += 1
+            if part_moved:
+                # rows whose partition-column value changed must MOVE to
+                # their new partition's regions, or the stale region tag
+                # makes pruning silently drop them from results
+                self._rehome_partition_rows()
         if collect_cols is not None:
             return (updated,
                     pa.concat_tables(old_rows).combine_chunks(),

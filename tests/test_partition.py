@@ -200,6 +200,45 @@ def test_partition_bounds_validated():
                   "PARTITION p0 VALUES LESS THAN (10))")
 
 
+def test_update_moves_row_across_partitions():
+    """UPDATE changing the partition-column value must MOVE the row to its
+    new partition's regions — a stale region tag would make pruning drop
+    it from results."""
+    s = mk()
+    s.execute("CREATE TABLE t (id BIGINT, v BIGINT, PRIMARY KEY (id)) "
+              "PARTITION BY RANGE (v) ("
+              "PARTITION p0 VALUES LESS THAN (10), "
+              "PARTITION p1 VALUES LESS THAN (20))")
+    s.execute("INSERT INTO t VALUES (1, 5), (2, 15)")
+    s.execute("UPDATE t SET v = 15 WHERE id = 1")
+    got = s.query("SELECT id FROM t WHERE v = 15 ORDER BY id")
+    assert [r["id"] for r in got] == [1, 2]
+    store = s.db.stores[f"{s.current_db}.t"]
+    for r in store.regions:
+        if r.num_rows and r.part >= 0:
+            assert set(store.partition_ids(r.data).tolist()) == {r.part}
+    # moving OUT of every range fails the statement cleanly
+    with pytest.raises(Exception, match="no partition for value"):
+        s.execute("UPDATE t SET v = 99 WHERE id = 1")
+    assert s.query("SELECT COUNT(*) n FROM t") == [{"n": 2}]
+
+
+def test_unroutable_insert_does_not_strand_wal_row(tmp_path):
+    """A rejected INSERT (no partition for value) must not leave a durable
+    WAL row that bricks replay on reopen."""
+    d = str(tmp_path / "db")
+    s = Session(Database(data_dir=d))
+    s.execute("CREATE TABLE t (id BIGINT, v BIGINT, PRIMARY KEY (id)) "
+              "PARTITION BY RANGE (v) "
+              "(PARTITION p0 VALUES LESS THAN (10))")
+    s.execute("INSERT INTO t VALUES (1, 5)")
+    with pytest.raises(Exception, match="no partition for value"):
+        s.execute("INSERT INTO t VALUES (2, 25)")
+    # reopen: replay must succeed and hold exactly the committed row
+    s2 = Session(Database(data_dir=d))
+    assert s2.query("SELECT id FROM t") == [{"id": 1}]
+
+
 def test_partitions_survive_checkpoint_reload(tmp_path):
     d = str(tmp_path / "db")
     s = Session(Database(data_dir=d))
