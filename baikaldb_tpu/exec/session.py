@@ -39,7 +39,11 @@ from ..storage.column_store import ROWID as ROWID_COL
 from ..storage.column_store import TableStore, schema_to_arrow
 from ..types import Field, LType, Schema
 from ..utils import metrics
-from ..utils.flags import FLAGS
+from ..utils.flags import FLAGS, define
+
+define("cold_fs_dir", "",
+       "external cold-storage root (posix AFS stand-in); empty = cold "
+       "tier disabled")
 from .executor import compile_plan
 
 # join overflow retry budget lives in FLAGS.join_retry_max: retries settle
@@ -169,7 +173,7 @@ class Database:
     of baikalStore restart recovery (SURVEY §3.4)."""
 
     def __init__(self, data_dir: Optional[str] = None, fleet=None,
-                 cluster=None):
+                 cluster=None, cold_dir: Optional[str] = None):
         """``fleet``: a raft.fleet.StoreFleet — when set, every table's hot
         row tier is raft-replicated across the fleet's store nodes (DML
         quorum-commits through region raft groups; a new Database over the
@@ -207,6 +211,10 @@ class Database:
         # wire server (reference: show processlist over NetworkServer conns)
         self.processlist: dict[int, dict] = {}
         self.data_dir = data_dir
+        # external cold-storage FS (AFS stand-in, storage/coldfs): segment
+        # bytes live here, manifests replicate through the region groups
+        self.cold_dir = cold_dir
+        self._cold_fs = None
         if data_dir:
             import os
             os.makedirs(data_dir, exist_ok=True)
@@ -220,6 +228,19 @@ class Database:
     def store(self, key: str) -> TableStore:
         return self.stores[key]
 
+    def cold_fs(self, required: bool = False):
+        """The external cold-storage FS, or None when unconfigured."""
+        if self._cold_fs is None:
+            root = self.cold_dir or str(FLAGS.cold_fs_dir)
+            if root:
+                from ..storage.coldfs import ExternalFS
+
+                self._cold_fs = ExternalFS(root)
+        if required and self._cold_fs is None:
+            raise PlanError("no cold storage configured (set cold_dir or "
+                            "the cold_fs_dir flag)")
+        return self._cold_fs
+
     def make_store(self, info) -> TableStore:
         """Create a table's store; durable (WAL-attached) under data_dir,
         raft-replicated when the Database is fleet-bound."""
@@ -230,7 +251,16 @@ class Database:
             tier = ReplicatedRowTier.get_or_create(
                 self.fleet, info.table_id, key, st._row_schema(),
                 [ROWID_COL])
-            st.attach_replicated(tier)
+            fs = self.cold_fs()
+            if fs is None and tier.has_cold():
+                # the manifests record cold segments this frontend cannot
+                # read: rebuilding from the (evicted) hot tier alone would
+                # silently lose rows
+                raise ValueError(
+                    f"table {key!r} has cold segments but no cold storage "
+                    f"is configured (set cold_dir or the cold_fs_dir flag)")
+            cold = tier.cold_rows(fs) if fs is not None else None
+            st.attach_replicated(tier, cold_rows=cold)
             return st
         if self.cluster is not None:
             from ..storage.remote_tier import RemoteRowTier
@@ -894,6 +924,32 @@ class Session:
         if s.command in ("store_heartbeat", "balance_tick"):
             # one control-loop turn: heartbeats in, balance orders executed
             return Result(affected_rows=self._fleet_required().control_tick())
+        if s.command in ("cold_flush", "cold_gc", "cold_status") and s.args:
+            # handle cold_flush <db.table> [upto_rowid]: hot rows -> one
+            # immutable segment per region on the external FS, manifest +
+            # eviction raft-committed (region_olap.cpp:445 flush_to_cold);
+            # cold_gc merges segments (latest version per rowid, deletes
+            # dropped); cold_status reports hot bytes + manifest size
+            has_upto = s.command == "cold_flush" and len(s.args) > 1 and \
+                str(s.args[-1]).isdigit()
+            key = "".join(s.args[:-1] if has_upto else s.args)
+            st = self.db.stores.get(key)
+            if st is None or st.replicated is None or \
+                    not hasattr(st.replicated, "flush_cold"):
+                raise PlanError(f"no cold-capable replicated tier for "
+                                f"{key!r}")
+            fs = self.db.cold_fs(required=True)
+            tier = st.replicated
+            if s.command == "cold_flush":
+                upto = int(s.args[-1]) if has_upto else None
+                return Result(affected_rows=tier.flush_cold(fs, upto=upto))
+            if s.command == "cold_gc":
+                return Result(affected_rows=tier.cold_gc(fs))
+            entries = sum(len(self._cold_manifest_of(tier, i))
+                          for i in range(len(tier.groups)))
+            return Result(columns=["hot_bytes", "cold_segments"], arrow=(
+                pa.table({"hot_bytes": [tier.hot_bytes()],
+                          "cold_segments": [entries]})))
         if s.command == "compact":
             # raft log compaction across every replicated tier (the
             # space-efficient snapshot scheme)
@@ -905,6 +961,11 @@ class Session:
                     fleet.meta.compact_all()
             return Result()
         raise SqlError(f"unsupported HANDLE command {s.command!r}")
+
+    @staticmethod
+    def _cold_manifest_of(tier, i):
+        g = tier.groups[i]
+        return g.bus.nodes[g.leader()].cold_manifest
 
     def _fleet_required(self):
         if self.db.fleet is None:

@@ -34,6 +34,10 @@ CMD_ROLLBACK = 3     # drop buffered txn_id
 CMD_DECIDE = 4       # primary-region commit decision record
 CMD_SET_RANGE = 5    # split/merge finalize: shrink/grow key range + version
 CMD_TRIM = 6         # drop keys outside the region's range (post-split GC)
+CMD_COLD = 7         # cold-tier manifest op + hot eviction (region_olap
+#                      flush_to_cold analog: segment bytes live on the
+#                      external FS, the manifest and the eviction watermark
+#                      replicate here)
 
 
 def encode_range(version: int, start: bytes, end: bytes) -> bytes:
@@ -121,6 +125,11 @@ class ReplicatedRegion:
         # only rolls back prepares older than a grace window, so it cannot
         # abort a live coordinator mid-2PC (the reference's txn timeout)
         self.prepared_at: dict[int, float] = {}
+        # cold-tier manifest: ordered (seq, file, watermark) entries.  The
+        # segment FILES live on the external FS; this list is the raft-
+        # replicated truth about which segments exist and which rowid range
+        # was evicted from the hot table (region_olap.cpp:727-882)
+        self.cold_manifest: list[tuple[int, str, int]] = []
         # key-range ownership: [start_key, end_key) with b"" = unbounded;
         # range_version bumps at every split/merge finalize (the reference's
         # region version used to reject stale-routed requests,
@@ -169,6 +178,8 @@ class ReplicatedRegion:
                             if not self._covers(k)]
                     if dead:
                         self.table.write_batch(dead)
+                elif cmd == CMD_COLD:
+                    self._apply_cold(body)
                 self.applied_index = c.index
             elif c.kind == SNAPSHOT_KIND:
                 self._install_snapshot(c.data)
@@ -176,6 +187,30 @@ class ReplicatedRegion:
             else:
                 self.applied_index = c.index
         return commits
+
+    def _apply_cold(self, body: bytes) -> None:
+        """Cold-tier manifest op, deterministic on every replica.
+        add:   record (seq, file, watermark) and EVICT hot rows with
+               rowid <= watermark (the bytes already sit immutably on the
+               external FS — written by the flush coordinator BEFORE this
+               committed).  Eviction is not deletion: the rows live on in
+               the segment and recovery replays cold-then-hot.
+        reset: replace this region's whole manifest (cold GC/merge)."""
+        import json as _json
+
+        m = _json.loads(body.decode())
+        if m["op"] == "add":
+            self.cold_manifest.append((int(m["seq"]), m["file"],
+                                       int(m["watermark"])))
+            wkey = self.table.key_codec.encode_one(
+                {self.key_columns[0]: int(m["watermark"])})
+            dead = [(1, k, b"") for k, _ in self.table.scan_raw()
+                    if k <= wkey]
+            if dead:
+                self.table.write_batch(dead)
+        elif m["op"] == "reset":
+            self.cold_manifest = [(int(s), f, int(w))
+                                  for s, f, w in m["entries"]]
 
     def _covers(self, key: bytes) -> bool:
         if self.start_key and key < self.start_key:
@@ -206,6 +241,10 @@ class ReplicatedRegion:
             out.append(struct.pack("<QB", txn, d))
         rng = encode_range(self.range_version, self.start_key, self.end_key)
         out.append(struct.pack("<I", len(rng)) + rng)
+        import json as _json
+
+        cold = _json.dumps(self.cold_manifest).encode()
+        out.append(struct.pack("<I", len(cold)) + cold)
         return b"".join(out)
 
     def _install_snapshot(self, data: bytes):
@@ -243,11 +282,21 @@ class ReplicatedRegion:
             txn, d = struct.unpack_from("<QB", data, pos)
             pos += 9
             self.decisions[txn] = d
+        self.cold_manifest = []
         if pos < len(data):
             (rlen,) = struct.unpack_from("<I", data, pos)
             pos += 4
             v, s, e = decode_range(data[pos:pos + rlen])
             self.start_key, self.end_key, self.range_version = s, e, v
+            pos += rlen
+        if pos < len(data):
+            import json as _json
+
+            (clen,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            self.cold_manifest = [
+                (int(sq), f, int(w))
+                for sq, f, w in _json.loads(data[pos:pos + clen].decode())]
 
     def compact(self):
         """Snapshot own state into the core, truncating the log (the

@@ -1,0 +1,84 @@
+"""External cold-storage filesystem (the AFS stand-in).
+
+The reference offloads cold data as immutable SSTs/Parquet onto an external
+filesystem with posix and AFS backends
+(/root/reference/src/engine/external_filesystem.cpp:93-111) and keeps the
+authoritative manifest in raft (region_olap.cpp:727-882 olap state sync).
+Here ``ExternalFS`` is the posix backend of that abstraction: atomic puts
+of immutable segment files, named reads, listing and GC deletes.  The
+manifest itself never lives here — it replicates through the region groups
+(raft/cluster.py CMD_COLD), exactly the reference's split of durability
+responsibilities: bytes on the external FS, truth in consensus.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+
+class ExternalFS:
+    """Posix-dir backend; the API is the AFS-client shape (open/read/write/
+    list/remove) so a real AFS/HDFS client can slot in behind it."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        safe = name.replace("/", "_")
+        return os.path.join(self.root, safe)
+
+    def put(self, name: str, data: bytes) -> None:
+        """Atomic immutable write (segments are never modified in place)."""
+        tmp = self._path(name) + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path(name))
+
+    def get(self, name: str) -> bytes:
+        with open(self._path(name), "rb") as f:
+            return f.read()
+
+    def exists(self, name: str) -> bool:
+        return os.path.exists(self._path(name))
+
+    def list(self) -> list[str]:
+        return sorted(f for f in os.listdir(self.root)
+                      if not f.endswith(".tmp") and ".tmp." not in f)
+
+    def delete(self, name: str) -> None:
+        try:
+            os.remove(self._path(name))
+        except FileNotFoundError:
+            pass
+
+
+def segment_bytes(rows: list[dict], arrow_schema: pa.Schema) -> bytes:
+    """Serialize row dicts (incl. __rowid / __del) into one immutable
+    Parquet segment."""
+    # deltas, not final rows: __del markers carry NULLs in every data
+    # column, so the segment schema is fully nullable regardless of the
+    # table's declared constraints
+    arrow_schema = pa.schema([pa.field(f.name, f.type, nullable=True)
+                              for f in arrow_schema])
+    arrays = []
+    for f in arrow_schema:
+        vals = [r.get(f.name) for r in rows]
+        if pa.types.is_boolean(f.type):
+            # the row codec decodes BOOL as 0/1 ints
+            vals = [None if v is None else bool(v) for v in vals]
+        arrays.append(pa.array(vals, type=f.type))
+    table = pa.Table.from_arrays(arrays, schema=arrow_schema)
+    buf = io.BytesIO()
+    pq.write_table(table, buf)
+    return buf.getvalue()
+
+
+def segment_rows(data: bytes) -> list[dict]:
+    return pq.read_table(io.BytesIO(data)).to_pylist()

@@ -301,13 +301,26 @@ class TableStore:
         self._build_row_tier(path)
         self._replay_hot(self.row_table.scan_rows())
 
-    def attach_replicated(self, tier):
+    def attach_replicated(self, tier, cold_rows: Optional[list] = None):
         """Bind this table to its raft-replicated hot tier and recover: the
         replicas' committed row state replays over the cold state, exactly
         like a WAL replay — but the log here survives any single node (the
-        on_snapshot_load_for_restart analog, include/store/region.h:644)."""
+        on_snapshot_load_for_restart analog, include/store/region.h:644).
+
+        ``cold_rows``: manifest-ordered rows from the external cold tier
+        (storage/coldfs) — they replay FIRST, with the hot tier's (newer)
+        versions winning per rowid, so a SELECT transparently spans
+        hot + cold (region_olap.cpp's cold-SST + hot-Rocks merge)."""
         self.replicated = tier
-        self._replay_hot(tier.scan_rows())
+        rows = tier.scan_rows()
+        if cold_rows:
+            merged: dict[int, dict] = {}
+            for r in cold_rows:
+                merged[int(r[ROWID])] = r
+            for r in rows:
+                merged[int(r[ROWID])] = r
+            rows = [merged[k] for k in sorted(merged)]
+        self._replay_hot(rows)
 
     def _replay_hot(self, rows: list[dict]):
         """Apply recovered hot-tier rows over cold state, advancing the
@@ -855,13 +868,17 @@ class TableStore:
             else nparts - 1
         return set(range(first, min(last, nparts - 1) + 1))
 
-    def _rehome_partition_rows(self) -> None:
+    def _rehome_partition_rows(self, only_ids: Optional[set] = None) -> None:
         """Move rows whose partition-column value no longer matches their
         region's tag into the right partition's regions (post-UPDATE; the
-        caller holds self._lock and has already validated routability)."""
+        caller holds self._lock and has already validated routability).
+        ``only_ids``: id()s of the regions the update actually staged —
+        the only ones that can hold misrouted rows."""
         moved_tabs, moved_ids = [], []
         for r in self.regions:
             if r.part < 0 or not r.num_rows:
+                continue
+            if only_ids is not None and id(r) not in only_ids:
                 continue
             ids = self.partition_ids(r.data)
             wrong = ids != r.part
@@ -1201,7 +1218,7 @@ class TableStore:
                 # rows whose partition-column value changed must MOVE to
                 # their new partition's regions, or the stale region tag
                 # makes pruning silently drop them from results
-                self._rehome_partition_rows()
+                self._rehome_partition_rows({id(r) for r, _ in staged})
         if collect_cols is not None:
             return (updated,
                     pa.concat_tables(old_rows).combine_chunks(),

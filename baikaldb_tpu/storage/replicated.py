@@ -33,7 +33,7 @@ import bisect
 import threading
 from typing import TYPE_CHECKING, Optional
 
-from ..raft.cluster import RaftGroup
+from ..raft.cluster import CMD_COLD, RaftGroup
 from ..raft.core import LEADER
 from ..raft.twopc import TwoPhaseCoordinator, TwoPhaseError, next_txn_id
 from ..types import Schema
@@ -61,6 +61,12 @@ class ReplicationError(RuntimeError):
 
 class SplitError(RuntimeError):
     """A region split/merge could not complete (aborted, state unchanged)."""
+
+
+def _schema_arrow(schema: Schema):
+    from .column_store import schema_to_arrow   # lazy: avoids module cycle
+
+    return schema_to_arrow(schema)
 
 
 def write_ops_atomic(pairs: list[tuple["ReplicatedRowTier", list]]) -> None:
@@ -389,6 +395,18 @@ class ReplicatedRowTier:
                               self._ends[idx + 1])
         ok = ok and ((not pairs) or left_g.write([(0, k, v)
                                                   for k, v in pairs]))
+        if ok and right_node.cold_manifest:
+            # the right's cold segments must survive the merge: fold its
+            # manifest into the left's (raft-committed), or the evicted
+            # rows would vanish from every future read and rebuild
+            import json as _json
+
+            left_node = self._leader_node(left_m, left_g)
+            combined = sorted(set(map(tuple, left_node.cold_manifest)) |
+                              set(map(tuple, right_node.cold_manifest)))
+            ok = left_g.propose_cmd(CMD_COLD, 0, _json.dumps(
+                {"op": "reset",
+                 "entries": [list(e) for e in combined]}).encode())
         if not ok:
             raise SplitError(
                 f"merge of region {right_m.region_id} aborted (no quorum)")
@@ -399,6 +417,132 @@ class ReplicatedRowTier:
         return merged
 
     # -- maintenance -------------------------------------------------------
+    # -- cold tier (reference: region_olap.cpp:445 flush_to_cold; manifest
+    # raft-synced, bytes on the external FS) ------------------------------
+    def flush_cold(self, fs, upto: Optional[int] = None) -> int:
+        """Flush each region's hot rows (rowid <= watermark) into one
+        immutable Parquet segment on ``fs``, then raft-commit the manifest
+        entry + eviction.  The segment is written BEFORE the proposal: a
+        crash in between leaves an orphan file (GC'able), never a manifest
+        entry without bytes.  Returns rows flushed."""
+        import json as _json
+
+        from .coldfs import segment_bytes
+
+        arrow = _schema_arrow(self.row_schema)
+        rowid_col = self.key_columns[0]
+        flushed = 0
+        with self._mu:
+            for m, g in zip(self.metas, self.groups):
+                node = self._leader_node(m, g)
+                rows = [r for r in self._decode_all(node)
+                        if upto is None or r[rowid_col] <= upto]
+                if not rows:
+                    continue
+                watermark = max(r[rowid_col] for r in rows)
+                seq = self.alloc_rowids(1)
+                seg = (f"{self.table_key}.r{m.region_id}"
+                       f".s{seq}.parquet")
+                fs.put(seg, segment_bytes(rows, arrow))
+                payload = _json.dumps({"op": "add", "seq": int(seq),
+                                       "file": seg,
+                                       "watermark": int(watermark)}).encode()
+                if not g.propose_cmd(CMD_COLD, 0, payload):
+                    raise ReplicationError(
+                        f"region {g.region_id}: cold manifest propose "
+                        f"failed")
+                flushed += len(rows)
+        return flushed
+
+    def _decode_all(self, node) -> list[dict]:
+        """Every row-tier entry the region OWNS, del markers included —
+        cold segments must carry the exact replayable state."""
+        return [node.table.row_codec.decode(v)
+                for k, v in node.table.scan_raw() if node._covers(k)]
+
+    def has_cold(self) -> bool:
+        """True when any region's manifest references cold segments."""
+        with self._mu:
+            for m, g in zip(self.metas, self.groups):
+                if self._leader_node(m, g).cold_manifest:
+                    return True
+            return False
+
+    def cold_rows(self, fs) -> list[dict]:
+        """All cold rows across regions in GLOBAL manifest order (entries
+        carry a cluster-monotonic seq so replay order is well-defined even
+        after splits/merges moved rowid ranges between regions)."""
+        from .coldfs import segment_rows
+
+        entries = []
+        with self._mu:
+            for m, g in zip(self.metas, self.groups):
+                node = self._leader_node(m, g)
+                entries.extend(node.cold_manifest)
+        out: list[dict] = []
+        seen = set()
+        for seq, f, _w in sorted(entries):
+            if f in seen:           # split copies may reference one file
+                continue
+            seen.add(f)
+            out.extend(segment_rows(fs.get(f)))
+        return out
+
+    def cold_gc(self, fs) -> int:
+        """Merge each region's segments into one (latest version per rowid,
+        del-marked rows dropped) and reset the manifest; orphan files are
+        deleted AFTER the reset commits.  Returns segments reclaimed."""
+        import json as _json
+
+        from .coldfs import segment_bytes, segment_rows
+
+        arrow = _schema_arrow(self.row_schema)
+        rowid_col = self.key_columns[0]
+        reclaimed = 0
+        with self._mu:
+            for m, g in zip(self.metas, self.groups):
+                node = self._leader_node(m, g)
+                if not node.cold_manifest:
+                    continue
+                latest: dict[int, dict] = {}
+                raw_rows = 0
+                for seq, f, _w in sorted(node.cold_manifest):
+                    for r in segment_rows(fs.get(f)):
+                        raw_rows += 1
+                        latest[int(r[rowid_col])] = r
+                live = [r for _, r in sorted(latest.items())
+                        if not r.get("__del")]
+                if len(node.cold_manifest) == 1 and len(live) == raw_rows:
+                    continue    # single clean segment: nothing to reclaim
+                old_files = [f for _, f, _w in node.cold_manifest]
+                entries = []
+                if live:
+                    seq = self.alloc_rowids(1)
+                    seg = f"{self.table_key}.r{m.region_id}.s{seq}.parquet"
+                    fs.put(seg, segment_bytes(live, arrow))
+                    entries = [[int(seq), seg,
+                                max(r[rowid_col] for r in live)]]
+                payload = _json.dumps({"op": "reset",
+                                       "entries": entries}).encode()
+                if not g.propose_cmd(CMD_COLD, 0, payload):
+                    raise ReplicationError(
+                        f"region {g.region_id}: cold gc propose failed")
+                for f in old_files:
+                    fs.delete(f)
+                reclaimed += len(old_files)
+        return reclaimed
+
+    def hot_bytes(self) -> int:
+        """Approximate live bytes held by the hot row tier (leader view) —
+        the number cold eviction exists to shrink."""
+        with self._mu:
+            total = 0
+            for m, g in zip(self.metas, self.groups):
+                node = self._leader_node(m, g)
+                total += sum(len(k) + len(v)
+                             for k, v in node.table.scan_raw())
+            return total
+
     def truncate(self) -> None:
         """TRUNCATE: retire the regions and create fresh (empty) ones —
         O(regions), vs per-row tombstones that would live in every replica

@@ -1,0 +1,180 @@
+"""Cold/OLAP external storage tier (VERDICT r03 missing #3 / next #6).
+
+Reference: hot rows flush to immutable cold SSTs/Parquet on an external FS
+(src/store/region_olap.cpp:445 flush_to_cold,
+src/engine/external_filesystem.cpp:93-111) with the manifest raft-synced
+(region_olap.cpp:727-882).  Here: segment bytes on storage/coldfs.ExternalFS
+(posix AFS stand-in), manifest + eviction watermark replicated via CMD_COLD
+through every region group, reads recovered cold-then-hot.
+"""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Database, Session
+from baikaldb_tpu.raft.core import raft_available
+
+pytestmark = pytest.mark.skipif(not raft_available(),
+                                reason="native raft core unavailable")
+
+
+def fleet_session(tmp_path, **dbkw):
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.fleet import StoreFleet
+
+    meta = MetaService(peer_count=3)
+    fleet = StoreFleet(meta, ["a:1", "b:1", "c:1"], seed=23)
+    db = Database(fleet=fleet, cold_dir=str(tmp_path / "afs"), **dbkw)
+    return Session(db), fleet
+
+
+def test_flush_evicts_hot_and_select_spans_hot_plus_cold(tmp_path):
+    s, fleet = fleet_session(tmp_path)
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    for i in range(20):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    tier = fleet.row_tiers["default.t"]
+    hot_before = tier.hot_bytes()
+    n = s.execute("HANDLE cold_flush default.t").affected_rows
+    assert n == 20
+    # cold bytes EVICTED from the row tier
+    assert tier.hot_bytes() < hot_before / 4
+    assert tier.num_rows() == 0                      # hot is empty
+    fs = s.db.cold_fs()
+    assert fs.list()                                 # segments on the FS
+    # new rows land hot; SELECT spans hot + cold transparently
+    s.execute("INSERT INTO t VALUES (100, 1.5)")
+    got = s.query("SELECT COUNT(*) n, SUM(v) sv FROM t")
+    assert got == [{"n": 21, "sv": float(sum(range(20))) + 1.5}]
+
+
+def test_kill_and_rebuild_loses_nothing(tmp_path):
+    """The verdict's done-criterion: kill after cold flush loses nothing —
+    a store dies AND a fresh frontend rebuilds from cold + the surviving
+    replicas."""
+    s, fleet = fleet_session(tmp_path)
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    for i in range(15):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    s.execute("HANDLE cold_flush default.t")
+    s.execute("INSERT INTO t VALUES (50, 0.5)")      # hot on top of cold
+    s.execute("UPDATE t SET v = 99.0 WHERE id = 3")  # hot update of a COLD row
+    s.execute("DELETE FROM t WHERE id = 7")          # hot delete of a COLD row
+    fleet.kill_store("a:1")
+    s2 = Session(Database(fleet=fleet, cold_dir=str(s.db.cold_dir)))
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    got = s2.query("SELECT COUNT(*) n, SUM(v) sv FROM t")
+    want_sum = sum(float(i) for i in range(15) if i not in (3, 7)) \
+        + 99.0 + 0.5
+    assert got == [{"n": 15, "sv": want_sum}]
+    assert s2.query("SELECT v FROM t WHERE id = 3") == [{"v": 99.0}]
+    assert s2.query("SELECT v FROM t WHERE id = 7") == []
+
+
+def test_manifest_survives_leader_change_and_snapshot(tmp_path):
+    s, fleet = fleet_session(tmp_path)
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    for i in range(10):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    s.execute("HANDLE cold_flush default.t")
+    tier = fleet.row_tiers["default.t"]
+    g = tier.groups[0]
+    # compaction folds the manifest into the raft snapshot; a follower that
+    # catches up via snapshot-install must still know the cold segments
+    for node in g.bus.nodes.values():
+        node.compact()
+    old = g.leader()
+    g.bus.kill(old)
+    new = g.bus.elect()
+    assert new != old
+    assert g.bus.nodes[new].cold_manifest    # manifest survived
+    g.bus.revive(old)
+    s2 = Session(Database(fleet=fleet, cold_dir=str(s.db.cold_dir)))
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    assert s2.query("SELECT COUNT(*) n FROM t") == [{"n": 10}]
+
+
+def test_repeated_flush_and_gc(tmp_path):
+    s, fleet = fleet_session(tmp_path)
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    for i in range(8):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    s.execute("HANDLE cold_flush default.t")
+    for i in range(8, 16):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    s.execute("DELETE FROM t WHERE id = 2")          # deletes a cold row
+    s.execute("HANDLE cold_flush default.t")         # second segment
+    fs = s.db.cold_fs()
+    files_before = len(fs.list())
+    assert files_before >= 2
+    reclaimed = s.execute("HANDLE cold_gc default.t").affected_rows
+    assert reclaimed >= 2
+    assert len(fs.list()) < files_before             # orphans deleted
+    # GC'd cold state still reads correctly from a fresh frontend
+    s2 = Session(Database(fleet=fleet, cold_dir=str(s.db.cold_dir)))
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    got = s2.query("SELECT COUNT(*) n, SUM(v) sv FROM t")
+    assert got == [{"n": 15,
+                    "sv": float(sum(range(16)) - 2)}]
+
+
+def test_region_merge_preserves_cold_manifest(tmp_path):
+    """Merging regions must fold the right region's cold manifest into the
+    survivor — the evicted rows live only in those segments."""
+    s, fleet = fleet_session(tmp_path)
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    tier = fleet.row_tiers["default.t"]
+    tier.split_rows = 8
+    for i in range(20):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    assert len(tier.groups) > 1
+    s.execute("HANDLE cold_flush default.t")
+    fs = s.db.cold_fs()
+    before = len(tier.cold_rows(fs))
+    tier.split_rows = 0
+    while len(tier.groups) > 1:
+        tier.merge_region(0)
+    assert len(tier.cold_rows(fs)) == before         # nothing lost
+    s2 = Session(Database(fleet=fleet, cold_dir=str(s.db.cold_dir)))
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    assert s2.query("SELECT COUNT(*) n FROM t") == [{"n": 20}]
+
+
+def test_frontend_without_cold_fs_refuses_rebuild(tmp_path):
+    s, fleet = fleet_session(tmp_path)
+    s.execute("CREATE TABLE t (id BIGINT, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1), (2)")
+    s.execute("HANDLE cold_flush default.t")
+    s2 = Session(Database(fleet=fleet))             # cold_dir forgotten
+    with pytest.raises(ValueError, match="cold segments"):
+        s2.execute("CREATE TABLE t (id BIGINT, PRIMARY KEY (id))")
+
+
+def test_gc_compacts_single_dirty_segment(tmp_path):
+    """A lone segment carrying __del markers or superseded versions still
+    compacts (the common one-segment-per-region case)."""
+    s, fleet = fleet_session(tmp_path)
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    for i in range(6):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    s.execute("DELETE FROM t WHERE id = 2")
+    s.execute("HANDLE cold_flush default.t")        # one segment, has marker
+    assert s.execute("HANDLE cold_gc default.t").affected_rows >= 1
+    # idempotent: a clean single segment is left alone
+    assert s.execute("HANDLE cold_gc default.t").affected_rows == 0
+    s2 = Session(Database(fleet=fleet, cold_dir=str(s.db.cold_dir)))
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    assert s2.query("SELECT COUNT(*) n FROM t") == [{"n": 5}]
+
+
+def test_cold_flush_requires_configured_fs(tmp_path):
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.fleet import StoreFleet
+    from baikaldb_tpu.plan.planner import PlanError
+
+    meta = MetaService(peer_count=3)
+    fleet = StoreFleet(meta, ["a:1", "b:1", "c:1"], seed=29)
+    s = Session(Database(fleet=fleet))        # no cold_dir, no flag
+    s.execute("CREATE TABLE t (id BIGINT, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1)")
+    with pytest.raises(PlanError, match="no cold storage"):
+        s.execute("HANDLE cold_flush default.t")
