@@ -173,7 +173,9 @@ class Database:
     of baikalStore restart recovery (SURVEY §3.4)."""
 
     def __init__(self, data_dir: Optional[str] = None, fleet=None,
-                 cluster=None, cold_dir: Optional[str] = None):
+                 cluster=None, cold_dir: Optional[str] = None,
+                 read_replica: str = "leader", read_tag: str = "",
+                 read_max_lag: int = 0):
         """``fleet``: a raft.fleet.StoreFleet — when set, every table's hot
         row tier is raft-replicated across the fleet's store nodes (DML
         quorum-commits through region raft groups; a new Database over the
@@ -215,6 +217,14 @@ class Database:
         # bytes live here, manifests replicate through the region groups
         self.cold_dir = cold_dir
         self._cold_fs = None
+        # read routing (reference: fetcher_store.cpp:351 choose_opt_instance
+        # — leader for writes; follower/learner resource-isolated reads):
+        # "follower" serves this frontend's table rebuilds from non-leader
+        # replicas under a bounded applied-index staleness check, optionally
+        # pinned to instances with a resource tag (the OLAP-isolated reader)
+        self.read_replica = read_replica
+        self.read_tag = read_tag
+        self.read_max_lag = int(read_max_lag)
         if data_dir:
             import os
             os.makedirs(data_dir, exist_ok=True)
@@ -260,7 +270,11 @@ class Database:
                     f"table {key!r} has cold segments but no cold storage "
                     f"is configured (set cold_dir or the cold_fs_dir flag)")
             cold = tier.cold_rows(fs) if fs is not None else None
-            st.attach_replicated(tier, cold_rows=cold)
+            hot = None
+            if self.read_replica == "follower":
+                hot = tier.follower_rows(max_lag=self.read_max_lag,
+                                         resource_tag=self.read_tag)
+            st.attach_replicated(tier, cold_rows=cold, hot_rows=hot)
             return st
         if self.cluster is not None:
             from ..storage.remote_tier import RemoteRowTier
@@ -901,7 +915,22 @@ class Session:
             # handle migrate -> cluster_manager migrate handling)
             self._fleet_meta().drop_instance("".join(s.args))
             return Result()
-        if s.command in ("add_peer", "remove_peer", "trans_leader") and \
+        if s.command == "add_instance" and s.args:
+            # handle add_instance <store_addr> [resource_tag]: register a
+            # store (e.g. an OLAP-isolated learner host) with the meta.
+            # The lexer splits "host:port" into tokens, so the tag is only
+            # the trailing arg when it can't be part of an address (no
+            # colon, not a bare port number)
+            args = [str(a) for a in s.args]
+            tag = ""
+            if len(args) > 1 and ":" not in args[-1] and \
+                    not args[-1].isdigit():
+                tag = args[-1]
+                args = args[:-1]
+            self._fleet_meta().add_instance("".join(args), resource_tag=tag)
+            return Result()
+        if s.command in ("add_peer", "remove_peer", "trans_leader",
+                         "add_learner", "remove_learner") and \
                 len(s.args) >= 2:
             # handle add_peer|remove_peer|trans_leader <region_id> <store>:
             # validated, executed, and recorded in meta by the fleet (the

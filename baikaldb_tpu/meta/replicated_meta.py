@@ -146,7 +146,8 @@ class MetaReplica:
         if op == "update_region_membership":
             svc.update_region_membership(cmd["region_id"],
                                          cmd.get("peers"),
-                                         cmd.get("leader"))
+                                         cmd.get("leader"),
+                                         cmd.get("learners"))
             return None
         if op == "alloc_ids":
             return svc.alloc_ids(cmd["table_id"], cmd["n"],
@@ -167,7 +168,7 @@ class MetaReplica:
                           for i in svc.instances.values()],
             "regions": [[r.region_id, r.table_id, r.start_row, r.end_row,
                          r.peers, r.leader, r.version, r.num_rows,
-                         r.start_key, r.end_key]
+                         r.start_key, r.end_key, r.learners]
                         for r in svc.regions.values()],
             "next_region_id": svc._last_region_id + 1,
             "params": svc._params,
@@ -195,9 +196,12 @@ class MetaReplica:
         for a, tag, room, cap, status, hb, used in state["instances"]:
             svc.instances[a] = InstanceInfo(a, tag, room, cap, status, hb,
                                             used)
-        for rid, tid, s, e, peers, ldr, ver, n, sk, ek in state["regions"]:
-            svc.regions[rid] = RegionMeta(rid, tid, s, e, list(peers), ldr,
-                                          ver, n, sk, ek)
+        for entry in state["regions"]:
+            rid, tid, s, e, peers, ldr, ver, n, sk, ek = entry[:10]
+            rm = RegionMeta(rid, tid, s, e, list(peers), ldr, ver, n, sk, ek)
+            if len(entry) > 10:
+                rm.learners = list(entry[10])
+            svc.regions[rid] = rm
         svc._region_ids = itertools.count(state["next_region_id"])
         svc._last_region_id = state["next_region_id"] - 1
         svc._params = {k: dict(v) for k, v in state["params"].items()}
@@ -354,10 +358,10 @@ class ReplicatedMeta:
                        "name": name, "value": value})
 
     def update_region_membership(self, region_id: int, peers=None,
-                                 leader=None):
+                                 leader=None, learners=None):
         self._propose({"op": "update_region_membership",
                        "region_id": int(region_id), "peers": peers,
-                       "leader": leader})
+                       "leader": leader, "learners": learners})
         return self._svc.regions[int(region_id)]
 
     def alloc_ids(self, table_id: int, n: int, floor: int = 0) -> int:

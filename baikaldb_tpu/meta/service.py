@@ -56,6 +56,10 @@ class RegionMeta:
     # replicated row tier's split/merge (reference: RegionInfo start/end key)
     start_key: str = ""
     end_key: str = ""
+    # non-voting read replicas (reference: learner list, region.h:261-267;
+    # learner_load_balance, region_manager.cpp:197).  LAST field: older
+    # code constructs RegionMeta positionally
+    learners: list[str] = field(default_factory=list)
 
 
 @dataclass
@@ -284,16 +288,20 @@ class MetaService:
 
     def update_region_membership(self, region_id: int,
                                  peers: Optional[list[str]] = None,
-                                 leader: Optional[str] = None) -> RegionMeta:
-        """Record an executed membership change (operator add/remove peer,
-        leadership transfer) so routing and balancing see the real raft
-        state — membership has ONE owner: this registry."""
+                                 leader: Optional[str] = None,
+                                 learners: Optional[list[str]] = None
+                                 ) -> RegionMeta:
+        """Record an executed membership change (operator add/remove peer/
+        learner, leadership transfer) so routing and balancing see the real
+        raft state — membership has ONE owner: this registry."""
         with self._mu:
             rm = self.regions[region_id]
             if peers is not None:
                 rm.peers = list(peers)
             if leader is not None:
                 rm.leader = leader
+            if learners is not None:
+                rm.learners = list(learners)
             return rm
 
     def route(self, table_id: int, row: int) -> Optional[RegionMeta]:

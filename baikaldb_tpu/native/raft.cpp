@@ -120,6 +120,12 @@ struct RaftNode {
     // config is always base_peers replayed through the in-log E_CONFIG
     // entries, so truncating a conflicting suffix reverts memberships too
     std::vector<int64_t> base_peers;
+    // LEARNERS: non-voting members (reference: learner replicas,
+    // include/store/region.h:261-267).  They receive full log replication
+    // and apply commits — read-serving replicas — but never count toward
+    // quorum, never vote, and never start elections.
+    std::vector<int64_t> learners;
+    std::vector<int64_t> base_learners;
 
     // -- volatile state
     Role role = FOLLOWER;
@@ -160,7 +166,19 @@ struct RaftNode {
     bool is_member(int64_t nid) const {
         return std::find(peers.begin(), peers.end(), nid) != peers.end();
     }
+    bool is_learner(int64_t nid) const {
+        return std::find(learners.begin(), learners.end(), nid)
+            != learners.end();
+    }
     size_t quorum() const { return peers.size() / 2 + 1; }
+
+    std::vector<int64_t> repl_targets() const {
+        // everyone the leader replicates to: voters + learners
+        std::vector<int64_t> out = peers;
+        for (int64_t l : learners)
+            if (!is_member(l)) out.push_back(l);
+        return out;
+    }
 
     void reset_election_deadline() {
         ticks_since_reset = 0;
@@ -209,7 +227,7 @@ struct RaftNode {
         hb_elapsed = 0;
         next_index.clear();
         match_index.clear();
-        for (int64_t p : peers) {
+        for (int64_t p : repl_targets()) {
             next_index[p] = last_index() + 1;
             match_index[p] = 0;
         }
@@ -239,7 +257,7 @@ struct RaftNode {
 
     // -- replication --------------------------------------------------------
     void broadcast_append() {
-        for (int64_t p : peers) {
+        for (int64_t p : repl_targets()) {
             if (p == id) continue;
             send_append(p);
         }
@@ -255,6 +273,8 @@ struct RaftNode {
             // receiver's recompute base stays correct after log reset
             put_u32(&m, (uint32_t)base_peers.size());
             for (int64_t bp : base_peers) put_i64(&m, bp);
+            put_u32(&m, (uint32_t)base_learners.size());
+            for (int64_t bl : base_learners) put_i64(&m, bl);
             put_u64(&m, (uint64_t)snapshot.size());
             m += snapshot;
             send(p, std::move(m));
@@ -307,31 +327,45 @@ struct RaftNode {
     }
 
     static void apply_config_to(std::vector<int64_t>* ps,
+                                std::vector<int64_t>* ls,
                                 const std::string& data) {
-        // payload: u8 op (0=add,1=remove) + i64 id
+        // payload: u8 op (0=add voter, 1=remove voter, 2=add learner,
+        // 3=remove learner) + i64 id.  Adding a learner as a voter
+        // PROMOTES it (erased from learners); a voter is never added as a
+        // learner.
         if (data.size() < 9) return;
         uint8_t op = (uint8_t)data[0];
         int64_t nid;
         std::memcpy(&nid, data.data() + 1, 8);
+        auto in = [](std::vector<int64_t>* v, int64_t x) {
+            return std::find(v->begin(), v->end(), x) != v->end();
+        };
+        auto drop = [](std::vector<int64_t>* v, int64_t x) {
+            v->erase(std::remove(v->begin(), v->end(), x), v->end());
+        };
         if (op == 0) {
-            if (std::find(ps->begin(), ps->end(), nid) == ps->end())
-                ps->push_back(nid);
-        } else {
-            ps->erase(std::remove(ps->begin(), ps->end(), nid), ps->end());
+            if (!in(ps, nid)) ps->push_back(nid);
+            drop(ls, nid);
+        } else if (op == 1) {
+            drop(ps, nid);
+        } else if (op == 2) {
+            if (!in(ps, nid) && !in(ls, nid)) ls->push_back(nid);
+        } else if (op == 3) {
+            drop(ls, nid);
         }
     }
 
     void apply_config(const std::string& data) {
-        std::vector<int64_t> before = peers;
-        apply_config_to(&peers, data);
-        for (int64_t p : peers) {
+        std::vector<int64_t> before = repl_targets();
+        apply_config_to(&peers, &learners, data);
+        for (int64_t p : repl_targets()) {
             if (role == LEADER && !next_index.count(p)) {
                 next_index[p] = last_index() + 1;
                 match_index[p] = 0;
             }
         }
         for (int64_t p : before) {
-            if (!is_member(p)) {
+            if (!is_member(p) && !is_learner(p)) {
                 next_index.erase(p);
                 match_index.erase(p);
             }
@@ -343,13 +377,18 @@ struct RaftNode {
         // every E_CONFIG entry still in the log; called after any suffix
         // truncation so reverted membership changes actually revert
         std::vector<int64_t> ps = base_peers;
+        std::vector<int64_t> ls = base_learners;
         for (const Entry& e : log)
-            if (e.kind == E_CONFIG) apply_config_to(&ps, e.data);
+            if (e.kind == E_CONFIG) apply_config_to(&ps, &ls, e.data);
         peers = ps;
+        learners = ls;
+        auto keep = [this](int64_t n) {
+            return is_member(n) || is_learner(n);
+        };
         for (auto it = next_index.begin(); it != next_index.end();)
-            it = is_member(it->first) ? std::next(it) : next_index.erase(it);
+            it = keep(it->first) ? std::next(it) : next_index.erase(it);
         for (auto it = match_index.begin(); it != match_index.end();)
-            it = is_member(it->first) ? std::next(it) : match_index.erase(it);
+            it = keep(it->first) ? std::next(it) : match_index.erase(it);
     }
 
     // -- input: tick --------------------------------------------------------
@@ -504,6 +543,10 @@ struct RaftNode {
             std::vector<int64_t> snap_peers;
             for (uint32_t k = 0; k < np && r->ok; k++)
                 snap_peers.push_back(r->get<int64_t>());
+            uint32_t nl = r->get<uint32_t>();
+            std::vector<int64_t> snap_learners;
+            for (uint32_t k = 0; k < nl && r->ok; k++)
+                snap_learners.push_back(r->get<int64_t>());
             uint64_t len = r->get<uint64_t>();
             std::string data = r->bytes(len);
             if (!r->ok || mterm < term) break;
@@ -520,6 +563,8 @@ struct RaftNode {
                 applied = sidx;
                 base_peers = snap_peers;
                 peers = snap_peers;
+                base_learners = snap_learners;
+                learners = snap_learners;
                 // host must install: surface as a special commit record
                 commits.push_back({sidx, 255, std::move(data)});
             }
@@ -581,7 +626,7 @@ struct RaftNode {
         // roll the config base forward through the entries being dropped
         for (uint64_t i = first_index; i <= upto; i++)
             if (at(i).kind == E_CONFIG)
-                apply_config_to(&base_peers, at(i).data);
+                apply_config_to(&base_peers, &base_learners, at(i).data);
         log.erase(log.begin(), log.begin() + (upto - first_index + 1));
         first_index = upto + 1;
     }
@@ -625,6 +670,13 @@ int rf_peer_count(void* h) { return (int)((RaftNode*)h)->peers.size(); }
 void rf_peers(void* h, int64_t* out) {
     auto& p = ((RaftNode*)h)->peers;
     std::copy(p.begin(), p.end(), out);
+}
+int rf_learner_count(void* h) {
+    return (int)((RaftNode*)h)->learners.size();
+}
+void rf_learners(void* h, int64_t* out) {
+    auto& l = ((RaftNode*)h)->learners;
+    std::copy(l.begin(), l.end(), out);
 }
 
 // outbound messages

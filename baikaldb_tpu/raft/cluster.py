@@ -511,6 +511,45 @@ class RaftGroup:
         self.bus.nodes.pop(new_id, None)    # never caught up: no ghost
         return False
 
+    def add_learner(self, new_id: int, max_ticks: int = 400) -> bool:
+        """Add a NON-VOTING learner replica (reference: learner replicas,
+        region.h:261-267): it receives full log replication and applies
+        commits — a read-serving replica — but never counts toward quorum
+        and never elects."""
+        ldr = self.leader()
+        if ldr is None:
+            ldr = self.bus.elect()
+        peers = self.bus.nodes[ldr].core.peers()
+        payload = struct.pack("<Bq", 2, new_id)
+        idx = self.bus.nodes[ldr].core.propose(payload, kind=CONFIG)
+        if idx < 0:
+            return False
+        replica = ReplicatedRegion(new_id, peers, seed=self.seed,
+                                   schema=self.schema,
+                                   key_columns=self.key_columns)
+        self.bus.add(replica)
+        for _ in range(max_ticks):
+            self.bus.pump()
+            if replica.core.commit_index >= idx:
+                return True
+            self.bus.advance(1)
+        self.bus.nodes.pop(new_id, None)
+        return False
+
+    def remove_learner(self, learner_id: int, max_ticks: int = 400) -> bool:
+        ldr = self.leader()
+        payload = struct.pack("<Bq", 3, learner_id)
+        idx = self.bus.nodes[ldr].core.propose(payload, kind=CONFIG)
+        if idx < 0:
+            return False
+        for _ in range(max_ticks):
+            self.bus.pump()
+            if self.bus.nodes[ldr].core.commit_index >= idx:
+                self.bus.nodes.pop(learner_id, None)
+                return True
+            self.bus.advance(1)
+        return False
+
     def remove_peer(self, dead_id: int, max_ticks: int = 400) -> bool:
         ldr = self.leader()
         if ldr == dead_id:

@@ -53,9 +53,10 @@ def _sig(lib):
     lib.rf_receive.argtypes = [c.c_void_p, P8, c.c_int64]
     lib.rf_propose.restype = c.c_int64
     lib.rf_propose.argtypes = [c.c_void_p, c.c_uint8, P8, c.c_int64]
-    for name in ("rf_role", "rf_peer_count"):
+    for name in ("rf_role", "rf_peer_count", "rf_learner_count"):
         getattr(lib, name).restype = c.c_int
         getattr(lib, name).argtypes = [c.c_void_p]
+    lib.rf_learners.argtypes = [c.c_void_p, P64]
     for name in ("rf_term", "rf_commit_index", "rf_last_index",
                  "rf_first_index"):
         getattr(lib, name).restype = c.c_uint64
@@ -212,4 +213,12 @@ class RaftCore:
         n = self._lib.rf_peer_count(self._h)
         arr = (ctypes.c_int64 * max(1, n))()
         self._lib.rf_peers(self._h, arr)
+        return [int(arr[i]) for i in range(n)]
+
+    def learners(self) -> list[int]:
+        """Non-voting replicated members (reference: learner replicas,
+        include/store/region.h:261-267)."""
+        n = self._lib.rf_learner_count(self._h)
+        arr = (ctypes.c_int64 * max(1, n))()
+        self._lib.rf_learners(self._h, arr)
         return [int(arr[i]) for i in range(n)]

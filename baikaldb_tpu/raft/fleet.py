@@ -50,6 +50,9 @@ class StoreFleet:
             nid = max(self._addr) + 1 if self._addr else 1
             self._ids[address] = nid
             self._addr[nid] = address
+            # late-joining stores (e.g. OLAP learner hosts) must heartbeat
+            # like everyone else, or meta's health check marks them DEAD
+            self.addresses.append(address)
         return self._ids[address]
 
     # -- region lifecycle -------------------------------------------------
@@ -193,6 +196,22 @@ class StoreFleet:
                 raise RuntimeError(f"remove_peer {address} did not commit")
             self.meta.update_region_membership(
                 region_id, peers=[p for p in rm.peers if p != address])
+        elif kind == "add_learner":
+            if address in rm.peers or address in rm.learners:
+                raise ValueError(f"{address} already hosts a replica")
+            if not g.add_learner(self._id_of(address)):
+                raise RuntimeError(f"add_learner {address} did not commit")
+            self.meta.update_region_membership(
+                region_id, learners=list(rm.learners) + [address])
+        elif kind == "remove_learner":
+            if address not in rm.learners:
+                raise ValueError(f"{address} is not a learner")
+            if not g.remove_learner(self._ids.get(address)):
+                raise RuntimeError(f"remove_learner {address} did not "
+                                   f"commit")
+            self.meta.update_region_membership(
+                region_id,
+                learners=[a for a in rm.learners if a != address])
         elif kind == "trans_leader":
             src = g.leader()
             tgt = self._ids.get(address)

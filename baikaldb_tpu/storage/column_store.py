@@ -301,7 +301,8 @@ class TableStore:
         self._build_row_tier(path)
         self._replay_hot(self.row_table.scan_rows())
 
-    def attach_replicated(self, tier, cold_rows: Optional[list] = None):
+    def attach_replicated(self, tier, cold_rows: Optional[list] = None,
+                          hot_rows: Optional[list] = None):
         """Bind this table to its raft-replicated hot tier and recover: the
         replicas' committed row state replays over the cold state, exactly
         like a WAL replay — but the log here survives any single node (the
@@ -312,7 +313,7 @@ class TableStore:
         versions winning per rowid, so a SELECT transparently spans
         hot + cold (region_olap.cpp's cold-SST + hot-Rocks merge)."""
         self.replicated = tier
-        rows = tier.scan_rows()
+        rows = hot_rows if hot_rows is not None else tier.scan_rows()
         if cold_rows:
             merged: dict[int, dict] = {}
             for r in cold_rows:

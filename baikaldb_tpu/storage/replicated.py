@@ -229,6 +229,44 @@ class ReplicatedRowTier:
             return group.bus.nodes[nid]
         return group.bus.nodes[group.leader()]
 
+    def follower_rows(self, max_lag: int = 0,
+                      resource_tag: str = "") -> list[dict]:
+        """Bounded-staleness read served by a FOLLOWER or LEARNER replica
+        per region (reference: replica selection with resource-isolated
+        learner reads, fetcher_store.cpp:351 choose_opt_instance).
+
+        Replica choice: a non-leader replica whose meta instance carries
+        ``resource_tag`` (when given) and whose applied index is within
+        ``max_lag`` entries of the leader's commit index — the applied-
+        index staleness bound.  Falls back to the leader for a region with
+        no qualifying replica (never fails the read, never returns rows
+        staler than the bound)."""
+        with self._mu:
+            out: list[dict] = []
+            for m, g in zip(self.metas, self.groups):
+                node = self._pick_read_replica(g, max_lag, resource_tag)
+                out.extend(node.rows_in_range())
+            return out
+
+    def _pick_read_replica(self, g: RaftGroup, max_lag: int,
+                           resource_tag: str):
+        ldr_id = g.leader()
+        ldr = g.bus.nodes[ldr_id]
+        commit = ldr.core.commit_index
+        meta_insts = getattr(self.fleet.meta, "instances", {})
+        for nid, node in sorted(g.bus.nodes.items()):
+            if nid == ldr_id or nid in g.bus.down:
+                continue
+            if resource_tag:
+                addr = self.fleet._addr.get(nid, "")
+                inst = meta_insts.get(addr)
+                if inst is None or inst.resource_tag != resource_tag:
+                    continue
+            node.apply_committed()       # drain anything already delivered
+            if commit - node.applied_index <= max_lag:
+                return node
+        return ldr                        # no qualifying replica: leader read
+
     def scan_rows(self) -> list[dict]:
         """Latest committed row versions across all regions (leader reads,
         each filtered to the range the region OWNS so mid-split copies are
@@ -517,8 +555,13 @@ class ReplicatedRowTier:
                 old_files = [f for _, f, _w in node.cold_manifest]
                 entries = []
                 if live:
-                    seq = self.alloc_rowids(1)
-                    seg = f"{self.table_key}.r{m.region_id}.s{seq}.parquet"
+                    # keep the MAX of the merged segments' seqs: a fresh
+                    # (higher) seq would re-order this region's old row
+                    # versions ABOVE newer segments from sibling regions in
+                    # the global replay, resurrecting stale values
+                    seq = max(sq for sq, _f, _w in node.cold_manifest)
+                    seg = (f"{self.table_key}.r{m.region_id}"
+                           f".s{seq}.gc{len(old_files)}.parquet")
                     fs.put(seg, segment_bytes(live, arrow))
                     entries = [[int(seq), seg,
                                 max(r[rowid_col] for r in live)]]
