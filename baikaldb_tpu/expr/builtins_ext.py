@@ -463,3 +463,6 @@ _TYPE_RULES.update({
     "time_to_sec": LType.INT64, "curdate": LType.DATE, "now": LType.DATETIME,
     "utc_date": LType.DATE,
 })
+
+# second batch registers the remaining user-facing MySQL surface
+from . import builtins_ext2  # noqa: E402,F401  (import for side effects)

@@ -1,0 +1,494 @@
+"""Second builtin batch: the remaining user-facing MySQL surface
+(reference: src/expr/internal_functions.cpp; registration fn_manager.cpp).
+
+Same implementation disciplines as builtins_ext (which imports this module
+last): numeric/temporal work is jnp elementwise; string work evaluates once
+per DISTINCT dictionary value host-side.  Functions whose output is a
+data-dependent string set over NUMERIC inputs (HEX(int), BIN, FORMAT,
+DATE_FORMAT over date columns) remain deliberately absent — a device string
+column needs a static dictionary at trace time (see builtins_ext's note);
+STR_TO_DATE goes the feasible direction (string -> temporal).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import hashlib
+import json as _json
+
+import jax.numpy as jnp
+
+from ..column.batch import Column
+from ..types import LType
+from ..utils import datetime_kernels as dtk
+from .ast import Lit
+from .compile import (ExprError, HostStr, _dict_scalar, _dict_transform,
+                      _eval, _raw, _reg, _str_fn, _TYPE_RULES)
+from .builtins_ext import _lit_str
+
+
+# -- bit operations (reference: internal_functions bit_and/or/xor/not,
+# left_shift/right_shift) ---------------------------------------------------
+
+def _int2(fn):
+    def h(a: Column, b: Column) -> Column:
+        return Column(fn(a.data.astype(jnp.int64),
+                         b.data.astype(jnp.int64)), None, LType.INT64)
+    return h
+
+
+_reg("bit_and", _int2(jnp.bitwise_and), LType.INT64)
+_reg("bit_or", _int2(jnp.bitwise_or), LType.INT64)
+_reg("bit_xor", _int2(jnp.bitwise_xor), LType.INT64)
+_reg("left_shift", _int2(jnp.left_shift), LType.INT64)
+_reg("right_shift", _int2(jnp.right_shift), LType.INT64)
+_reg("bit_not", lambda a: Column(~a.data.astype(jnp.int64), None,
+                                 LType.INT64), LType.INT64)
+
+
+@_raw("bit_length")
+def _bit_length(e, batch):
+    a = _eval(e.args[0], batch)
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(8 * len(str(a).encode()), jnp.int64),
+                      None, LType.INT64)
+    return _dict_scalar(a, lambda s: 8 * len(s.encode()), LType.INT64)
+
+
+# -- temporal arithmetic ----------------------------------------------------
+
+def _tcol(a):
+    """Coerce a date-shaped string literal to a temporal Column (raw
+    handlers bypass the _SIMPLE wrapper's implicit cast)."""
+    if isinstance(a, HostStr):
+        from .compile import _temporal_hoststr
+
+        c = _temporal_hoststr(a)
+        if c is None:
+            raise ExprError(f"not a temporal literal: {a!r}")
+        return c
+    return a
+
+
+def _to_us(a: Column):
+    a = _tcol(a)
+    if a.ltype is LType.DATE:
+        return a.data.astype(jnp.int64) * dtk.US_PER_DAY
+    return a.data.astype(jnp.int64)
+
+
+def _shift_months(days, n):
+    """Calendar month shift with MySQL day clamping (2024-01-31 + 1 MONTH
+    = 2024-02-29)."""
+    y, m, d = dtk.civil_from_days(days)
+    total = y * 12 + (m - 1) + n
+    ny, nm = total // 12, total % 12 + 1
+    ld = dtk.last_day(dtk.days_from_civil(ny, nm, jnp.asarray(1, jnp.int32)))
+    _, _, maxd = dtk.civil_from_days(ld)
+    nd = jnp.minimum(d, maxd)
+    return dtk.days_from_civil(ny, nm, nd)
+
+
+def _date_add_months(a: Column, n: Column) -> Column:
+    nn = n.data.astype(jnp.int32)
+    if a.ltype is LType.DATE:
+        return Column(_shift_months(a.data.astype(jnp.int32), nn)
+                      .astype(jnp.int32), None, LType.DATE)
+    days = dtk.dt_days(a.data)
+    tod = dtk.dt_time_of_day_us(a.data)
+    nd = _shift_months(days.astype(jnp.int32), nn)
+    return Column(nd.astype(jnp.int64) * dtk.US_PER_DAY + tod, None, a.ltype)
+
+
+_reg("date_add_months", _date_add_months, lambda ts: ts[0])
+_reg("date_sub_months", lambda a, n: _date_add_months(
+    a, Column(-n.data, None, n.ltype)), lambda ts: ts[0])
+
+
+def _date_add_us(a: Column, n: Column) -> Column:
+    """Add microseconds; a DATE input becomes a DATETIME (MySQL)."""
+    us = _to_us(a) + n.data.astype(jnp.int64)
+    return Column(us, None,
+                  LType.DATETIME if a.ltype is LType.DATE else a.ltype)
+
+
+_reg("date_add_us", _date_add_us,
+     lambda ts: LType.DATETIME if ts[0] is LType.DATE else ts[0])
+_reg("microsecond", lambda a: Column(
+    dtk.dt_time_of_day_us(_to_us(a)) % dtk.US_PER_SEC, None, LType.INT64),
+    LType.INT64)
+_reg("to_seconds", lambda a: Column(
+    _to_us(a) // dtk.US_PER_SEC + 62167219200, None, LType.INT64),
+    LType.INT64)   # MySQL: seconds since year 0
+_reg("timestampdiff_seconds", lambda a, b: Column(
+    (_to_us(b) - _to_us(a)) // dtk.US_PER_SEC, None, LType.INT64),
+    LType.INT64)
+
+
+@_raw("timestampdiff")
+def _timestampdiff(e, batch):
+    """TIMESTAMPDIFF(unit, a, b) — unit arrives as a string literal from
+    the parser."""
+    unit = _lit_str(e, 0, "timestampdiff")
+    a = _eval(e.args[1], batch)
+    b = _eval(e.args[2], batch)
+    ua, ub = _to_us(a), _to_us(b)
+    per = {"second": dtk.US_PER_SEC, "minute": dtk.US_PER_MIN,
+           "hour": dtk.US_PER_HOUR, "day": dtk.US_PER_DAY,
+           "week": dtk.US_PER_DAY * 7}
+    if unit in per:
+        return Column((ub - ua) // per[unit], None, LType.INT64)
+    if unit in ("month", "quarter", "year"):
+        da, db = dtk.dt_days(ua), dtk.dt_days(ub)
+        ya, ma, _ = dtk.civil_from_days(da)
+        yb, mb, _ = dtk.civil_from_days(db)
+        months = (yb - ya) * 12 + (mb - ma)
+        # partial months don't count (MySQL): back the end off by the
+        # month delta and compare the remainder
+        rolled = _shift_months(da.astype(jnp.int32),
+                               months.astype(jnp.int32))
+        toda = ua - da.astype(jnp.int64) * dtk.US_PER_DAY
+        todb = ub - db.astype(jnp.int64) * dtk.US_PER_DAY
+        over = (rolled.astype(jnp.int64) * dtk.US_PER_DAY + toda) > ub
+        under = (rolled.astype(jnp.int64) * dtk.US_PER_DAY + toda) < ua
+        months = months - jnp.where((months > 0) & over, 1, 0) \
+            + jnp.where((months < 0) & under, 1, 0) + 0 * todb
+        div = {"month": 1, "quarter": 3, "year": 12}[unit]
+        return Column((months // div).astype(jnp.int64), None, LType.INT64)
+    raise ExprError(f"TIMESTAMPDIFF unit {unit!r} unsupported")
+
+
+_MYSQL_TO_PYFMT = {
+    "Y": "%Y", "y": "%y", "m": "%m", "c": "%m", "d": "%d", "e": "%d",
+    "H": "%H", "k": "%H", "h": "%I", "I": "%I", "i": "%M", "s": "%S",
+    "S": "%S", "p": "%p", "M": "%B", "b": "%b", "j": "%j", "a": "%a",
+    "W": "%A", "T": "%H:%M:%S", "r": "%I:%M:%S %p", "f": "%f", "%": "%%",
+}
+
+
+def _mysql_fmt_to_py(fmt: str) -> str:
+    """MySQL DATE_FORMAT/STR_TO_DATE specifiers -> strptime ones (%i is
+    minutes, %s seconds, %M month NAME — all different from Python)."""
+    out = []
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "%" and i + 1 < len(fmt):
+            spec = fmt[i + 1]
+            py = _MYSQL_TO_PYFMT.get(spec)
+            if py is None:
+                raise ExprError(f"unsupported format specifier %{spec}")
+            out.append(py)
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+@_raw("str_to_date")
+def _str_to_date(e, batch):
+    """STR_TO_DATE(str_col, fmt) — the feasible (string -> temporal)
+    direction; evaluated per distinct dictionary value."""
+    fmt = _lit_str(e, 1, "str_to_date")
+    a = _eval(e.args[0], batch)
+    has_time = any(x in fmt for x in ("%H", "%k", "%h", "%I", "%i", "%s",
+                                      "%S", "%T", "%r"))
+    pyfmt = _mysql_fmt_to_py(fmt)
+
+    def f(s: str):
+        try:
+            t = _dt.datetime.strptime(s, pyfmt)
+        except ValueError:
+            return None
+        if has_time:
+            return int((t - _dt.datetime(1970, 1, 1)).total_seconds() * 1e6)
+        return (t.date() - _dt.date(1970, 1, 1)).days
+
+    lt = LType.DATETIME if has_time else LType.DATE
+    if isinstance(a, HostStr):
+        v = f(str(a))
+        if v is None:
+            return Column(jnp.zeros((), lt.np_dtype), jnp.asarray(False), lt)
+        return Column(jnp.asarray(v, lt.np_dtype), None, lt)
+    return _str_to_date_col(a, f, lt)
+
+
+def _str_to_date_col(a: Column, f, lt: LType) -> Column:
+    import numpy as np
+
+    vals = [f(s) for s in a.dictionary.values]
+    ok = np.asarray([v is not None for v in vals], bool)
+    table = np.asarray([0 if v is None else v for v in vals],
+                       lt.np_dtype)
+    data = jnp.take(jnp.asarray(table), jnp.clip(a.data, 0, None),
+                    mode="clip")
+    good = jnp.take(jnp.asarray(ok), jnp.clip(a.data, 0, None), mode="clip")
+    validity = good if a.validity is None else (a.validity & good)
+    return Column(data, validity, lt)
+
+
+# -- string functions -------------------------------------------------------
+
+_str_fn("quote", lambda s: "'" + s.replace("\\", "\\\\")
+        .replace("'", "\\'") + "'")
+_str_fn("unhex", lambda s: bytes.fromhex(s).decode("utf-8", "replace")
+        if len(s) % 2 == 0 and all(c in "0123456789abcdefABCDEF"
+                                   for c in s) else "")
+_str_fn("sha", lambda s: hashlib.sha1(s.encode()).hexdigest())
+_str_fn("sha2", lambda s: hashlib.sha256(s.encode()).hexdigest())
+
+
+def _soundex(s: str) -> str:
+    if not s:
+        return ""
+    codes = {**dict.fromkeys("bfpv", "1"), **dict.fromkeys("cgjkqsxz", "2"),
+             **dict.fromkeys("dt", "3"), "l": "4",
+             **dict.fromkeys("mn", "5"), "r": "6"}
+    s2 = [c for c in s.lower() if c.isalpha()]
+    if not s2:
+        return ""
+    out = s2[0].upper()
+    prev = codes.get(s2[0], "")
+    for c in s2[1:]:
+        d = codes.get(c, "")
+        if d and d != prev:
+            out += d
+        if c not in "hw":
+            prev = d
+    return (out + "000")[:4] if len(out) < 4 else out
+
+
+_str_fn("soundex", _soundex)
+
+@_raw("split_part")
+def _split_part(e, batch):
+    a = _eval(e.args[0], batch)
+    delim = _lit_str(e, 1, "split_part")
+    n = _lit_int(e, 2, "split_part")
+
+    def f(s: str) -> str:
+        if n < 1:
+            return ""
+        parts = s.split(delim)
+        return parts[n - 1] if n <= len(parts) else ""
+
+    if isinstance(a, HostStr):
+        return HostStr(f(str(a)))
+    return _dict_transform(a, f)
+
+
+def _lit_int(e, i, name):
+    a = e.args[i]
+    if not isinstance(a, Lit) or isinstance(a.value, str):
+        raise ExprError(f"{name} argument {i + 1} must be an integer "
+                        f"literal")
+    return int(a.value)
+
+
+@_raw("insert")
+def _insert_fn(e, batch):
+    """INSERT(str, pos, len, newstr) with literal pos/len/newstr."""
+    a = _eval(e.args[0], batch)
+    pos = _lit_int(e, 1, "insert")
+    ln = _lit_int(e, 2, "insert")
+    new = _lit_str(e, 3, "insert")
+
+    def f(s: str) -> str:
+        if pos < 1 or pos > len(s):
+            return s
+        return s[:pos - 1] + new + s[pos - 1 + ln:]
+
+    if isinstance(a, HostStr):
+        return HostStr(f(str(a)))
+    return _dict_transform(a, f)
+
+
+@_raw("regexp_replace")
+def _regexp_replace(e, batch):
+    import re
+
+    a = _eval(e.args[0], batch)
+    pat = re.compile(_lit_str(e, 1, "regexp_replace"))
+    repl = _lit_str(e, 2, "regexp_replace")
+    f = lambda s: pat.sub(repl, s)   # noqa: E731
+    if isinstance(a, HostStr):
+        return HostStr(f(str(a)))
+    return _dict_transform(a, f)
+
+
+@_raw("elt")
+def _elt(e, batch):
+    """ELT(n, s1, s2, ...) with literal strings: a static dictionary of the
+    choices, device select by n."""
+    from .builtins_ext import _code_string
+    import numpy as np
+
+    n = _eval(e.args[0], batch)
+    choices = [_lit_str(e, i, "elt") for i in range(1, len(e.args))]
+    idx = n.data.astype(jnp.int32) - 1
+    good = (idx >= 0) & (idx < len(choices))
+    validity = good if n.validity is None else (n.validity & good)
+    return _code_string(jnp.clip(idx, 0, len(choices) - 1),
+                        np.asarray(choices, dtype=object), validity)
+
+
+@_raw("space")
+def _space(e, batch):
+    return HostStr(" " * _lit_int(e, 0, "space"))
+
+
+# -- JSON (reference: json_extract family) ---------------------------------
+
+def _json_parse(s: str):
+    try:
+        return _json.loads(s), True
+    except (ValueError, TypeError):
+        return None, False
+
+
+@_raw("json_valid")
+def _json_valid(e, batch):
+    a = _eval(e.args[0], batch)
+    f = lambda s: 1 if _json_parse(s)[1] else 0   # noqa: E731
+    if isinstance(a, HostStr):
+        return Column(jnp.asarray(bool(f(str(a)))), None, LType.BOOL)
+    c = _dict_scalar(a, f, LType.INT8)
+    return Column(c.data.astype(jnp.bool_), c.validity, LType.BOOL)
+
+
+@_raw("json_type")
+def _json_type(e, batch):
+    def f(s: str) -> str:
+        v, ok = _json_parse(s)
+        if not ok:
+            return "INVALID"
+        return {dict: "OBJECT", list: "ARRAY", str: "STRING", bool:
+                "BOOLEAN", int: "INTEGER", float: "DOUBLE",
+                type(None): "NULL"}[type(v)]
+    a = _eval(e.args[0], batch)
+    if isinstance(a, HostStr):
+        return HostStr(f(str(a)))
+    return _dict_transform(a, f)
+
+
+def _json_path_get(v, path: str):
+    """Subset of MySQL JSON paths: $.a.b[0].c"""
+    if not path.startswith("$"):
+        return None
+    cur = v
+    import re as _re
+
+    for part in _re.findall(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]",
+                            path[1:]):
+        key, idx = part
+        if key:
+            if not isinstance(cur, dict) or key not in cur:
+                return None
+            cur = cur[key]
+        else:
+            i = int(idx)
+            if not isinstance(cur, list) or i >= len(cur):
+                return None
+            cur = cur[i]
+    return cur
+
+
+@_raw("json_extract")
+def _json_extract(e, batch):
+    path = _lit_str(e, 1, "json_extract")
+
+    def f(s: str) -> str:
+        v, ok = _json_parse(s)
+        if not ok:
+            return ""
+        got = _json_path_get(v, path)
+        return "" if got is None else _json.dumps(got)
+
+    a = _eval(e.args[0], batch)
+    if isinstance(a, HostStr):
+        return HostStr(f(str(a)))
+    return _dict_transform(a, f)
+
+
+@_raw("json_unquote")
+def _json_unquote(e, batch):
+    def f(s: str) -> str:
+        v, ok = _json_parse(s)
+        return v if ok and isinstance(v, str) else s
+    a = _eval(e.args[0], batch)
+    if isinstance(a, HostStr):
+        return HostStr(f(str(a)))
+    return _dict_transform(a, f)
+
+
+# -- collation (utf8mb4_general_ci comparisons) ----------------------------
+
+@_raw("__collate_ci")
+def _collate_ci(e, batch):
+    """Case-insensitive collation marker: fold the value; the parser wraps
+    BOTH sides of a comparison when either carries COLLATE *_ci, so
+    comparisons/sorts against the folded dictionary are CI."""
+    a = _eval(e.args[0], batch)
+    if isinstance(a, HostStr):
+        return HostStr(str(a).casefold())
+    return _dict_transform(a, str.casefold)
+
+
+def _iso_week(days):
+    """ISO-8601 week number (MySQL WEEKOFYEAR == WEEK(d, 3))."""
+    dow = dtk.weekday(days)            # Monday = 0
+    thu = days - dow + 3               # this ISO week's Thursday
+    doy_thu = dtk.day_of_year(thu)     # 1-based within Thursday's year
+    return ((doy_thu - 1) // 7 + 1).astype(jnp.int32)
+
+
+def _as_days_l(a):
+    from .compile import _as_days
+
+    return _as_days(a)
+
+
+_reg("weekofyear", lambda a: Column(_iso_week(_as_days_l(a)), None,
+                                    LType.INT32), LType.INT32)
+
+
+@_raw("utc_timestamp")
+def _utc_timestamp(e, batch):
+    t = _dt.datetime.now(_dt.timezone.utc).replace(tzinfo=None)
+    us = int((t - _dt.datetime(1970, 1, 1)).total_seconds() * 1e6)
+    return Column(jnp.asarray(us, jnp.int64), None, LType.DATETIME)
+
+
+# -- misc ------------------------------------------------------------------
+
+@_raw("version")
+def _version(e, batch):
+    return HostStr("8.0.0-baikaldb-tpu")
+
+
+@_raw("connection_id")
+def _connection_id(e, batch):
+    return Column(jnp.asarray(0, jnp.int64), None, LType.INT64)
+
+
+_TYPE_RULES.update({
+    "bit_and": LType.INT64, "bit_or": LType.INT64, "bit_xor": LType.INT64,
+    "bit_not": LType.INT64, "left_shift": LType.INT64,
+    "right_shift": LType.INT64, "bit_length": LType.INT64,
+    "microsecond": LType.INT64, "to_seconds": LType.INT64,
+    "timestampdiff": LType.INT64, "str_to_date": LType.DATE,
+    "quote": LType.STRING, "unhex": LType.STRING, "sha": LType.STRING,
+    "sha2": LType.STRING, "soundex": LType.STRING,
+    "split_part": LType.STRING, "insert": LType.STRING,
+    "regexp_replace": LType.STRING, "elt": LType.STRING,
+    "space": LType.STRING, "json_valid": LType.BOOL,
+    "json_type": LType.STRING, "json_extract": LType.STRING,
+    "json_unquote": LType.STRING, "__collate_ci": LType.STRING,
+    "version": LType.STRING, "connection_id": LType.INT64,
+    "weekofyear": LType.INT32, "utc_timestamp": LType.DATETIME,
+    "date_add_months": lambda ts: ts[0],
+    "date_sub_months": lambda ts: ts[0],
+    "date_add_us": lambda ts: (LType.DATETIME if ts[0] is LType.DATE
+                               else ts[0]),
+})

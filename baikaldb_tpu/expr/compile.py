@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import math
 import re
+from typing import Optional
 from dataclasses import replace
 
 import jax.numpy as jnp
@@ -150,11 +151,39 @@ def _eval(e: Expr, batch: ColumnBatch):
     raise ExprError(f"cannot evaluate {e!r}")
 
 
+# functions whose arguments MySQL implicitly casts string->temporal; the
+# cast must not leak into plain arithmetic ('2024-01-10' + 1 is a NUMERIC
+# prefix cast in MySQL, not a date)
+_TEMPORAL_ARG_FNS = {
+    "year", "month", "day", "dayofmonth", "quarter", "dayofweek", "weekday",
+    "dayofyear", "last_day", "week", "yearweek", "weekofyear", "datediff",
+    "date", "to_days", "unix_timestamp", "time_to_sec", "date_add_days",
+    "date_sub_days", "date_add_months", "date_sub_months", "date_add_us",
+    "microsecond", "to_seconds", "greatest", "least",
+}
+
+
 def _devalue_hoststr(a, op):
     if isinstance(a, HostStr):
+        if op in _TEMPORAL_ARG_FNS:
+            c = _temporal_hoststr(a)
+            if c is not None:
+                return c    # MySQL implicit string->temporal cast
         raise ExprError(f"string literal not supported as argument of {op!r} "
                         "(device path); handled only in comparisons/LIKE/IN")
     return a
+
+
+def _temporal_hoststr(a) -> Optional[Column]:
+    """A date/datetime-shaped string literal as a temporal scalar Column
+    (MySQL's implicit cast in temporal contexts), else None."""
+    s = str(a).strip()
+    lt = LType.DATE if len(s) <= 10 else LType.DATETIME
+    try:
+        v = parse_temporal(s, lt)
+    except (ValueError, ExprError):
+        return None
+    return Column(jnp.asarray(v, lt.np_dtype), None, lt)
 
 
 def _with_null_prop(h, args: list[Column]) -> Column:

@@ -963,13 +963,40 @@ class Parser:
             return Call("not", (self._not_expr(),))
         return self._cmp_expr()
 
+    @staticmethod
+    def _ci_fold_lit(lhs: Expr, item: Expr) -> Expr:
+        """When ``lhs`` carries COLLATE *_ci, fold the comparand: string
+        literals casefold in place (LIKE/IN/BETWEEN handlers need literal
+        operands, so wrapping them in a call would break them); other
+        expressions wrap in the fold call."""
+        if not (isinstance(lhs, Call) and lhs.op == "__collate_ci"):
+            return item
+        if isinstance(item, Lit) and isinstance(item.value, str):
+            return Lit(item.value.casefold())
+        if isinstance(item, Call) and item.op == "__collate_ci":
+            return item
+        return Call("__collate_ci", (item,))
+
+    @staticmethod
+    def _ci_wrap(a: Expr, b: Expr) -> tuple:
+        """COLLATE *_ci on either comparison operand folds BOTH (MySQL:
+        the collation applies to the comparison, not one side)."""
+        def is_ci(x):
+            return isinstance(x, Call) and x.op == "__collate_ci"
+        if is_ci(a) and not is_ci(b):
+            return a, Call("__collate_ci", (b,))
+        if is_ci(b) and not is_ci(a):
+            return Call("__collate_ci", (a,)), b
+        return a, b
+
     def _cmp_expr(self) -> Expr:
         e = self._add_expr()
         while True:
             t = self.peek()
             if t.kind == "OP" and t.value in _CMP_OPS:
                 self.advance()
-                e = Call(_CMP_OPS[t.value], (e, self._add_expr()))
+                a, b = self._ci_wrap(e, self._add_expr())
+                e = Call(_CMP_OPS[t.value], (a, b))
                 continue
             if t.kind == "KW" and t.value == "is":
                 self.advance()
@@ -982,7 +1009,7 @@ class Parser:
             if self.try_kw("not"):
                 neg = True
             if self.try_kw("like"):
-                pat = self._add_expr()
+                pat = self._ci_fold_lit(e, self._add_expr())
                 e = Call("not_like" if neg else "like", (e, pat))
                 continue
             if self.try_kw("regexp") or self.try_kw("rlike"):
@@ -998,16 +1025,16 @@ class Parser:
                     e = Call("not_in_subquery" if neg else "in_subquery",
                              (e, Subquery(sub)))
                     continue
-                args = [e, self._in_item()]
+                args = [e, self._ci_fold_lit(e, self._in_item())]
                 while self.try_op(","):
-                    args.append(self._in_item())
+                    args.append(self._ci_fold_lit(e, self._in_item()))
                 self.expect_op(")")
                 e = Call("not_in" if neg else "in", tuple(args))
                 continue
             if self.try_kw("between"):
-                lo = self._add_expr()
+                lo = self._ci_fold_lit(e, self._add_expr())
                 self.expect_kw("and")
-                hi = self._add_expr()
+                hi = self._ci_fold_lit(e, self._add_expr())
                 b = Call("between", (e, lo, hi))
                 e = Call("not", (b,)) if neg else b
                 continue
@@ -1059,7 +1086,17 @@ class Parser:
             return Call("neg", (inner,))
         if self.try_op("+"):
             return self._unary_expr()
-        return self._primary()
+        e = self._primary()
+        # postfix COLLATE: *_ci collations fold the operand (comparison
+        # construction folds the OTHER side too); binary/_bin collations
+        # are the default code semantics and parse as no-ops
+        while self.peek().kind == "IDENT" and \
+                self.peek().value.lower() == "collate":
+            self.advance()
+            name = self.ident().lower()
+            if name.endswith("_ci"):
+                e = Call("__collate_ci", (e,))
+        return e
 
     def _primary(self) -> Expr:
         t = self.peek()
@@ -1184,18 +1221,59 @@ class Parser:
             if w is None:
                 raise SqlError(f"{lname} requires an OVER clause")
             return w
-        # DATE_ADD(x, INTERVAL n DAY)
+        # DATE_ADD(x, INTERVAL n unit) — day/week/month/quarter/year plus
+        # sub-day units (hour/minute/second/microsecond, which promote DATE
+        # to DATETIME like MySQL)
         if lname in ("date_add", "date_sub"):
             x = self.expr()
             self.expect_op(",")
             self.expect_kw("interval")
             n = self.expr()
-            unit = self.ident().lower()
+            unit = self.ident().lower().rstrip("s")
             self.expect_op(")")
-            if unit not in ("day", "days"):
-                raise SqlError(f"unsupported INTERVAL unit {unit!r} (round 1)")
-            return Call("date_add_days" if lname == "date_add" else "date_sub_days",
-                        (x, n))
+            sub = lname == "date_sub"
+            if unit == "week":
+                n = Call("mul", (n, Lit(7)))
+                unit = "day"
+            if unit == "day":
+                return Call("date_sub_days" if sub else "date_add_days",
+                            (x, n))
+            if unit in ("month", "quarter", "year"):
+                mult = {"month": 1, "quarter": 3, "year": 12}[unit]
+                if mult != 1:
+                    n = Call("mul", (n, Lit(mult)))
+                return Call("date_sub_months" if sub else "date_add_months",
+                            (x, n))
+            us = {"hour": 3600_000_000, "minute": 60_000_000,
+                  "second": 1_000_000, "microsecond": 1}.get(unit)
+            if us is None:
+                raise SqlError(f"unsupported INTERVAL unit {unit!r}")
+            n = Call("mul", (n, Lit(us)))
+            if sub:
+                n = Call("neg", (n,))
+            return Call("date_add_us", (x, n))
+        # TIMESTAMPDIFF(unit, a, b) — the unit is a bare word
+        if lname == "timestampdiff":
+            unit = self.ident().lower().rstrip("s")
+            self.expect_op(",")
+            a = self.expr()
+            self.expect_op(",")
+            b = self.expr()
+            self.expect_op(")")
+            return Call("timestampdiff", (Lit(unit), a, b))
+        # EXTRACT(unit FROM e) -> the matching single-field function
+        if lname == "extract":
+            unit = self.ident().lower().rstrip("s")
+            self.expect_kw("from")
+            e = self.expr()
+            self.expect_op(")")
+            fn = {"year": "year", "month": "month", "day": "day",
+                  "hour": "hour", "minute": "minute", "second": "second",
+                  "quarter": "quarter", "week": "week",
+                  "microsecond": "microsecond"}.get(unit)
+            if fn is None:
+                raise SqlError(f"unsupported EXTRACT unit {unit!r}")
+            return Call(fn, (e,))
         args = []
         if not self.try_op(")"):
             args.append(self.expr())

@@ -536,7 +536,7 @@ class ReplicatedRowTier:
 
         arrow = _schema_arrow(self.row_schema)
         rowid_col = self.key_columns[0]
-        reclaimed = 0
+        candidates: set[str] = set()
         with self._mu:
             for m, g in zip(self.metas, self.groups):
                 node = self._leader_node(m, g)
@@ -570,9 +570,17 @@ class ReplicatedRowTier:
                 if not g.propose_cmd(CMD_COLD, 0, payload):
                     raise ReplicationError(
                         f"region {g.region_id}: cold gc propose failed")
-                for f in old_files:
-                    fs.delete(f)
-                reclaimed += len(old_files)
+                candidates.update(old_files)
+            # delete only files NO region still references: split children
+            # can share a parent segment file across their manifests
+            still_used: set[str] = set()
+            for m, g in zip(self.metas, self.groups):
+                node = self._leader_node(m, g)
+                still_used.update(f for _s, f, _w in node.cold_manifest)
+            reclaimed = 0
+            for f in candidates - still_used:
+                fs.delete(f)
+                reclaimed += 1
         return reclaimed
 
     def hot_bytes(self) -> int:

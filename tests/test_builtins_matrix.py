@@ -1,0 +1,220 @@
+"""Builtin function golden matrix (VERDICT r03 missing #5 / next #8).
+
+Ports the reference's function test matrix
+(/root/reference/test/test_internal_functions.cpp: round half-away-from-
+zero, substring_index, week/weekofyear/yearweek) and extends it across the
+newly-registered families (bit ops, temporal arithmetic incl. INTERVAL
+units, string, JSON, collation).  Expected values are MySQL 8.0 semantics.
+"""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Database, Session
+
+
+@pytest.fixture(scope="module")
+def s():
+    return Session(Database())
+
+
+def one(s, expr):
+    return s.query(f"SELECT {expr} AS v")[0]["v"]
+
+
+# -- the reference's own matrix (test_internal_functions.cpp) --------------
+
+@pytest.mark.parametrize("expr,want", [
+    ("ROUND(1.5)", 2.0), ("ROUND(-1.5)", -2.0),       # half away from zero
+    ("ROUND(2.5)", 3.0), ("ROUND(-2.5)", -3.0),
+    ("ROUND(1.298, 1)", 1.3), ("ROUND(1.298, 0)", 1.0),
+    ("ROUND(23.298, -1)", 20.0),
+])
+def test_round_matrix(s, expr, want):
+    assert one(s, expr) == pytest.approx(want)
+
+
+@pytest.mark.parametrize("expr,want", [
+    ("SUBSTRING_INDEX('www.mysql.com', '.', 2)", "www.mysql"),
+    ("SUBSTRING_INDEX('www.mysql.com', '.', -2)", "mysql.com"),
+    ("SUBSTRING_INDEX('www.mysql.com', '.', 0)", ""),
+    ("SUBSTRING_INDEX('www.mysql.com', '.', 10)", "www.mysql.com"),
+    ("SUBSTRING_INDEX('a,b,c', ',', 1)", "a"),
+])
+def test_substring_index_matrix(s, expr, want):
+    assert one(s, expr) == want
+
+
+@pytest.mark.parametrize("expr,want", [
+    ("WEEK('2008-02-20')", 7),            # mode 0: Sunday-start
+    ("WEEK('2008-12-31')", 52),
+    ("WEEKOFYEAR('2008-02-20')", 8),      # ISO (mode 3)
+    ("WEEKOFYEAR('2024-01-01')", 1),
+    ("WEEKOFYEAR('2023-01-01')", 52),     # Sunday: still prior ISO year
+    ("YEARWEEK('2008-02-20')", 200807),
+])
+def test_week_matrix(s, expr, want):
+    assert one(s, expr) == want
+
+
+# -- temporal arithmetic ----------------------------------------------------
+
+@pytest.mark.parametrize("expr,want", [
+    ("DATE_ADD('2024-01-31', INTERVAL 1 MONTH)", "2024-02-29"),  # clamp
+    ("DATE_ADD('2024-02-29', INTERVAL 1 YEAR)", "2025-02-28"),
+    ("DATE_SUB('2024-03-31', INTERVAL 1 MONTH)", "2024-02-29"),
+    ("DATE_ADD('2024-01-01', INTERVAL 2 WEEK)", "2024-01-15"),
+    ("DATE_ADD('2024-01-01', INTERVAL 1 QUARTER)", "2024-04-01"),
+])
+def test_interval_units(s, expr, want):
+    assert str(one(s, expr)) == want
+
+
+def test_interval_subday_promotes_to_datetime(s):
+    got = str(one(s, "DATE_ADD('2024-01-01', INTERVAL 90 MINUTE)"))
+    assert got.startswith("2024-01-01 01:30")
+
+
+@pytest.mark.parametrize("expr,want", [
+    ("TIMESTAMPDIFF(DAY, '2024-01-01', '2024-03-01')", 60),
+    ("TIMESTAMPDIFF(MONTH, '2024-01-15', '2024-03-14')", 1),   # partial
+    ("TIMESTAMPDIFF(MONTH, '2024-01-15', '2024-03-15')", 2),
+    ("TIMESTAMPDIFF(YEAR, '2020-06-01', '2024-05-31')", 3),
+    ("TIMESTAMPDIFF(WEEK, '2024-01-01', '2024-01-20')", 2),
+    ("EXTRACT(YEAR FROM '2024-05-17')", 2024),
+    ("EXTRACT(MONTH FROM '2024-05-17')", 5),
+    ("MICROSECOND('2024-01-01')", 0),
+])
+def test_timestampdiff_extract(s, expr, want):
+    assert one(s, expr) == want
+
+
+def test_str_to_date(s):
+    assert str(one(s, "STR_TO_DATE('17,5,2024', '%d,%m,%Y')")) \
+        == "2024-05-17"
+    # unparsable -> NULL
+    assert one(s, "STR_TO_DATE('nope', '%d,%m,%Y')") is None
+    # MySQL specifiers that differ from Python's: %s seconds, %i minutes,
+    # %M month name
+    got = str(one(s, "STR_TO_DATE('2024-01-01 10:20:30', "
+                     "'%Y-%m-%d %H:%i:%s')"))
+    assert got.startswith("2024-01-01 10:20:30")
+    assert str(one(s, "STR_TO_DATE('May 17, 2024', '%M %d, %Y')")) \
+        == "2024-05-17"
+
+
+def test_date_string_arithmetic_still_rejected(s):
+    """The implicit string->temporal cast must not leak into arithmetic:
+    MySQL treats '2024-01-10' + 1 as a numeric prefix cast, which this
+    engine refuses loudly rather than answering with epoch-day math."""
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="string literal"):
+        one(s, "'2024-01-10' + 1")
+
+
+def test_str_to_date_over_column(s):
+    s.execute("CREATE TABLE std_t (id BIGINT, d VARCHAR(16), "
+              "PRIMARY KEY (id))")
+    s.execute("INSERT INTO std_t VALUES (1, '2024-01-02'), (2, 'bad'), "
+              "(3, '2023-12-31')")
+    got = s.query("SELECT id, YEAR(STR_TO_DATE(d, '%Y-%m-%d')) y "
+                  "FROM std_t ORDER BY id")
+    assert [r["y"] for r in got] == [2024, None, 2023]
+
+
+# -- bit operations ---------------------------------------------------------
+
+@pytest.mark.parametrize("expr,want", [
+    ("BIT_AND(12, 10)", 8), ("BIT_OR(12, 10)", 14),
+    ("BIT_XOR(12, 10)", 6), ("BIT_NOT(0)", -1),
+    ("LEFT_SHIFT(1, 10)", 1024), ("RIGHT_SHIFT(1024, 3)", 128),
+    ("BIT_LENGTH('abc')", 24), ("BIT_COUNT(29)", 4),
+])
+def test_bit_ops(s, expr, want):
+    assert one(s, expr) == want
+
+
+# -- strings ---------------------------------------------------------------
+
+@pytest.mark.parametrize("expr,want", [
+    ("QUOTE(\"it's\")", "'it\\'s'"),
+    ("UNHEX('4D7953514C')", "MySQL"),
+    ("SOUNDEX('Robert')", "R163"),
+    ("SOUNDEX('Rupert')", "R163"),
+    ("SPLIT_PART('a,b,c', ',', 2)", "b"),
+    ("SPLIT_PART('a,b,c', ',', 9)", ""),
+    ("INSERT('Quadratic', 3, 4, 'What')", "QuWhattic"),
+    ("REGEXP_REPLACE('a b  c', ' +', '_')", "a_b_c"),
+    ("ELT(2, 'ein', 'zwei', 'drei')", "zwei"),
+    ("SPACE(3)", "   "),
+    ("SHA('abc')", "a9993e364706816aba3e25717850c26c9cd0d89d"),
+])
+def test_string_fns(s, expr, want):
+    assert one(s, expr) == want
+
+
+def test_elt_out_of_range_is_null(s):
+    assert one(s, "ELT(9, 'a', 'b')") is None
+
+
+# -- JSON ------------------------------------------------------------------
+
+@pytest.mark.parametrize("expr,want", [
+    ("JSON_VALID('{\"a\": 1}')", 1),
+    ("JSON_VALID('nope')", 0),
+    ("JSON_TYPE('[1,2]')", "ARRAY"),
+    ("JSON_TYPE('{\"a\": 1}')", "OBJECT"),
+    ("JSON_EXTRACT('{\"a\": {\"b\": 7}}', '$.a.b')", "7"),
+    ("JSON_EXTRACT('{\"a\": [1, 2, 3]}', '$.a[1]')", "2"),
+    ("JSON_UNQUOTE('\"hi\"')", "hi"),
+])
+def test_json_fns(s, expr, want):
+    got = one(s, expr)
+    if isinstance(want, int) and not isinstance(got, str):
+        got = int(got)
+    assert got == want
+
+
+def test_json_over_column(s):
+    s.execute("CREATE TABLE js_t (id BIGINT, j VARCHAR(64), "
+              "PRIMARY KEY (id))")
+    s.execute('INSERT INTO js_t VALUES (1, \'{"k": "x"}\'), '
+              "(2, '[4,5]'), (3, 'junk')")
+    got = s.query("SELECT id, JSON_TYPE(j) t FROM js_t ORDER BY id")
+    assert [r["t"] for r in got] == ["OBJECT", "ARRAY", "INVALID"]
+
+
+# -- collation (utf8mb4_general_ci) ----------------------------------------
+
+def test_collate_ci_comparisons(s):
+    s.execute("CREATE TABLE ci_t (id BIGINT, name VARCHAR(32), "
+              "PRIMARY KEY (id))")
+    s.execute("INSERT INTO ci_t VALUES (1, 'Alice'), (2, 'ALICE'), "
+              "(3, 'bob')")
+    got = s.query("SELECT id FROM ci_t WHERE name COLLATE "
+                  "utf8mb4_general_ci = 'alice' ORDER BY id")
+    assert [r["id"] for r in got] == [1, 2]
+    # without the collation, byte semantics hold
+    got = s.query("SELECT id FROM ci_t WHERE name = 'alice'")
+    assert got == []
+    # folding applies to BOTH sides regardless of which operand carries it
+    got = s.query("SELECT id FROM ci_t WHERE 'BOB' COLLATE "
+                  "utf8mb4_general_ci = name")
+    assert [r["id"] for r in got] == [3]
+    # ... and to IN / LIKE / BETWEEN comparands
+    got = s.query("SELECT id FROM ci_t WHERE name COLLATE "
+                  "utf8mb4_general_ci IN ('BOB', 'nobody') ORDER BY id")
+    assert [r["id"] for r in got] == [3]
+    got = s.query("SELECT id FROM ci_t WHERE name COLLATE "
+                  "utf8mb4_general_ci LIKE 'ALI%' ORDER BY id")
+    assert [r["id"] for r in got] == [1, 2]
+    got = s.query("SELECT id FROM ci_t WHERE name COLLATE "
+                  "utf8mb4_general_ci BETWEEN 'AA' AND 'AZ' ORDER BY id")
+    assert [r["id"] for r in got] == [1, 2]
+
+
+# -- misc ------------------------------------------------------------------
+
+def test_version_and_utc(s):
+    assert "baikaldb" in one(s, "VERSION()")
+    assert str(one(s, "UTC_TIMESTAMP()")).startswith("20")

@@ -166,6 +166,34 @@ def test_gc_compacts_single_dirty_segment(tmp_path):
     assert s2.query("SELECT COUNT(*) n FROM t") == [{"n": 5}]
 
 
+def test_gc_never_deletes_segment_shared_by_split_child(tmp_path):
+    """Split children can reference the parent's segment file; GC of one
+    region must not delete a file a sibling manifest still needs."""
+    s, fleet = fleet_session(tmp_path)
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    tier = fleet.row_tiers["default.t"]
+    for i in range(12):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    s.execute("HANDLE cold_flush default.t")        # one shared-era segment
+    for i in range(12, 24):
+        s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+    s.execute("DELETE FROM t WHERE id = 1")
+    s.execute("HANDLE cold_flush default.t")        # second segment
+    s.execute("DELETE FROM t WHERE id = 2")
+    s.execute("HANDLE cold_flush default.t")
+    s.execute("HANDLE cold_gc default.t")
+    # every manifest-referenced file must still exist
+    fs = s.db.cold_fs()
+    for m, g in zip(tier.metas, tier.groups):
+        node = g.bus.nodes[g.leader()]
+        for _sq, f, _w in node.cold_manifest:
+            assert fs.exists(f), f
+    s2 = Session(Database(fleet=fleet, cold_dir=str(s.db.cold_dir)))
+    s2.execute("CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    got = s2.query("SELECT COUNT(*) n FROM t")
+    assert got == [{"n": 22}]
+
+
 def test_cold_flush_requires_configured_fs(tmp_path):
     from baikaldb_tpu.meta.service import MetaService
     from baikaldb_tpu.raft.fleet import StoreFleet
