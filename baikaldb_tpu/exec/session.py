@@ -33,7 +33,8 @@ from ..sql.stmt import (AlterTableStmt, CreateDatabaseStmt, CreateTableStmt, Del
                         ExplainStmt, InsertStmt, SelectStmt, ShowStmt,
                         SetStmt, TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
 from ..meta.privileges import READ, WRITE, AccessError, PrivilegeManager
-from ..sql.stmt import (CreateUserStmt, DropUserStmt, GrantStmt, HandleStmt,
+from ..sql.stmt import (CreateUserStmt, CreateViewStmt, DropUserStmt,
+                        DropViewStmt, GrantStmt, HandleStmt,
                         LoadDataStmt, RevokeStmt)
 from ..storage.column_store import ROWID as ROWID_COL
 from ..storage.column_store import TableStore, schema_to_arrow
@@ -316,6 +317,9 @@ class Database:
                                 for ix in info.indexes],
                     "options": dict(info.options or {}),
                 })
+        out["views"] = [{"database": db, "name": v,
+                         **self.catalog.get_view(db, v)}
+                        for db in dbs for v in self.catalog.views(db)]
         tmp = os.path.join(self.data_dir, "catalog.json.tmp")
         with open(tmp, "w") as f:
             json.dump(out, f)
@@ -347,6 +351,9 @@ class Database:
             for ix in indexes:
                 if ix.params.get("state") == "backfilling":
                     resume.append((key, ix))
+        for v in saved.get("views", []):
+            self.catalog.create_view(v["database"], v["name"], v["sql"],
+                                     v.get("columns"), or_replace=True)
         # resume interrupted backfills only AFTER every table is loaded:
         # the worker save_catalog()s at publish, and a snapshot taken
         # mid-recovery would persist a catalog missing later tables
@@ -483,7 +490,8 @@ class Session:
             for _, e in getattr(s, "assignments", []) or []:
                 sub_dbs(e)
             return
-        if isinstance(s, (CreateTableStmt, DropTableStmt, AlterTableStmt)):
+        if isinstance(s, (CreateTableStmt, DropTableStmt, AlterTableStmt,
+                          CreateViewStmt, DropViewStmt)):
             P.check(self.user, s.table.database or self.current_db, WRITE)
             return
         if isinstance(s, CreateDatabaseStmt):
@@ -571,7 +579,8 @@ class Session:
         # DDL implicitly commits any open transaction (MySQL semantics);
         # rolling back across a schema change is not supported
         if isinstance(s, (CreateTableStmt, DropTableStmt, CreateDatabaseStmt,
-                          DropDatabaseStmt, TruncateStmt, AlterTableStmt)):
+                          DropDatabaseStmt, TruncateStmt, AlterTableStmt,
+                          CreateViewStmt, DropViewStmt)):
             self._commit_txn()
         if isinstance(s, SelectStmt):
             return self._select(s)
@@ -594,6 +603,42 @@ class Session:
             return self._delete(s)
         if isinstance(s, CreateTableStmt):
             return self._create_table(s)
+        if isinstance(s, CreateViewStmt):
+            db = s.table.database or self.current_db
+            prior = self.db.catalog.get_view(db, s.table.name)
+            try:
+                self.db.catalog.create_view(db, s.table.name, s.select_sql,
+                                            s.columns, s.or_replace)
+            except ValueError as e:
+                raise PlanError(str(e)) from None
+            # a view shadows nothing but must PLAN against current tables:
+            # surface body errors at CREATE, like the reference's validator
+            try:
+                self._plan_select(parse_sql(
+                    f"SELECT * FROM {db}.{s.table.name}")[0])
+            except Exception:
+                # a failed OR REPLACE keeps the previous definition (MySQL)
+                if prior is not None:
+                    self.db.catalog.create_view(db, s.table.name,
+                                                prior["sql"],
+                                                prior.get("columns"),
+                                                or_replace=True)
+                else:
+                    self.db.catalog.drop_view(db, s.table.name,
+                                              if_exists=True)
+                raise
+            self._plan_cache.clear()
+            self.db.save_catalog()
+            return Result()
+        if isinstance(s, DropViewStmt):
+            db = s.table.database or self.current_db
+            try:
+                self.db.catalog.drop_view(db, s.table.name, s.if_exists)
+            except ValueError as e:
+                raise PlanError(str(e)) from None
+            self._plan_cache.clear()
+            self.db.save_catalog()
+            return Result()
         if isinstance(s, AlterTableStmt):
             return self._alter_table(s)
         if isinstance(s, DropTableStmt):
@@ -706,10 +751,20 @@ class Session:
             db = s.database or self.current_db
             names = [n for n in cat.tables(db) if not is_rollup_table(n)
                      and not is_backing_table(n)]
+            names = sorted(names + cat.views(db))   # MySQL lists views too
             return Result(columns=[f"Tables_in_{db}"],
                           arrow=pa.table({f"Tables_in_{db}": names}))
         if s.what == "create_table":
             db = s.table.database or self.current_db
+            view = cat.get_view(db, s.table.name)
+            if view is not None:
+                cols = f" ({', '.join(view['columns'])})" \
+                    if view["columns"] else ""
+                ddl = (f"CREATE VIEW `{s.table.name}`{cols} AS "
+                       f"{view['sql']}")
+                return Result(columns=["View", "Create View"],
+                              arrow=pa.table({"View": [s.table.name],
+                                              "Create View": [ddl]}))
             info = cat.get_table(db, s.table.name)
             lines = []
             pk = info.primary_key()
@@ -2233,13 +2288,18 @@ class Session:
             stale = any(self.db.stores.get(tk) is None or
                         self.db.stores[tk].version != v
                         for tk, v in entry["versions"].items())
+            # view redefinitions (possibly by ANOTHER session) change plans
+            # without touching any table store version
+            if entry.get("view_gen") != self.db.catalog.view_gen:
+                stale = True
             if stale:
                 entry = None
         (metrics.plan_cache_hits if entry is not None
          else metrics.plan_cache_misses).add(1)
         if entry is None:
             plan = self._plan_select(stmt)
-            entry = {"plan": plan, "compiled": {}, "versions": {}}
+            entry = {"plan": plan, "compiled": {}, "versions": {},
+                     "view_gen": self.db.catalog.view_gen}
             cap = int(FLAGS.plan_cache_size)
             if cache_key and cap > 0:
                 self._plan_cache[cache_key] = entry

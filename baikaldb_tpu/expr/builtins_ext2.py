@@ -148,11 +148,13 @@ def _timestampdiff(e, batch):
         rolled = _shift_months(da.astype(jnp.int32),
                                months.astype(jnp.int32))
         toda = ua - da.astype(jnp.int64) * dtk.US_PER_DAY
-        todb = ub - db.astype(jnp.int64) * dtk.US_PER_DAY
-        over = (rolled.astype(jnp.int64) * dtk.US_PER_DAY + toda) > ub
-        under = (rolled.astype(jnp.int64) * dtk.US_PER_DAY + toda) < ua
+        shifted = rolled.astype(jnp.int64) * dtk.US_PER_DAY + toda
+        # a + months must not overshoot b in either direction (MySQL
+        # counts only complete periods)
+        over = shifted > ub
+        under = shifted < ub
         months = months - jnp.where((months > 0) & over, 1, 0) \
-            + jnp.where((months < 0) & under, 1, 0) + 0 * todb
+            + jnp.where((months < 0) & under, 1, 0)
         div = {"month": 1, "quarter": 3, "year": 12}[unit]
         return Column((months // div).astype(jnp.int64), None, LType.INT64)
     raise ExprError(f"TIMESTAMPDIFF unit {unit!r} unsupported")

@@ -93,6 +93,12 @@ class Catalog:
         # generations.  Treat both dicts as immutable after publish.
         self._snap: tuple[dict[str, frozenset[str]], dict[str, TableInfo]] \
             = ({"default": frozenset()}, {})
+        # "db.name" -> {"sql", "columns"} (immutable after publish, like
+        # the table snapshot).  view_gen bumps on every view change so
+        # OTHER sessions' plan caches notice redefinitions (their staleness
+        # checks otherwise only watch table store versions)
+        self._views: dict[str, dict] = {}
+        self.view_gen = 0
 
     @property
     def _databases(self) -> dict[str, "frozenset[str]"]:
@@ -129,6 +135,8 @@ class Catalog:
             dbs = dict(self._databases)
             del dbs[name]
             self._snap = (dbs, tables)          # atomic publish
+            self._views = {k: v for k, v in self._views.items()
+                           if not k.startswith(f"{name}.")}
 
     def databases(self) -> list[str]:
         return sorted(set(self._databases) | {"information_schema"})
@@ -146,6 +154,8 @@ class Catalog:
                 if if_not_exists:
                     return self._tables[key]
                 raise ValueError(f"table {key!r} exists")
+            if key in self._views:
+                raise ValueError(f"view {key!r} exists")
             info = TableInfo(next(self._ids), "default", database, name, schema,
                              indexes=indexes or [], options=options or {})
             tables = dict(self._tables)
@@ -211,6 +221,45 @@ class Catalog:
         if key not in tables:
             raise ValueError(f"table {key!r} does not exist")
         return tables[key]
+
+    # -- views (reference: view DDL in src/logical_plan/ddl_planner.cpp;
+    # expansion at plan time like a derived table) -----------------------
+    def create_view(self, database: str, name: str, sql: str,
+                    columns: list[str] | None = None,
+                    or_replace: bool = False) -> None:
+        with self._lock:
+            if database not in self._databases:
+                raise ValueError(f"database {database!r} does not exist")
+            key = f"{database}.{name}"
+            if key in self._tables:
+                raise ValueError(f"table {key!r} exists")
+            if key in self._views and not or_replace:
+                raise ValueError(f"view {key!r} exists")
+            views = dict(self._views)
+            views[key] = {"sql": sql, "columns": list(columns or [])}
+            self._views = views                 # atomic publish
+            self.view_gen += 1
+
+    def get_view(self, database: str, name: str):
+        """{'sql', 'columns'} or None."""
+        return self._views.get(f"{database}.{name}")
+
+    def drop_view(self, database: str, name: str,
+                  if_exists: bool = False) -> None:
+        with self._lock:
+            key = f"{database}.{name}"
+            if key not in self._views:
+                if if_exists:
+                    return
+                raise ValueError(f"view {key!r} does not exist")
+            views = dict(self._views)
+            del views[key]
+            self._views = views
+            self.view_gen += 1
+
+    def views(self, database: str) -> list[str]:
+        pre = f"{database}."
+        return sorted(k[len(pre):] for k in self._views if k.startswith(pre))
 
     def has_table(self, database: str, name: str) -> bool:
         return f"{database}.{name}" in self._tables

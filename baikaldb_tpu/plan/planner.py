@@ -603,6 +603,42 @@ class Planner:
                 return self._plan_table_ref(ref2, scope)
             finally:
                 self._ctes = saved
+        if ref.subquery is None:
+            vdb = ref.database or self.default_db
+            view = self.catalog.get_view(vdb, ref.name) \
+                if hasattr(self.catalog, "get_view") else None
+            if view is not None:
+                # view expansion: plan the stored body as a derived table
+                # under the reference's label (reference: view DDL,
+                # ddl_planner.cpp; MySQL MERGE-less TEMPTABLE semantics)
+                key = f"{vdb}.{ref.name}"
+                stack = getattr(self, "_view_stack", set())
+                if key in stack:
+                    raise PlanError(f"view {key!r} is recursive")
+                from ..sql.parser import parse_sql
+                sel = parse_sql(view["sql"])[0]
+                cols = view.get("columns") or []
+                if cols:
+                    if len(cols) != len(sel.items):
+                        raise PlanError(
+                            f"view {key!r} declares {len(cols)} columns "
+                            f"but selects {len(sel.items)}")
+                    for item, cname in zip(sel.items, cols):
+                        item.alias = cname
+                import copy
+                ref2 = copy.copy(ref)
+                ref2.subquery = sel
+                ref2.alias = ref.alias or ref.name
+                self._view_stack = stack | {key}
+                saved_db = self.default_db
+                # unqualified names in the body resolve against the VIEW's
+                # database, not the querying session's (MySQL semantics)
+                self.default_db = vdb
+                try:
+                    return self._plan_table_ref(ref2, scope)
+                finally:
+                    self._view_stack = stack
+                    self.default_db = saved_db
         if ref.subquery is not None:
             sub = self._plan_query(ref.subquery)
             label = ref.label

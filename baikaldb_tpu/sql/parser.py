@@ -16,8 +16,9 @@ from typing import Optional
 from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, Subquery, WindowCall
 from .lexer import SqlError, Token, tokenize
 from .stmt import (AlterTableStmt, ColumnDef, CreateDatabaseStmt,
-                   CreateTableStmt, CreateUserStmt, DeleteStmt, DescribeStmt,
-                   DropDatabaseStmt, DropTableStmt, DropUserStmt, ExplainStmt,
+                   CreateTableStmt, CreateUserStmt, CreateViewStmt,
+                   DeleteStmt, DescribeStmt, DropDatabaseStmt, DropTableStmt,
+                   DropUserStmt, DropViewStmt, ExplainStmt,
                    GrantStmt, HandleStmt, InsertStmt, JoinClause,
                    LoadDataStmt, OrderItem, RevokeStmt, SelectItem,
                    SelectStmt, SetStmt, ShowStmt, TableRef, TruncateStmt, TxnStmt,
@@ -48,6 +49,7 @@ _CMP_OPS = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le",
 def parse_sql(sql: str):
     """Parse one or more ;-separated statements -> list of stmt nodes."""
     p = Parser(tokenize(sql))
+    p.sql = sql              # source text (CREATE VIEW stores its body)
     stmts = []
     while not p.at_end():
         if p.try_op(";"):
@@ -62,6 +64,7 @@ class Parser:
     def __init__(self, tokens: list[Token]):
         self.toks = tokens
         self.i = 0
+        self.sql = ""
 
     # -- token helpers ---------------------------------------------------
     def peek(self, k: int = 0) -> Token:
@@ -471,6 +474,30 @@ class Parser:
                     raise SqlError("IDENTIFIED BY needs a string literal")
                 password = t.value
             return CreateUserStmt(name, password, ine)
+        or_replace = False
+        if self.try_kw("or"):
+            self.expect_kw("replace")
+            or_replace = True
+        if self.peek().kind == "IDENT" and \
+                self.peek().value.lower() == "view":
+            # CREATE [OR REPLACE] VIEW name [(col, ...)] AS select
+            self.advance()
+            table = self.table_name()
+            cols = []
+            if self.peek().kind == "OP" and self.peek().value == "(":
+                cols = self._paren_name_list()
+            self.expect_kw("as")
+            start = self.peek().pos
+            sel = self.select_stmt()            # validates the body
+            end = self.peek().pos if not self.at_end() else len(self.sql)
+            body = self.sql[start:end].strip().rstrip(";").strip() \
+                if self.sql else ""
+            if not body:
+                raise SqlError("CREATE VIEW needs source text")
+            del sel     # body validated; expansion re-parses from text
+            return CreateViewStmt(table, body, cols, or_replace)
+        if or_replace:
+            raise SqlError("OR REPLACE only applies to CREATE VIEW")
         self.expect_kw("table")
         ine = self._if_not_exists()
         table = self.table_name()
@@ -786,6 +813,11 @@ class Parser:
             self.advance()
             ie = self._if_exists()
             return DropUserStmt(self._user_name(), ie)
+        if self.peek().kind == "IDENT" and \
+                self.peek().value.lower() == "view":
+            self.advance()
+            ie = self._if_exists()
+            return DropViewStmt(self.table_name(), ie)
         self.expect_kw("table")
         ie = self._if_exists()
         return DropTableStmt(self.table_name(), ie)

@@ -123,6 +123,22 @@ class DropTableStmt:
 
 
 @dataclass
+class CreateViewStmt:
+    """CREATE [OR REPLACE] VIEW name [(cols)] AS select (reference: view
+    DDL, ddl_planner.cpp)."""
+    table: TableRef
+    select_sql: str              # the view body, stored as SQL text
+    columns: list = field(default_factory=list)
+    or_replace: bool = False
+
+
+@dataclass
+class DropViewStmt:
+    table: TableRef
+    if_exists: bool = False
+
+
+@dataclass
 class TruncateStmt:
     table: TableRef
 
