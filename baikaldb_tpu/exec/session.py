@@ -317,9 +317,13 @@ class Database:
                                 for ix in info.indexes],
                     "options": dict(info.options or {}),
                 })
-        out["views"] = [{"database": db, "name": v,
-                         **self.catalog.get_view(db, v)}
-                        for db in dbs for v in self.catalog.views(db)]
+        vsnap = self.catalog._views      # ONE published dict: a concurrent
+        #                                  DROP VIEW swaps the attr, never
+        #                                  mutates this snapshot
+        out["views"] = [
+            {"database": k.split(".", 1)[0], "name": k.split(".", 1)[1], **v}
+            for k, v in sorted(vsnap.items())
+            if k.split(".", 1)[0] in dbs]
         tmp = os.path.join(self.data_dir, "catalog.json.tmp")
         with open(tmp, "w") as f:
             json.dump(out, f)
@@ -615,7 +619,7 @@ class Session:
             # surface body errors at CREATE, like the reference's validator
             try:
                 self._plan_select(parse_sql(
-                    f"SELECT * FROM {db}.{s.table.name}")[0])
+                    f"SELECT * FROM `{db}`.`{s.table.name}`")[0])
             except Exception:
                 # a failed OR REPLACE keeps the previous definition (MySQL)
                 if prior is not None:

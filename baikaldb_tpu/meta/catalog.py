@@ -137,6 +137,7 @@ class Catalog:
             self._snap = (dbs, tables)          # atomic publish
             self._views = {k: v for k, v in self._views.items()
                            if not k.startswith(f"{name}.")}
+            self.view_gen += 1      # cached plans over dropped views replan
 
     def databases(self) -> list[str]:
         return sorted(set(self._databases) | {"information_schema"})

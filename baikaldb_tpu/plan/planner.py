@@ -631,14 +631,19 @@ class Planner:
                 ref2.alias = ref.alias or ref.name
                 self._view_stack = stack | {key}
                 saved_db = self.default_db
+                saved_ctes = self._ctes
                 # unqualified names in the body resolve against the VIEW's
-                # database, not the querying session's (MySQL semantics)
+                # database, not the querying session's (MySQL semantics) —
+                # and the CALLER's CTEs must not shadow tables the body
+                # names (a view is a sealed scope)
                 self.default_db = vdb
+                self._ctes = {}
                 try:
                     return self._plan_table_ref(ref2, scope)
                 finally:
                     self._view_stack = stack
                     self.default_db = saved_db
+                    self._ctes = saved_ctes
         if ref.subquery is not None:
             sub = self._plan_query(ref.subquery)
             label = ref.label
@@ -1342,8 +1347,11 @@ class Planner:
                 subplan = self._plan_query(e.stmt)
             except PlanError as uncorr_err:
                 # outer references inside: try equality-correlated aggregate
-                # decorrelation (group by the correlation keys + join back)
+                # decorrelation (group by the correlation keys + join back),
+                # then the general Apply for everything else
                 col = self._try_correlated_scalar(e.stmt, holder, scope)
+                if col is None:
+                    col = self._try_general_apply(e.stmt, holder, scope)
                 if col is None:
                     raise uncorr_err
                 return col
@@ -1507,6 +1515,161 @@ class Planner:
         self._maybe_dense_join(jn)
         holder[0] = jn
         scope.extras[vname] = subplan.schema.field(vname).ltype
+        if is_bare_count:
+            return Call("ifnull", (ColRef(vname), Lit(0)))
+        return ColRef(vname)
+
+    def _try_general_apply(self, stmt, holder, scope):
+        """General correlated scalar AGGREGATE subquery — arbitrary
+        correlation predicates, not just equality (the reference's
+        ApplyNode, src/exec/apply_node.cpp 726 LoC).  Lowering:
+
+        1. tag the outer stream with a synthetic row identity,
+        2. join it to the subquery's FROM (equality correlation conjuncts
+           become join keys when present, else a cross join) and filter the
+           remaining correlation conjuncts over the combined row,
+        3. aggregate per outer row identity,
+        4. LEFT JOIN the per-row values back (NULL for outer rows with no
+           qualifying inner rows; bare COUNT gets IFNULL 0).
+
+        Returns the value expr, or None when the shape doesn't fit (not a
+        single aggregate item, or conjuncts that resolve in neither
+        scope)."""
+        from ..ops.hashagg import AggSpec, agg_result_type
+
+        if stmt.table is None or stmt.group_by or stmt.having or \
+                stmt.order_by or stmt.limit is not None or stmt.ctes or \
+                stmt.union is not None or stmt.distinct:
+            return None
+        if len(stmt.items) != 1 or not _contains_agg(stmt.items[0].expr):
+            return None
+        item = stmt.items[0].expr
+        is_bare_count = isinstance(item, AggCall) and \
+            item.op in ("count", "count_star")
+        if not (is_bare_count or isinstance(item, AggCall)) or \
+                (isinstance(item, AggCall) and len(item.args) > 1):
+            return None
+        # plan the subquery's FROM under its own scope
+        subscope = Scope()
+        try:
+            subplan = self._plan_table_ref(stmt.table, subscope)
+            for j in stmt.joins:
+                subplan = self._plan_join(subplan, j, subscope, stmt)
+        except PlanError:
+            return None
+        inner_res = _Resolver(subscope)
+        outer_res = _Resolver(scope)
+
+        def comb_res(x):
+            """Resolve with MySQL subquery scoping: unqualified names bind
+            INNER-first, outer only as a fallback — per ColRef, so one
+            conjunct can mix both sides."""
+            if isinstance(x, ColRef):
+                try:
+                    return inner_res(x)
+                except PlanError:
+                    return outer_res(x)
+            if isinstance(x, AggCall):
+                return AggCall(x.op, tuple(comb_res(a) for a in x.args),
+                               getattr(x, "distinct", False))
+            if isinstance(x, Call):
+                return Call(x.op, tuple(comb_res(a) for a in x.args))
+            if isinstance(x, Lit):
+                return x
+            raise PlanError(f"unsupported expression in Apply: {x!r}")
+        inner_pred = None
+        pairs: list[tuple[Expr, Expr]] = []      # (outer RESOLVED, inner)
+        residuals: list[Expr] = []               # resolved in comb
+        for c in _conjuncts(stmt.where) if stmt.where is not None else []:
+            try:
+                rc = inner_res(c)
+                inner_pred = rc if inner_pred is None \
+                    else Call("and", (inner_pred, rc))
+                continue
+            except PlanError:
+                pass
+            matched = False
+            if isinstance(c, Call) and c.op == "eq" and len(c.args) == 2:
+                a, b = c.args
+                for ie, oe in ((a, b), (b, a)):
+                    try:
+                        rie = inner_res(ie)
+                        roe = outer_res(oe)
+                    except PlanError:
+                        continue
+                    pairs.append((roe, rie))
+                    matched = True
+                    break
+            if matched:
+                continue
+            try:
+                residuals.append(comb_res(c))
+            except PlanError:
+                return None         # references neither scope fully
+        if not pairs and not residuals:
+            return None             # uncorrelated: not this path
+        if inner_pred is not None:
+            subplan = FilterNode(children=[subplan], pred=inner_pred,
+                                 schema=subplan.schema)
+        holder[0], rid = self._ensure_col(holder[0],
+                                          Call("__row_index", ()))
+        lkeys, rkeys = [], []
+        for roe, rie in pairs:
+            holder[0], k = self._ensure_col(holder[0], roe)
+            lkeys.append(k)
+            subplan, k2 = self._ensure_col(subplan, rie)
+            rkeys.append(k2)
+        if lkeys:
+            jn = JoinNode(children=[holder[0], subplan], how="inner",
+                          left_keys=lkeys, right_keys=rkeys,
+                          schema=_join_schema(holder[0], subplan, "inner"))
+            self._maybe_dense_join(jn)
+        else:
+            jn = JoinNode(children=[holder[0], subplan], how="cross",
+                          schema=_join_schema(holder[0], subplan, "cross"))
+        jn.subquery_right = True
+        mid: PlanNode = jn
+        if residuals:
+            pred = None
+            for rc in residuals:
+                pred = rc if pred is None else Call("and", (pred, rc))
+            mid = FilterNode(children=[mid], pred=pred, schema=mid.schema)
+        # per-outer-row aggregation over the row identity
+        spec_in = None
+        vname = self._tmp("av")
+        if item.args:
+            try:
+                varg = comb_res(item.args[0])
+            except PlanError:
+                return None
+            mid, spec_in = self._ensure_col(mid, varg)
+        op = "count_star" if (isinstance(item, AggCall) and
+                              item.op == "count_star") else item.op
+        distinct = bool(getattr(item, "distinct", False))
+        at = mid.schema.field(spec_in).ltype if spec_in else LType.INT64
+        ridk = self._tmp("ark")
+        keep = ProjectNode(
+            children=[mid],
+            exprs=[ColRef(rid)] + ([ColRef(spec_in)] if spec_in else []),
+            names=[ridk] + ([spec_in] if spec_in else []),
+            schema=Schema(tuple([Field(ridk, LType.INT64)] +
+                                ([mid.schema.field(spec_in)]
+                                 if spec_in else []))))
+        keep.derived = True          # outer pushdown stops here
+        agg = AggNode(
+            children=[keep], key_names=[ridk],
+            specs=[AggSpec(op, spec_in, vname, distinct=distinct)],
+            strategy="sorted", max_groups=0,
+            schema=Schema((Field(ridk, LType.INT64),
+                           Field(vname, agg_result_type(
+                               "count" if op == "count_star" else op, at)))))
+        # join the per-row value back by row identity
+        jb = JoinNode(children=[holder[0], agg], how="left",
+                      left_keys=[rid], right_keys=[ridk],
+                      schema=_join_schema(holder[0], agg, "left"))
+        jb.subquery_right = True
+        holder[0] = jb
+        scope.extras[vname] = agg.schema.field(vname).ltype
         if is_bare_count:
             return Call("ifnull", (ColRef(vname), Lit(0)))
         return ColRef(vname)

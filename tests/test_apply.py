@@ -1,0 +1,110 @@
+"""General correlated scalar subqueries — the Apply operator (VERDICT r03
+missing #6; reference: src/exec/apply_node.cpp, 726 LoC).  Correlations
+that are NOT pure equality lower to row-identity join + residual filter +
+per-outer-row aggregation + join-back."""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Database, Session
+
+
+@pytest.fixture()
+def s():
+    s = Session(Database())
+    s.execute("CREATE TABLE emp (id BIGINT, dept BIGINT, sal DOUBLE, "
+              "hired BIGINT, PRIMARY KEY (id))")
+    s.execute("INSERT INTO emp VALUES "
+              "(1, 10, 100.0, 2001), (2, 10, 200.0, 2003), "
+              "(3, 20, 300.0, 2002), (4, 20, 150.0, 2005), "
+              "(5, 30, 250.0, 2004)")
+    return s
+
+
+def golden(rows, fn):
+    return [fn(r, rows) for r in rows]
+
+
+EMP = [(1, 10, 100.0, 2001), (2, 10, 200.0, 2003), (3, 20, 300.0, 2002),
+       (4, 20, 150.0, 2005), (5, 30, 250.0, 2004)]
+
+
+def test_non_equality_correlated_scalar_in_select(s):
+    """Count of STRICTLY-EARLIER hires — inequality correlation, the shape
+    the equality decorrelation cannot touch."""
+    got = s.query("SELECT id, (SELECT COUNT(*) FROM emp e2 "
+                  "WHERE e2.hired < e1.hired) AS earlier "
+                  "FROM emp e1 ORDER BY id")
+    want = {i: sum(1 for (_, _, _, h2) in EMP if h2 < h)
+            for (i, _, _, h) in EMP}
+    assert {r["id"]: r["earlier"] for r in got} == want
+
+
+def test_non_equality_correlated_scalar_in_where(s):
+    """Salary above the average of everyone hired before them."""
+    got = s.query("SELECT id FROM emp e1 WHERE sal > "
+                  "(SELECT AVG(sal) FROM emp e2 WHERE e2.hired < e1.hired) "
+                  "ORDER BY id")
+    def avg_before(h):
+        xs = [sal for (_, _, sal, h2) in EMP if h2 < h]
+        return sum(xs) / len(xs) if xs else None
+    want = [i for (i, _, sal, h) in EMP
+            if avg_before(h) is not None and sal > avg_before(h)]
+    assert [r["id"] for r in got] == want
+
+
+def test_mixed_equality_and_residual_correlation(s):
+    """Equality on dept AND an inequality residual: the eq pair becomes the
+    join key, the inequality the residual filter."""
+    got = s.query("SELECT id, (SELECT SUM(sal) FROM emp e2 "
+                  "WHERE e2.dept = e1.dept AND e2.sal < e1.sal) AS below "
+                  "FROM emp e1 ORDER BY id")
+    def below(dept, sal):
+        xs = [s2 for (_, d2, s2, _) in EMP if d2 == dept and s2 < sal]
+        return sum(xs) if xs else None
+    want = {i: below(d, sal) for (i, d, sal, _) in EMP}
+    assert {r["id"]: r["below"] for r in got} == want
+
+
+def test_empty_groups_yield_null_and_count_zero(s):
+    got = s.query("SELECT id, "
+                  "(SELECT MAX(sal) FROM emp e2 WHERE e2.hired < e1.hired) "
+                  "AS mx, "
+                  "(SELECT COUNT(*) FROM emp e2 WHERE e2.hired < e1.hired) "
+                  "AS n FROM emp e1 WHERE e1.id = 1")
+    assert got == [{"id": 1, "mx": None, "n": 0}]   # earliest hire
+
+
+def test_apply_preserves_distinct(s):
+    s.execute("INSERT INTO emp VALUES (6, 10, 100.0, 2006)")  # dup sal 100
+    got = s.query("SELECT id, (SELECT COUNT(DISTINCT e2.sal) FROM emp e2 "
+                  "WHERE e2.hired < e1.hired) AS ds "
+                  "FROM emp e1 WHERE e1.id = 6")
+    # hires before 2006: sals {100,200,300,150,250} -> 5 distinct; with a
+    # plain COUNT the answer would be the same here, so ALSO check a case
+    # with duplicates in range
+    assert got == [{"id": 6, "ds": 5}]
+    s.execute("INSERT INTO emp VALUES (7, 10, 100.0, 2007)")
+    got = s.query("SELECT (SELECT COUNT(DISTINCT e2.sal) FROM emp e2 "
+                  "WHERE e2.hired < e1.hired) AS ds "
+                  "FROM emp e1 WHERE e1.id = 7")
+    assert got == [{"ds": 5}]                  # 100 appears twice, counted once
+
+
+def test_view_body_immune_to_outer_cte():
+    s = Session(Database())
+    s.execute("CREATE TABLE t (id BIGINT, PRIMARY KEY (id))")
+    s.execute("CREATE TABLE u (x BIGINT, PRIMARY KEY (x))")
+    s.execute("INSERT INTO t VALUES (1)")
+    s.execute("INSERT INTO u VALUES (99)")
+    s.execute("CREATE VIEW v AS SELECT id FROM t")
+    got = s.query("WITH t AS (SELECT x AS id FROM u) "
+                  "SELECT id FROM v")
+    assert got == [{"id": 1}]                  # the view still reads base t
+
+
+def test_apply_composes_with_aggregation(s):
+    """The Apply value feeds an OUTER aggregate."""
+    got = s.query("SELECT SUM(x.earlier) total FROM (SELECT id, "
+                  "(SELECT COUNT(*) FROM emp e2 WHERE e2.hired < e1.hired) "
+                  "AS earlier FROM emp e1) x")
+    assert got == [{"total": 0 + 1 + 2 + 3 + 4}]
