@@ -188,7 +188,8 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
             out, ovf = join_ops.join(
                 left, node.left_keys, right, node.right_keys, how=node.how,
                 cap=node.cap,
-                wide_keys_ok=getattr(node, "pack32_verified", False))
+                wide_keys_ok=getattr(node, "pack32_verified", False),
+                build_sorted=getattr(node, "build_sorted", False))
         overflows.append((node, ovf))
         # label-qualified names are globally unique, no suffixing occurs
         return out

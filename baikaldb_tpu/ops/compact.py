@@ -31,6 +31,18 @@ def compact(batch: ColumnBatch) -> ColumnBatch:
     return out
 
 
+def stable_partition(live) -> "jnp.ndarray":
+    """Permutation moving live rows to the front, STABLY, via prefix sums
+    and one scatter — O(n), no sort.  order[j] = source index of output
+    row j; the live prefix preserves input order (so an input sorted over
+    its live rows stays sorted)."""
+    n = live.shape[0]
+    nl = jnp.cumsum(live)
+    dest = jnp.where(live, nl - 1, nl[-1] + jnp.cumsum(~live) - 1)
+    return jnp.zeros(n, jnp.int32).at[dest].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+
 def shrink(batch: ColumnBatch, cap: int):
     """Pack live rows into a batch of STATIC capacity ``cap`` (smaller than
     the input's), returning (packed batch, needed live count).

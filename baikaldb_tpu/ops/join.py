@@ -201,7 +201,8 @@ def semi_join_neq(probe: ColumnBatch, probe_keys: list[str],
 def join(probe: ColumnBatch, probe_keys: list[str],
          build: ColumnBatch, build_keys: list[str],
          how: str = "inner", cap: int | None = None,
-         suffix: str = "_r", wide_keys_ok: bool = False):
+         suffix: str = "_r", wide_keys_ok: bool = False,
+         build_sorted: bool = False):
     """Returns (out_batch, needed_rows).
 
     ``needed_rows`` (traced int32) is the true output cardinality; the caller
@@ -225,7 +226,16 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     # key equal to dtype-max still sorts before every dead row, so the
     # first-dead clamp below is exact for all key values
     bdead = _build_dead(build, bvalid)
-    order = jnp.lexsort((bk, bdead))
+    if build_sorted:
+        # the planner proved the build side arrives key-sorted over its
+        # LIVE rows (e.g. the output of a sorted group-by on exactly these
+        # keys): a STABLE partition by deadness — O(n) prefix sums, no
+        # bitonic sort — yields the same layout lexsort would
+        from .compact import stable_partition
+
+        order = stable_partition(~bdead)
+    else:
+        order = jnp.lexsort((bk, bdead))
     n_live = jnp.sum(~bdead).astype(jnp.int32)
     bk_sorted = jnp.where(jnp.arange(len(build)) < n_live,
                           bk[order], _sentinel_max(bk.dtype))

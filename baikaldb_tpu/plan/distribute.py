@@ -44,6 +44,25 @@ REP = "rep"
 BROADCAST_ROWS = 1 << 16
 
 
+def _clear_exchanged_sorted_builds(plan: PlanNode) -> None:
+    """An Exchange on a join's build side (all_gather concatenation of
+    per-shard runs, or all_to_all interleave) destroys the key order the
+    planner's interesting-order pass proved — the O(n)-partition fast path
+    would silently mis-join, so it must revert to the lexsort."""
+    def has_exchange(n: PlanNode) -> bool:
+        if isinstance(n, ExchangeNode):
+            return True
+        return any(has_exchange(c) for c in n.children)
+
+    def walk(n: PlanNode) -> None:
+        if isinstance(n, JoinNode) and getattr(n, "build_sorted", False) \
+                and len(n.children) > 1 and has_exchange(n.children[1]):
+            n.build_sorted = False
+        for c in n.children:
+            walk(c)
+    walk(plan)
+
+
 def distribute(plan: PlanNode, n_shards: int,
                rows_fn: Optional[Callable[[str], int]] = None,
                broadcast_rows: Optional[int] = None) -> PlanNode:
@@ -54,6 +73,7 @@ def distribute(plan: PlanNode, n_shards: int,
         broadcast_rows = BROADCAST_ROWS     # module attr: patchable in tests
     d = _Distributor(n_shards, rows_fn or (lambda tk: 0), broadcast_rows)
     dist, _ = d.visit(plan)
+    _clear_exchanged_sorted_builds(plan)
     if dist == SHARD:
         root = ExchangeNode(children=[plan], schema=plan.schema, kind="gather")
         root.dist = REP

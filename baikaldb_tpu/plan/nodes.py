@@ -102,6 +102,10 @@ class JoinNode(PlanNode):
     # executor feeds store.sort_permutation(cols) so the kernel skips its
     # on-device sort.  (table_key, (key_col, neq_col))
     presort: Optional[tuple] = None
+    # build side PROVED key-sorted over live rows (a sorted group-by on
+    # exactly the join keys): the kernel's lexsort degrades to an O(n)
+    # stable deadness partition
+    build_sorted: bool = False
 
     def _label(self):
         dense = ""

@@ -112,7 +112,65 @@ class Planner:
         plan = self._plan_query(stmt)
         self._prune_columns(plan)
         plan = self._insert_shrinks(plan)
+        self._mark_sorted_builds(plan)
         return plan
+
+    def _mark_sorted_builds(self, plan: PlanNode) -> None:
+        """Sort-join build sides that are the output of a SORTED group-by on
+        exactly the join keys arrive already key-sorted (interesting-order
+        reuse): the join kernel's lexsort degrades to an O(n) deadness
+        partition.  Conditions: every right key traces through rename-only
+        Projects to the agg's key_names IN ORDER; integer keys only (string
+        codes remap at dictionary merge); for composite keys, non-negative
+        domains (32-bit packing must preserve the lexicographic order)."""
+        def trace(node: PlanNode, names: list[str]):
+            """Follow rename-only projections down; -> (node, names)."""
+            while isinstance(node, ProjectNode):
+                mapped = []
+                for n in names:
+                    try:
+                        i = node.names.index(n)
+                    except ValueError:
+                        return None
+                    e = node.exprs[i]
+                    if not isinstance(e, ColRef):
+                        return None
+                    mapped.append(e.name)
+                names = mapped
+                node = node.children[0]
+            return node, names
+
+        def walk(n: PlanNode) -> None:
+            for c in n.children:
+                walk(c)
+            if not (isinstance(n, JoinNode) and n.strategy != "dense"
+                    and n.how in ("inner", "left", "semi", "anti")
+                    and n.right_keys and not n.build_sorted):
+                return
+            hit = trace(n.children[1], list(n.right_keys))
+            if hit is None:
+                return
+            node, names = hit
+            # BOTH group-by strategies emit key-ordered outputs: sorted by
+            # the key sort itself, dense by domain-order slot layout
+            if not (isinstance(node, AggNode) and
+                    node.strategy in ("sorted", "dense")
+                    and list(node.key_names) == names):
+                return
+            for kn in names:
+                f = node.schema.field(kn)
+                if not (f.ltype.is_integer or f.ltype is LType.DATE):
+                    return
+            if len(names) > 1:
+                # packed order == lex order only when later keys never go
+                # negative; prove it from statistics
+                for kn in names[1:]:
+                    st = self._key_stats(node, kn)
+                    if not st or st.get("min") is None or int(st["min"]) < 0:
+                        return
+            n.build_sorted = True
+
+        walk(plan)
 
     def _insert_shrinks(self, plan: PlanNode) -> PlanNode:
         """Adaptive capacity cuts (ops/compact.shrink): a selective probe
@@ -145,6 +203,29 @@ class Planner:
                 i = parent.children.index(n)
                 parent.children[i] = ShrinkNode(children=[n],
                                                 schema=n.schema)
+            # (c) group-by / sort / distinct over a join-filtered chain:
+            # the multi-key device sort otherwise runs at the base table's
+            # capacity (q16: 160k lanes for 23k live rows).  Joins in the
+            # chain already rule out the host-presort position contract.
+            # Skip when the chain bottoms out at a semi/anti join — rule
+            # (b) shrinks that one, and a second cut would just re-compact.
+            def chain_end(x: PlanNode) -> PlanNode:
+                while isinstance(x, (FilterNode, ProjectNode,
+                                     MembershipNode)) and x.children:
+                    x = x.children[0]
+                return x
+
+            if isinstance(n, (AggNode, SortNode, DistinctNode)) and \
+                    n.children:
+                child = n.children[0]
+                end = chain_end(child)
+                covered = isinstance(end, ShrinkNode) or \
+                    (isinstance(end, JoinNode) and
+                     end.how in ("semi", "anti"))
+                if not isinstance(child, (ShrinkNode, JoinNode)) and \
+                        not covered and selective(child):
+                    n.children[0] = ShrinkNode(children=[child],
+                                               schema=child.schema)
             for c in list(n.children):
                 walk(c, n)
 

@@ -117,6 +117,74 @@ def test_shrink_cap_retry_grows_to_exact_need():
     assert caps and all(c > 16 for c in caps)
 
 
+def _sorted_build_session(n=4000, mesh=None):
+    s = Session(Database(), mesh=mesh)
+    s.execute("CREATE TABLE fact (id BIGINT, k BIGINT, v DOUBLE, "
+              "PRIMARY KEY (id))")
+    import pyarrow as pa
+
+    rng = np.random.default_rng(11)
+    s.load_arrow("fact", pa.table({
+        "id": np.arange(n, dtype=np.int64),
+        "k": rng.integers(0, 1 << 30, n).astype(np.int64),
+        "v": rng.normal(size=n)}))
+    return s
+
+
+SORTED_BUILD_Q = ("SELECT COUNT(*) n, SUM(a.sv) s FROM fact "
+                  "LEFT JOIN (SELECT k, SUM(v) sv FROM fact GROUP BY k) a "
+                  "ON fact.k = a.k WHERE fact.v > 0")
+
+
+def test_sorted_build_join_marked_and_exact():
+    """A join whose build is a group-by on exactly the join keys skips the
+    lexsort (interesting-order reuse); results must be exact."""
+    from baikaldb_tpu.plan.nodes import JoinNode
+    from baikaldb_tpu.sql.parser import parse_sql
+
+    s = _sorted_build_session()
+    plan = s._plan_select(parse_sql(SORTED_BUILD_Q)[0])
+    marked = []
+
+    def walk(n):
+        if isinstance(n, JoinNode):
+            marked.append(n.build_sorted)
+        for c in n.children:
+            walk(c)
+    walk(plan)
+    assert any(marked)
+    got = s.query(SORTED_BUILD_Q)[0]
+    t = None
+    import pandas as pd
+
+    # host golden
+    import pyarrow as pa
+    rng = np.random.default_rng(11)
+    n = 4000
+    df = pd.DataFrame({"id": np.arange(n), "k": rng.integers(0, 1 << 30, n),
+                       "v": rng.normal(size=n)})
+    sv = df.groupby("k").v.sum()
+    m = df[df.v > 0]
+    want_n = len(m)
+    want_s = float(m.k.map(sv).sum())
+    assert got["n"] == want_n
+    assert abs(got["s"] - want_s) < 1e-6
+
+
+def test_sorted_build_join_exact_under_mesh():
+    """Mesh mode: exchanges on the build side destroy the proved order —
+    the fast path must disengage and results stay exact."""
+    from baikaldb_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs multi-device mesh")
+    s1 = _sorted_build_session(2000)
+    want = s1.query(SORTED_BUILD_Q)
+    s2 = _sorted_build_session(2000, mesh=make_mesh(4))
+    got = s2.query(SORTED_BUILD_Q)
+    assert got == want
+
+
 def test_shrink_under_mesh():
     """Shrink inside the shard_map program: per-shard cut, pmax'd caps."""
     from baikaldb_tpu.parallel.mesh import make_mesh
