@@ -282,7 +282,14 @@ class Database:
             st = TableStore(info)
             tier = RemoteRowTier.get_or_create(
                 self.cluster, key, st._row_schema(), [ROWID_COL])
-            st.attach_replicated(tier)
+            fs = self.cold_fs()
+            if fs is None and tier.has_cold():
+                raise ValueError(
+                    f"table {key!r} has cold segments but no cold storage "
+                    f"is configured (set cold_dir or the cold_fs_dir flag)")
+            # one manifest fetch: cold_rows returns [] when no cold exists
+            cold = tier.cold_rows(fs) if fs is not None else None
+            st.attach_replicated(tier, cold_rows=cold)
             return st
         if not self.data_dir:
             return TableStore(info)
@@ -1033,8 +1040,10 @@ class Session:
                 return Result(affected_rows=tier.flush_cold(fs, upto=upto))
             if s.command == "cold_gc":
                 return Result(affected_rows=tier.cold_gc(fs))
+            n_regions = len(tier.groups) if hasattr(tier, "groups") \
+                else len(tier.regions)
             entries = sum(len(self._cold_manifest_of(tier, i))
-                          for i in range(len(tier.groups)))
+                          for i in range(n_regions))
             return Result(columns=["hot_bytes", "cold_segments"], arrow=(
                 pa.table({"hot_bytes": [tier.hot_bytes()],
                           "cold_segments": [entries]})))
@@ -1052,8 +1061,10 @@ class Session:
 
     @staticmethod
     def _cold_manifest_of(tier, i):
-        g = tier.groups[i]
-        return g.bus.nodes[g.leader()].cold_manifest
+        if hasattr(tier, "groups"):     # in-process fleet plane
+            g = tier.groups[i]
+            return g.bus.nodes[g.leader()].cold_manifest
+        return tier._region_manifest(tier.regions[i])   # daemon plane
 
     def _fleet_required(self):
         if self.db.fleet is None:

@@ -190,18 +190,40 @@ class ReplicatedRegion:
 
     def _apply_cold(self, body: bytes) -> None:
         """Cold-tier manifest op, deterministic on every replica.
-        add:   record (seq, file, watermark) and EVICT hot rows with
-               rowid <= watermark (the bytes already sit immutably on the
-               external FS — written by the flush coordinator BEFORE this
-               committed).  Eviction is not deletion: the rows live on in
-               the segment and recovery replays cold-then-hot.
-        reset: replace this region's whole manifest (cold GC/merge)."""
+        add:   record (seq, file, watermark) and EVICT the flushed hot rows
+               (the bytes already sit immutably on the external FS —
+               written by the flush coordinator BEFORE this committed).
+               Eviction is not deletion: the rows live on in the segment
+               and recovery replays cold-then-hot.  With a "keys" list
+               ([hex key, value hash] pairs), eviction is per-key
+               compare-and-swap — a row another frontend rewrote between
+               the coordinator's scan and this apply keeps its NEWER hot
+               version (the segment's stale copy is shadowed at replay).
+               Without it (a coordinator that serializes flushes itself),
+               everything at rowid <= watermark evicts.
+        reset: replace this region's manifest (cold GC/merge); with
+               "expect" (the file list the reset was computed from), a
+               mismatch — a concurrent flush added a segment — makes the
+               reset a deterministic no-op instead of orphaning it."""
         import json as _json
 
         m = _json.loads(body.decode())
         if m["op"] == "add":
             self.cold_manifest.append((int(m["seq"]), m["file"],
                                        int(m["watermark"])))
+            if "keys" in m:
+                from ..storage.replicated import _fnv64
+
+                snap = dict(self.table.scan_raw())
+                dead = []
+                for khex, vh in m["keys"]:
+                    k = bytes.fromhex(khex)
+                    v = snap.get(k)
+                    if v is not None and _fnv64(v) == int(vh):
+                        dead.append((1, k, b""))
+                if dead:
+                    self.table.write_batch(dead)
+                return
             wkey = self.table.key_codec.encode_one(
                 {self.key_columns[0]: int(m["watermark"])})
             dead = [(1, k, b"") for k, _ in self.table.scan_raw()
@@ -209,6 +231,10 @@ class ReplicatedRegion:
             if dead:
                 self.table.write_batch(dead)
         elif m["op"] == "reset":
+            if "expect" in m:
+                current = sorted(f for _s, f, _w in self.cold_manifest)
+                if current != sorted(m["expect"]):
+                    return      # stale gc: a flush raced it — no-op
             self.cold_manifest = [(int(s), f, int(w))
                                   for s, f, w in m["entries"]]
 

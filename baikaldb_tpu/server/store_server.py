@@ -54,7 +54,7 @@ class StoreServer:
         self._stop = threading.Event()
         for name in ("create_region", "drop_region", "raft_msg", "propose",
                      "scan_raw", "region_status", "region_size", "ping",
-                     "txn_status"):
+                     "txn_status", "cold_manifest"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
 
     # -- lifecycle --------------------------------------------------------
@@ -184,6 +184,22 @@ class StoreServer:
                                      for t in region.prepared},
                     "decisions": {str(t): int(d)
                                   for t, d in region.decisions.items()}}
+
+    def rpc_cold_manifest(self, region_id: int):
+        """This region's raft-committed cold-tier manifest (segment files
+        live on the external FS; the manifest is the consensus truth —
+        region_olap.cpp:727-882)."""
+        region = self.regions.get(int(region_id))
+        if region is None:
+            return {"status": "no_region"}
+        with self._mu:
+            if region.core.role != LEADER:
+                return {"status": "not_leader",
+                        "leader": int(region.core.leader)}
+            region.apply_committed()
+            return {"status": "ok",
+                    "entries": [[int(s), f, int(w)]
+                                for s, f, w in region.cold_manifest]}
 
     def rpc_region_size(self, region_id: int):
         """Live-key count + committed range of this region (the split

@@ -17,7 +17,8 @@ import time
 from bisect import bisect_right
 from typing import Optional
 
-from ..raft.cluster import (CMD_COMMIT, CMD_DECIDE, CMD_PREPARE, CMD_ROLLBACK,
+from ..raft.cluster import (CMD_COLD, CMD_COMMIT, CMD_DECIDE, CMD_PREPARE,
+                            CMD_ROLLBACK,
                             CMD_SET_RANGE, CMD_TRIM, CMD_WRITE, encode_cmd,
                             encode_ops, encode_range)
 from ..types import Schema
@@ -445,6 +446,151 @@ class RemoteRowTier:
                     out[txn] = "unresolved"   # next attach retries
         return out
 
+    # -- cold tier (daemon plane; apply logic is shared ReplicatedRegion
+    # code — see raft/cluster.py CMD_COLD) -------------------------------
+    def _region_manifest(self, region: _RemoteRegion) -> list:
+        resp = self._leader_call(region, "cold_manifest", 2.0)
+        if resp is None:
+            raise ReplicationError(
+                f"region {region.region_id}: cold manifest unavailable")
+        return [(int(s), f, int(w)) for s, f, w in resp["entries"]]
+
+    def _with_routing_retry(self, fn):
+        """The cold entry points retry stale routing like scan_rows and
+        write_ops do (another frontend may have split regions)."""
+        for attempt in range(3):
+            try:
+                return fn()
+            except StaleRoutingError:
+                if attempt == 2:
+                    raise ReplicationError(
+                        f"{self.table_key}: routing kept going stale")
+                self.refresh_routing()
+
+    def has_cold(self) -> bool:
+        """True when any region's manifest references cold segments;
+        propagates unavailability (a transiently leaderless region must
+        surface as the REAL error, not as phantom cold state)."""
+        def go():
+            return any(self._region_manifest(r) for r in self.regions)
+        return self._with_routing_retry(go)
+
+    def flush_cold(self, fs, upto: Optional[int] = None) -> int:
+        """Flush daemon-hosted hot rows into immutable segments on ``fs``;
+        manifest + eviction raft-commit on each region.  Eviction is
+        per-key compare-and-swap ([key, value-hash] pairs ride the
+        manifest op): a row another frontend rewrote between this scan and
+        the apply keeps its newer hot version — concurrent frontends
+        cannot lose writes to a flush."""
+        return self._with_routing_retry(lambda: self._flush_cold(fs, upto))
+
+    def _flush_cold(self, fs, upto: Optional[int]) -> int:
+        import json as _json
+
+        from .coldfs import segment_bytes
+        from .column_store import schema_to_arrow
+        from .replicated import _fnv64
+
+        arrow = schema_to_arrow(self.row_schema)
+        rowid_col = self.key_columns[0]
+        flushed = 0
+        for region in list(self.regions):
+            pairs = self._scan_region(region)
+            rows, keys = [], []
+            for k, v in pairs:
+                r = self.row_codec.decode(v)
+                if upto is not None and r[rowid_col] > upto:
+                    continue
+                rows.append(r)
+                keys.append([k.hex(), int(_fnv64(v))])
+            if not rows:
+                continue
+            watermark = max(r[rowid_col] for r in rows)
+            seq = self.alloc_rowids(1)
+            seg = f"{self.table_key}.r{region.region_id}.s{seq}.parquet"
+            fs.put(seg, segment_bytes(rows, arrow))
+            payload = _json.dumps({"op": "add", "seq": int(seq),
+                                   "file": seg, "keys": keys,
+                                   "watermark": int(watermark)}).encode()
+            self._propose(region, encode_cmd(CMD_COLD, 0, payload))
+            flushed += len(rows)
+        return flushed
+
+    def cold_rows(self, fs) -> list[dict]:
+        from .coldfs import segment_rows
+
+        def go():
+            entries: list = []
+            for r in self.regions:
+                entries.extend(self._region_manifest(r))
+            out: list[dict] = []
+            seen: set[str] = set()
+            for seq, f, _w in sorted(entries):
+                if f in seen:
+                    continue
+                seen.add(f)
+                out.extend(segment_rows(fs.get(f)))
+            return out
+        return self._with_routing_retry(go)
+
+    def cold_gc(self, fs) -> int:
+        return self._with_routing_retry(lambda: self._cold_gc(fs))
+
+    def _cold_gc(self, fs) -> int:
+        import json as _json
+
+        from .coldfs import segment_bytes, segment_rows
+        from .column_store import schema_to_arrow
+
+        arrow = schema_to_arrow(self.row_schema)
+        rowid_col = self.key_columns[0]
+        candidates: set[str] = set()
+        for region in list(self.regions):
+            manifest = self._region_manifest(region)
+            if not manifest:
+                continue
+            latest: dict[int, dict] = {}
+            raw_rows = 0
+            for seq, f, _w in sorted(manifest):
+                for r in segment_rows(fs.get(f)):
+                    raw_rows += 1
+                    latest[int(r[rowid_col])] = r
+            live = [r for _, r in sorted(latest.items())
+                    if not r.get("__del")]
+            if len(manifest) == 1 and len(live) == raw_rows:
+                continue
+            entries = []
+            if live:
+                seq = max(sq for sq, _f, _w in manifest)
+                seg = (f"{self.table_key}.r{region.region_id}"
+                       f".s{seq}.gc{len(manifest)}.parquet")
+                fs.put(seg, segment_bytes(live, arrow))
+                entries = [[int(seq), seg,
+                            max(r[rowid_col] for r in live)]]
+            # "expect" makes the reset a no-op when a concurrent flush
+            # added a segment after this manifest read — the reset can
+            # never orphan it
+            payload = _json.dumps({"op": "reset", "entries": entries,
+                                   "expect": [f for _s, f, _w in manifest]
+                                   }).encode()
+            self._propose(region, encode_cmd(CMD_COLD, 0, payload))
+            candidates.update(f for _s, f, _w in manifest)
+        still: set[str] = set()
+        for region in self.regions:
+            still.update(f for _s, f, _w in self._region_manifest(region))
+        reclaimed = 0
+        for f in candidates - still:
+            fs.delete(f)
+            reclaimed += 1
+        return reclaimed
+
+    def hot_bytes(self) -> int:
+        def go():
+            return sum(len(k) + len(v)
+                       for region in self.regions
+                       for k, v in self._scan_region(region))
+        return self._with_routing_retry(go)
+
     def alloc_rowids(self, n: int, floor: int = 0) -> int:
         """Cluster-wide rowid range from the meta daemon: concurrent
         frontends never mint colliding keys.  The meta daemon is the
@@ -674,6 +820,21 @@ class RemoteRowTier:
         if pairs:
             self._propose(left, encode_cmd(
                 CMD_WRITE, 0, encode_ops([(0, k, v) for k, v in pairs])))
+        right_cold = self._region_manifest(right)
+        if right_cold:
+            # the right's cold segments must survive the merge: fold its
+            # manifest into the left's (raft-committed) before the right's
+            # replicas drop, or the evicted rows would vanish from every
+            # read and rebuild (mirrors the fleet plane's merge)
+            import json as _json
+
+            left_cold = self._region_manifest(left)
+            combined = sorted(set(map(tuple, left_cold)) |
+                              set(map(tuple, right_cold)))
+            self._propose(left, encode_cmd(CMD_COLD, 0, _json.dumps(
+                {"op": "reset",
+                 "entries": [list(e) for e in combined],
+                 "expect": [f for _s, f, _w in left_cold]}).encode()))
         # (X, X) with non-empty X covers nothing: the right now owns — and
         # serves — the empty range
         self._propose(right, encode_cmd(

@@ -160,7 +160,7 @@ class RpcClient:
     _IDEMPOTENT = frozenset({
         "ping", "scan_raw", "txn_status", "region_size", "region_status",
         "instances", "table_regions", "heartbeat", "tso", "raft_msg",
-        "drop_region", "drop_regions", "register_store",
+        "drop_region", "drop_regions", "register_store", "cold_manifest",
     })
 
     def call(self, method: str, **args):

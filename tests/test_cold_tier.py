@@ -194,6 +194,44 @@ def test_gc_never_deletes_segment_shared_by_split_child(tmp_path):
     assert got == [{"n": 22}]
 
 
+def test_daemon_plane_cold_flush(tmp_path):
+    """Cold tier on the multi-process cluster: the flush coordinator runs
+    on the frontend over RPC, segments land on the shared external FS, the
+    manifest raft-commits inside the store daemons (shared CMD_COLD apply),
+    and a SIGKILL'd store loses nothing."""
+    from baikaldb_tpu.tools.deploy_cluster import spawn_cluster, teardown
+
+    cold = str(tmp_path / "afs")
+    ddl = "CREATE TABLE t (id BIGINT, v DOUBLE, PRIMARY KEY (id))"
+    meta_addr, procs = spawn_cluster(n_stores=3, base_port=9650)
+    try:
+        s = Session(Database(cluster=meta_addr, cold_dir=cold))
+        s.execute(ddl)
+        for i in range(12):
+            s.execute(f"INSERT INTO t VALUES ({i}, {float(i)})")
+        n = s.execute("HANDLE cold_flush default.t").affected_rows
+        assert n == 12
+        st = s.execute("HANDLE cold_status default.t").arrow.to_pylist()[0]
+        assert st["hot_bytes"] == 0 and st["cold_segments"] >= 1
+        s.execute("INSERT INTO t VALUES (50, 0.5)")          # hot again
+        s.execute("DELETE FROM t WHERE id = 3")              # del of a COLD row
+        procs["stores"][0].kill()                            # SIGKILL
+        s2 = Session(Database(cluster=meta_addr, cold_dir=cold))
+        s2.execute(ddl)
+        got = s2.query("SELECT COUNT(*) n, SUM(v) sv FROM t")
+        want = sum(float(i) for i in range(12) if i != 3) + 0.5
+        assert got == [{"n": 12, "sv": want}]
+        # a frontend without the cold FS refuses a lossy rebuild
+        with pytest.raises(ValueError, match="cold segments"):
+            s3 = Session(Database(cluster=meta_addr))
+            s3.execute(ddl)
+        s2.execute("HANDLE cold_flush default.t")
+        assert s2.execute("HANDLE cold_gc default.t").affected_rows >= 1
+        assert s2.query("SELECT COUNT(*) n FROM t") == [{"n": 12}]
+    finally:
+        teardown(procs)
+
+
 def test_cold_flush_requires_configured_fs(tmp_path):
     from baikaldb_tpu.meta.service import MetaService
     from baikaldb_tpu.raft.fleet import StoreFleet
