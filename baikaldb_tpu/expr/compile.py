@@ -880,10 +880,9 @@ def _match_against(e, batch):
     if not (isinstance(a, Column) and a.ltype is LType.STRING
             and a.dictionary is not None):
         raise ExprError("MATCH requires a dictionary-encoded string column")
-    from ..index.fulltext import index_for_dictionary
+    from ..index.fulltext import match_mask
 
-    ix = index_for_dictionary(a.dictionary)
-    mask = ix.query_mask(q.value, boolean_mode=boolean_mode)
+    mask = match_mask(a.dictionary, q.value, boolean_mode=boolean_mode)
     hit = jnp.take(jnp.asarray(mask), jnp.clip(a.data, 0, None), mode="clip")
     hit = jnp.where(a.data >= 0, hit, False)
     return Column(hit, a.validity, LType.BOOL)

@@ -133,15 +133,147 @@ def parse_boolean_query(query: str, tokenizer):
 
 
 # ---------------------------------------------------------------------------
-# per-dictionary index (used by the expr compiler's MATCH..AGAINST).  The
-# index hangs off the immutable Dictionary object itself, so its lifetime and
-# identity exactly track the dictionary (no id()-reuse staleness, no global
-# cache growth).
+# incremental value-space index (reference: the 3-level LSM inverted index
+# merges NEW postings into levels instead of rebuilding,
+# include/reverse/reverse_index.h:30).
+#
+# Dictionaries here are sorted-unique and REMAP codes when they grow, so a
+# per-dictionary index would rebuild O(dict) on every batch of new values
+# (the round-3 weakness).  This index lives in VALUE space instead: every
+# distinct string ever seen is tokenized ONCE (ensure() indexes only the
+# set-difference of a new dictionary against what is already indexed — the
+# LSM level-merge analog), and a query produces a set of matching VALUES;
+# the per-dictionary code mask is then one sorted membership probe.  Growth
+# is O(new values); dictionary changes cost nothing.
 
+class IncrementalFulltext:
+    """token -> internal doc ids over an append-only value log."""
+
+    def __init__(self, tokenizer=tokenize_words):
+        self.tokenizer = tokenizer
+        self.values: list[str] = []          # append-only value log
+        self._sorted: np.ndarray = np.zeros(0, object)   # sorted view
+        self._sorted_ids: np.ndarray = np.zeros(0, np.int64)
+        self.doc_tokens: list[list[str]] = []
+        self.postings: dict[str, list] = {}  # token -> [internal ids]
+        self._lock = threading.Lock()
+
+    # growth bound: past this many distinct values the index resets and
+    # lazily re-fills from whatever dictionaries keep querying — bounded
+    # memory for long-lived daemons churning high-cardinality text
+    MAX_VALUES = 2_000_000
+
+    def ensure(self, dict_values: np.ndarray) -> int:
+        """Index values not yet seen; returns how many were new."""
+        with self._lock:
+            return self._ensure_locked(dict_values)
+
+    def _ensure_locked(self, dict_values: np.ndarray) -> int:
+        vals = np.asarray(dict_values, dtype=object)
+        if len(self._sorted):
+            pos = np.searchsorted(self._sorted, vals)
+            pos_c = np.clip(pos, 0, len(self._sorted) - 1)
+            known = self._sorted[pos_c] == vals
+            new = vals[~known]
+        else:
+            new = vals
+        if not len(new):
+            return 0
+        if len(self.values) + len(new) > self.MAX_VALUES:
+            self.values = []
+            self._sorted = np.zeros(0, object)
+            self._sorted_ids = np.zeros(0, np.int64)
+            self.doc_tokens = []
+            self.postings = {}
+            new = vals
+        start = len(self.values)
+        for i, v in enumerate(new):
+            toks = self.tokenizer(str(v))
+            self.doc_tokens.append(toks)
+            for t in set(toks):
+                self.postings.setdefault(t, []).append(start + i)
+            self.values.append(str(v))
+        # merge the (sorted) new values into the sorted view: O(total)
+        # memmove, no full re-sort per batch
+        norder = np.argsort(new)
+        nsorted = new[norder]
+        nids = (start + norder).astype(np.int64)
+        ins = np.searchsorted(self._sorted, nsorted)
+        self._sorted = np.insert(self._sorted, ins, nsorted)
+        self._sorted_ids = np.insert(self._sorted_ids, ins, nids)
+        return len(new)
+
+    # -- retrieval (internal ids) ----------------------------------------
+    def _term_docs(self, term: str) -> np.ndarray:
+        return np.asarray(self.postings.get(term.lower(), ()), np.int64)
+
+    def _phrase_docs(self, phrase: list[str]) -> np.ndarray:
+        if not phrase:
+            return np.zeros(0, np.int64)
+        cand = self._term_docs(phrase[0])
+        for t in phrase[1:]:
+            cand = np.intersect1d(cand, self._term_docs(t))
+        out = [int(d) for d in cand
+               if any(self.doc_tokens[int(d)][i:i + len(phrase)] == phrase
+                      for i in range(len(self.doc_tokens[int(d)])
+                                     - len(phrase) + 1))]
+        return np.asarray(out, np.int64)
+
+    def _docs(self, group) -> np.ndarray:
+        if isinstance(group, list):
+            return self._phrase_docs(group)
+        return self._term_docs(group)
+
+    def query_mask(self, dict_values: np.ndarray, query: str,
+                   boolean_mode: bool = False) -> np.ndarray:
+        """bool mask over ``dict_values`` codes for the boolean query."""
+        with self._lock:     # one lock: concurrent ensure() from another
+            #                  connection thread must not grow state under
+            #                  this query's arrays
+            return self._query_mask_locked(dict_values, query, boolean_mode)
+
+    def _query_mask_locked(self, dict_values: np.ndarray, query: str,
+                           boolean_mode: bool) -> np.ndarray:
+        self._ensure_locked(dict_values)
+        must, must_not, should = parse_boolean_query(query, self.tokenizer)
+        n = len(self.values)
+        m = np.zeros(n, bool)
+        if boolean_mode:
+            if must:
+                m[:] = True
+                for g in must:
+                    mm = np.zeros(n, bool)
+                    mm[self._docs(g)] = True
+                    m &= mm
+            elif should:
+                for g in should:
+                    m[self._docs(g)] = True
+        else:
+            for g in must + should:
+                m[self._docs(g)] = True
+        for g in must_not:
+            m[self._docs(g)] = False
+        # matched internal ids -> matched VALUE strings -> membership mask
+        # over THIS dictionary's codes (sorted probe, no rebuild; masking
+        # the sorted view preserves order — no extra sort)
+        matched = self._sorted[m[self._sorted_ids]]
+        vals = np.asarray(dict_values, dtype=object)
+        if not len(matched):
+            return np.zeros(len(vals), bool)
+        pos = np.clip(np.searchsorted(matched, vals), 0, len(matched) - 1)
+        return matched[pos] == vals
+
+
+# one index per tokenizer, shared across every column and dictionary
+# version: queries filter by membership against the asking dictionary, so
+# values indexed for OTHER columns can never leak into a mask
+_WORD_INDEX = IncrementalFulltext(tokenize_words)
 _build_lock = threading.Lock()
 
 
 def index_for_dictionary(dictionary) -> InvertedIndex:
+    """Per-dictionary snapshot index (kept for the standalone API and
+    tests); MATCH..AGAINST goes through match_mask below."""
     ix = dictionary._ft_index
     if ix is not None:
         return ix
@@ -149,3 +281,10 @@ def index_for_dictionary(dictionary) -> InvertedIndex:
         if dictionary._ft_index is None:
             dictionary._ft_index = InvertedIndex.build(dictionary.values)
         return dictionary._ft_index
+
+
+def match_mask(dictionary, query: str, boolean_mode: bool = False):
+    """Code mask for MATCH..AGAINST over ``dictionary`` — served by the
+    shared incremental index (O(new values) maintenance, not O(dict))."""
+    return _WORD_INDEX.query_mask(dictionary.values, query,
+                                  boolean_mode=boolean_mode)

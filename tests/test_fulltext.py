@@ -54,3 +54,46 @@ def test_match_against_sql():
     # composes with other predicates in the same kernel
     rows = s.query("SELECT id FROM docs WHERE MATCH(body) AGAINST('native') AND id > 1")
     assert [r["id"] for r in rows] == [3]
+
+
+def test_incremental_value_space_index():
+    """The shared MATCH index grows by O(new values) instead of rebuilding
+    per dictionary change (reference: LSM level merges, reverse_index.h)."""
+    import numpy as np
+
+    from baikaldb_tpu.index.fulltext import IncrementalFulltext
+
+    ix = IncrementalFulltext()
+    assert ix.ensure(np.asarray(["red apple", "green pear"], object)) == 2
+    # same values again: nothing new indexed
+    assert ix.ensure(np.asarray(["green pear", "red apple"], object)) == 0
+    # a grown (remapped) dictionary: only the new value tokenizes
+    d2 = np.asarray(["blue fig", "green pear", "red apple"], object)
+    assert ix.ensure(d2) == 1
+    mask = ix.query_mask(d2, "apple fig")
+    assert mask.tolist() == [True, False, True]
+    # membership filtering: a dictionary NOT containing an indexed value
+    # never sees it
+    d3 = np.asarray(["green pear"], object)
+    assert ix.query_mask(d3, "apple").tolist() == [False]
+
+
+def test_match_against_after_dictionary_growth():
+    """SQL MATCH..AGAINST stays correct as inserts remap the dictionary,
+    and the shared index only tokenizes the new values."""
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.index import fulltext as ft
+
+    s = Session(Database())
+    s.execute("CREATE TABLE docs (id BIGINT, body VARCHAR(64), "
+              "PRIMARY KEY (id), FULLTEXT INDEX ft_b (body))")
+    s.execute("INSERT INTO docs VALUES (1, 'alpha beta'), (2, 'gamma')")
+    q = ("SELECT id FROM docs WHERE MATCH(body) AGAINST('beta') "
+         "ORDER BY id")
+    assert [r["id"] for r in s.query(q)] == [1]
+    before = len(ft._WORD_INDEX.values)
+    s.execute("INSERT INTO docs VALUES (3, 'beta delta'), (4, 'aardvark')")
+    assert [r["id"] for r in s.query(q)] == [1, 3]
+    grown = len(ft._WORD_INDEX.values) - before
+    assert grown <= 2          # only the new values were tokenized
+    #      (0 if an earlier test in this process already indexed them)
