@@ -189,7 +189,8 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
                 left, node.left_keys, right, node.right_keys, how=node.how,
                 cap=node.cap,
                 wide_keys_ok=getattr(node, "pack32_verified", False),
-                build_sorted=getattr(node, "build_sorted", False))
+                build_sorted=getattr(node, "build_sorted", False),
+                order=_presort_order(node, batches, len(right)))
         overflows.append((node, ovf))
         # label-qualified names are globally unique, no suffixing occurs
         return out

@@ -202,7 +202,7 @@ def join(probe: ColumnBatch, probe_keys: list[str],
          build: ColumnBatch, build_keys: list[str],
          how: str = "inner", cap: int | None = None,
          suffix: str = "_r", wide_keys_ok: bool = False,
-         build_sorted: bool = False):
+         build_sorted: bool = False, order=None):
     """Returns (out_batch, needed_rows).
 
     ``needed_rows`` (traced int32) is the true output cardinality; the caller
@@ -226,7 +226,16 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     # key equal to dtype-max still sorts before every dead row, so the
     # first-dead clamp below is exact for all key values
     bdead = _build_dead(build, bvalid)
-    if build_sorted:
+    if order is not None:
+        # host-precomputed per-version key permutation of the base table
+        # (the secondary-index read): compose with a stable deadness
+        # partition so filtered/NULL rows land in the tail — no on-device
+        # sort at all
+        from .compact import stable_partition
+
+        o = jnp.asarray(order)
+        order = o[stable_partition(~bdead[o])]
+    elif build_sorted:
         # the planner proved the build side arrives key-sorted over its
         # LIVE rows (e.g. the output of a sorted group-by on exactly these
         # keys): a STABLE partition by deadness — O(n) prefix sums, no

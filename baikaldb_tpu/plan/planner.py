@@ -151,6 +151,23 @@ class Planner:
             if hit is None:
                 return
             node, names = hit
+            if len(names) == 1 and n.presort is None and n.neq is None \
+                    and self._position_preserving(n.children[1]):
+                # single-key build over a position-preserving base-table
+                # chain: the executor can feed the host-precomputed
+                # per-version sort permutation (q13's orders build —
+                # lexsort of 300k keys per execution becomes an O(n)
+                # deadness partition).  Integer keys only: string codes
+                # remap at dictionary merges.
+                f0 = n.children[1].schema.field(n.right_keys[0])
+                hk = self._key_scan(n.children[1], n.right_keys[0])
+                # no UINT64: the host permutation casts to int64, so values
+                # past 2^63 would wrap and disagree with the device's
+                # unsigned key order
+                if hk is not None and len(hk) == 2 and \
+                        f0.ltype is not LType.UINT64 and \
+                        (f0.ltype.is_integer or f0.ltype is LType.DATE):
+                    n.presort = ("join", hk[0], (hk[1],))
             # BOTH group-by strategies emit key-ordered outputs: sorted by
             # the key sort itself, dense by domain-order slot layout
             if not (isinstance(node, AggNode) and
