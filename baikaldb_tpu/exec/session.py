@@ -2628,6 +2628,79 @@ class Session:
                 "data_type": [r[3] for r in rows],
                 "is_nullable": [r[4] for r in rows],
             }) if rows else _empty_info("columns")
+        if name == "views":
+            vsnap = cat._views        # one atomic snapshot: a concurrent
+            #                           DROP VIEW swaps the attr, never
+            #                           mutates this dict
+            rows = [(k.split(".", 1)[0], k.split(".", 1)[1], v["sql"])
+                    for k, v in sorted(vsnap.items())]
+            return pa.table({
+                "table_schema": [r[0] for r in rows],
+                "table_name": [r[1] for r in rows],
+                "view_definition": [r[2] for r in rows],
+            }) if rows else _empty_info("views")
+        if name == "partitions":
+            rows = []
+            for db in cat.databases():
+                if db == "information_schema":
+                    continue
+                for t in cat.tables(db):
+                    info = cat.get_table(db, t)
+                    spec = (info.options or {}).get("partition")
+                    if not spec:
+                        continue
+                    st = self.db.stores.get(f"{db}.{t}")
+                    counts: dict[int, int] = {}
+                    if st is not None:
+                        for r in st.regions:
+                            counts[r.part] = counts.get(r.part, 0) \
+                                + r.num_rows
+                    if spec["kind"] == "hash":
+                        for i in range(int(spec["n"])):
+                            rows.append((db, t, f"p{i}", "HASH",
+                                         spec["column"], "",
+                                         counts.get(i, 0)))
+                    else:
+                        for i, (nm, up) in enumerate(
+                                zip(spec["names"], spec["uppers"])):
+                            rows.append((db, t, nm, "RANGE",
+                                         spec["column"],
+                                         "MAXVALUE" if up is None
+                                         else str(up), counts.get(i, 0)))
+            return pa.table({
+                "table_schema": [r[0] for r in rows],
+                "table_name": [r[1] for r in rows],
+                "partition_name": [r[2] for r in rows],
+                "partition_method": [r[3] for r in rows],
+                "partition_expression": [r[4] for r in rows],
+                "partition_description": [r[5] for r in rows],
+                "table_rows": pa.array([r[6] for r in rows], pa.int64()),
+            }) if rows else _empty_info("partitions")
+        if name == "cold_segments":
+            rows = []
+            for key, st in self.db.stores.items():
+                tier = st.replicated
+                if tier is None or not hasattr(tier, "cold_rows"):
+                    continue
+                db, _, tname = key.partition(".")
+                metas = tier.metas if hasattr(tier, "groups") \
+                    else tier.regions
+                for i, m in enumerate(metas):
+                    try:       # a leaderless/unreachable region skips, it
+                        #        must not fail the whole listing
+                        manifest = self._cold_manifest_of(tier, i)
+                    except Exception:   # noqa: BLE001
+                        continue
+                    for seq, f, w in manifest:
+                        rows.append((db, tname, m.region_id, seq, f, w))
+            return pa.table({
+                "table_schema": [r[0] for r in rows],
+                "table_name": [r[1] for r in rows],
+                "region_id": pa.array([r[2] for r in rows], pa.int64()),
+                "seq": pa.array([r[3] for r in rows], pa.int64()),
+                "file": [r[4] for r in rows],
+                "watermark": pa.array([r[5] for r in rows], pa.int64()),
+            }) if rows else _empty_info("cold_segments")
         if name == "query_log":
             log = list(self.db.query_log)
             return pa.table({

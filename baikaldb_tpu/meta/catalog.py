@@ -207,6 +207,22 @@ class Catalog:
                             Field("regions_done", LType.INT64),
                             Field("regions_total", LType.INT64),
                             Field("error", LType.STRING))),
+        "views": Schema((Field("table_schema", LType.STRING),
+                         Field("table_name", LType.STRING),
+                         Field("view_definition", LType.STRING))),
+        "partitions": Schema((Field("table_schema", LType.STRING),
+                              Field("table_name", LType.STRING),
+                              Field("partition_name", LType.STRING),
+                              Field("partition_method", LType.STRING),
+                              Field("partition_expression", LType.STRING),
+                              Field("partition_description", LType.STRING),
+                              Field("table_rows", LType.INT64))),
+        "cold_segments": Schema((Field("table_schema", LType.STRING),
+                                 Field("table_name", LType.STRING),
+                                 Field("region_id", LType.INT64),
+                                 Field("seq", LType.INT64),
+                                 Field("file", LType.STRING),
+                                 Field("watermark", LType.INT64))),
     }
 
     def get_table(self, database: str, name: str) -> TableInfo:

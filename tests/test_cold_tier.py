@@ -244,3 +244,15 @@ def test_cold_flush_requires_configured_fs(tmp_path):
     s.execute("INSERT INTO t VALUES (1)")
     with pytest.raises(PlanError, match="no cold storage"):
         s.execute("HANDLE cold_flush default.t")
+
+
+def test_information_schema_cold_segments(tmp_path):
+    s, fleet = fleet_session(tmp_path)
+    s.execute("CREATE TABLE t (id BIGINT, PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1), (2)")
+    s.execute("HANDLE cold_flush default.t")
+    got = s.query("SELECT table_schema, table_name, file FROM "
+                  "information_schema.cold_segments")
+    assert got and got[0]["table_schema"] == "default"
+    assert got[0]["table_name"] == "t"
+    assert got[0]["file"].endswith(".parquet")

@@ -138,3 +138,23 @@ def test_views_survive_restart(tmp_path):
     s2 = mk(data_dir=d)
     got = s2.query("SELECT i FROM v ORDER BY i")
     assert [r["i"] for r in got] == [2, 3]
+
+
+def test_information_schema_views_and_partitions():
+    s = mk()
+    seed(s)
+    s.execute("CREATE VIEW v AS SELECT id FROM orders")
+    s.execute("CREATE TABLE pt (id BIGINT, v BIGINT, PRIMARY KEY (id)) "
+              "PARTITION BY RANGE (v) ("
+              "PARTITION p0 VALUES LESS THAN (10), "
+              "PARTITION pmax VALUES LESS THAN MAXVALUE)")
+    s.execute("INSERT INTO pt VALUES (1, 5), (2, 50), (3, 60)")
+    got = s.query("SELECT table_name, view_definition FROM "
+                  "information_schema.views")
+    assert got[0]["table_name"] == "v"
+    assert got[0]["view_definition"].startswith("SELECT")
+    got = s.query("SELECT partition_name, partition_method, table_rows "
+                  "FROM information_schema.partitions "
+                  "WHERE table_name = 'pt' ORDER BY partition_name")
+    assert [(r["partition_name"], r["table_rows"]) for r in got] == \
+        [("p0", 1), ("pmax", 2)]
