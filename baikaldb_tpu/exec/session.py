@@ -2651,18 +2651,27 @@ class Session:
                         continue
                     st = self.db.stores.get(f"{db}.{t}")
                     counts: dict[int, int] = {}
-                    if st is not None:
-                        for r in st.regions:
-                            counts[r.part] = counts.get(r.part, 0) \
-                                + r.num_rows
+                    # snapshot names/uppers/counts under the store lock:
+                    # ALTER ... PARTITION pops those lists in place under
+                    # the same lock, and an unlocked read between the two
+                    # pops would mispair bounds with names
+                    import contextlib
+
+                    with (st._lock if st is not None
+                          else contextlib.nullcontext()):
+                        names = list(spec.get("names", ()))
+                        uppers = list(spec.get("uppers", ()))
+                        if st is not None:
+                            for r in st.regions:
+                                counts[r.part] = counts.get(r.part, 0) \
+                                    + r.num_rows
                     if spec["kind"] == "hash":
                         for i in range(int(spec["n"])):
                             rows.append((db, t, f"p{i}", "HASH",
                                          spec["column"], "",
                                          counts.get(i, 0)))
                     else:
-                        for i, (nm, up) in enumerate(
-                                zip(spec["names"], spec["uppers"])):
+                        for i, (nm, up) in enumerate(zip(names, uppers)):
                             rows.append((db, t, nm, "RANGE",
                                          spec["column"],
                                          "MAXVALUE" if up is None
@@ -2678,21 +2687,32 @@ class Session:
             }) if rows else _empty_info("partitions")
         if name == "cold_segments":
             rows = []
-            for key, st in self.db.stores.items():
+            for key, st in list(self.db.stores.items()):  # DDL-safe snap
                 tier = st.replicated
                 if tier is None or not hasattr(tier, "cold_rows"):
                     continue
                 db, _, tname = key.partition(".")
-                metas = tier.metas if hasattr(tier, "groups") \
-                    else tier.regions
-                for i, m in enumerate(metas):
+                if hasattr(tier, "groups"):
+                    # aligned (meta, group) pairs under the tier lock: a
+                    # concurrent split inserts into both lists
+                    with tier._mu:
+                        sources = [(m.region_id, g)
+                                   for m, g in zip(tier.metas, tier.groups)]
+                else:
+                    sources = [(r.region_id, r)
+                               for r in list(tier.regions)]
+                for rid, src in sources:
                     try:       # a leaderless/unreachable region skips, it
                         #        must not fail the whole listing
-                        manifest = self._cold_manifest_of(tier, i)
+                        if hasattr(tier, "groups"):
+                            manifest = src.bus.nodes[
+                                src.leader()].cold_manifest
+                        else:
+                            manifest = tier._region_manifest(src)
                     except Exception:   # noqa: BLE001
                         continue
                     for seq, f, w in manifest:
-                        rows.append((db, tname, m.region_id, seq, f, w))
+                        rows.append((db, tname, rid, seq, f, w))
             return pa.table({
                 "table_schema": [r[0] for r in rows],
                 "table_name": [r[1] for r in rows],

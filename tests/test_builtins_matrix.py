@@ -218,3 +218,13 @@ def test_collate_ci_comparisons(s):
 def test_version_and_utc(s):
     assert "baikaldb" in one(s, "VERSION()")
     assert str(one(s, "UTC_TIMESTAMP()")).startswith("20")
+
+
+def test_collate_ci_in_order_by(s):
+    s.execute("CREATE TABLE ci_o (id BIGINT, name VARCHAR(16), "
+              "PRIMARY KEY (id))")
+    s.execute("INSERT INTO ci_o VALUES (1, 'b'), (2, 'A'), (3, 'a'), "
+              "(4, 'B')")
+    got = s.query("SELECT name FROM ci_o ORDER BY name COLLATE "
+                  "utf8mb4_general_ci, id")
+    assert [r["name"] for r in got] == ["A", "a", "b", "B"]
