@@ -462,6 +462,90 @@ def _utc_timestamp(e, batch):
     return Column(jnp.asarray(us, jnp.int64), None, LType.DATETIME)
 
 
+def _period_to_months(p):
+    """MySQL period YYYYMM (or YYMM) -> absolute months."""
+    y = p // 100
+    y = jnp.where(y < 70, y + 2000, jnp.where(y < 100, y + 1900, y))
+    return y * 12 + (p % 100) - 1
+
+
+def _months_to_period(m):
+    return (m // 12) * 100 + (m % 12) + 1
+
+
+_reg("period_add", lambda p, n: Column(
+    _months_to_period(_period_to_months(p.data.astype(jnp.int64))
+                      + n.data.astype(jnp.int64)), None, LType.INT64),
+    LType.INT64)
+_reg("period_diff", lambda a, b: Column(
+    _period_to_months(a.data.astype(jnp.int64))
+    - _period_to_months(b.data.astype(jnp.int64)), None, LType.INT64),
+    LType.INT64)
+
+
+@_raw("make_set")
+def _make_set(e, batch):
+    """MAKE_SET(bits, s1, s2, ...) with literal strings: 64 possible
+    outputs collapse to the DISTINCT subsets the bits column selects —
+    static dictionary, device select."""
+    from .builtins_ext import _code_string
+    import numpy as np
+
+    bits = _eval(e.args[0], batch)
+    strs = [_lit_str(e, i, "make_set") for i in range(1, len(e.args))]
+    if len(strs) > 16:
+        raise ExprError("MAKE_SET supports up to 16 literal strings")
+    combos = np.asarray([",".join(s for j, s in enumerate(strs)
+                                  if m >> j & 1)
+                         for m in range(1 << len(strs))], dtype=object)
+    idx = (bits.data.astype(jnp.int64) &
+           ((1 << len(strs)) - 1)).astype(jnp.int32)
+    return _code_string(idx, combos, bits.validity)
+
+
+@_raw("export_set")
+def _export_set(e, batch):
+    """EXPORT_SET(bits, on, off [, sep [, n_bits]]) with literals."""
+    from .builtins_ext import _code_string
+    import numpy as np
+
+    bits = _eval(e.args[0], batch)
+    on = _lit_str(e, 1, "export_set")
+    off = _lit_str(e, 2, "export_set")
+    sep = _lit_str(e, 3, "export_set") if len(e.args) > 3 else ","
+    nb = _lit_int(e, 4, "export_set") if len(e.args) > 4 else 64
+    if nb > 16:
+        raise ExprError("EXPORT_SET supports up to 16 bits (a wider set "
+                        "would need a 2^n-entry static dictionary)")
+    combos = np.asarray([sep.join(on if m >> j & 1 else off
+                                  for j in range(nb))
+                         for m in range(1 << nb)], dtype=object)
+    idx = (bits.data.astype(jnp.int64) & ((1 << nb) - 1)).astype(jnp.int32)
+    return _code_string(idx, combos, bits.validity)
+
+
+@_raw("convert_tz")
+def _convert_tz(e, batch):
+    """CONVERT_TZ(dt, from, to) with literal '+HH:MM' offsets (named zones
+    would need per-VALUE DST host math, which numeric device columns can't
+    route through the dictionary path)."""
+    def off_us(s: str) -> int:
+        s = s.strip()
+        sign = -1 if s.startswith("-") else 1
+        hh, mm = s.lstrip("+-").split(":")
+        return sign * (int(hh) * 3600 + int(mm) * 60) * dtk.US_PER_SEC
+
+    a = _tcol(_eval(e.args[0], batch))
+    frm = _lit_str(e, 1, "convert_tz")
+    to = _lit_str(e, 2, "convert_tz")
+    try:
+        delta = off_us(to) - off_us(frm)
+    except (ValueError, IndexError):
+        raise ExprError("CONVERT_TZ supports literal '+HH:MM' offsets")
+    return Column(_to_us(a) + delta, a.validity,
+                  LType.DATETIME if a.ltype is LType.DATE else a.ltype)
+
+
 # -- misc ------------------------------------------------------------------
 
 @_raw("version")
@@ -489,6 +573,11 @@ _TYPE_RULES.update({
     "json_unquote": LType.STRING, "__collate_ci": LType.STRING,
     "version": LType.STRING, "connection_id": LType.INT64,
     "weekofyear": LType.INT32, "utc_timestamp": LType.DATETIME,
+    "period_add": LType.INT64, "period_diff": LType.INT64,
+    "make_set": LType.STRING, "export_set": LType.STRING,
+    "convert_tz": lambda ts: (LType.DATETIME if not ts or
+                              ts[0] in (LType.DATE, LType.STRING)
+                              else ts[0]),
     "date_add_months": lambda ts: ts[0],
     "date_sub_months": lambda ts: ts[0],
     "date_add_us": lambda ts: (LType.DATETIME if ts[0] is LType.DATE

@@ -228,3 +228,37 @@ def test_collate_ci_in_order_by(s):
     got = s.query("SELECT name FROM ci_o ORDER BY name COLLATE "
                   "utf8mb4_general_ci, id")
     assert [r["name"] for r in got] == ["A", "a", "b", "B"]
+
+
+@pytest.mark.parametrize("expr,want", [
+    ("PERIOD_ADD(202401, 2)", 202403),
+    ("PERIOD_ADD(202411, 3)", 202502),
+    ("PERIOD_DIFF(202403, 202401)", 2),
+    ("PERIOD_DIFF(202401, 202311)", 2),
+    ("MAKE_SET(5, 'a', 'b', 'c')", "a,c"),
+    ("MAKE_SET(0, 'a', 'b')", ""),
+    ("EXPORT_SET(5, 'Y', 'N', ',', 4)", "Y,N,Y,N"),
+])
+def test_period_and_set_fns(s, expr, want):
+    assert one(s, expr) == want
+
+
+def test_export_set_wide_raises(s):
+    with pytest.raises(Exception, match="16 bits"):
+        one(s, "EXPORT_SET(5, 'Y', 'N')")       # MySQL default 64 bits
+
+
+def test_convert_tz_null_propagates(s):
+    s.execute("CREATE TABLE tz_t (id BIGINT, d DATETIME, PRIMARY KEY (id))")
+    s.execute("INSERT INTO tz_t VALUES (1, '2024-01-01 10:00:00'), "
+              "(2, NULL)")
+    got = s.query("SELECT id, CONVERT_TZ(d, '+00:00', '+01:00') c "
+                  "FROM tz_t ORDER BY id")
+    assert str(got[0]["c"]).startswith("2024-01-01 11:00")
+    assert got[1]["c"] is None
+
+
+def test_convert_tz_offsets(s):
+    got = str(one(s, "CONVERT_TZ('2024-01-01 12:00:00', '+00:00', "
+                     "'+05:30')"))
+    assert got.startswith("2024-01-01 17:30")
