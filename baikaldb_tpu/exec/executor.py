@@ -45,6 +45,25 @@ class ExecError(RuntimeError):
     pass
 
 
+from ..utils.flags import FLAGS, define  # noqa: E402
+
+define("radix_join_buckets", 0,
+       "hash-partition sort-join builds into this many buckets (power of "
+       "two; 0 = off): batched per-bucket sorts replace the one global "
+       "bitonic sort — the TPU-shaped hash join (ops/radix.py)")
+define("radix_join_min_build", 65536,
+       "radix-partition joins only engage for builds at least this large")
+
+
+class _CapBox:
+    """A retryable capacity knob that rides the join-overflow protocol:
+    the session retry loop grows ``.cap`` to the reported need and
+    re-traces (used for the radix join's per-bucket width)."""
+
+    def __init__(self, cap=None):
+        self.cap = cap
+
+
 def compile_plan(plan: PlanNode, trace: bool = False, mesh=None) -> Callable:
     """-> fn(table_batches: dict) -> (ColumnBatch, overflow_flags[, counts]).
 
@@ -185,12 +204,38 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
                 # key-FK joins emit at most max(sides) rows; true many-to-many
                 # expansion beyond that reports its exact need via the flag
                 node.cap = max(1, len(left), len(right))
+            nb = int(FLAGS.radix_join_buckets)
+            presort = _presort_order(node, batches, len(right))
+            float_keys = any(right.column(k).ltype.is_float
+                             for k in node.right_keys
+                             if k in right.names)
+            use_radix = (nb >= 2 and (nb & (nb - 1)) == 0 and
+                         presort is None and not float_keys and
+                         not getattr(node, "build_sorted", False) and
+                         len(right) >= int(FLAGS.radix_join_min_build))
+            if use_radix:
+                box = getattr(node, "radix_width", None)
+                if box is None:
+                    box = node.radix_width = _CapBox()
+                if box.cap is None:
+                    # 4x average occupancy as the first guess; skew reports
+                    # the exact need through the flag channel
+                    box.cap = max(64, 1 << (4 * len(right) // nb - 1)
+                                  .bit_length())
+                out, ovf, wneed = join_ops.radix_join(
+                    left, node.left_keys, right, node.right_keys,
+                    how=node.how, cap=node.cap,
+                    wide_keys_ok=getattr(node, "pack32_verified", False),
+                    n_buckets=nb, width=box.cap)
+                overflows.append((node, ovf))
+                overflows.append((box, wneed))
+                return out
             out, ovf = join_ops.join(
                 left, node.left_keys, right, node.right_keys, how=node.how,
                 cap=node.cap,
                 wide_keys_ok=getattr(node, "pack32_verified", False),
                 build_sorted=getattr(node, "build_sorted", False),
-                order=_presort_order(node, batches, len(right)))
+                order=presort)
         overflows.append((node, ovf))
         # label-qualified names are globally unique, no suffixing occurs
         return out

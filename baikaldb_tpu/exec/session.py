@@ -2764,6 +2764,11 @@ class Session:
         # a plan with no scans has no sharded state (distribute leaves it
         # fully replicated) — run it as a plain single-device program
         mesh = self.mesh if batches else None
+        # trace-time execution flags join the executable key: flipping
+        # SET GLOBAL radix_join_buckets must re-trace, not silently reuse
+        # an executable compiled under the other strategy
+        shape_key = (shape_key, int(FLAGS.radix_join_buckets),
+                     int(FLAGS.radix_join_min_build))
         for _ in range(int(FLAGS.join_retry_max) + 1):
             pair = entry["compiled"].get(shape_key)
             if pair is None:

@@ -492,11 +492,13 @@ def _make_set(e, batch):
     import numpy as np
 
     bits = _eval(e.args[0], batch)
-    strs = [_lit_str(e, i, "make_set") for i in range(1, len(e.args))]
+    strs = [_lit_str(e, i, "make_set", default=None)
+            for i in range(1, len(e.args))]
+    strs = [None if v is None else str(v) for v in strs]  # MySQL coerces
     if len(strs) > 16:
         raise ExprError("MAKE_SET supports up to 16 literal strings")
     combos = np.asarray([",".join(s for j, s in enumerate(strs)
-                                  if m >> j & 1)
+                                  if (m >> j & 1) and s is not None)
                          for m in range(1 << len(strs))], dtype=object)
     idx = (bits.data.astype(jnp.int64) &
            ((1 << len(strs)) - 1)).astype(jnp.int32)
@@ -514,8 +516,8 @@ def _export_set(e, batch):
     off = _lit_str(e, 2, "export_set")
     sep = _lit_str(e, 3, "export_set") if len(e.args) > 3 else ","
     nb = _lit_int(e, 4, "export_set") if len(e.args) > 4 else 64
-    if nb > 16:
-        raise ExprError("EXPORT_SET supports up to 16 bits (a wider set "
+    if not 1 <= nb <= 16:
+        raise ExprError("EXPORT_SET supports 1..16 bits (a wider set "
                         "would need a 2^n-entry static dictionary)")
     combos = np.asarray([sep.join(on if m >> j & 1 else off
                                   for j in range(nb))

@@ -262,6 +262,22 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     if how == "anti":
         return probe.and_sel(counts == 0), jnp.int32(0)
 
+    def bidx_of(pi_c, k):
+        bpos = lo[pi_c] + k                    # index into sorted build
+        return order[jnp.clip(bpos, 0, len(build) - 1)]
+
+    return _expand_matches(probe, build, how, cap, counts, psel_dead,
+                           bidx_of, suffix)
+
+
+def _expand_matches(probe: ColumnBatch, build: ColumnBatch, how: str,
+                    cap: int | None, counts, psel_dead, bidx_of,
+                    suffix: str):
+    """Shared match-expansion machinery of every join kernel: per-probe
+    match counts -> cumsum offsets -> output rows up to ``cap`` with the
+    exact total reported for the retry protocol.  ``bidx_of(pi_c, k)``
+    maps (probe row, match ordinal) -> build row index — the only part
+    that differs between the globally-sorted and radix layouts."""
     if how == "left":
         # NULL-key probe rows still survive a LEFT JOIN (with NULL build side);
         # only sel-dead rows are dropped
@@ -282,9 +298,8 @@ def join(probe: ColumnBatch, probe_keys: list[str],
     pi_c = jnp.clip(pi, 0, len(probe) - 1)
     k = j - starts[pi_c]                      # match ordinal within probe row
     live_out = j < total
-    bpos = lo[pi_c] + k                        # index into sorted build
     matched = k < counts[pi_c]
-    bidx = order[jnp.clip(bpos, 0, len(build) - 1)]
+    bidx = bidx_of(pi_c, k)
 
     out_p = probe.gather(pi_c, valid=None)
     bvalid_out = jnp.where(matched, True, False) & live_out
@@ -301,6 +316,58 @@ def join(probe: ColumnBatch, probe_keys: list[str],
         cols.append(c)
     out = ColumnBatch(tuple(names), cols, live_out, None)
     return out, total
+
+
+def radix_join(probe: ColumnBatch, probe_keys: list[str],
+               build: ColumnBatch, build_keys: list[str],
+               how: str = "inner", cap: int | None = None,
+               suffix: str = "_r", wide_keys_ok: bool = False,
+               n_buckets: int = 256, width: int = 1024):
+    """Hash-partitioned variant of ``join`` (reference: hash join,
+    src/exec/join_node.cpp; ops/radix.py for the partition machinery).
+
+    The build side partitions into ``n_buckets`` by key hash and sorts
+    per-bucket (batched log^2(width) stages instead of one global
+    log^2(n) bitonic); probes binary-search only their bucket.  Returns
+    (out_batch, needed_rows, needed_width): ``needed_width`` reports the
+    true max bucket occupancy — when it exceeds ``width`` (skew), the
+    caller re-traces with a bigger width, the same contract as join caps.
+    Semantics identical to ``join`` (inner/left/semi/anti, NULL handling,
+    name suffixing)."""
+    from .radix import radix_build, radix_probe
+
+    probe, build = _align_string_keys(probe, probe_keys, build, build_keys)
+    pk, pvalid = _key_array(probe, probe_keys, wide_keys_ok)
+    bk, bvalid = _key_array(build, build_keys, wide_keys_ok)
+    bdead = _build_dead(build, bvalid)
+    sort_src, sort_keys, needed_width = radix_build(bk, bdead, n_buckets,
+                                                    width)
+    psel_dead, pdead = _probe_dead(probe, pvalid)
+    b, lo, hi = radix_probe(pk, pdead, sort_keys, n_buckets)
+    # clamp to each bucket's LIVE occupancy: live rows sort to the front of
+    # their bucket row, so a probe key equal to the padding sentinel can't
+    # overcount into the pad
+    live_w = jnp.sum(sort_src < len(build), axis=1).astype(jnp.int32)
+    lo = jnp.minimum(lo, live_w[b])
+    hi = jnp.minimum(hi, live_w[b])
+    counts = jnp.where(pdead, 0, hi - lo)
+
+    if how == "semi":
+        return probe.and_sel(counts > 0), jnp.int32(0), needed_width
+    if how == "anti":
+        return probe.and_sel(counts == 0), jnp.int32(0), needed_width
+
+    flat_src = sort_src.reshape(-1)
+
+    def bidx_of(pi_c, k):
+        bpos = (b[pi_c].astype(jnp.int64) * width
+                + lo[pi_c].astype(jnp.int64) + k)
+        return jnp.clip(flat_src[jnp.clip(bpos, 0, flat_src.shape[0] - 1)],
+                        0, len(build) - 1)
+
+    out, total = _expand_matches(probe, build, how, cap, counts, psel_dead,
+                                 bidx_of, suffix)
+    return out, total, needed_width
 
 
 def _dense_slots(batch: ColumnBatch, keys: list[str],

@@ -1,0 +1,144 @@
+"""Radix hash-partition machinery for the sort join (reference: the hash
+join is the reference's default join, src/exec/join_node.cpp; this is its
+TPU-shaped analog — VERDICT r03 next #4).
+
+The sort join's cost on large non-dense builds is ONE global bitonic sort:
+O(n log^2 n) compare-exchange stages.  Radix partitioning replaces it with
+
+1. bucket = multiplicative-hash(key) >> (64 - log2 nb)   (one op per row),
+2. a STABLE counting scatter into bucket-major order — a lax.scan over
+   fixed-size row blocks carrying per-bucket counters, so the working set
+   stays [block, nb] instead of [n, nb],
+3. per-bucket sorts of ~n/nb rows as ONE batched sort over a [nb, width]
+   matrix — log^2(width) stages instead of log^2(n),
+4. probes hash to their bucket and binary-search only its width.
+
+Static shapes throughout: buckets pad to a common ``width``; skew past it
+reports the true max occupancy through the same retry-flag protocol as
+join caps.  Everything is plain XLA (portable CPU/TPU); the per-bucket
+sort is where a Pallas kernel slots in next.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+_MULT = 0x9E3779B97F4A7C15
+
+
+def bucket_of(keys: jnp.ndarray, n_buckets: int) -> jnp.ndarray:
+    """Multiplicative hash -> bucket id in [0, n_buckets); n_buckets must
+    be a power of two (high bits: multiplicative hashing concentrates its
+    quality there)."""
+    if n_buckets < 2 or n_buckets & (n_buckets - 1):
+        raise ValueError("n_buckets must be a power of two >= 2 (a shift "
+                         "by 64 is implementation-defined)")
+    shift = 64 - int(math.log2(n_buckets))
+    h = (keys.astype(jnp.uint64) * jnp.uint64(_MULT)) >> jnp.uint64(shift)
+    return h.astype(jnp.int32)
+
+
+def stable_bucket_order(bucket: jnp.ndarray, n_buckets: int,
+                        block: int = 4096) -> tuple:
+    """-> (perm, offsets, counts): ``perm`` lists row indices bucket-major
+    (stable within a bucket); offsets[b] = bucket b's start position.
+
+    A scan over row blocks carries per-bucket counters; each step ranks its
+    block's rows within their buckets via a [block, nb+1] one-hot cumsum —
+    bounded memory, n/block scan steps."""
+    n = bucket.shape[0]
+    nb = n_buckets
+    pad = (-n) % block
+    b_pad = jnp.concatenate([bucket.astype(jnp.int32),
+                             jnp.full((pad,), nb, jnp.int32)]) \
+        if pad else bucket.astype(jnp.int32)
+    blocks = b_pad.reshape(-1, block)
+
+    def step(carry, blk):
+        onehot = jax.nn.one_hot(blk, nb + 1, dtype=jnp.int32)
+        before = jnp.cumsum(onehot, axis=0) - onehot   # earlier same-bucket
+        rank_in_block = jnp.sum(before * onehot, axis=1).astype(jnp.int32)
+        base = carry[blk]
+        return ((carry + jnp.sum(onehot, axis=0)).astype(jnp.int32),
+                (base + rank_in_block).astype(jnp.int32))
+
+    counts, rank_blocks = jax.lax.scan(step,
+                                       jnp.zeros(nb + 1, jnp.int32), blocks)
+    rank = rank_blocks.reshape(-1)[:n]
+    counts = counts[:nb]
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(counts)[:-1].astype(jnp.int32)])
+    dest = offsets[jnp.clip(bucket, 0, nb - 1)] + rank
+    dest = jnp.where(bucket < nb, dest, n)             # pad bucket: drop
+    perm = jnp.zeros(n + 1, jnp.int32).at[
+        jnp.clip(dest, 0, n)].set(jnp.arange(n, dtype=jnp.int32))[:n]
+    return perm, offsets, counts
+
+
+def radix_build(keys: jnp.ndarray, dead: jnp.ndarray, n_buckets: int,
+                width: int):
+    """Partition + per-bucket sort of the BUILD side.
+
+    -> (sort_src [nb, width], sort_keys [nb, width], needed): per-bucket
+    key-sorted layout with the padding sentinel (dtype max) at each row's
+    tail and sort_src = source row indices (len(keys) for padding).  Dead
+    rows (NULL keys / sel-dead) route to an overflow bucket and never
+    enter the matrix.  ``needed`` = true max LIVE bucket occupancy (the
+    caller re-traces with width >= needed on skew overflow)."""
+    nb = n_buckets
+    n = keys.shape[0]
+    bucket = jnp.where(dead, nb, bucket_of(keys, nb))
+    perm, offsets, counts = stable_bucket_order(bucket, nb + 1)
+    needed = jnp.max(counts[:nb])
+    src = perm
+    row_bucket = bucket[src]
+    slot = jnp.arange(n, dtype=jnp.int32) - offsets[row_bucket]
+    ok = (row_bucket < nb) & (slot < width)
+    sentinel = jnp.iinfo(keys.dtype).max
+    tgt = jnp.where(ok, row_bucket * width + slot, nb * width)  # scratch
+    flat = jnp.full((nb * width + 1,), sentinel, keys.dtype).at[tgt].set(
+        jnp.where(ok, keys[src], sentinel))
+    srcflat = jnp.full((nb * width + 1,), n, jnp.int32).at[tgt].set(
+        jnp.where(ok, src, n))
+    mat = flat[:-1].reshape(nb, width)
+    srcmat = srcflat[:-1].reshape(nb, width)
+    sort_keys, sort_src = jax.lax.sort([mat, srcmat], num_keys=1)
+    return sort_src, sort_keys, needed
+
+
+def radix_probe(pk: jnp.ndarray, pdead: jnp.ndarray, sort_keys: jnp.ndarray,
+                n_buckets: int):
+    """-> (bucket, lo, hi): each probe key's match range within ITS
+    bucket's sorted row.  Branchless binary search over the FLAT matrix
+    with per-probe base offsets — O(log width) single-element gathers per
+    probe, never a [n_probe, width] row materialization (that gather is
+    what made the naive vmapped searchsorted blow up)."""
+    width = sort_keys.shape[1]
+    flat = sort_keys.reshape(-1)
+    b = bucket_of(pk, n_buckets)
+    base = b.astype(jnp.int64) * width
+
+    def bsearch(right: bool):
+        lo = jnp.zeros(pk.shape, jnp.int32)
+        hi = jnp.full(pk.shape, width, jnp.int32)
+        steps = int(width).bit_length()
+
+        def body(_, lohi):
+            lo, hi = lohi
+            mid = (lo + hi) // 2
+            v = flat[base + mid]
+            go_right = (v <= pk) if right else (v < pk)
+            return (jnp.where((lo < hi) & go_right, mid + 1, lo),
+                    jnp.where((lo < hi) & ~go_right, mid, hi))
+
+        lo, hi = jax.lax.fori_loop(0, steps + 1, body, (lo, hi))
+        return lo
+
+    lo = bsearch(False)
+    hi = bsearch(True)
+    lo = jnp.where(pdead, 0, lo).astype(jnp.int32)
+    hi = jnp.where(pdead, 0, hi).astype(jnp.int32)
+    return b, lo, hi
