@@ -64,6 +64,12 @@ def _stmt_image(kind: str, s) -> str:
     if kind == "update":
         sets = ", ".join(f"{n}={e!r}" for n, e in s.assignments)
         return f"UPDATE {s.table.name} SET {sets}{where}"
+    if kind == "replace":
+        return f"REPLACE INTO {s.table.name} ({len(s.rows)} rows)"
+    if kind == "upsert":
+        sets = ", ".join(f"{c}={v!r}" for c, v in s.on_dup)
+        return (f"INSERT INTO {s.table.name} ({len(s.rows)} rows) "
+                f"ON DUPLICATE KEY UPDATE {sets}")
     return f"DELETE FROM {s.table.name}{where}"
 
 
@@ -1880,6 +1886,12 @@ class Session:
                 t = t.rename_columns(s.columns)
             else:
                 t = t.rename_columns(schema.names()[:t.num_columns])
+            if s.replace or s.on_dup:
+                # REPLACE INTO .. SELECT / INSERT .. SELECT .. ON DUP KEY:
+                # same upsert semantics as the VALUES form
+                return self._insert_upsert(
+                    store, s, t.to_pylist(),
+                    s.table.database or self.current_db)
             if t.num_rows <= HOT_INSERT_ROWS:
                 # small INSERT..SELECT takes the hot path: PK-checked and
                 # WAL-durable like INSERT..VALUES
@@ -1933,6 +1945,8 @@ class Session:
                     else:
                         r[f.name] = datetime.datetime(1970, 1, 1) + \
                             datetime.timedelta(microseconds=v)
+        if s.replace or s.on_dup:
+            return self._insert_upsert(store, s, rows, db_name)
         # the coupling decision, unique check, and mutation must be ONE
         # critical section against the backfill worker's publish (which
         # snapshots + flips the index state under this same lock): deciding
@@ -1946,6 +1960,125 @@ class Session:
         self._log_binlog("insert", db_name, s.table.name, rows=rows,
                          affected=len(rows))
         return Result(affected_rows=len(rows))
+
+    def _insert_upsert(self, store: TableStore, s, rows: list[dict],
+                       db_name: str) -> Result:
+        """REPLACE INTO (delete conflicting PKs, insert all — MySQL counts
+        2 per replaced row) and INSERT ... ON DUPLICATE KEY UPDATE
+        (insert the new, apply assignments to the conflicting — literals
+        and VALUES(col) references).  Reference: insert_planner.cpp
+        REPLACE / ON DUP KEY handling."""
+        import numpy as np
+
+        if store._pk_cols is None:
+            raise PlanError("REPLACE / ON DUPLICATE KEY needs a PRIMARY "
+                            "KEY")
+        cols = {f.name: [r.get(f.name) for r in rows]
+                for f in store.arrow_schema}
+        incoming = pa.table(cols, schema=store.arrow_schema)
+        with store._lock:
+            keys = store._encode_pk_table(incoming)
+            idx = store._ensure_pk_index()
+            # MySQL processes VALUES rows in order: a key may conflict with
+            # the TABLE or with an EARLIER row of the same statement — both
+            # are "duplicates", and later occurrences win sequentially
+            dupset: set = set()
+            new_rows: list[dict] = []
+            dup_rows: list[tuple] = []
+            seen: set = set()
+            for k, r in zip(keys, rows):
+                if k in idx or k in seen:
+                    dup_rows.append((k, r))
+                    if k in idx:
+                        dupset.add(k)
+                else:
+                    new_rows.append(r)
+                seen.add(k)
+            # rows beyond the first occurrence of their key, however the
+            # first fared: each counts as a sequential within-batch replace
+            batch_extras = len(rows) - len(seen)
+
+            def mask_over(keyset):
+                def pk_mask(t: pa.Table):
+                    ks = store._encode_pk_table(t)
+                    return np.asarray([k in keyset for k in ks], bool)
+                return pk_mask
+
+            coupled = self._coupled_global(store)
+            affected = 0
+            if s.replace:
+                if dupset:
+                    if coupled:
+                        n = self._delete_with_global(store, coupled,
+                                                     mask_over(dupset))
+                    else:
+                        n = store.delete_where(mask_over(dupset),
+                                               self._tctx(store))
+                    affected += n
+                # last occurrence per key wins (sequential REPLACE result)
+                effective: dict = {}
+                order: list = []
+                for k, r in zip(keys, rows):
+                    if k not in effective:
+                        order.append(k)
+                    effective[k] = r
+                ins = [effective[k] for k in order]
+                if coupled:
+                    self._insert_with_global(store, coupled, ins)
+                else:
+                    store.insert_rows(ins, self._tctx(store))
+                affected += len(rows) + batch_extras
+            else:
+                if new_rows:
+                    if coupled:
+                        self._insert_with_global(store, coupled, new_rows)
+                    else:
+                        store.insert_rows(new_rows, self._tctx(store))
+                    affected += len(new_rows)
+                if dup_rows:
+                    pk_mask = mask_over({k for k, _ in dup_rows})
+                    mapping = {}
+                    for k, r in dup_rows:
+                        vals = {}
+                        for col, (kind, v) in s.on_dup:
+                            if col not in store.info.schema:
+                                raise PlanError(f"unknown column {col!r}")
+                            vals[col] = r.get(v) if kind == "values" else v
+                        mapping[k] = vals
+                    assigned = sorted({c for c, _ in s.on_dup})
+
+                    def assign_fn(t: pa.Table, mask):
+                        ks = store._encode_pk_table(t)
+                        out = t
+                        for col in assigned:
+                            f = store.arrow_schema.field(col)
+                            old = t.column(col).to_pylist()
+                            newv = [mapping.get(k, {}).get(col, old[i])
+                                    if m else old[i]
+                                    for i, (k, m) in enumerate(
+                                        zip(ks, np.asarray(mask)))]
+                            out = out.set_column(
+                                out.column_names.index(col), f,
+                                pa.array(newv, f.type))
+                        return out
+
+                    if coupled:
+                        n = self._update_with_global(store, coupled,
+                                                     pk_mask, assign_fn,
+                                                     assigned)
+                    else:
+                        n = store.update_where(pk_mask, assign_fn,
+                                               self._tctx(store),
+                                               changed_cols=assigned)
+                    affected += 2 * n       # MySQL: 2 per updated row
+        # statement image only: the applied row state differs from the
+        # incoming VALUES for updated rows, so a row-image 'insert' event
+        # would diverge CDC subscribers from the source
+        self._log_binlog("insert", db_name, s.table.name,
+                         affected=affected,
+                         statement=_stmt_image(
+                             "replace" if s.replace else "upsert", s))
+        return Result(affected_rows=affected)
 
     def _user_columns(self, store: TableStore) -> list[str]:
         """Declared column order with vector components collapsed back to

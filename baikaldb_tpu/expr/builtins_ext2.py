@@ -492,9 +492,10 @@ def _make_set(e, batch):
     import numpy as np
 
     bits = _eval(e.args[0], batch)
-    strs = [_lit_str(e, i, "make_set", default=None)
-            for i in range(1, len(e.args))]
-    strs = [None if v is None else str(v) for v in strs]  # MySQL coerces
+    # _lit_str returns Lit(None).value = None for SQL NULL literals, which
+    # MySQL's MAKE_SET skips; numeric literals coerce to strings
+    strs = [_lit_str(e, i, "make_set") for i in range(1, len(e.args))]
+    strs = [None if v is None else str(v) for v in strs]
     if len(strs) > 16:
         raise ExprError("MAKE_SET supports up to 16 literal strings")
     combos = np.asarray([",".join(s for j, s in enumerate(strs)

@@ -366,9 +366,36 @@ class Parser:
                 rows.append(row)
                 if not self.try_op(","):
                     break
-            return InsertStmt(table, columns, rows, replace=replace)
+            return InsertStmt(table, columns, rows, replace=replace,
+                              on_dup=self._on_dup_clause())
         sel = self.select_stmt()
-        return InsertStmt(table, columns, [], select=sel, replace=replace)
+        return InsertStmt(table, columns, [], select=sel, replace=replace,
+                          on_dup=self._on_dup_clause())
+
+    def _on_dup_clause(self) -> list:
+        """ON DUPLICATE KEY UPDATE col = literal | VALUES(col), ..."""
+        if not self.try_kw("on"):
+            return []
+        w = self.ident()
+        if w.lower() != "duplicate":
+            raise SqlError(f"expected DUPLICATE, got {w!r}")
+        self.expect_kw("key")
+        self.expect_kw("update")
+        out = []
+        while True:
+            col = self.ident()
+            self.expect_op("=")
+            if self.peek().kind == "KW" and self.peek().value == "values" \
+                    and self.peek(1).value == "(":
+                self.advance()
+                self.expect_op("(")
+                out.append((col, ("values", self.ident())))
+                self.expect_op(")")
+            else:
+                out.append((col, ("lit", self.literal_value())))
+            if not self.try_op(","):
+                break
+        return out
 
     def literal_value(self):
         """A literal (or signed literal / NULL) inside VALUES(...)."""

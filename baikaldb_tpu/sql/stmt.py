@@ -65,6 +65,8 @@ class InsertStmt:
     rows: list[list]              # literal rows
     select: Optional[SelectStmt] = None
     replace: bool = False
+    # ON DUPLICATE KEY UPDATE assignments: (col, ("lit", v) | ("values", c))
+    on_dup: list = field(default_factory=list)
 
 
 @dataclass

@@ -1,0 +1,88 @@
+"""REPLACE INTO and INSERT..ON DUPLICATE KEY UPDATE (reference:
+insert_planner.cpp REPLACE/ON DUP KEY handling, SURVEY §2.3)."""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Database, PlanError, Session
+from baikaldb_tpu.raft.core import raft_available
+
+
+def mk():
+    s = Session(Database())
+    s.execute("CREATE TABLE t (id BIGINT, v BIGINT, name VARCHAR(16), "
+              "PRIMARY KEY (id))")
+    s.execute("INSERT INTO t VALUES (1, 10, 'a'), (2, 20, 'b')")
+    return s
+
+
+def test_replace_into():
+    s = mk()
+    r = s.execute("REPLACE INTO t VALUES (1, 99, 'z'), (3, 30, 'c')")
+    assert r.affected_rows == 3            # 2 for replaced + 1 new
+    got = s.query("SELECT id, v FROM t ORDER BY id")
+    assert [(x["id"], x["v"]) for x in got] == [(1, 99), (2, 20), (3, 30)]
+
+
+def test_on_duplicate_key_update_literal_and_values():
+    s = mk()
+    r = s.execute("INSERT INTO t VALUES (1, 111, 'x'), (4, 40, 'd') "
+                  "ON DUPLICATE KEY UPDATE v = VALUES(v), name = 'dup'")
+    assert r.affected_rows == 3            # 1 inserted + 2 for updated
+    got = s.query("SELECT id, v, name FROM t ORDER BY id")
+    assert [(x["id"], x["v"], x["name"]) for x in got] == \
+        [(1, 111, "dup"), (2, 20, "b"), (4, 40, "d")]
+
+
+def test_upsert_requires_pk():
+    s = Session(Database())
+    s.execute("CREATE TABLE nop (x BIGINT)")
+    with pytest.raises(PlanError, match="PRIMARY KEY"):
+        s.execute("REPLACE INTO nop VALUES (1)")
+
+
+@pytest.mark.skipif(not raft_available(),
+                    reason="native raft core unavailable")
+def test_replace_maintains_global_index():
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.fleet import StoreFleet
+    from baikaldb_tpu.storage.rowstore import ConflictError
+
+    meta = MetaService(peer_count=3)
+    fleet = StoreFleet(meta, ["a:1", "b:1", "c:1"], seed=61)
+    s = Session(Database(fleet=fleet))
+    s.execute("CREATE TABLE u (id BIGINT, email VARCHAR(32), "
+              "PRIMARY KEY (id), GLOBAL UNIQUE INDEX g (email))")
+    s.execute("INSERT INTO u VALUES (1, 'a@x'), (2, 'b@x')")
+    s.execute("REPLACE INTO u VALUES (1, 'c@x')")      # frees 'a@x'
+    s.execute("INSERT INTO u VALUES (3, 'a@x')")
+    with pytest.raises(ConflictError):
+        s.execute("INSERT INTO u VALUES (4, 'c@x')")   # taken by new row 1
+    s.execute("INSERT INTO u VALUES (5, 'e@x') "
+              "ON DUPLICATE KEY UPDATE email = 'ignored'")
+    got = s.query("SELECT id, email FROM u ORDER BY id")
+    assert [(r["id"], r["email"]) for r in got] == \
+        [(1, "c@x"), (2, "b@x"), (3, "a@x"), (5, "e@x")]
+
+
+def test_within_batch_duplicate_pks():
+    """VALUES repeating a PK: MySQL's sequential semantics — never a
+    failed statement with data already deleted."""
+    s = mk()
+    r = s.execute("REPLACE INTO t VALUES (1, 50, 'p'), (1, 60, 'q')")
+    assert r.affected_rows == 4            # row1: replace(2) + row2: replace(2)
+    got = s.query("SELECT v, name FROM t WHERE id = 1")
+    assert got == [{"v": 60, "name": "q"}]           # last wins
+    r = s.execute("INSERT INTO t VALUES (9, 1, 'a'), (9, 2, 'b') "
+                  "ON DUPLICATE KEY UPDATE v = VALUES(v)")
+    got = s.query("SELECT v, name FROM t WHERE id = 9")
+    assert got == [{"v": 2, "name": "a"}]  # first inserts, second updates v
+
+
+def test_replace_into_select():
+    s = mk()
+    s.execute("CREATE TABLE src (id BIGINT, v BIGINT, name VARCHAR(16), "
+              "PRIMARY KEY (id))")
+    s.execute("INSERT INTO src VALUES (1, 500, 'srcrow'), (7, 70, 'new')")
+    s.execute("REPLACE INTO t SELECT * FROM src")
+    got = s.query("SELECT id, v FROM t ORDER BY id")
+    assert [(x["id"], x["v"]) for x in got] == [(1, 500), (2, 20), (7, 70)]
