@@ -2270,6 +2270,58 @@ class Session:
         return Result(affected_rows=n)
 
     # -- SELECT ---------------------------------------------------------
+    def _select_into_outfile(self, stmt: SelectStmt, cache_key) -> Result:
+        """SELECT ... INTO OUTFILE: run the query, stream the rows to a
+        file (reference: full_export_node streaming export,
+        src/exec/full_export_node.cpp).  MySQL conventions: refuses to
+        overwrite (O_EXCL claim, concurrency-safe), \\N for NULL,
+        backslash escaping of separators, 1/0 booleans, the row count as
+        the result."""
+        import copy
+        import os
+        import tempfile
+
+        path, fsep, lsep = stmt.into_outfile
+        try:
+            final_fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            raise PlanError(f"OUTFILE {path!r} already exists") from None
+        inner = copy.copy(stmt)
+        inner.into_outfile = None
+        try:
+            res = self._select(
+                inner, cache_key=None if cache_key is None else
+                (cache_key[0] + " /*outfile*/", cache_key[1]))
+
+            def cell(v):
+                if v is None:
+                    return "\\N"
+                if isinstance(v, bool):
+                    return "1" if v else "0"
+                s = str(v)
+                return (s.replace("\\", "\\\\")
+                        .replace(fsep, "\\" + fsep)
+                        .replace(lsep, "\\" + lsep))
+
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(
+                os.path.abspath(path)) or ".", suffix=".outfile")
+            try:
+                with os.fdopen(fd, "w", encoding="utf-8", newline="") as f:
+                    for r in res.rows:          # positional: duplicate
+                        f.write(fsep.join(       # column names stay intact
+                            cell(v) for v in r) + lsep)
+                os.replace(tmp, path)
+            except BaseException:
+                os.unlink(tmp)
+                raise
+        except BaseException:
+            os.close(final_fd)
+            os.unlink(path)
+            raise
+        os.close(final_fd)
+        n = res.arrow.num_rows if res.arrow is not None else 0
+        return Result(affected_rows=n)
+
     def _select_group_concat(self, stmt: SelectStmt) -> Result:
         """GROUP_CONCAT is an egress aggregate: device strings are dictionary
         codes, so concatenation happens at the result layer (the reference
@@ -2407,6 +2459,8 @@ class Session:
         per SQL text, one compiled executable per (table versions, shapes)."""
         from ..expr.ast import AggCall
 
+        if stmt.into_outfile is not None:
+            return self._select_into_outfile(stmt, cache_key)
         point = self._try_point_lookup(stmt)
         if point is not None:
             return point

@@ -228,6 +228,31 @@ class Parser:
                 stmt.limit = a
                 if self.try_kw("offset"):
                     stmt.offset = self._int_lit()
+        if self.try_kw("into"):
+            # INTO OUTFILE 'path' [FIELDS TERMINATED BY 's']
+            # [LINES TERMINATED BY 's'] — the full-export surface
+            w = self.ident()
+            if w.lower() != "outfile":
+                raise SqlError(f"expected OUTFILE, got {w!r}")
+            t = self.advance()
+            if t.kind != "STR":
+                raise SqlError("OUTFILE needs a string literal path")
+            path, fsep, lsep = t.value, ",", "\n"
+            while self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() in ("fields", "lines"):
+                which = self.advance().value.lower()
+                w = self.ident()
+                if w.lower() != "terminated":
+                    raise SqlError(f"expected TERMINATED, got {w!r}")
+                self.expect_kw("by")
+                t = self.advance()
+                if t.kind != "STR":
+                    raise SqlError("TERMINATED BY needs a string literal")
+                if which == "fields":
+                    fsep = t.value
+                else:
+                    lsep = t.value
+            stmt.into_outfile = (path, fsep, lsep)
         return stmt
 
     def _select_core(self) -> SelectStmt:

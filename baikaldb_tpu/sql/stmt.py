@@ -56,6 +56,9 @@ class SelectStmt:
     distinct: bool = False
     union: Optional[tuple[str, "SelectStmt"]] = None  # ("all"|"distinct", rhs)
     ctes: list[tuple[str, "SelectStmt"]] = field(default_factory=list)
+    # SELECT ... INTO OUTFILE 'path' (reference: full_export_node streaming
+    # export): (path, field_sep, line_sep) or None
+    into_outfile: Optional[tuple] = None
 
 
 @dataclass

@@ -86,3 +86,35 @@ def test_replace_into_select():
     s.execute("REPLACE INTO t SELECT * FROM src")
     got = s.query("SELECT id, v FROM t ORDER BY id")
     assert [(x["id"], x["v"]) for x in got] == [(1, 500), (2, 20), (7, 70)]
+
+
+def test_select_into_outfile(tmp_path):
+    """SELECT ... INTO OUTFILE (reference: full_export_node streaming
+    export): CSV-ish file, \\N NULLs, refuses overwrite, round-trips
+    through LOAD DATA."""
+    s = mk()
+    s.execute("INSERT INTO t VALUES (3, NULL, 'n')")
+    out = str(tmp_path / "dump.csv")
+    r = s.execute(f"SELECT id, v, name FROM t ORDER BY id "
+                  f"INTO OUTFILE '{out}'")
+    assert r.affected_rows == 3
+    lines = open(out).read().splitlines()
+    assert lines == ["1,10,a", "2,20,b", "3,\\N,n"]
+    with pytest.raises(Exception, match="exists"):
+        s.execute(f"SELECT id FROM t INTO OUTFILE '{out}'")
+    # round-trip through LOAD DATA
+    s.execute("CREATE TABLE t2 (id BIGINT, v BIGINT, name VARCHAR(16), "
+              "PRIMARY KEY (id))")
+    s.execute(f"LOAD DATA INFILE '{out}' INTO TABLE t2")
+    assert s.query("SELECT COUNT(*) n FROM t2") == [{"n": 3}]
+    assert s.query("SELECT v FROM t2 WHERE id = 3") == [{"v": None}]
+
+
+def test_outfile_duplicate_columns_and_escaping(tmp_path):
+    s = mk()
+    s.execute("INSERT INTO t VALUES (5, 50, 'a,b')")   # separator in data
+    out = str(tmp_path / "d.csv")
+    r = s.execute(f"SELECT id, id, name FROM t WHERE id = 5 "
+                  f"INTO OUTFILE '{out}'")
+    assert r.affected_rows == 1
+    assert open(out).read() == "5,5,a\\,b\n"           # 3 fields, escaped
