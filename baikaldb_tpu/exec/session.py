@@ -11,6 +11,7 @@ compiled XLA executable.
 
 from __future__ import annotations
 
+import functools
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -53,6 +54,16 @@ from .executor import compile_plan
 # INSERT..SELECT at or below this lands in the hot (WAL-durable) row tier;
 # above it, the bulk cold path (durable at the next checkpoint)
 HOT_INSERT_ROWS = 100_000
+
+
+@functools.lru_cache(maxsize=64)
+def _show_like_rx(pat: str):
+    """Compiled SHOW ... LIKE matcher (MySQL semantics: case-insensitive,
+    wildcard/escape translation shared with expression-level LIKE)."""
+    import re
+
+    from ..expr.compile import _like_to_regex
+    return re.compile(_like_to_regex(pat), re.IGNORECASE)
 
 
 def _empty_info(name: str):
@@ -729,6 +740,21 @@ class Session:
             return self._handle(s)
         if isinstance(s, DescribeStmt):
             db = s.table.database or self.current_db
+            if self.db.catalog.get_view(db, s.table.name) is not None:
+                # DESCRIBE on a view: plan the view body (no execution) and
+                # read the root node's output schema — logical type names
+                # match what tables report (MySQL describes views alike)
+                stmt = parse_sql(
+                    f"SELECT * FROM `{db}`.`{s.table.name}`")[0]
+                fields = self._plan_select(stmt).schema.fields
+                return Result(
+                    columns=["Field", "Type", "Null", "Key"],
+                    arrow=pa.table({
+                        "Field": [f.name for f in fields],
+                        "Type": [f.ltype.value for f in fields],
+                        "Null": ["YES" if f.nullable else "NO"
+                                 for f in fields],
+                        "Key": [""] * len(fields)}))
             info = self.db.catalog.get_table(db, s.table.name)
             pk = info.primary_key()
             pkcols = set(pk.columns) if pk else set()
@@ -755,7 +781,18 @@ class Session:
     # -- SHOW / admin surface ---------------------------------------------
     def _show(self, s: ShowStmt) -> Result:
         """SHOW command family (reference: show_helper.cpp's registry)."""
-        import fnmatch
+        def like(name: str, pat: str) -> bool:
+            # MySQL LIKE for SHOW ... LIKE: case-insensitive; wildcard and
+            # \-escape translation shared with expression-level LIKE
+            return _show_like_rx(pat).match(name) is not None
+
+        def visible(db):
+            # user-facing tables + views: rollup and global-index backing
+            # tables are internal
+            from ..index.globalindex import is_backing_table
+            from ..index.rollup import is_rollup_table
+            return ([n for n in cat.tables(db) if not is_rollup_table(n)
+                     and not is_backing_table(n)], list(cat.views(db)))
 
         cat = self.db.catalog
         if s.what == "databases":
@@ -763,14 +800,104 @@ class Session:
             return Result(columns=["Database"],
                           arrow=pa.table({"Database": names}))
         if s.what == "tables":
-            from ..index.globalindex import is_backing_table
-            from ..index.rollup import is_rollup_table
             db = s.database or self.current_db
-            names = [n for n in cat.tables(db) if not is_rollup_table(n)
-                     and not is_backing_table(n)]
-            names = sorted(names + cat.views(db))   # MySQL lists views too
+            tbls, views = visible(db)
+            names = sorted(tbls + views)   # MySQL lists views too
+            if s.pattern is not None:
+                names = [n for n in names if like(n, s.pattern)]
             return Result(columns=[f"Tables_in_{db}"],
                           arrow=pa.table({f"Tables_in_{db}": names}))
+        if s.what == "full_tables":
+            db = s.database or self.current_db
+            tbls, views = visible(db)
+            all_names = sorted(tbls + views)
+            if s.pattern is not None:
+                all_names = [n for n in all_names if like(n, s.pattern)]
+            vset = set(views)
+            return Result(
+                columns=[f"Tables_in_{db}", "Table_type"],
+                arrow=pa.table({
+                    f"Tables_in_{db}": all_names,
+                    "Table_type": ["VIEW" if n in vset else "BASE TABLE"
+                                   for n in all_names]}))
+        if s.what == "collation":
+            # the collations the engine actually implements (reference:
+            # show_helper.cpp _show_collation; comparisons support _bin
+            # semantics by default and utf8mb4_general_ci via COLLATE)
+            rows = [("utf8mb4_bin", "utf8mb4", 46, "Yes"),
+                    ("utf8mb4_general_ci", "utf8mb4", 45, ""),
+                    ("binary", "binary", 63, "Yes")]
+            if s.pattern is not None:
+                rows = [r for r in rows if like(r[0], s.pattern)]
+            return Result(
+                columns=["Collation", "Charset", "Id", "Default",
+                         "Compiled", "Sortlen"],
+                arrow=pa.table({
+                    "Collation": [r[0] for r in rows],
+                    "Charset": [r[1] for r in rows],
+                    "Id": pa.array([r[2] for r in rows], pa.int64()),
+                    "Default": [r[3] for r in rows],
+                    "Compiled": ["Yes"] * len(rows),
+                    "Sortlen": pa.array([1] * len(rows), pa.int64()),
+                }))
+        if s.what == "charset":
+            rows = [("utf8mb4", "UTF-8 Unicode", "utf8mb4_bin", 4),
+                    ("binary", "Binary pseudo charset", "binary", 1)]
+            if s.pattern is not None:
+                rows = [r for r in rows if like(r[0], s.pattern)]
+            return Result(
+                columns=["Charset", "Description", "Default collation",
+                         "Maxlen"],
+                arrow=pa.table({
+                    "Charset": [r[0] for r in rows],
+                    "Description": [r[1] for r in rows],
+                    "Default collation": [r[2] for r in rows],
+                    "Maxlen": pa.array([r[3] for r in rows], pa.int64()),
+                }))
+        if s.what == "engines":
+            return Result(
+                columns=["Engine", "Support", "Comment", "Transactions",
+                         "XA", "Savepoints"],
+                arrow=pa.table({
+                    "Engine": ["BaikalTPU"],
+                    "Support": ["DEFAULT"],
+                    "Comment": ["TPU-native columnar HTAP engine (JAX/XLA)"],
+                    "Transactions": ["YES"],
+                    "XA": ["NO"],
+                    "Savepoints": ["YES"]}))
+        if s.what == "table_status":
+            db = s.database or self.current_db
+            tbls, views = visible(db)
+            if s.pattern is not None:   # filter names before the per-table store scans
+                tbls = [n for n in tbls if like(n, s.pattern)]
+                views = [n for n in views if like(n, s.pattern)]
+            rows = []
+            for n in tbls:
+                # don't force-materialize stores for a metadata listing
+                # (fleet/cluster tiers, cold segments, WAL attach): a table
+                # this frontend hasn't touched reports Rows=NULL (MySQL
+                # treats Rows as an estimate; NULL = unknown)
+                st = self.db.stores.get(f"{db}.{n}")
+                nrows = st.num_rows if st is not None else None
+                info = cat.get_table(db, n)
+                pspec = (info.options or {}).get("partition")
+                rows.append((n, "BaikalTPU", nrows,
+                             "partitioned" if pspec else "", ""))
+            for n in views:
+                rows.append((n, None, None, "", "VIEW"))
+            rows.sort(key=lambda r: r[0])
+            return Result(
+                columns=["Name", "Engine", "Rows", "Collation",
+                         "Create_options", "Comment"],
+                arrow=pa.table({
+                    "Name": [r[0] for r in rows],
+                    "Engine": pa.array([r[1] for r in rows], pa.string()),
+                    "Rows": pa.array([r[2] for r in rows], pa.int64()),
+                    "Collation": pa.array(
+                        ["utf8mb4_bin" if r[1] else None for r in rows],
+                        pa.string()),
+                    "Create_options": [r[3] for r in rows],
+                    "Comment": [r[4] for r in rows]}))
         if s.what == "create_table":
             db = s.table.database or self.current_db
             view = cat.get_view(db, s.table.name)
@@ -820,8 +947,41 @@ class Session:
                         f"({parts})")
             return Result(columns=["Table", "Create Table"], arrow=pa.table(
                 {"Table": [s.table.name], "Create Table": [ddl]}))
-        if s.what == "columns":
-            return self._execute_stmt(DescribeStmt(s.table))
+        if s.what in ("columns", "full_columns"):
+            base = self._execute_stmt(DescribeStmt(s.table)).arrow
+            if s.pattern is not None:
+                base = base.take(
+                    [i for i, f in
+                     enumerate(base.column("Field").to_pylist())
+                     if like(f, s.pattern)])
+            if s.what == "columns":
+                return Result(columns=list(base.column_names), arrow=base)
+            # the FULL shape MySQL connectors index by name:
+            # Field/Type/Collation/Null/Key/Default/Extra/Privileges/Comment
+            fields = base.column("Field").to_pylist()
+            types = base.column("Type").to_pylist()
+            db = s.table.database or self.current_db
+            auto_col = None
+            if cat.get_view(db, s.table.name) is None:
+                info = cat.get_table(db, s.table.name)
+                auto_col = (info.options or {}).get("auto_increment")
+            return Result(
+                columns=["Field", "Type", "Collation", "Null", "Key",
+                         "Default", "Extra", "Privileges", "Comment"],
+                arrow=pa.table({
+                    "Field": fields,
+                    "Type": types,
+                    "Collation": pa.array(
+                        ["utf8mb4_bin" if t == "string" else None
+                         for t in types], pa.string()),
+                    "Null": base.column("Null"),
+                    "Key": base.column("Key"),
+                    "Default": pa.array([None] * len(fields), pa.string()),
+                    "Extra": ["auto_increment" if f == auto_col else ""
+                              for f in fields],
+                    "Privileges": ["select,insert,update,references"]
+                    * len(fields),
+                    "Comment": [""] * len(fields)}))
         if s.what == "index":
             db = s.table.database or self.current_db
             info = cat.get_table(db, s.table.name)
@@ -864,9 +1024,8 @@ class Session:
                     for k, v in st.items():
                         vals[f"{name}.{k}"] = str(v)
             items = sorted(vals.items())
-            if s.pattern:
-                items = [(k, v) for k, v in items
-                         if fnmatch.fnmatch(k, s.pattern.replace("%", "*"))]
+            if s.pattern is not None:
+                items = [(k, v) for k, v in items if like(k, s.pattern)]
             return Result(columns=["Variable_name", "Value"], arrow=pa.table({
                 "Variable_name": [k for k, _ in items],
                 "Value": [v for _, v in items]}))

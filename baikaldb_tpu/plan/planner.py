@@ -490,7 +490,17 @@ class Planner:
                 proj_exprs.append(oe)
             sort_keys.append((hit, asc))
 
-        out_schema = Schema(tuple(Field(n, infer_type(e, sch))
+        def _nullable(e) -> bool:
+            # bare column references keep base-table nullability (DESCRIBE
+            # on views reads this); anything computed is nullable
+            if isinstance(e, ColRef):
+                try:
+                    return sch.field(e.name).nullable
+                except Exception:
+                    return True
+            return True
+
+        out_schema = Schema(tuple(Field(n, infer_type(e, sch), _nullable(e))
                                   for n, e in zip(proj_names, proj_exprs)))
         plan = ProjectNode(children=[plan], exprs=proj_exprs, names=proj_names,
                            schema=out_schema)

@@ -83,7 +83,10 @@ def tokenize(sql: str) -> list[Token]:
             while j < n:
                 if sql[j] == "\\" and j + 1 < n:
                     esc = sql[j + 1]
-                    buf.append({"n": "\n", "t": "\t", "0": "\0"}.get(esc, esc))
+                    # MySQL keeps \% and \_ verbatim in string literals so
+                    # LIKE can distinguish escaped wildcards
+                    buf.append({"n": "\n", "t": "\t", "0": "\0",
+                                "%": "\\%", "_": "\\_"}.get(esc, esc))
                     j += 2
                 elif sql[j] == q:
                     if j + 1 < n and sql[j + 1] == q:  # '' escape

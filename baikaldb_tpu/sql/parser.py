@@ -967,15 +967,37 @@ class Parser:
             return True
         return False
 
+    def _like_pat(self) -> Optional[str]:
+        """Optional SHOW ... LIKE 'pattern' — the operand must be a string
+        literal (MySQL rejects identifiers and a missing operand)."""
+        if not self.try_kw("like"):
+            return None
+        t = self.peek()
+        if t.kind != "STR":
+            raise SqlError(f"expected string after LIKE at {t.pos}")
+        self.advance()
+        return t.value
+
+    def _db_and_pat(self):
+        """[FROM|IN db] [LIKE 'pat'] tail shared by SHOW [FULL] TABLES and
+        SHOW TABLE STATUS."""
+        db = None
+        if self.try_kw("from") or self.try_kw("in"):
+            db = self.ident()
+        return db, self._like_pat()
+
+    def _tbl_and_pat(self):
+        """FROM tbl [LIKE 'pat'] tail shared by SHOW [FULL] COLUMNS."""
+        self.expect_kw("from")
+        return self.table_name(), self._like_pat()
+
     def show_stmt(self) -> ShowStmt:
         """SHOW surface (reference: show_helper.cpp's 5.5k-LoC command map —
         the high-traffic subset)."""
         self.expect_kw("show")
         if self.try_kw("tables"):
-            db = None
-            if self.try_kw("from"):
-                db = self.ident()
-            return ShowStmt("tables", db)
+            db, pat = self._db_and_pat()
+            return ShowStmt("tables", db, pattern=pat)
         if self.try_kw("databases"):
             return ShowStmt("databases")
         if self.try_kw("create"):
@@ -988,18 +1010,42 @@ class Parser:
         word = self.peek().value.lower()
         if word == "columns":
             self.advance()
-            self.expect_kw("from")
-            return ShowStmt("columns", table=self.table_name())
+            tbl, pat = self._tbl_and_pat()
+            return ShowStmt("columns", table=tbl, pattern=pat)
         if word in ("variables", "status"):
             self.advance()
-            pat = None
-            if self.try_kw("like"):
-                pat = self.advance().value
+            pat = self._like_pat()
             return ShowStmt(word, pattern=pat)
         if word == "full" and self.peek(1).value.lower() == "processlist":
             self.advance()
             self.advance()
             return ShowStmt("processlist")
+        if word == "full" and self.peek(1).value.lower() == "tables":
+            self.advance()
+            self.advance()
+            db, pat = self._db_and_pat()
+            return ShowStmt("full_tables", db, pattern=pat)
+        if word == "full" and self.peek(1).value.lower() == "columns":
+            self.advance()
+            self.advance()
+            tbl, pat = self._tbl_and_pat()
+            return ShowStmt("full_columns", table=tbl, pattern=pat)
+        if word in ("collation", "engines") or \
+                (word == "charset") or \
+                (word == "character" and self.peek(1).value.lower() == "set"):
+            what = "charset" if word in ("charset", "character") else word
+            self.advance()
+            if word == "character":
+                self.advance()
+            # MySQL rejects LIKE on SHOW ENGINES; leaving the token
+            # unconsumed surfaces the same syntax error here
+            pat = self._like_pat() if what != "engines" else None
+            return ShowStmt(what, pattern=pat)
+        if word == "table" and self.peek(1).value.lower() == "status":
+            self.advance()
+            self.advance()
+            db, pat = self._db_and_pat()
+            return ShowStmt("table_status", db, pattern=pat)
         if word == "processlist":
             self.advance()
             return ShowStmt("processlist")

@@ -1,0 +1,240 @@
+"""Client-compat SHOW surface: FULL TABLES, COLLATION, CHARSET, ENGINES,
+TABLE STATUS (reference: src/protocol/show_helper.cpp command registry —
+these are the commands GUI clients and connectors issue at connect time)."""
+
+from baikaldb_tpu.exec.session import Database, Session
+
+
+def _sess():
+    s = Session()
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    s.execute("CREATE TABLE t (id BIGINT PRIMARY KEY, v DOUBLE)")
+    s.execute("INSERT INTO t VALUES (1, 1.5), (2, 2.5)")
+    s.execute("CREATE VIEW vw AS SELECT id FROM t")
+    return s
+
+
+def test_show_full_tables_marks_views():
+    s = _sess()
+    rows = s.query("SHOW FULL TABLES")
+    got = {r["Tables_in_d"]: r["Table_type"] for r in rows}
+    assert got == {"t": "BASE TABLE", "vw": "VIEW"}
+
+
+def test_show_full_tables_from_db():
+    s = _sess()
+    s.execute("CREATE DATABASE other")
+    s.execute("CREATE TABLE other.x (id BIGINT PRIMARY KEY)")
+    rows = s.query("SHOW FULL TABLES FROM other")
+    assert [r["Tables_in_other"] for r in rows] == ["x"]
+
+
+def test_show_full_columns():
+    s = _sess()
+    rows = s.query("SHOW FULL COLUMNS FROM t")
+    assert [r["Field"] for r in rows] == ["id", "v"]
+    # the FULL shape connectors index by name
+    for col in ("Collation", "Default", "Extra", "Privileges", "Comment"):
+        assert col in rows[0]
+    assert rows[0]["Key"] == "PRI"
+
+
+def test_show_full_columns_string_collation_and_auto_inc():
+    s = _sess()
+    s.execute("CREATE TABLE ai (id BIGINT PRIMARY KEY AUTO_INCREMENT, "
+              "name VARCHAR(32))")
+    rows = s.query("SHOW FULL COLUMNS FROM ai")
+    by = {r["Field"]: r for r in rows}
+    assert by["id"]["Extra"] == "auto_increment"
+    assert by["id"]["Collation"] is None
+    assert by["name"]["Collation"] == "utf8mb4_bin"
+
+
+def test_show_collation():
+    s = _sess()
+    rows = s.query("SHOW COLLATION")
+    names = {r["Collation"] for r in rows}
+    assert {"utf8mb4_bin", "utf8mb4_general_ci", "binary"} <= names
+    rows = s.query("SHOW COLLATION LIKE 'utf8mb4%'")
+    assert all(r["Collation"].startswith("utf8mb4") for r in rows)
+    assert len(rows) == 2
+
+
+def test_show_charset_both_spellings():
+    s = _sess()
+    a = s.query("SHOW CHARSET")
+    b = s.query("SHOW CHARACTER SET")
+    assert [r["Charset"] for r in a] == [r["Charset"] for r in b]
+    assert "utf8mb4" in {r["Charset"] for r in a}
+
+
+def test_show_engines():
+    s = _sess()
+    rows = s.query("SHOW ENGINES")
+    assert len(rows) == 1
+    assert rows[0]["Support"] == "DEFAULT"
+    assert rows[0]["Transactions"] == "YES"
+
+
+def test_show_table_status():
+    s = _sess()
+    rows = s.query("SHOW TABLE STATUS")
+    by = {r["Name"]: r for r in rows}
+    assert by["t"]["Rows"] == 2
+    assert by["t"]["Engine"] == "BaikalTPU"
+    assert by["vw"]["Comment"] == "VIEW"
+    assert by["vw"]["Engine"] is None
+
+
+def test_show_table_status_like():
+    s = _sess()
+    rows = s.query("SHOW TABLE STATUS LIKE 't%'")
+    assert [r["Name"] for r in rows] == ["t"]
+
+
+def test_show_like_mysql_semantics():
+    s = _sess()
+    # case-insensitive
+    rows = s.query("SHOW COLLATION LIKE 'UTF8MB4%'")
+    assert len(rows) == 2
+    # _ is a single-char wildcard
+    rows = s.query("SHOW TABLE STATUS LIKE '_'")
+    assert [r["Name"] for r in rows] == ["t"]
+    rows = s.query("SHOW TABLE STATUS LIKE 'v_'")
+    assert [r["Name"] for r in rows] == ["vw"]
+    # fnmatch metachars are literal, not character classes
+    rows = s.query("SHOW TABLE STATUS LIKE 't[1]'")
+    assert rows == []
+
+
+def test_show_in_synonym_for_from():
+    s = _sess()
+    a = s.query("SHOW TABLES IN d")
+    b = s.query("SHOW TABLES FROM d")
+    assert a == b
+    rows = s.query("SHOW FULL TABLES IN d")
+    assert len(rows) == 2
+    rows = s.query("SHOW TABLE STATUS IN d")
+    assert len(rows) == 2
+
+
+def test_show_full_processlist_still_parses():
+    s = _sess()
+    rows = s.query("SHOW FULL PROCESSLIST")
+    assert isinstance(rows, list)
+
+
+def test_show_tables_like():
+    s = _sess()
+    assert [r["Tables_in_d"] for r in s.query("SHOW TABLES LIKE 'v%'")] \
+        == ["vw"]
+    assert [r["Tables_in_d"] for r in
+            s.query("SHOW FULL TABLES LIKE 't%'")] == ["t"]
+    rows = s.query("SHOW COLUMNS FROM t LIKE 'id'")
+    assert [r["Field"] for r in rows] == ["id"]
+    rows = s.query("SHOW FULL COLUMNS FROM t LIKE 'v'")
+    assert [r["Field"] for r in rows] == ["v"]
+
+
+def test_show_columns_on_view():
+    s = _sess()
+    rows = s.query("SHOW FULL COLUMNS FROM vw")
+    assert [r["Field"] for r in rows] == ["id"]
+    assert rows[0]["Extra"] == ""
+    rows = s.query("DESCRIBE vw")
+    assert [r["Field"] for r in rows] == ["id"]
+
+
+def test_show_like_operand_validation():
+    import pytest
+    from baikaldb_tpu.sql.parser import SqlError
+    s = _sess()
+    with pytest.raises(SqlError):
+        s.query("SHOW TABLES LIKE")          # missing operand
+    with pytest.raises(SqlError):
+        s.query("SHOW TABLES LIKE foo")      # identifier, not a string
+    # empty pattern matches nothing (MySQL), not everything
+    assert s.query("SHOW TABLES LIKE ''") == []
+    assert s.query("SHOW COLLATION LIKE ''") == []
+
+
+def test_describe_view_nullability():
+    s = _sess()
+    # vw selects t.id, the NOT NULL primary key: Null must stay NO
+    rows = s.query("DESCRIBE vw")
+    assert rows == [{"Field": "id", "Type": "int64", "Null": "NO",
+                     "Key": ""}]
+
+
+def test_describe_view_logical_type_names():
+    # views report the same logical type names as tables (not raw arrow
+    # type strings): schema comes from the planned body, not execution
+    s = _sess()
+    s.execute("CREATE TABLE ty (id BIGINT PRIMARY KEY, dt DATE, "
+              "nm VARCHAR(8))")
+    s.execute("CREATE VIEW tyv AS SELECT id, dt, nm FROM ty")
+    tt = {r["Field"]: r["Type"] for r in s.query("DESCRIBE ty")}
+    vt = {r["Field"]: r["Type"] for r in s.query("DESCRIBE tyv")}
+    assert vt == tt
+    assert vt["dt"] == "date"
+
+
+def test_table_status_lazy_store_fleet():
+    # a fresh frontend sharing a fleet has catalog entries but no
+    # materialized TableStore; SHOW TABLE STATUS must still count rows
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.core import raft_available
+    import pytest as _pytest
+    if not raft_available():
+        _pytest.skip("native raft core unavailable")
+    from baikaldb_tpu.raft.fleet import StoreFleet
+    meta = MetaService(peer_count=3)
+    fleet = StoreFleet(meta, ["a:1", "b:1", "c:1"], seed=77)
+    s1 = Session(Database(fleet=fleet))
+    s1.execute("CREATE DATABASE fd")
+    s1.execute("USE fd")
+    s1.execute("CREATE TABLE ft (id BIGINT PRIMARY KEY, v DOUBLE)")
+    s1.execute("INSERT INTO ft VALUES (1,1.0),(2,2.0),(3,3.0)")
+    # simulate a fresh frontend: catalog entry present, store not yet
+    # materialized — the listing must not force-materialize every store
+    # (cluster tiers, cold reads); Rows reports NULL = unknown instead
+    s1.db.stores.pop("fd.ft")
+    rows = s1.query("SHOW TABLE STATUS")
+    by = {r["Name"]: r for r in rows}
+    assert by["ft"]["Rows"] is None
+    assert "fd.ft" not in s1.db.stores   # listing did not materialize it
+    s1.query("SELECT COUNT(*) n FROM ft")   # touching the table does
+    rows = s1.query("SHOW TABLE STATUS")
+    assert {r["Name"]: r for r in rows}["ft"]["Rows"] == 3
+
+
+def test_show_like_backslash_escape():
+    s = _sess()
+    s.execute("CREATE TABLE t_x (id BIGINT PRIMARY KEY)")
+    s.execute("CREATE TABLE tax (id BIGINT PRIMARY KEY)")
+    # \_ is a literal underscore, not a wildcard
+    rows = s.query(r"SHOW TABLES LIKE 't\_x'")
+    assert [r["Tables_in_d"] for r in rows] == ["t_x"]
+    rows = s.query("SHOW TABLES LIKE 't_x'")
+    assert [r["Tables_in_d"] for r in rows] == ["t_x", "tax"]
+
+
+def test_where_like_backslash_escape():
+    # the lexer preserves \% and \_ in string literals, so expression-level
+    # LIKE sees the escape too (MySQL string-literal semantics)
+    s = _sess()
+    s.execute("CREATE TABLE w (id BIGINT PRIMARY KEY, nm VARCHAR(16))")
+    s.execute("INSERT INTO w VALUES (1, 'a_b'), (2, 'axb')")
+    rows = s.query(r"SELECT id FROM w WHERE nm LIKE 'a\_b' ORDER BY id")
+    assert [r["id"] for r in rows] == [1]
+    rows = s.query("SELECT id FROM w WHERE nm LIKE 'a_b' ORDER BY id")
+    assert [r["id"] for r in rows] == [1, 2]
+
+
+def test_show_engines_rejects_like():
+    import pytest
+    from baikaldb_tpu.sql.parser import SqlError
+    s = _sess()
+    with pytest.raises(SqlError):
+        s.query("SHOW ENGINES LIKE 'x'")
