@@ -12,6 +12,7 @@ compiled XLA executable.
 from __future__ import annotations
 
 import functools
+import itertools
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -54,6 +55,52 @@ from .executor import compile_plan
 # INSERT..SELECT at or below this lands in the hot (WAL-durable) row tier;
 # above it, the bulk cold path (durable at the next checkpoint)
 HOT_INSERT_ROWS = 100_000
+
+
+# server-level system variable defaults (reference: the session_variables
+# map MySQL clients read at connect; SHOW VARIABLES and SELECT @@x share it)
+_SERVER_VARS = {
+    "version": "8.0.0-baikaldb-tpu",
+    "version_comment": "baikaldb_tpu (JAX/XLA)",
+    "lower_case_table_names": "0",
+    "max_allowed_packet": str(1 << 24),
+    "character_set_server": "utf8mb4",
+    "character_set_client": "utf8mb4",
+    "character_set_results": "utf8mb4",
+    "collation_server": "utf8mb4_bin",
+    "collation_connection": "utf8mb4_bin",
+    "autocommit": "ON",
+    "sql_mode": "STRICT_TRANS_TABLES",
+    "tx_isolation": "REPEATABLE-READ",
+    "transaction_isolation": "REPEATABLE-READ",
+    "wait_timeout": "28800",
+    "interactive_timeout": "28800",
+    "net_write_timeout": "60",
+    "time_zone": "SYSTEM",
+    "system_time_zone": "UTC",
+    "init_connect": "",
+    "license": "Apache-2.0",
+    "performance_schema": "0",
+}
+
+_CONN_IDS = itertools.count(1)
+
+_ENV_FNS = ("database", "schema", "user", "current_user", "session_user",
+            "system_user", "connection_id", "version")
+
+
+def _env_alias(e):
+    """MySQL column captions for environment expressions: SELECT @@version
+    titles the column '@@version', DATABASE() titles it 'DATABASE()'."""
+    from ..expr.ast import Call
+    if isinstance(e, Call):
+        if e.op == "__sysvar__":
+            return "@@" + e.args[0].value
+        if e.op == "__uservar__":
+            return "@" + e.args[0].value
+        if e.op in _ENV_FNS and not e.args:
+            return f"{e.op.upper()}()"
+    return None
 
 
 @functools.lru_cache(maxsize=64)
@@ -574,7 +621,10 @@ class Session:
                 self.db.qos.admit(sql, cost=float(billable))
         if len(stmts) == 1 and isinstance(stmts[0], SelectStmt):
             self._access_check(stmts[0])
-            return self._select(stmts[0], cache_key=(sql, self.current_db))
+            stmt, env = self._resolve_session_exprs(stmts[0])
+            # env-substituted literals are session state: never cache those
+            return self._select(stmt, cache_key=None if env
+                                else (sql, self.current_db))
         res = Result()
         for s in stmts:
             # check immediately before EACH statement: an earlier USE in the
@@ -585,6 +635,118 @@ class Session:
 
     def query(self, sql: str) -> list[dict]:
         return self.execute(sql).to_pylist()
+
+    def _sysvar(self, name: str):
+        """@@name lookup: session SETs override server defaults; live flags
+        are visible too (they appear in SHOW VARIABLES)."""
+        if name in ("tx_isolation", "transaction_isolation"):
+            # the two spellings are one variable in MySQL: a SET of either
+            # must be visible through both
+            for k in ("transaction_isolation", "tx_isolation"):
+                if k in self.session_vars:
+                    return self.session_vars[k]
+            return _SERVER_VARS[name]
+        if name in self.session_vars:
+            return self.session_vars[name]
+        if name in _SERVER_VARS:
+            if name == "autocommit":
+                return 1 if self.session_vars.get("autocommit",
+                                                  "ON") in ("ON", 1) else 0
+            return _SERVER_VARS[name]
+        flags = FLAGS.snapshot()
+        if name in flags:
+            return flags[name]
+        raise SqlError(f"Unknown system variable '{name}'")
+
+    def _resolve_session_exprs(self, stmt):
+        """Substitute connection-environment expressions — @@sysvars, @user
+        vars, DATABASE()/USER()/VERSION()/CONNECTION_ID() — with literals
+        before planning (reference: these never reach the executor in the
+        reference either; the protocol layer answers them).  Returns
+        (stmt, changed); changed disables the plan cache for the statement
+        since the substituted values are session state."""
+        from ..expr.ast import AggCall, Call, Lit, Subquery, WindowCall
+        from ..sql.stmt import SelectStmt
+        changed = [False]
+
+        def lit(v):
+            changed[0] = True
+            return Lit(v)
+
+        def walk_e(e):
+            if isinstance(e, Call):
+                if e.op == "__sysvar__":
+                    return lit(self._sysvar(e.args[0].value))
+                if e.op == "__uservar__":
+                    return lit(self.session_vars.get("@" + e.args[0].value))
+                if e.op in ("database", "schema") and not e.args:
+                    return lit(self.current_db or None)
+                if e.op in ("user", "current_user", "session_user",
+                            "system_user") and not e.args:
+                    return lit(f"{self.user}@localhost")
+                if e.op == "connection_id" and not e.args:
+                    if not hasattr(self, "_conn_id"):
+                        self._conn_id = next(_CONN_IDS)
+                    return lit(self._conn_id)
+                if e.op == "version" and not e.args:
+                    return lit(_SERVER_VARS["version"])
+                return Call(e.op, tuple(walk_e(a) for a in e.args))
+            if isinstance(e, AggCall):
+                return AggCall(e.op, tuple(walk_e(a) for a in e.args),
+                               e.distinct)
+            if isinstance(e, WindowCall):
+                return WindowCall(
+                    e.op, tuple(walk_e(a) for a in e.args),
+                    tuple(walk_e(p) for p in e.partition_by),
+                    tuple((walk_e(oe), asc) for oe, asc in e.order_by),
+                    e.running)
+            if isinstance(e, Subquery):
+                return Subquery(walk_s(e.stmt))
+            return e
+
+        def opt(e):
+            return None if e is None else walk_e(e)
+
+        def walk_s(st: SelectStmt) -> SelectStmt:
+            from dataclasses import replace
+            from ..sql.stmt import OrderItem, SelectItem
+            def walk_t(t):
+                if t is not None and t.subquery is not None:
+                    return replace(t, subquery=walk_s(t.subquery))
+                return t
+
+            return replace(
+                st,
+                items=[SelectItem(opt(it.expr),
+                                  it.alias or _env_alias(it.expr),
+                                  it.star_table) for it in st.items],
+                table=walk_t(st.table),
+                where=opt(st.where),
+                group_by=[walk_e(g) for g in st.group_by],
+                having=opt(st.having),
+                order_by=[OrderItem(walk_e(o.expr), o.asc)
+                          for o in st.order_by],
+                joins=[replace(j, table=walk_t(j.table), on=opt(j.on))
+                       for j in st.joins],
+                ctes=[(n, walk_s(c)) for n, c in st.ctes],
+                union=None if st.union is None
+                else (st.union[0], walk_s(st.union[1])))
+
+        from dataclasses import replace as _rep
+        from ..sql.stmt import DeleteStmt, InsertStmt, UpdateStmt
+        if isinstance(stmt, SelectStmt):
+            out = walk_s(stmt)
+        elif isinstance(stmt, UpdateStmt):
+            out = _rep(stmt, assignments=[(n, walk_e(e))
+                                          for n, e in stmt.assignments],
+                       where=opt(stmt.where))
+        elif isinstance(stmt, DeleteStmt):
+            out = _rep(stmt, where=opt(stmt.where))
+        elif isinstance(stmt, InsertStmt) and stmt.select is not None:
+            out = _rep(stmt, select=walk_s(stmt.select))
+        else:
+            return (stmt, False)
+        return (out, True) if changed[0] else (stmt, False)
 
     def _set_stmt(self, s: SetStmt) -> Result:
         """SET (reference: setkv_planner.cpp): GLOBAL names update the flag
@@ -610,6 +772,10 @@ class Session:
                           DropDatabaseStmt, TruncateStmt, AlterTableStmt,
                           CreateViewStmt, DropViewStmt)):
             self._commit_txn()
+        if isinstance(s, (SelectStmt, UpdateStmt, DeleteStmt, InsertStmt)):
+            # connection-env expressions are legal anywhere MySQL allows
+            # an expression — DML included
+            s = self._resolve_session_exprs(s)[0]
         if isinstance(s, SelectStmt):
             return self._select(s)
         if isinstance(s, ExplainStmt):
@@ -1001,14 +1167,10 @@ class Session:
                 }))
         if s.what in ("variables", "status"):
             if s.what == "variables":
-                vals = {
-                    "version": "8.0.0-baikaldb-tpu",
-                    "version_comment": "baikaldb_tpu (JAX/XLA)",
-                    "lower_case_table_names": "0",
-                    "max_allowed_packet": str(1 << 24),
-                    "character_set_server": "utf8mb4",
-                    "autocommit": "ON",
-                }
+                vals = dict(_SERVER_VARS)
+                # per-session overrides (SET name = v)
+                vals.update({k: str(v) for k, v in self.session_vars.items()
+                             if not k.startswith("@")})
                 # live flag table (gflags analog — SHOW VARIABLES is how
                 # MySQL clients inspect server config)
                 vals.update({k: str(v).lower() if isinstance(v, bool)

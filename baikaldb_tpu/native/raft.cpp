@@ -660,6 +660,14 @@ int64_t rf_propose(void* h, uint8_t kind, const uint8_t* data, int64_t len) {
 }
 
 int rf_role(void* h) { return ((RaftNode*)h)->role; }
+// Read barrier (Raft §8): a fresh leader may not apply entries committed
+// under the old term until its own election no-op commits; leaders must
+// not serve reads before then or failover loses acknowledged writes.
+int rf_committed_current_term(void* h) {
+    RaftNode* n = (RaftNode*)h;
+    return (n->commit_index > 0 &&
+            n->term_at(n->commit_index) == n->term) ? 1 : 0;
+}
 uint64_t rf_term(void* h) { return ((RaftNode*)h)->term; }
 int64_t rf_leader(void* h) { return ((RaftNode*)h)->leader; }
 uint64_t rf_commit_index(void* h) { return ((RaftNode*)h)->commit_index; }

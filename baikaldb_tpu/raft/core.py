@@ -53,7 +53,8 @@ def _sig(lib):
     lib.rf_receive.argtypes = [c.c_void_p, P8, c.c_int64]
     lib.rf_propose.restype = c.c_int64
     lib.rf_propose.argtypes = [c.c_void_p, c.c_uint8, P8, c.c_int64]
-    for name in ("rf_role", "rf_peer_count", "rf_learner_count"):
+    for name in ("rf_role", "rf_peer_count", "rf_learner_count",
+                 "rf_committed_current_term"):
         getattr(lib, name).restype = c.c_int
         getattr(lib, name).argtypes = [c.c_void_p]
     lib.rf_learners.argtypes = [c.c_void_p, P64]
@@ -200,6 +201,13 @@ class RaftCore:
     @property
     def commit_index(self) -> int:
         return int(self._lib.rf_commit_index(self._h))
+
+    @property
+    def read_safe(self) -> bool:
+        """Raft §8 read barrier: True once an entry of the CURRENT term is
+        committed.  A fresh leader must not serve reads before this — it
+        cannot yet have applied entries the old leader committed."""
+        return bool(self._lib.rf_committed_current_term(self._h))
 
     @property
     def last_index(self) -> int:

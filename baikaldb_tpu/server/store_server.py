@@ -145,14 +145,26 @@ class StoreServer:
             time.sleep(self.tick_interval / 2)
         return {"status": "timeout"}
 
+    def _read_gate(self, region):
+        """None when this replica may serve a linearizable read, else the
+        retryable routing response.  Beyond leadership, this is the Raft §8
+        read barrier: a FRESH leader cannot have applied entries the old
+        leader committed until its own election no-op commits — serving a
+        read in that window would silently drop acknowledged writes (the
+        clients' _leader_call retry loop absorbs the short wait)."""
+        if region.core.role != LEADER or not region.core.read_safe:
+            return {"status": "not_leader",
+                    "leader": int(region.core.leader)}
+        return None
+
     def rpc_scan_raw(self, region_id: int):
         region = self.regions.get(int(region_id))
         if region is None:
             return {"status": "no_region"}
         with self._mu:
-            if region.core.role != LEADER:
-                return {"status": "not_leader",
-                        "leader": int(region.core.leader)}
+            gate = self._read_gate(region)
+            if gate is not None:
+                return gate
             # propose acks at COMMIT; the tick loop applies on its next
             # turn — drain here so a read right after a write sees it
             # (read-your-writes on the leader)
@@ -172,9 +184,9 @@ class StoreServer:
         if region is None:
             return {"status": "no_region"}
         with self._mu:
-            if region.core.role != LEADER:
-                return {"status": "not_leader",
-                        "leader": int(region.core.leader)}
+            gate = self._read_gate(region)
+            if gate is not None:
+                return gate
             region.apply_committed()
             now = time.time()
             return {"status": "ok",
@@ -193,9 +205,9 @@ class StoreServer:
         if region is None:
             return {"status": "no_region"}
         with self._mu:
-            if region.core.role != LEADER:
-                return {"status": "not_leader",
-                        "leader": int(region.core.leader)}
+            gate = self._read_gate(region)
+            if gate is not None:
+                return gate
             region.apply_committed()
             return {"status": "ok",
                     "entries": [[int(s), f, int(w)]
@@ -208,9 +220,9 @@ class StoreServer:
         if region is None:
             return {"status": "no_region"}
         with self._mu:
-            if region.core.role != LEADER:
-                return {"status": "not_leader",
-                        "leader": int(region.core.leader)}
+            gate = self._read_gate(region)
+            if gate is not None:
+                return gate
             region.apply_committed()
             return {"status": "ok",
                     "live": int(region.table.num_live_keys()),

@@ -468,6 +468,23 @@ class Parser:
             if not (nxt.kind == "OP" and nxt.value == "="):
                 scope = t.value.lower()
                 self.advance()
+        t = self.peek()
+        if t.kind in ("IDENT", "KW") and t.value.lower() == "transaction":
+            # SET [SESSION|GLOBAL] TRANSACTION ISOLATION LEVEL w [w] /
+            # READ ONLY|WRITE — connectors send this on connect; recorded
+            # as a session var (the engine runs snapshot-isolated reads)
+            self.advance()
+            words = []
+            while self.peek().kind in ("IDENT", "KW"):
+                words.append(self.advance().value.lower())
+            mode = " ".join(words)
+            if mode.startswith("isolation level ") and len(words) > 2:
+                iso = "-".join(words[2:]).upper()
+                return SetStmt("transaction_isolation", iso, scope)
+            if mode in ("read only", "read write"):
+                return SetStmt("transaction_read_only",
+                               mode == "read only", scope)
+            raise SqlError(f"unsupported SET TRANSACTION {mode!r}")
         assigns = [self._set_assignment()]
         while self.try_op(","):
             assigns.append(self._set_assignment())
@@ -995,6 +1012,9 @@ class Parser:
         """SHOW surface (reference: show_helper.cpp's 5.5k-LoC command map —
         the high-traffic subset)."""
         self.expect_kw("show")
+        if self.peek().value.lower() in ("session", "global") and \
+                self.peek(1).value.lower() in ("variables", "status"):
+            self.advance()   # scope word is cosmetic here
         if self.try_kw("tables"):
             db, pat = self._db_and_pat()
             return ShowStmt("tables", db, pattern=pat)
@@ -1233,6 +1253,18 @@ class Parser:
         if t.kind == "IDENT" and t.value.lower() == "match" and \
                 self.peek(1).kind == "OP" and self.peek(1).value == "(":
             return self._match_against()
+        if t.kind == "OP" and t.value == "@":
+            # @@[session.|global.]name system variable / @name user
+            # variable — both resolve to literals per-session before
+            # planning (Session._resolve_session_exprs)
+            self.advance()
+            if self.try_op("@"):
+                name = self.ident()
+                if name.lower() in ("session", "global") and \
+                        self.try_op("."):
+                    name = self.ident()
+                return Call("__sysvar__", (Lit(name.lower()),))
+            return Call("__uservar__", (Lit(self.ident().lower()),))
         if t.kind == "NUM":
             self.advance()
             return Lit(_num(t.value))

@@ -226,8 +226,19 @@ class ReplicatedRowTier:
         if nid is not None and nid in group.bus.nodes and \
                 nid not in group.bus.down and \
                 group.bus.nodes[nid].core.role == LEADER:
-            return group.bus.nodes[nid]
-        return group.bus.nodes[group.leader()]
+            node = group.bus.nodes[nid]
+        else:
+            node = group.bus.nodes[group.leader()]
+        # Raft §8 read barrier: a just-elected leader may not have applied
+        # entries the OLD leader committed until its election no-op commits;
+        # pump the bus until a current-term entry is committed so a read
+        # right after a leader kill never misses acknowledged writes
+        for _ in range(400):
+            if node.core.read_safe:
+                break
+            group.bus.advance(1)
+        node.apply_committed()
+        return node
 
     def follower_rows(self, max_lag: int = 0,
                       resource_tag: str = "") -> list[dict]:

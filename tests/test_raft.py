@@ -141,3 +141,33 @@ def test_add_and_remove_peer_moves_replica():
 def test_ops_codec_roundtrip():
     ops = [(0, b"a", b"1"), (1, b"bb", b""), (0, b"", b"xyz")]
     assert decode_ops(encode_ops(ops)) == ops
+
+
+def test_read_barrier_after_failover():
+    """Raft §8: a freshly elected leader exposes read_safe=False until an
+    entry of ITS term commits; pumping the bus turns it True and the
+    committed-by-the-old-leader write is applied and visible.  This is the
+    barrier the store read paths gate on (a scan served in that window
+    would silently miss acknowledged writes — the daemon-plane cold-tier
+    flake this pins down)."""
+    g = make_group(3)
+    ldr = g.leader()
+    assert g.put_row(g.bus.nodes[ldr], {"k": 1, "v": "acked"})
+    g.bus.kill(ldr)
+    new_ldr = g.bus.elect()
+    node = g.bus.nodes[new_ldr]
+    # pump until the new term's no-op commits; must happen quickly
+    for _ in range(400):
+        if node.core.read_safe:
+            break
+        g.bus.advance(1)
+    assert node.core.read_safe
+    node.apply_committed()
+    assert {r["k"] for r in node.rows()} == {1}
+
+
+def test_read_safe_single_node():
+    g = make_group(1)
+    r = g.bus.nodes[1]
+    assert g.put_row(r, {"k": 1, "v": "x"})
+    assert r.core.read_safe

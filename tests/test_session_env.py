@@ -1,0 +1,113 @@
+"""Connection-environment expressions: @@system variables, @user variables,
+DATABASE()/USER()/VERSION()/CONNECTION_ID(), SET NAMES / TRANSACTION
+ISOLATION — the burst every MySQL connector sends at connect time
+(reference: src/protocol query handling of session sysvars)."""
+
+import pytest
+
+from baikaldb_tpu.exec.session import Session
+from baikaldb_tpu.sql.lexer import SqlError
+
+
+def _one(s, sql):
+    rows = s.query(sql)
+    assert len(rows) == 1
+    return rows[0]
+
+
+def test_sysvar_select():
+    s = Session()
+    r = _one(s, "SELECT @@version")
+    assert r == {"@@version": "8.0.0-baikaldb-tpu"}
+    assert _one(s, "SELECT @@session.autocommit")["@@autocommit"] == 1
+    assert _one(s, "SELECT @@global.max_allowed_packet") \
+        == {"@@max_allowed_packet": str(1 << 24)}
+
+
+def test_sysvar_unknown_errors():
+    s = Session()
+    with pytest.raises(SqlError, match="Unknown system variable"):
+        s.query("SELECT @@no_such_thing")
+
+
+def test_sysvar_reflects_set_not_cached():
+    # same SQL text twice with a SET between: env substitution must
+    # bypass the plan cache
+    s = Session()
+    s.execute("SET SESSION TRANSACTION ISOLATION LEVEL READ COMMITTED")
+    assert _one(s, "SELECT @@tx_isolation")["@@tx_isolation"] \
+        == "READ-COMMITTED"
+    s.execute("SET SESSION TRANSACTION ISOLATION LEVEL REPEATABLE READ")
+    assert _one(s, "SELECT @@tx_isolation")["@@tx_isolation"] \
+        == "REPEATABLE-READ"
+
+
+def test_user_vars():
+    s = Session()
+    s.execute("SET @x = 5")
+    assert _one(s, "SELECT @x") == {"@x": 5}
+    assert _one(s, "SELECT @never_set") == {"@never_set": None}
+
+
+def test_env_functions():
+    s = Session()
+    s.execute("CREATE DATABASE envdb")
+    s.execute("USE envdb")
+    assert _one(s, "SELECT DATABASE()") == {"DATABASE()": "envdb"}
+    assert _one(s, "SELECT SCHEMA()")["SCHEMA()"] == "envdb"
+    assert _one(s, "SELECT USER()") == {"USER()": "root@localhost"}
+    assert _one(s, "SELECT CURRENT_USER()")["CURRENT_USER()"] \
+        == "root@localhost"
+    assert _one(s, "SELECT VERSION()")["VERSION()"].startswith("8.0")
+    cid = _one(s, "SELECT CONNECTION_ID()")["CONNECTION_ID()"]
+    assert isinstance(cid, int)
+    assert _one(s, "SELECT CONNECTION_ID()")["CONNECTION_ID()"] == cid
+
+
+def test_env_exprs_in_where_and_alias():
+    s = Session()
+    s.execute("CREATE TABLE u (id BIGINT PRIMARY KEY, owner VARCHAR(32))")
+    s.execute("INSERT INTO u VALUES (1, 'root@localhost'), (2, 'other')")
+    rows = s.query("SELECT id FROM u WHERE owner = USER()")
+    assert [r["id"] for r in rows] == [1]
+    assert _one(s, "SELECT @@version AS v") == {"v": "8.0.0-baikaldb-tpu"}
+
+
+def test_connect_burst_set_forms():
+    s = Session()
+    s.execute("SET NAMES utf8mb4")
+    s.execute("SET NAMES utf8mb4 COLLATE utf8mb4_general_ci")
+    s.execute("SET character_set_results = NULL")
+    s.execute("SET SESSION TRANSACTION ISOLATION LEVEL SERIALIZABLE")
+    assert _one(s, "SELECT @@transaction_isolation") \
+        == {"@@transaction_isolation": "SERIALIZABLE"}
+    s.execute("SET TRANSACTION READ ONLY")
+    s.execute("SET autocommit=0")
+    assert _one(s, "SELECT @@autocommit")["@@autocommit"] == 0
+
+
+def test_show_scope_prefix():
+    s = Session()
+    rows = s.query("SHOW SESSION VARIABLES LIKE 'version'")
+    assert rows and rows[0]["Value"].startswith("8.0")
+    assert isinstance(s.query("SHOW GLOBAL STATUS"), list)
+    # SET overrides surface in SHOW VARIABLES too
+    s.execute("SET sql_mode = ''")
+    rows = s.query("SHOW VARIABLES LIKE 'sql_mode'")
+    assert rows[0]["Value"] == ""
+
+
+def test_sysvar_wire_protocol():
+    from baikaldb_tpu.client.mysql_client import Connection
+    from baikaldb_tpu.exec.session import Database
+    from baikaldb_tpu.server.mysql_server import MySQLServer
+    srv = MySQLServer(Database(), port=0)
+    srv.start()
+    try:
+        c = Connection("127.0.0.1", srv.port)
+        r = c.query("SELECT @@version_comment")
+        assert r.rows[0][0] == "baikaldb_tpu (JAX/XLA)"
+        r = c.query("SELECT DATABASE(), CONNECTION_ID()")
+        assert len(r.rows[0]) == 2
+    finally:
+        srv.stop()
