@@ -1,0 +1,540 @@
+"""Row-wise expression interpreter + wire codec for pushed-down fragments.
+
+The reference executes plan fragments ON the store daemons: the frontend
+serializes an ExprNode tree into the pb::Plan it ships with store.interface
+RPCs, and Region::query interprets it row-wise against RocksDB rows
+(/root/reference/src/store/region.cpp:2671, src/expr/expr_node.cpp
+get_value(MemRow)).  This module is that store-side interpreter for the
+daemon plane: expressions evaluate over RowCodec-decoded Python rows with
+MySQL semantics (3-valued NULL logic, numeric string coercion, binary
+collation compares — matching expr/compile.py's device lowering so a pushed
+filter and an image-side filter agree bit-for-bit).
+
+The TPU plane never uses this: in-process queries lower to XLA
+(expr/compile.py).  This path exists so a daemon-plane SELECT moves only
+qualifying rows over TCP instead of whole regions (VERDICT r04 missing #1).
+
+Wire form (JSON-safe, no pickle — a store must not execute payloads):
+  ["c", name]            column reference
+  ["l", value]           literal (values via val_to_wire)
+  ["f", op, [args...]]   function call
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+import re
+from typing import Any, Optional
+
+from .ast import AggCall, Call, ColRef, Expr, Lit, Subquery, WindowCall
+
+_DATE0 = datetime.date(1970, 1, 1)
+_DT0 = datetime.datetime(1970, 1, 1)
+
+
+class RowEvalError(ValueError):
+    """Expression not evaluable row-wise (unsupported op / operand type).
+    The frontend treats this as 'fragment not pushable' and falls back to
+    the raw-scan + image path."""
+
+
+# -- value wire codec -------------------------------------------------------
+
+def val_to_wire(v):
+    if isinstance(v, datetime.datetime):
+        us = (v - _DT0) // datetime.timedelta(microseconds=1)
+        return {"__dtm": int(us)}
+    if isinstance(v, datetime.date):
+        return {"__date": (v - _DATE0).days}
+    if isinstance(v, float) and not math.isfinite(v):
+        return {"__f": repr(v)}
+    return v
+
+
+def val_from_wire(v):
+    if isinstance(v, dict):
+        if "__date" in v:
+            return _DATE0 + datetime.timedelta(days=int(v["__date"]))
+        if "__dtm" in v:
+            return _DT0 + datetime.timedelta(microseconds=int(v["__dtm"]))
+        if "__f" in v:
+            return float(v["__f"])
+    return v
+
+
+# -- expression wire codec --------------------------------------------------
+
+def expr_to_wire(e: Expr) -> list:
+    if isinstance(e, ColRef):
+        return ["c", e.name]
+    if isinstance(e, Lit):
+        return ["l", val_to_wire(e.value)]
+    if isinstance(e, Call):
+        return ["f", e.op, [expr_to_wire(a) for a in e.args]]
+    raise RowEvalError(f"not wire-serializable: {type(e).__name__}")
+
+
+def expr_from_wire(w) -> Expr:
+    if not isinstance(w, (list, tuple)) or not w:
+        raise RowEvalError(f"bad expr wire form: {w!r}")
+    tag = w[0]
+    if tag == "c":
+        return ColRef(str(w[1]))
+    if tag == "l":
+        return Lit(val_from_wire(w[1]))
+    if tag == "f":
+        return Call(str(w[1]), tuple(expr_from_wire(a) for a in w[2]))
+    raise RowEvalError(f"bad expr wire tag: {tag!r}")
+
+
+# -- support check ----------------------------------------------------------
+
+SUPPORTED_OPS = frozenset({
+    # comparison / logic
+    "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "not", "xor",
+    "is_null", "is_not_null", "in", "not_in", "between",
+    "like", "not_like",
+    # conditionals
+    "case_when", "if", "ifnull", "nullif", "coalesce",
+    # arithmetic
+    "add", "sub", "mul", "div", "int_div", "mod", "neg", "abs",
+    "ceil", "floor", "round", "truncate", "sign", "pow", "sqrt",
+    "exp", "ln", "log10", "log2", "sin", "cos", "tan",
+    "greatest", "least",
+    # strings (binary collation, like the device path)
+    "upper", "lower", "length", "char_length", "trim", "ltrim", "rtrim",
+    "reverse", "substr", "concat",
+    # temporal
+    "year", "month", "day", "dayofmonth", "quarter", "dayofweek",
+    "weekday", "dayofyear", "last_day", "to_days", "date", "datediff",
+    "hour", "minute", "second", "date_add_days", "date_sub_days",
+    "unix_timestamp", "from_unixtime",
+})
+
+
+def expr_supported(e: Expr) -> bool:
+    """True when every node of ``e`` evaluates row-wise (columns, literals,
+    SUPPORTED_OPS calls).  AggCall/WindowCall/Subquery are never row-wise —
+    the fragment extractor substitutes aggregates BEFORE this check."""
+    if isinstance(e, (ColRef, Lit)):
+        return True
+    if isinstance(e, (AggCall, WindowCall, Subquery)):
+        return False
+    if isinstance(e, Call):
+        return e.op in SUPPORTED_OPS and all(expr_supported(a)
+                                             for a in e.args)
+    return False
+
+
+# -- interpreter ------------------------------------------------------------
+
+def truthy(v) -> bool:
+    """Row KEPT by a predicate value: MySQL truth, NULL/unknown -> False.
+    The one truth test both fragment sides use (store filter, frontend
+    HAVING) so pushed and image paths agree on string predicates."""
+    return _truth(v) is True
+
+
+def _truth(v) -> Optional[bool]:
+    """MySQL predicate truth: NULL -> None, number -> !=0, str -> numeric."""
+    if v is None:
+        return None
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return v != 0
+    if isinstance(v, str):
+        return _str_num(v) != 0
+    raise RowEvalError(f"no truth value for {type(v).__name__}")
+
+
+def _str_num(s: str) -> float:
+    """MySQL string->number: longest numeric prefix, else 0."""
+    m = re.match(r"\s*[-+]?(\d+(\.\d*)?|\.\d+)([eE][-+]?\d+)?", s)
+    if not m or not m.group(0).strip():
+        return 0.0
+    try:
+        return float(m.group(0))
+    except ValueError:
+        return 0.0
+
+
+def _parse_temporal(s: str, want_date: bool):
+    s = s.strip()
+    try:
+        if want_date and len(s) <= 10:
+            return datetime.date.fromisoformat(s)
+        if len(s) <= 10:
+            return datetime.datetime.fromisoformat(s)
+        return datetime.datetime.fromisoformat(s.replace("T", " "))
+    except ValueError:
+        raise RowEvalError(f"bad temporal literal {s!r}")
+
+
+def _cmp_pair(a, b):
+    """Coerce (a, b) to a comparable pair with MySQL semantics."""
+    if isinstance(a, bool):
+        a = int(a)
+    if isinstance(b, bool):
+        b = int(b)
+    ta, tb = type(a), type(b)
+    if isinstance(a, str) and isinstance(b, str):
+        return a, b                                # binary collation
+    if isinstance(a, datetime.datetime) or isinstance(b, datetime.datetime):
+        def up(v):
+            if isinstance(v, datetime.datetime):
+                return v
+            if isinstance(v, datetime.date):
+                return datetime.datetime(v.year, v.month, v.day)
+            if isinstance(v, str):
+                t = _parse_temporal(v, False)
+                return t if isinstance(t, datetime.datetime) else \
+                    datetime.datetime(t.year, t.month, t.day)
+            raise RowEvalError(f"cannot compare datetime with {type(v)}")
+        return up(a), up(b)
+    if isinstance(a, datetime.date) or isinstance(b, datetime.date):
+        def upd(v):
+            if isinstance(v, datetime.date):
+                return v
+            if isinstance(v, str):
+                t = _parse_temporal(v, True)
+                return t if isinstance(t, datetime.date) else t.date()
+            raise RowEvalError(f"cannot compare date with {type(v)}")
+        return upd(a), upd(b)
+    if isinstance(a, str):
+        a = _str_num(a)
+    if isinstance(b, str):
+        b = _str_num(b)
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return a, b
+    raise RowEvalError(f"cannot compare {ta.__name__} with {tb.__name__}")
+
+
+def _num(v):
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, str):
+        return _str_num(v)
+    raise RowEvalError(f"not numeric: {type(v).__name__}")
+
+
+def _as_days(v) -> int:
+    if isinstance(v, datetime.datetime):
+        return ((v - _DT0).days)
+    if isinstance(v, datetime.date):
+        return (v - _DATE0).days
+    if isinstance(v, str):
+        t = _parse_temporal(v, True)
+        return _as_days(t)
+    raise RowEvalError(f"not temporal: {type(v).__name__}")
+
+
+def _as_date(v) -> datetime.date:
+    if isinstance(v, datetime.datetime):
+        return v.date()
+    if isinstance(v, datetime.date):
+        return v
+    if isinstance(v, str):
+        t = _parse_temporal(v, True)
+        return t if isinstance(t, datetime.date) and \
+            not isinstance(t, datetime.datetime) else t.date()
+    raise RowEvalError(f"not temporal: {type(v).__name__}")
+
+
+def _as_dt(v) -> datetime.datetime:
+    if isinstance(v, datetime.datetime):
+        return v
+    if isinstance(v, datetime.date):
+        return datetime.datetime(v.year, v.month, v.day)
+    if isinstance(v, str):
+        t = _parse_temporal(v, False)
+        return _as_dt(t)
+    raise RowEvalError(f"not temporal: {type(v).__name__}")
+
+
+def _like_to_regex(p: str) -> str:
+    # keep in lockstep with expr/compile._like_to_regex (one semantics for
+    # both planes)
+    out = []
+    i = 0
+    while i < len(p):
+        ch = p[i]
+        if ch == "\\" and i + 1 < len(p):
+            out.append(re.escape(p[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+def _round_half_away(x, d: int):
+    scale = 10.0 ** d
+    v = x * scale
+    r = math.floor(abs(v) + 0.5) * (1 if v >= 0 else -1)
+    out = r / scale
+    if isinstance(x, int) and d >= 0:
+        return int(out)
+    return out
+
+
+def eval_row(e: Expr, row: dict) -> Any:
+    """Evaluate ``e`` against one decoded row dict.  Returns a Python value
+    (None = SQL NULL).  Raises RowEvalError on anything unsupported."""
+    if isinstance(e, ColRef):
+        if e.name not in row:
+            raise RowEvalError(f"unknown column {e.name!r}")
+        return row[e.name]
+    if isinstance(e, Lit):
+        return e.value
+    if not isinstance(e, Call):
+        raise RowEvalError(f"not row-evaluable: {type(e).__name__}")
+    op = e.op
+    # short-circuit / NULL-logic forms evaluate their own args
+    if op == "and":
+        a = _truth(eval_row(e.args[0], row))
+        if a is False:
+            return False
+        b = _truth(eval_row(e.args[1], row))
+        if b is False:
+            return False
+        return None if a is None or b is None else True
+    if op == "or":
+        a = _truth(eval_row(e.args[0], row))
+        if a is True:
+            return True
+        b = _truth(eval_row(e.args[1], row))
+        if b is True:
+            return True
+        return None if a is None or b is None else False
+    if op == "not":
+        a = _truth(eval_row(e.args[0], row))
+        return None if a is None else not a
+    if op == "xor":
+        a = _truth(eval_row(e.args[0], row))
+        b = _truth(eval_row(e.args[1], row))
+        return None if a is None or b is None else a != b
+    if op == "is_null":
+        return eval_row(e.args[0], row) is None
+    if op == "is_not_null":
+        return eval_row(e.args[0], row) is not None
+    if op in ("if",):
+        c = _truth(eval_row(e.args[0], row))
+        return eval_row(e.args[1] if c else e.args[2], row)
+    if op == "ifnull":
+        v = eval_row(e.args[0], row)
+        return eval_row(e.args[1], row) if v is None else v
+    if op == "nullif":
+        a = eval_row(e.args[0], row)
+        b = eval_row(e.args[1], row)
+        if a is None or b is None:
+            return a
+        x, y = _cmp_pair(a, b)
+        return None if x == y else a
+    if op == "coalesce":
+        for a in e.args:
+            v = eval_row(a, row)
+            if v is not None:
+                return v
+        return None
+    if op == "case_when":
+        args = list(e.args)
+        else_e = args.pop() if len(args) % 2 == 1 else None
+        for i in range(0, len(args), 2):
+            if _truth(eval_row(args[i], row)):
+                return eval_row(args[i + 1], row)
+        return eval_row(else_e, row) if else_e is not None else None
+    if op == "between":
+        x = Call("and", (Call("ge", (e.args[0], e.args[1])),
+                         Call("le", (e.args[0], e.args[2]))))
+        return eval_row(x, row)
+    if op in ("in", "not_in"):
+        key = eval_row(e.args[0], row)
+        if key is None:
+            return None
+        saw_null = False
+        hit = False
+        for a in e.args[1:]:
+            v = eval_row(a, row)
+            if v is None:
+                saw_null = True
+                continue
+            x, y = _cmp_pair(key, v)
+            if x == y:
+                hit = True
+                break
+        if hit:
+            return op == "in"
+        if saw_null:
+            return None
+        return op != "in"
+    if op in ("like", "not_like"):
+        v = eval_row(e.args[0], row)
+        p = eval_row(e.args[1], row)
+        if v is None or p is None:
+            return None
+        if not isinstance(v, str) or not isinstance(p, str):
+            raise RowEvalError("LIKE needs strings")
+        hit = re.match(_like_to_regex(p), v, re.S) is not None
+        return hit if op == "like" else not hit
+
+    # strict forms: NULL in any argument -> NULL
+    vals = [eval_row(a, row) for a in e.args]
+    if any(v is None for v in vals):
+        return None
+    if op in ("eq", "ne", "lt", "le", "gt", "ge"):
+        a, b = _cmp_pair(vals[0], vals[1])
+        return {"eq": a == b, "ne": a != b, "lt": a < b,
+                "le": a <= b, "gt": a > b, "ge": a >= b}[op]
+    if op == "add":
+        return _num(vals[0]) + _num(vals[1])
+    if op == "sub":
+        return _num(vals[0]) - _num(vals[1])
+    if op == "mul":
+        return _num(vals[0]) * _num(vals[1])
+    if op == "div":
+        b = _num(vals[1])
+        return None if b == 0 else _num(vals[0]) / b
+    if op == "int_div":
+        # the device lowering casts both operands to int64 then
+        # floor-divides (expr/compile._int_div) — mirror exactly
+        a, b = int(_num(vals[0])), int(_num(vals[1]))
+        if b == 0:
+            return None
+        return a // b
+    if op == "mod":
+        a, b = _num(vals[0]), _num(vals[1])
+        if b == 0:
+            return None
+        if isinstance(a, int) and isinstance(b, int):
+            r = abs(a) % abs(b)             # exact; dividend's sign (MySQL)
+            return -r if a < 0 else r
+        return math.fmod(a, b)
+    if op == "neg":
+        return -_num(vals[0])
+    if op == "abs":
+        return abs(_num(vals[0]))
+    if op == "ceil":
+        return int(math.ceil(_num(vals[0])))
+    if op == "floor":
+        return int(math.floor(_num(vals[0])))
+    if op == "round":
+        d = int(_num(vals[1])) if len(vals) > 1 else 0
+        return _round_half_away(_num(vals[0]), d)
+    if op == "truncate":
+        d = int(_num(vals[1]))
+        scale = 10.0 ** d
+        v = _num(vals[0])
+        out = math.trunc(v * scale) / scale
+        return int(out) if isinstance(v, int) and d >= 0 else out
+    if op == "sign":
+        v = _num(vals[0])
+        return (v > 0) - (v < 0)
+    if op == "pow":
+        return float(_num(vals[0]) ** _num(vals[1]))
+    if op == "sqrt":
+        v = _num(vals[0])
+        return None if v < 0 else math.sqrt(v)
+    if op == "exp":
+        return math.exp(_num(vals[0]))
+    if op == "ln":
+        v = _num(vals[0])
+        return None if v <= 0 else math.log(v)
+    if op == "log10":
+        v = _num(vals[0])
+        return None if v <= 0 else math.log10(v)
+    if op == "log2":
+        v = _num(vals[0])
+        return None if v <= 0 else math.log2(v)
+    if op == "sin":
+        return math.sin(_num(vals[0]))
+    if op == "cos":
+        return math.cos(_num(vals[0]))
+    if op == "tan":
+        return math.tan(_num(vals[0]))
+    if op in ("greatest", "least"):
+        best = vals[0]
+        for v in vals[1:]:
+            a, b = _cmp_pair(best, v)
+            if (b > a) == (op == "greatest"):
+                best = v
+        return best
+    if op == "upper":
+        return str(vals[0]).upper()
+    if op == "lower":
+        return str(vals[0]).lower()
+    if op == "length":
+        return len(str(vals[0]).encode())
+    if op == "char_length":
+        return len(str(vals[0]))
+    if op == "trim":
+        return str(vals[0]).strip(" ")
+    if op == "ltrim":
+        return str(vals[0]).lstrip(" ")
+    if op == "rtrim":
+        return str(vals[0]).rstrip(" ")
+    if op == "reverse":
+        return str(vals[0])[::-1]
+    if op == "substr":
+        s = str(vals[0])
+        pos = int(_num(vals[1]))
+        n = int(_num(vals[2])) if len(vals) > 2 else None
+        if pos == 0:
+            return ""
+        start = pos - 1 if pos > 0 else len(s) + pos
+        if start < 0:
+            return ""
+        if n is None:
+            return s[start:]
+        return "" if n <= 0 else s[start:start + n]
+    if op == "concat":
+        return "".join(str(v) for v in vals)
+    if op in ("year", "month", "day", "dayofmonth", "quarter"):
+        d = _as_date(vals[0])
+        if op == "year":
+            return d.year
+        if op == "month":
+            return d.month
+        if op == "quarter":
+            return (d.month - 1) // 3 + 1
+        return d.day
+    if op == "dayofweek":
+        return _as_date(vals[0]).isoweekday() % 7 + 1      # 1 = Sunday
+    if op == "weekday":
+        return _as_date(vals[0]).weekday()                 # 0 = Monday
+    if op == "dayofyear":
+        return _as_date(vals[0]).timetuple().tm_yday
+    if op == "last_day":
+        d = _as_date(vals[0])
+        nxt = datetime.date(d.year + (d.month == 12),
+                            d.month % 12 + 1, 1)
+        return nxt - datetime.timedelta(days=1)
+    if op == "to_days":
+        return _as_days(vals[0]) + 719528
+    if op == "date":
+        return _as_date(vals[0])
+    if op == "datediff":
+        return _as_days(vals[0]) - _as_days(vals[1])
+    if op in ("hour", "minute", "second"):
+        t = _as_dt(vals[0])
+        return {"hour": t.hour, "minute": t.minute,
+                "second": t.second}[op]
+    if op == "date_add_days":
+        return _as_date(vals[0]) + datetime.timedelta(
+            days=int(_num(vals[1])))
+    if op == "date_sub_days":
+        return _as_date(vals[0]) - datetime.timedelta(
+            days=int(_num(vals[1])))
+    if op == "unix_timestamp":
+        return int((_as_dt(vals[0]) - _DT0).total_seconds())
+    if op == "from_unixtime":
+        return _DT0 + datetime.timedelta(seconds=int(_num(vals[0])))
+    raise RowEvalError(f"unsupported op {op!r}")

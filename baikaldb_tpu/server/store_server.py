@@ -54,7 +54,7 @@ class StoreServer:
         self._stop = threading.Event()
         for name in ("create_region", "drop_region", "raft_msg", "propose",
                      "scan_raw", "region_status", "region_size", "ping",
-                     "txn_status", "cold_manifest"):
+                     "txn_status", "cold_manifest", "exec_fragment"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
 
     # -- lifecycle --------------------------------------------------------
@@ -175,6 +175,62 @@ class StoreServer:
         # by OWNERSHIP (mid-split copies must never be read twice)
         return {"status": "ok", "pairs": [[k, v] for k, v in pairs],
                 "start": start, "end": end}
+
+    def rpc_exec_fragment(self, region_id: int, frag: dict,
+                          route_start: bytes = b"", route_end: bytes = b""):
+        """Execute a pushed-down plan fragment against this region and
+        return only qualifying rows / partial aggregates — the reference's
+        store-side select execution (region.cpp:2671 over the pb::Plan of
+        store.interface.proto:418), replacing full-region raw pulls for
+        eligible reads.
+
+        ``route_start``/``route_end`` is the FRONTEND's routed range; rows
+        are filtered to its intersection with this replica's committed
+        range (the same double filter the raw-scan path applies) so
+        mid-split copies are never double-served.  The committed range
+        rides back for the caller's staleness check.  A fragment the
+        row evaluator cannot run raises — the RPC layer returns the error
+        and the frontend falls back to the raw path."""
+        from ..plan.fragment import run_fragment
+
+        region = self.regions.get(int(region_id))
+        if region is None:
+            return {"status": "no_region"}
+        with self._mu:
+            gate = self._read_gate(region)
+            if gate is not None:
+                return gate
+            region.apply_committed()
+            pairs = region.table.scan_raw()
+            start, end = region.start_key, region.end_key
+            cold = bool(region.cold_manifest)
+        if cold:
+            # cold segments live on the external FS the frontend reads;
+            # this store cannot see those rows — the fragment result would
+            # silently miss them
+            return {"status": "ok", "cold": True, "start": start,
+                    "end": end}
+        s = max(route_start or b"", start or b"")
+        if not route_end:
+            e = end
+        elif not end:
+            e = route_end
+        else:
+            e = min(route_end, end)
+        codec = region.table.row_codec
+
+        def rows():
+            for k, v in pairs:
+                if (s and k < s) or (e and k >= e):
+                    continue
+                row = codec.decode(v)
+                if row.get("__del"):
+                    continue
+                yield row
+
+        payload = run_fragment(rows(), frag)     # heavy work off the lock
+        payload.update(status="ok", cold=False, start=start, end=end)
+        return payload
 
     def rpc_txn_status(self, region_id: int):
         """Prepared (in-doubt) txns + decision records of one region — the

@@ -44,6 +44,17 @@ DEFAULT_REGION_ROWS = 1 << 20  # split threshold on the row axis
 ROWID = "__rowid"              # hidden parquet column carrying row identity
 
 
+def check_cold_readable(tier, fs, label: str) -> None:
+    """A frontend that cannot read the cold tier must refuse the table:
+    rebuilding from the (evicted) hot tier alone would silently lose rows.
+    Shared by eager attach (exec/session.make_store) and the deferred
+    materialization path."""
+    if fs is None and tier.has_cold():
+        raise ValueError(
+            f"table {label!r} has cold segments but no cold storage "
+            f"is configured (set cold_dir or the cold_fs_dir flag)")
+
+
 def _zone_scalar(x, ltype):
     """Normalize a zone-map bound or predicate literal to one comparable
     number in the COLUMN's unit (DATE: epoch days; DATETIME/TIMESTAMP: epoch
@@ -261,6 +272,10 @@ class TableStore:
         self._next_rowid = 1
         self._rowid_pool = 0          # meta-allocated range (replicated)
         self._rowid_pool_left = 0
+        # deferred cluster attach (set by attach_replicated_lazy): the
+        # remote tier's full-region pull happens on FIRST data touch, so a
+        # frontend whose reads all push down never pays it
+        self._attach_pending = None
         self.regions: list[Region] = [Region(self._alloc_region_id(),
                                              self.arrow_schema.empty_table())]
         self.wal_path = None
@@ -282,6 +297,53 @@ class TableStore:
         self._pk_stale = True
         if wal_path:
             self.attach_wal(wal_path)
+
+    # every data access inside TableStore flows through ``self.regions``
+    # (reads, writes, stats, the pk index), so the property is the ONE
+    # chokepoint where a deferred cluster attach materializes
+    @property
+    def regions(self) -> list:
+        if self._attach_pending is not None:
+            # double-checked under the store lock: concurrent first readers
+            # (thread-per-connection frontends) must either perform the
+            # attach or WAIT for it — a bare read during materialization
+            # would silently see the empty initial region
+            with self._lock:
+                if self._attach_pending is not None:
+                    self._ensure_attached()
+        return self._regions
+
+    @regions.setter
+    def regions(self, v: list) -> None:
+        self._regions = v
+
+    @property
+    def attach_pending(self) -> bool:
+        """True while the cluster image is deferred (nothing pulled yet)."""
+        return self._attach_pending is not None
+
+    def attach_replicated_lazy(self, tier, fs) -> None:
+        """Bind to a daemon-plane tier WITHOUT pulling any rows.  Eligible
+        SELECTs push fragments to the store daemons (exec/session
+        _try_pushdown); the first access that needs the local columnar
+        image (DML, complex plans, point lookups) triggers the pull.
+        The reference's frontend works this way permanently — it never
+        holds table images, every read executes on the stores."""
+        self.replicated = tier
+        self._attach_pending = (tier, fs)
+
+    def _ensure_attached(self) -> None:
+        pending, self._attach_pending = self._attach_pending, None
+        tier, fs = pending
+        try:
+            # re-checked at materialization time (not just at make_store):
+            # another frontend may have flushed cold segments since
+            check_cold_readable(tier, fs, self.info.name)
+            cold = tier.cold_rows(fs) if fs is not None else None
+            self.attach_replicated(tier, cold_rows=cold)
+        except Exception:
+            self._attach_pending = pending   # retry on next touch
+            raise
 
     # -- row tier ---------------------------------------------------------
     def _row_schema(self) -> Schema:

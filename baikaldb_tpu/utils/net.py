@@ -21,6 +21,11 @@ from typing import Callable, Optional
 
 _BYTES_TAG = "__b64__"
 
+# process-wide wire accounting (diagnostics + the pushdown transfer tests:
+# a pushed fragment must move a small fraction of what a raw region pull
+# moves).  Plain int adds under the GIL — close enough for accounting.
+WIRE_STATS = {"sent_bytes": 0, "recv_bytes": 0}
+
 
 def _enc(obj):
     if isinstance(obj, bytes):
@@ -44,6 +49,7 @@ def _dec(obj):
 
 def send_msg(sock: socket.socket, obj) -> None:
     body = json.dumps(_enc(obj)).encode()
+    WIRE_STATS["sent_bytes"] += 4 + len(body)
     sock.sendall(struct.pack("<I", len(body)) + body)
 
 
@@ -55,6 +61,7 @@ def recv_msg(sock: socket.socket):
     body = _recv_exact(sock, n)
     if body is None:
         return None
+    WIRE_STATS["recv_bytes"] += 4 + n
     return _dec(json.loads(body.decode()))
 
 
@@ -161,6 +168,7 @@ class RpcClient:
         "ping", "scan_raw", "txn_status", "region_size", "region_status",
         "instances", "table_regions", "heartbeat", "tso", "raft_msg",
         "drop_region", "drop_regions", "register_store", "cold_manifest",
+        "exec_fragment",
     })
 
     def call(self, method: str, **args):

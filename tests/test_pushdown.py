@@ -1,0 +1,305 @@
+"""Store-side pushed-down plan fragments (VERDICT r04 missing #1).
+
+The reference executes serialized plan fragments ON the store processes so
+only qualifying rows / partials cross the wire (region.cpp:2671,
+store.interface.proto:418).  These tests check (a) the row-wise fragment
+engine agrees with the compiled image path bit-for-bit, and (b) on REAL
+store daemons a selective aggregate moves <1% of the bytes a raw region
+pull moves, while matching its results.
+"""
+
+import os
+import time
+
+import pytest
+
+from baikaldb_tpu.meta.catalog import TableInfo
+from baikaldb_tpu.plan.fragment import (build_push_query,
+                                        merge_push_results, run_fragment)
+from baikaldb_tpu.raft.core import raft_available
+from baikaldb_tpu.sql.parser import parse_sql
+from baikaldb_tpu.types import Field, LType, Schema
+
+BASE_PORT = 9600 + (os.getpid() % 150) * 10
+
+
+# -- in-memory engine: differential vs the compiled image path --------------
+
+SCHEMA = Schema((Field("id", LType.INT64, False),
+                 Field("v", LType.FLOAT64, True),
+                 Field("grp", LType.INT64, True),
+                 Field("name", LType.STRING, True)))
+INFO = TableInfo(1, "ns", "default", "t", SCHEMA)
+
+ROWS = [{"id": i,
+         "v": None if i % 11 == 0 else float(i) * 0.5,
+         "grp": i % 4,
+         "name": None if i % 13 == 0 else f"name{i % 7}"}
+        for i in range(200)]
+
+QUERIES = [
+    "SELECT id, v FROM t WHERE v > 40 ORDER BY id",
+    "SELECT id FROM t WHERE v IS NULL ORDER BY id",
+    "SELECT id FROM t WHERE name = 'name3' ORDER BY id",
+    "SELECT id FROM t WHERE name LIKE 'name%' AND id < 20 ORDER BY id",
+    "SELECT id FROM t WHERE id BETWEEN 10 AND 15 ORDER BY id",
+    "SELECT id FROM t WHERE grp IN (1, 3) AND v IS NOT NULL ORDER BY id",
+    "SELECT id, id + grp * 2 x FROM t WHERE id < 10 ORDER BY x DESC",
+    "SELECT COUNT(*) n, COUNT(v) nv, SUM(v) s, MIN(v) lo, MAX(v) hi "
+    "FROM t",
+    "SELECT grp, COUNT(*) n, AVG(v) a FROM t GROUP BY grp ORDER BY grp",
+    "SELECT grp, SUM(v) s FROM t WHERE id >= 100 GROUP BY grp "
+    "HAVING SUM(v) > 1000 ORDER BY s DESC",
+    "SELECT grp, MAX(id) m FROM t GROUP BY grp ORDER BY m LIMIT 2",
+    "SELECT SUM(v) s FROM t WHERE v < -1",
+    "SELECT upper(name) u, id FROM t WHERE id IN (1, 2) ORDER BY id",
+    "SELECT id FROM t WHERE NOT (v > 40 OR v IS NULL) AND grp <> 2 "
+    "ORDER BY id LIMIT 5",
+    "SELECT id FROM t ORDER BY id LIMIT 4 OFFSET 3",
+    "SELECT CASE WHEN grp = 0 THEN 'z' ELSE 'nz' END c, COUNT(*) n "
+    "FROM t GROUP BY grp ORDER BY grp",
+    "SELECT id, v FROM t WHERE id < 30 ORDER BY 2 DESC, 1 ASC",
+    "SELECT grp, SUM(v) s FROM t GROUP BY grp ORDER BY 2 DESC",
+]
+
+
+def _fragment_result(sql):
+    stmt = parse_sql(sql)[0]
+    push = build_push_query(stmt, INFO)
+    assert push is not None, f"not pushable: {sql}"
+    third = len(ROWS) // 3
+    payloads = [run_fragment(iter(ROWS[:third]), push.frag),
+                run_fragment(iter(ROWS[third:2 * third]), push.frag),
+                run_fragment(iter(ROWS[2 * third:]), push.frag)]
+    return merge_push_results(push, payloads)
+
+
+def _image_session():
+    from baikaldb_tpu.exec.session import Database, Session
+
+    s = Session(Database())
+    s.execute("CREATE TABLE t (id BIGINT NOT NULL, v DOUBLE, grp BIGINT, "
+              "name VARCHAR(32), PRIMARY KEY (id))")
+    for i in range(0, len(ROWS), 50):
+        chunk = ROWS[i:i + 50]
+        vals = ", ".join(
+            "({}, {}, {}, {})".format(
+                r["id"],
+                "NULL" if r["v"] is None else r["v"],
+                r["grp"],
+                "NULL" if r["name"] is None else f"'{r['name']}'")
+            for r in chunk)
+        s.execute(f"INSERT INTO t (id, v, grp, name) VALUES {vals}")
+    return s
+
+
+@pytest.fixture(scope="module")
+def image():
+    return _image_session()
+
+
+@pytest.mark.parametrize("sql", QUERIES)
+def test_fragment_matches_image_path(image, sql):
+    names, rows = _fragment_result(sql)
+    expect = image.query(sql)
+    assert names == list(expect[0].keys()) if expect else True
+    got = [tuple(r) for r in rows]
+    want = [tuple(r.values()) for r in expect]
+
+    def norm(t):
+        return tuple(round(v, 9) if isinstance(v, float) else v for v in t)
+    if "ORDER BY" in sql:
+        assert [norm(t) for t in got] == [norm(t) for t in want]
+    else:
+        assert sorted(map(norm, got), key=repr) == \
+            sorted(map(norm, want), key=repr)
+
+
+def test_string_predicate_truthiness():
+    """WHERE <string column> keeps only numerically-truthy values (MySQL
+    coercion), matching expr/roweval._truth — not Python truthiness."""
+    rows = [{"id": 1, "s": "0"}, {"id": 2, "s": "3"},
+            {"id": 3, "s": "abc"}, {"id": 4, "s": None},
+            {"id": 5, "s": "2drinks"}]
+    schema = Schema((Field("id", LType.INT64, False),
+                     Field("s", LType.STRING, True)))
+    info = TableInfo(2, "ns", "default", "t", schema)
+    stmt = parse_sql("SELECT id FROM t WHERE s ORDER BY id")[0]
+    push = build_push_query(stmt, info)
+    assert push is not None
+    _, got = merge_push_results(push, [run_fragment(iter(rows), push.frag)])
+    assert got == [(2,), (5,)]
+
+
+def test_order_by_out_of_range_ordinal_not_pushed():
+    stmt = parse_sql("SELECT id FROM t ORDER BY 3")[0]
+    assert build_push_query(stmt, INFO) is None
+
+
+def test_duplicate_aliases_keep_distinct_values():
+    """SELECT id, v AS id: internal output names keep both columns."""
+    stmt = parse_sql("SELECT id, v AS id FROM t WHERE id = 2 "
+                     "ORDER BY 1")[0]
+    push = build_push_query(stmt, INFO)
+    assert push is not None
+    names, rows = merge_push_results(
+        push, [run_fragment(iter(ROWS), push.frag)])
+    assert names == ["id", "id"]
+    assert rows == [(2, 1.0)]
+
+
+def test_sum_over_string_column_coerces_numerically():
+    rows = [{"id": 1, "s": "2"}, {"id": 2, "s": "3.5"},
+            {"id": 3, "s": "abc"}, {"id": 4, "s": None}]
+    schema = Schema((Field("id", LType.INT64, False),
+                     Field("s", LType.STRING, True)))
+    info = TableInfo(3, "ns", "default", "t", schema)
+    stmt = parse_sql("SELECT SUM(s) x FROM t")[0]
+    push = build_push_query(stmt, info)
+    _, got = merge_push_results(push, [run_fragment(iter(rows), push.frag)])
+    assert got == [(5.5,)]
+
+
+def test_int_div_and_mod_match_device_semantics():
+    from baikaldb_tpu.expr.roweval import eval_row
+    from baikaldb_tpu.expr.ast import call, lit
+
+    # device lowering: int64 floor_divide / dividend-sign MOD
+    assert eval_row(call("int_div", lit(-7), lit(2)), {}) == -4
+    assert eval_row(call("int_div", lit(7), lit(2)), {}) == 3
+    assert eval_row(call("mod", lit(-5), lit(3)), {}) == -2
+    assert eval_row(call("mod", lit(5), lit(-3)), {}) == 2
+    big = 10 ** 18
+    assert eval_row(call("int_div", lit(big), lit(3)), {}) == big // 3
+    assert eval_row(call("mod", lit(big), lit(7)), {}) == big % 7
+
+
+def test_not_pushable_shapes():
+    for sql in [
+        "SELECT DISTINCT grp FROM t",
+        "SELECT grp, COUNT(DISTINCT v) FROM t GROUP BY grp",
+        "SELECT id FROM t a JOIN t b ON a.id = b.id",
+        "SELECT id, SUM(v) OVER (PARTITION BY grp) FROM t",
+        "SELECT id FROM t WHERE v > (SELECT AVG(v) FROM t)",
+        "SELECT v FROM t GROUP BY grp",            # non-grouped column
+    ]:
+        stmt = parse_sql(sql)[0]
+        assert build_push_query(stmt, INFO) is None, sql
+
+
+# -- daemon plane: real store processes -------------------------------------
+
+pytestmark_cluster = pytest.mark.skipif(
+    not raft_available(), reason="native raft core unavailable")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    if not raft_available():
+        pytest.skip("native raft core unavailable")
+    from baikaldb_tpu.tools.deploy_cluster import spawn_cluster, teardown
+
+    meta_addr, procs = spawn_cluster(n_stores=3, base_port=BASE_PORT)
+    yield meta_addr
+    teardown(procs)
+
+
+N_ROWS = 4000
+PAD = "x" * 96
+
+
+@pytest.fixture(scope="module")
+def seeded(cluster):
+    """A writer frontend seeds the table; returns the meta address."""
+    from baikaldb_tpu.exec.session import Database, Session
+
+    s = Session(Database(cluster=cluster))
+    s.execute("CREATE TABLE big (id BIGINT NOT NULL, v DOUBLE, "
+              "pad VARCHAR(128), PRIMARY KEY (id))")
+    for i in range(0, N_ROWS, 250):
+        vals = ", ".join(f"({j}, {float(j)}, '{PAD}')"
+                         for j in range(i, min(i + 250, N_ROWS)))
+        s.execute(f"INSERT INTO big (id, v, pad) VALUES {vals}")
+    return cluster
+
+
+def _fresh_session(meta_addr):
+    from baikaldb_tpu.exec.session import Database, Session
+
+    s = Session(Database(cluster=meta_addr))
+    s.execute("CREATE TABLE big (id BIGINT NOT NULL, v DOUBLE, "
+              "pad VARCHAR(128), PRIMARY KEY (id))")
+    return s
+
+
+def test_pushdown_moves_under_one_percent(seeded):
+    """The VERDICT r04 'done' bar: a selective daemon-plane aggregate moves
+    <1% of the bytes a raw full-region pull moves."""
+    from baikaldb_tpu.utils.net import WIRE_STATS
+
+    s = _fresh_session(seeded)
+    store = s.db.stores["default.big"]
+    assert store.attach_pending, "cold frontend should not have pulled"
+
+    base = dict(WIRE_STATS)
+    got = s.query("SELECT SUM(v) s, COUNT(*) n FROM big WHERE id < 4")
+    pushed_bytes = (WIRE_STATS["recv_bytes"] - base["recv_bytes"]
+                    + WIRE_STATS["sent_bytes"] - base["sent_bytes"])
+    assert got == [{"s": 0.0 + 1 + 2 + 3, "n": 4}]
+    assert store.attach_pending, "pushdown must not materialize the image"
+
+    base = dict(WIRE_STATS)
+    rows = store.replicated.scan_rows()
+    raw_bytes = (WIRE_STATS["recv_bytes"] - base["recv_bytes"]
+                 + WIRE_STATS["sent_bytes"] - base["sent_bytes"])
+    assert sum(1 for r in rows if not r.get("__del")) == N_ROWS
+    assert pushed_bytes < raw_bytes * 0.01, \
+        f"pushed {pushed_bytes}B vs raw {raw_bytes}B"
+
+
+def test_pushdown_explain_and_correctness(seeded):
+    s = _fresh_session(seeded)
+    plan = s.execute("EXPLAIN SELECT SUM(v) s FROM big WHERE id < 4")
+    assert "PushDown" in plan.plan_text
+    assert "store filter" in plan.plan_text
+    assert "store partial aggs" in plan.plan_text
+
+    # pushed vs image answers agree on the same daemons
+    queries = [
+        "SELECT COUNT(*) n FROM big",
+        "SELECT SUM(v) s FROM big WHERE id >= 3990",
+        "SELECT id, v FROM big WHERE id IN (7, 9) ORDER BY id",
+    ]
+    pushed = [s.query(q) for q in queries]
+    from baikaldb_tpu.utils.flags import set_flag
+
+    set_flag("pushdown_reads", "off")
+    try:
+        s2 = _fresh_session(seeded)
+        image = [s2.query(q) for q in queries]
+    finally:
+        set_flag("pushdown_reads", "auto")
+    assert pushed == image
+
+
+def test_pushdown_sees_other_frontends_writes(seeded):
+    """A cold frontend's pushed reads execute on the stores, so another
+    frontend's committed writes are immediately visible — the freshness
+    model the reference's store-side reads give every query."""
+    from baikaldb_tpu.exec.session import Database, Session
+
+    writer = Session(Database(cluster=seeded))
+    writer.execute("CREATE TABLE big (id BIGINT NOT NULL, v DOUBLE, "
+                   "pad VARCHAR(128), PRIMARY KEY (id))")
+    reader = _fresh_session(seeded)
+    n0 = reader.query("SELECT COUNT(*) n FROM big")[0]["n"]
+    writer.execute(f"INSERT INTO big (id, v, pad) VALUES "
+                   f"({N_ROWS + 1000}, 1.0, 'w')")
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        n1 = reader.query("SELECT COUNT(*) n FROM big")[0]["n"]
+        if n1 == n0 + 1:
+            break
+        time.sleep(0.2)
+    assert n1 == n0 + 1
+    writer.execute(f"DELETE FROM big WHERE id = {N_ROWS + 1000}")
