@@ -701,7 +701,7 @@ class Session:
                     e.op, tuple(walk_e(a) for a in e.args),
                     tuple(walk_e(p) for p in e.partition_by),
                     tuple((walk_e(oe), asc) for oe, asc in e.order_by),
-                    e.running)
+                    e.running, e.frame)
             if isinstance(e, Subquery):
                 return Subquery(walk_s(e.stmt))
             return e

@@ -128,13 +128,18 @@ class WindowCall(Expr):
     args: tuple
     partition_by: tuple = ()
     order_by: tuple = ()        # ((expr, asc), ...)
-    running: bool = False       # ROWS/RANGE UNBOUNDED PRECEDING..CURRENT ROW
+    running: bool = False       # ROWS UNBOUNDED PRECEDING..CURRENT ROW
+    # explicit frame spec (sql/parser._maybe_over): ("rows"|"range",
+    # (bound_kind[, n]), (bound_kind[, n])) with bound kinds "up"
+    # (UNBOUNDED PRECEDING), "p" (n PRECEDING), "c" (CURRENT ROW),
+    # "f" (n FOLLOWING), "uf" (UNBOUNDED FOLLOWING); () = no explicit frame
+    frame: tuple = ()
 
     def children(self):
         return self.args + self.partition_by + tuple(e for e, _ in self.order_by)
 
     def key(self):
-        return (("win", self.op, self.running)
+        return (("win", self.op, self.running, self.frame)
                 + tuple(a.key() for a in self.args)
                 + tuple(p.key() for p in self.partition_by)
                 + tuple((e.key(), asc) for e, asc in self.order_by))

@@ -34,6 +34,10 @@ class WinSpec:
     default: Optional[float] = None
     n: int = 1                   # ntile buckets
     running: bool = False        # ROWS UNBOUNDED PRECEDING .. CURRENT ROW
+    # explicit frame (reference: window frame specs of window_fn_call.cpp):
+    # ("rows"|"range", lo_bound, hi_bound); bounds as in expr/ast.WindowCall.
+    # Executed as O(n log n) prefix/sparse-table math — no per-row loops.
+    frame: Optional[tuple] = None
 
 
 def window_compute(batch: ColumnBatch, partition_names: list[str],
@@ -96,9 +100,25 @@ def window_compute(batch: ColumnBatch, partition_names: list[str],
 
     names = list(batch.names)
     cols = list(batch.columns)
+    fctx = None
+    if any(s.frame for s in specs):
+        # tie (peer) group bounds, shared by RANGE CURRENT ROW bounds
+        tstart = jnp.maximum.accumulate(jnp.where(tie, idx, 0))
+        tid = jnp.cumsum(tie.astype(jnp.int32)) - 1
+        tsize = seg_sum(sel_s.astype(jnp.int64),
+                        jnp.where(sel_s, tid, n), num_segments=nseg)[:n]
+        tsize_here = jnp.take(tsize, jnp.clip(tid, 0, n - 1))
+        tend = tstart + jnp.maximum(tsize_here, 1) - 1
+        fctx = {"tstart": tstart, "tend": tend, "sid": sid,
+                "start": start_idx, "end": end_idx, "idx": idx,
+                "sel_s": sel_s, "nseg": nseg, "order_keys": order_keys,
+                "perm": perm}
     for s in specs:
-        res = _one(s, batch, perm, idx, sel_s, flags, tie, sid, start_idx,
-                   end_idx, row_number, size_here, nseg)
+        if s.frame is not None:
+            res = _one_framed(s, batch, fctx)
+        else:
+            res = _one(s, batch, perm, idx, sel_s, flags, tie, sid,
+                       start_idx, end_idx, row_number, size_here, nseg)
         if len(res) == 4:
             out_sorted, validity_sorted, lt, dct = res
         else:
@@ -254,3 +274,172 @@ def _one(s: WinSpec, batch, perm, idx, sel_s, flags, tie, sid, start_idx,
         vc = jnp.take(tc, jnp.clip(sid, 0, n - 1)) > 0
         return sd, vc, c.ltype
     raise ValueError(f"unsupported window op {s.op}")
+
+
+def _first_true(a, b, pred_at, n: int):
+    """Vectorized monotone binary search: per row, the smallest j in
+    [a, b+1) with pred_at(j) True (b+1 when none).  pred must be monotone
+    (False..False True..True) over each row's range — the frame-bound
+    invariant over (partition, order)-sorted values."""
+    lo, hi = a, b + 1
+    for _ in range(max(n, 2).bit_length() + 1):
+        cont = lo < hi
+        mid = (lo + hi) >> 1
+        p = pred_at(jnp.clip(mid, 0, n - 1))
+        hi = jnp.where(cont & p, mid, hi)
+        lo = jnp.where(cont & ~p, mid + 1, lo)
+    return lo
+
+
+def _sparse_table(xm, combine, n: int):
+    """Doubling (sparse) table for O(1) range min/max queries: level k
+    holds combine over [i, i+2^k) (clamped).  n log n memory, built with
+    static shapes at trace time."""
+    levels = [xm]
+    shift = 1
+    while shift < n:
+        prev = levels[-1]
+        nxt = jnp.concatenate([combine(prev[:n - shift], prev[shift:]),
+                               prev[n - shift:]])
+        levels.append(nxt)
+        shift *= 2
+    return jnp.stack(levels)              # (K+1, n)
+
+
+def _range_query(table, combine_take, lo, hi, n: int):
+    """combine over [lo, hi] via two overlapping power-of-two blocks."""
+    length = jnp.maximum(hi - lo + 1, 1)
+    k = jnp.log2(length.astype(jnp.float64)).astype(jnp.int32)
+    k = jnp.clip(k, 0, table.shape[0] - 1)
+    flat = table.reshape(-1)
+    left = jnp.take(flat, k * n + jnp.clip(lo, 0, n - 1))
+    right_pos = hi - (1 << k.astype(jnp.int64)) + 1
+    right = jnp.take(flat, k * n + jnp.clip(right_pos, 0, n - 1))
+    return combine_take(left, right)
+
+
+def _one_framed(s: WinSpec, batch, fctx):
+    """Aggregates / first_value / last_value over an explicit ROWS or
+    RANGE frame (reference: src/exec/window_node.cpp frame execution).
+    Per-row frame bounds [lo, hi] come from clamped index arithmetic
+    (ROWS) or vectorized binary search over the single order key (RANGE
+    n PRECEDING/FOLLOWING); aggregation is prefix-sum differences, with a
+    sparse table for min/max — no per-partition loops."""
+    idx = fctx["idx"]
+    n = idx.shape[0]
+    start_idx, end_idx = fctx["start"], fctx["end"]
+    tstart, tend = fctx["tstart"], fctx["tend"]
+    sid, sel_s, nseg = fctx["sid"], fctx["sel_s"], fctx["nseg"]
+    perm = fctx["perm"]
+    unit, lo_b, hi_b = s.frame
+
+    def rows_bound(b, is_lo):
+        if b == ("up",):
+            return start_idx
+        if b == ("uf",):
+            return end_idx
+        if b == ("c",):
+            return idx
+        off = int(b[1])
+        return idx - off if b[0] == "p" else idx + off
+
+    def range_bound(b, is_lo):
+        if b == ("up",):
+            return start_idx
+        if b == ("uf",):
+            return end_idx
+        if b == ("c",):
+            # RANGE CURRENT ROW means the current row's PEER group
+            return tstart if is_lo else tend
+        # n PRECEDING / n FOLLOWING over the single numeric order key
+        ks = fctx["order_keys"]
+        if len(ks) != 1:
+            raise ValueError("RANGE n PRECEDING/FOLLOWING needs exactly "
+                             "one ORDER BY key")
+        oc = batch.column(ks[0].name)
+        if oc.ltype is LType.STRING:
+            raise ValueError("RANGE frames need a numeric or temporal "
+                             "ORDER BY key")
+        asc = ks[0].asc
+        ov = oc.data[perm]
+        ovalid = oc.valid_mask()[perm] & sel_s
+        delta = b[1]
+        dt = jnp.float64 if (ov.dtype.kind == "f"
+                             or isinstance(delta, float)) else jnp.int64
+        sv = ov.astype(dt)
+        sv = sv if asc else -sv               # ascending in sort order
+        # the order key's non-NULL run inside each partition (NULL rows
+        # are peers of each other only; their frame is their peer group)
+        first_valid = jnp.take(
+            seg_min(jnp.where(ovalid, idx, n),
+                    jnp.where(sel_s, sid, n), num_segments=nseg)[:n],
+            jnp.clip(sid, 0, n - 1))
+        last_valid = jnp.take(
+            seg_max(jnp.where(ovalid, idx, -1),
+                    jnp.where(sel_s, sid, n), num_segments=nseg)[:n],
+            jnp.clip(sid, 0, n - 1))
+        # target in ascending sv space: PRECEDING = -delta, FOLLOWING = +d;
+        # the search DIRECTION comes from which end of the frame this
+        # bound is — lo wants the first index >= target, hi the last
+        # index <= target (they differ for p-as-hi / f-as-lo frames)
+        d = jnp.asarray(delta, dt)
+        target = sv - d if b[0] == "p" else sv + d
+        if is_lo:
+            pos = _first_true(first_valid, last_valid,
+                              lambda j: jnp.take(sv, j) >= target, n)
+        else:
+            pos = _first_true(first_valid, last_valid,
+                              lambda j: jnp.take(sv, j) > target, n) - 1
+        # NULL-ordered rows: peer-group frame
+        return jnp.where(ovalid, pos, tstart if is_lo else tend)
+
+    bound = rows_bound if unit == "rows" else range_bound
+    lo = jnp.maximum(bound(lo_b, True), start_idx)
+    hi = jnp.minimum(bound(hi_b, False), end_idx)
+    nonempty = (hi >= lo) & sel_s
+    lo_c = jnp.clip(lo, 0, n - 1)
+    hi_c = jnp.clip(hi, 0, n - 1)
+
+    if s.op == "count" and s.input is None:
+        return (jnp.where(nonempty, hi - lo + 1, 0).astype(jnp.int64),
+                None, LType.INT64)
+
+    c = batch.column(s.input)
+    x = c.data[perm]
+    xv = (c.valid_mask()[perm]) & sel_s
+
+    if s.op == "first_value":
+        return (jnp.take(x, lo_c), jnp.take(xv, lo_c) & nonempty, c.ltype)
+    if s.op == "last_value":
+        return (jnp.take(x, hi_c), jnp.take(xv, hi_c) & nonempty, c.ltype)
+
+    dt = jnp.int64 if c.ltype.is_integer else jnp.float64
+    xa = jnp.where(xv, x.astype(dt), 0)
+    ones = xv.astype(jnp.int64)
+    cs = jnp.cumsum(xa)
+    cn = jnp.cumsum(ones)
+
+    def span(prefix):
+        head = jnp.take(prefix, hi_c)
+        tail = jnp.where(lo > 0, jnp.take(prefix, jnp.clip(lo - 1, 0, n - 1)),
+                         jnp.zeros((), prefix.dtype))
+        return jnp.where(nonempty, head - tail, 0)
+
+    cnt = span(cn)
+    if s.op == "count":
+        return cnt, None, LType.INT64
+    if s.op == "sum":
+        return (span(cs), cnt > 0,
+                LType.INT64 if dt == jnp.int64 else LType.FLOAT64)
+    if s.op == "avg":
+        return (span(cs).astype(jnp.float64) / jnp.maximum(cnt, 1),
+                cnt > 0, LType.FLOAT64)
+    if s.op in ("min", "max"):
+        big = (jnp.iinfo if x.dtype.kind in "iu" else jnp.finfo)(x.dtype)
+        ident = big.max if s.op == "min" else big.min
+        xm = jnp.where(xv, x, ident)
+        comb = jnp.minimum if s.op == "min" else jnp.maximum
+        table = _sparse_table(xm, comb, n)
+        vals = _range_query(table, comb, lo_c, hi_c, n)
+        return vals, (cnt > 0) & nonempty, c.ltype
+    raise ValueError(f"unsupported framed window op {s.op}")

@@ -426,7 +426,7 @@ class Planner:
                 return WindowCall(e.op, tuple(subst_alias(a) for a in e.args),
                                   tuple(subst_alias(a) for a in e.partition_by),
                                   tuple((subst_alias(x), asc) for x, asc in e.order_by),
-                                  e.running)
+                                  e.running, e.frame)
             if isinstance(e, Call):
                 return Call(e.op, tuple(subst_alias(a) for a in e.args))
             return e
@@ -1158,7 +1158,7 @@ class Planner:
                 return WindowCall(e.op, tuple(rewrite(x) for x in e.args),
                                   tuple(rewrite(x) for x in e.partition_by),
                                   tuple((rewrite(x), asc) for x, asc in e.order_by),
-                                  e.running)
+                                  e.running, e.frame)
             if isinstance(e, (Call, AggCall)):
                 new_args = tuple(rewrite(x) for x in e.args)
                 if isinstance(e, AggCall):
@@ -1518,7 +1518,7 @@ class Planner:
                                     for a in e.partition_by),
                               tuple((self._subst_scalar(x, holder, scope), asc)
                                     for x, asc in e.order_by),
-                              e.running)
+                              e.running, e.frame)
         if isinstance(e, Call):
             return Call(e.op, tuple(self._subst_scalar(a, holder, scope)
                                     for a in e.args))
@@ -1901,17 +1901,25 @@ class Planner:
                     raise PlanError(f"{op} default must be a literal")
                 default = w.args[2].value
             return WinSpec(op, inp, out, offset=offset, default=default)
+        frame = w.frame or None     # () = none; MySQL ignores frames on
+        #                             ranking functions, so only the
+        #                             frame-aware ops below receive it
+        if frame is not None and frame[0] == "range" and not w.order_by:
+            raise PlanError("RANGE frames require ORDER BY")
         if op in ("first_value", "last_value"):
             if len(w.args) != 1:
                 raise PlanError(f"{op} takes exactly one argument")
-            return WinSpec(op, as_col(w.args[0]), out, running=w.running)
+            return WinSpec(op, as_col(w.args[0]), out, running=w.running,
+                           frame=frame)
         if op in ("sum", "avg", "min", "max"):
             if len(w.args) != 1:
                 raise PlanError(f"window {op} takes exactly one argument")
-            return WinSpec(op, as_col(w.args[0]), out, running=w.running)
+            return WinSpec(op, as_col(w.args[0]), out, running=w.running,
+                           frame=frame)
         if op == "count":
             inp = as_col(w.args[0]) if w.args else None
-            return WinSpec("count", inp, out, running=w.running)
+            return WinSpec("count", inp, out, running=w.running,
+                           frame=frame)
         raise PlanError(f"unsupported window function {op!r}")
 
     def _win_result_type(self, w: WindowCall, sch: Schema) -> LType:
@@ -2303,7 +2311,7 @@ class _Resolver:
             return WindowCall(e.op, tuple(self(a) for a in e.args),
                               tuple(self(a) for a in e.partition_by),
                               tuple((self(x), asc) for x, asc in e.order_by),
-                              e.running)
+                              e.running, e.frame)
         if isinstance(e, Call):
             if e.op in ("l2_distance", "cosine_distance", "inner_product"):
                 return self._vector_distance(e)

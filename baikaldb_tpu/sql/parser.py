@@ -1511,21 +1511,67 @@ class Parser:
             while self.try_op(","):
                 o = self.order_item()
                 order.append((o.expr, o.asc))
-        if self._try_ctx("rows") or self._try_ctx("range"):
-            self.expect_kw("between")
-            self._expect_ctx("unbounded")
-            self._expect_ctx("preceding")
-            self.expect_kw("and")
-            self._expect_ctx("current")
-            self._expect_ctx("row")
-            running = True
+        frame: tuple = ()
+        unit = None
+        if self._try_ctx("rows"):
+            unit = "rows"
+        elif self._try_ctx("range"):
+            unit = "range"
+        if unit is not None:
+            if self.try_kw("between"):
+                lo = self._frame_bound()
+                self.expect_kw("and")
+                hi = self._frame_bound()
+            else:
+                # shorthand: <bound> == BETWEEN <bound> AND CURRENT ROW
+                lo, hi = self._frame_bound(), ("c",)
+            rank = {"up": 0, "p": 1, "c": 2, "f": 3, "uf": 4}
+            if lo == ("uf",) or hi == ("up",) or rank[lo[0]] > rank[hi[0]] \
+                    or (lo[0] == hi[0] == "p" and lo[1] < hi[1]) \
+                    or (lo[0] == hi[0] == "f" and lo[1] > hi[1]):
+                raise SqlError("window frame start must not follow its end")
+            if unit == "rows" and any(
+                    len(b) > 1 and not isinstance(b[1], int)
+                    for b in (lo, hi)):
+                raise SqlError("ROWS frame bounds must be integers")
+            if unit == "rows" and lo == ("up",) and hi == ("c",):
+                # ROWS UNBOUNDED PRECEDING..CURRENT ROW: the fused prefix
+                # path.  The RANGE spelling is NOT the same frame — RANGE
+                # CURRENT ROW spans the current row's peer group — so it
+                # goes through the framed path
+                running = True
+            else:
+                frame = (unit, lo, hi)
         self.expect_op(")")
-        if running is None:
+        if running is None and not frame and order and op in (
+                "sum", "count", "avg", "min", "max",
+                "first_value", "last_value"):
             # MySQL default frame with ORDER BY is RANGE UNBOUNDED
-            # PRECEDING..CURRENT ROW (running) for frame-aware functions
-            running = bool(order) and op in ("sum", "count", "avg", "min",
-                                             "max", "first_value", "last_value")
-        return WindowCall(op, args, tuple(partition), tuple(order), running)
+            # PRECEDING..CURRENT ROW — peers of the current row included
+            frame = ("range", ("up",), ("c",))
+        return WindowCall(op, args, tuple(partition), tuple(order),
+                          bool(running), frame)
+
+    def _frame_bound(self) -> tuple:
+        """UNBOUNDED PRECEDING/FOLLOWING | CURRENT ROW | <n> PRECEDING |
+        <n> FOLLOWING -> ("up",) / ("uf",) / ("c",) / ("p", n) / ("f", n)"""
+        if self._try_ctx("unbounded"):
+            if self._try_ctx("preceding"):
+                return ("up",)
+            self._expect_ctx("following")
+            return ("uf",)
+        if self._try_ctx("current"):
+            self._expect_ctx("row")
+            return ("c",)
+        t = self.peek()
+        if t.kind != "NUM":
+            raise SqlError(f"expected a frame bound at {t.pos}")
+        self.advance()
+        n = _num(t.value)
+        if self._try_ctx("preceding"):
+            return ("p", n)
+        self._expect_ctx("following")
+        return ("f", n)
 
 
 def _num(s: str):

@@ -276,6 +276,7 @@ class TableStore:
         # remote tier's full-region pull happens on FIRST data touch, so a
         # frontend whose reads all push down never pays it
         self._attach_pending = None
+        self._attaching = False
         self.regions: list[Region] = [Region(self._alloc_region_id(),
                                              self.arrow_schema.empty_table())]
         self.wal_path = None
@@ -307,9 +308,12 @@ class TableStore:
             # double-checked under the store lock: concurrent first readers
             # (thread-per-connection frontends) must either perform the
             # attach or WAIT for it — a bare read during materialization
-            # would silently see the empty initial region
+            # would silently see the empty initial region.  _attach_pending
+            # stays set until the pull SUCCEEDS (so the unlocked fast path
+            # can never skip a half-built image); _attaching breaks the
+            # same-thread re-entrancy of the replay, which reads .regions
             with self._lock:
-                if self._attach_pending is not None:
+                if self._attach_pending is not None and not self._attaching:
                     self._ensure_attached()
         return self._regions
 
@@ -333,17 +337,17 @@ class TableStore:
         self._attach_pending = (tier, fs)
 
     def _ensure_attached(self) -> None:
-        pending, self._attach_pending = self._attach_pending, None
-        tier, fs = pending
+        tier, fs = self._attach_pending
+        self._attaching = True
         try:
             # re-checked at materialization time (not just at make_store):
             # another frontend may have flushed cold segments since
             check_cold_readable(tier, fs, self.info.name)
             cold = tier.cold_rows(fs) if fs is not None else None
             self.attach_replicated(tier, cold_rows=cold)
-        except Exception:
-            self._attach_pending = pending   # retry on next touch
-            raise
+            self._attach_pending = None      # only a COMPLETE pull clears it
+        finally:
+            self._attaching = False
 
     # -- row tier ---------------------------------------------------------
     def _row_schema(self) -> Schema:

@@ -711,7 +711,9 @@ class RemoteRowTier:
                     raise handler_error(str(exc)) from None
                 resp = None
             except OSError:
-                resp = None
+                # dead peer: probe the next one immediately (the connect
+                # timeout already bounded this attempt)
+                continue
             if resp is not None and resp.get("status") == "ok":
                 region.leader_addr = addr
                 rs, re_ = resp.get("start", b""), resp.get("end", b"")
@@ -721,6 +723,7 @@ class RemoteRowTier:
                 if below or above:
                     raise StaleRoutingError(region.region_id)
                 return resp
+            # not_leader / mid-election answer: brief pause, try the next
             time.sleep(0.1)
         raise ReplicationError(
             f"region {region.region_id} of {self.table_key}: no leader "

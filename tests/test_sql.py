@@ -290,6 +290,96 @@ def test_window_arity_errors():
         s.query("SELECT FIRST_VALUE() OVER () FROM wa")
 
 
+def test_window_frame_specs_sql():
+    """ROWS/RANGE BETWEEN frames end to end (golden vs MySQL 8.0 frame
+    semantics; reference: window frame handling in window_fn_call.cpp)."""
+    s = Session()
+    s.execute("CREATE TABLE wf (id BIGINT, v DOUBLE)")
+    s.execute("INSERT INTO wf VALUES (1, 10), (2, 20), (3, 30), "
+              "(4, 40), (5, 50)")
+    rows = s.query(
+        "SELECT id, "
+        "SUM(v) OVER (ORDER BY id ROWS BETWEEN 1 PRECEDING AND 1 FOLLOWING)"
+        " s3, "
+        "AVG(v) OVER (ORDER BY id ROWS BETWEEN 2 PRECEDING AND CURRENT ROW)"
+        " a3, "
+        "MIN(v) OVER (ORDER BY id ROWS BETWEEN CURRENT ROW AND "
+        "UNBOUNDED FOLLOWING) mn, "
+        "COUNT(*) OVER (ORDER BY id ROWS 1 PRECEDING) c2 "
+        "FROM wf ORDER BY id")
+    assert [r["s3"] for r in rows] == [30.0, 60.0, 90.0, 120.0, 90.0]
+    assert [round(r["a3"], 6) for r in rows] == [10.0, 15.0, 20.0, 30.0,
+                                                 40.0]
+    assert [r["mn"] for r in rows] == [10.0, 20.0, 30.0, 40.0, 50.0]
+    assert [r["c2"] for r in rows] == [1, 2, 2, 2, 2]
+    # RANGE frames over the order value (MySQL 8.0: value distance)
+    s.execute("CREATE TABLE wr (id BIGINT, k BIGINT, v DOUBLE)")
+    s.execute("INSERT INTO wr VALUES (1, 1, 1), (2, 2, 2), (3, 4, 4), "
+              "(4, 7, 7), (5, 8, 8)")
+    rows = s.query(
+        "SELECT id, SUM(v) OVER (ORDER BY k RANGE BETWEEN 2 PRECEDING "
+        "AND 1 FOLLOWING) sr FROM wr ORDER BY id")
+    # k=1:[1,2]=3; k=2:[1,2]=3; k=4:[2,4]=6; k=7:[7,8]=15; k=8:[7,8]=15
+    assert [r["sr"] for r in rows] == [3.0, 3.0, 6.0, 15.0, 15.0]
+    # peers: RANGE CURRENT ROW spans the whole tie group
+    s.execute("CREATE TABLE wp (id BIGINT, k BIGINT, v DOUBLE)")
+    s.execute("INSERT INTO wp VALUES (1, 1, 1), (2, 2, 10), (3, 2, 100), "
+              "(4, 3, 1000)")
+    rows = s.query(
+        "SELECT id, SUM(v) OVER (ORDER BY k RANGE BETWEEN CURRENT ROW "
+        "AND CURRENT ROW) sp FROM wp ORDER BY id")
+    assert [r["sp"] for r in rows] == [1.0, 110.0, 110.0, 1000.0]
+
+
+def test_window_default_frame_includes_peers():
+    """MySQL 8.0: the implicit frame with ORDER BY is RANGE UNBOUNDED
+    PRECEDING..CURRENT ROW — running aggregates include the current row's
+    PEERS (and so does the explicit RANGE spelling)."""
+    s = Session()
+    s.execute("CREATE TABLE wk (id BIGINT, k BIGINT, v DOUBLE)")
+    s.execute("INSERT INTO wk VALUES (1, 1, 1), (2, 2, 10), (3, 2, 100), "
+              "(4, 3, 1000)")
+    for sql in [
+        "SELECT id, SUM(v) OVER (ORDER BY k) r FROM wk ORDER BY id",
+        "SELECT id, SUM(v) OVER (ORDER BY k RANGE BETWEEN UNBOUNDED "
+        "PRECEDING AND CURRENT ROW) r FROM wk ORDER BY id",
+    ]:
+        rows = s.query(sql)
+        assert [r["r"] for r in rows] == [1.0, 111.0, 111.0, 1111.0], sql
+    # the ROWS spelling is the strict per-row prefix
+    rows = s.query("SELECT id, SUM(v) OVER (ORDER BY k ROWS BETWEEN "
+                   "UNBOUNDED PRECEDING AND CURRENT ROW) r FROM wk "
+                   "ORDER BY id")
+    assert sorted(r["r"] for r in rows) == [1.0, 11.0, 111.0, 1111.0]
+
+
+def test_window_frame_survives_session_exprs():
+    """Regression: session-expr substitution (DATABASE(), @@vars) rebuilds
+    the expression tree — explicit frames must survive the rebuild."""
+    s = Session()
+    s.execute("CREATE TABLE ws (id BIGINT, v DOUBLE)")
+    s.execute("INSERT INTO ws VALUES (1, 10), (2, 20), (3, 30)")
+    rows = s.query(
+        "SELECT id, DATABASE() d, SUM(v) OVER (ORDER BY id ROWS BETWEEN "
+        "1 PRECEDING AND 1 FOLLOWING) s3 FROM ws ORDER BY id")
+    assert [r["s3"] for r in rows] == [30.0, 60.0, 50.0]
+
+
+def test_window_frame_parse_errors():
+    s = Session()
+    s.execute("CREATE TABLE we (id BIGINT, v DOUBLE)")
+    s.execute("INSERT INTO we VALUES (1, 1)")
+    with pytest.raises(Exception):
+        s.query("SELECT SUM(v) OVER (ORDER BY id ROWS BETWEEN CURRENT ROW "
+                "AND 1 PRECEDING) FROM we")
+    with pytest.raises(Exception):
+        s.query("SELECT SUM(v) OVER (ORDER BY id ROWS BETWEEN 1.5 "
+                "PRECEDING AND CURRENT ROW) FROM we")
+    with pytest.raises(Exception):
+        s.query("SELECT SUM(v) OVER (RANGE BETWEEN 1 PRECEDING AND "
+                "CURRENT ROW) FROM we")
+
+
 def test_sql_transactions():
     s = Session()
     s.execute("CREATE TABLE tx (a BIGINT)")

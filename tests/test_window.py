@@ -137,3 +137,184 @@ def test_lag_string_default():
                          [WinSpec("lag", "s", "lg", offset=1, default="none")])
     rows = sorted(out.to_arrow().to_pylist(), key=lambda r: r["o"])
     assert [r["lg"] for r in rows] == ["none", "x"]
+
+
+# -- explicit frame specifications (VERDICT r05 item: ROWS/RANGE BETWEEN;
+# reference: window frame handling in src/expr/window_fn_call.cpp) ---------
+
+def _ref_framed(ps, os_, vs, asc, unit, lo_b, hi_b, op):
+    """Brute-force MySQL-semantics reference: per partition, sort by the
+    order key (NULLs first asc / last desc), resolve each row's frame,
+    aggregate row-wise."""
+    n = len(ps)
+
+    def okey(i):
+        null_rank = 0 if (os_[i] is None) == asc else 1
+        if os_[i] is None:
+            return (null_rank, 0)
+        return (null_rank, os_[i] if asc else -os_[i])
+    order = sorted(range(n), key=lambda i: (ps[i],) + okey(i))
+    out = {}
+    by_p = {}
+    for i in order:
+        by_p.setdefault(ps[i], []).append(i)
+    for p, rows in by_p.items():
+        m = len(rows)
+        for pos, i in enumerate(rows):
+            if unit == "rows":
+                def rb(b, is_lo):
+                    if b == ("up",):
+                        return 0
+                    if b == ("uf",):
+                        return m - 1
+                    if b == ("c",):
+                        return pos
+                    return pos - b[1] if b[0] == "p" else pos + b[1]
+                lo, hi = max(rb(lo_b, True), 0), min(rb(hi_b, False), m - 1)
+                frame = rows[lo:hi + 1] if hi >= lo else []
+            else:
+                if os_[i] is None:
+                    # NULL row: n-bounds and CURRENT yield the NULL peer
+                    # set; UNBOUNDED extends to the partition edge
+                    peers = [j for j in rows if os_[j] is None]
+                    left = rows if lo_b == ("up",) else peers
+                    right = rows if hi_b == ("uf",) else peers
+                    lo_i = rows.index(left[0])
+                    hi_i = rows.index(right[-1])
+                    frame = rows[lo_i:hi_i + 1]
+                else:
+                    v = os_[i]
+                    nonnull = [j for j in rows if os_[j] is not None]
+                    def within(j):
+                        # signed distance along the sort direction:
+                        # PRECEDING = -d, FOLLOWING = +d on either side
+                        x = os_[j]
+                        if lo_b == ("up",):
+                            ok_lo = True
+                        elif lo_b == ("c",):
+                            ok_lo = (x >= v) if asc else (x <= v)
+                        else:
+                            s = -lo_b[1] if lo_b[0] == "p" else lo_b[1]
+                            ok_lo = (x >= v + s) if asc else (x <= v - s)
+                        if hi_b == ("uf",):
+                            ok_hi = True
+                        elif hi_b == ("c",):
+                            ok_hi = (x <= v) if asc else (x >= v)
+                        else:
+                            s = -hi_b[1] if hi_b[0] == "p" else hi_b[1]
+                            ok_hi = (x <= v + s) if asc else (x >= v - s)
+                        return ok_lo and ok_hi
+                    frame = [j for j in nonnull if within(j)]
+                    if lo_b == ("up",):
+                        # unbounded start additionally spans the NULL run
+                        nulls = [j for j in rows if os_[j] is None]
+                        if asc:
+                            frame = nulls + frame
+                    if hi_b == ("uf",):
+                        nulls = [j for j in rows if os_[j] is None]
+                        if not asc:
+                            frame = frame + nulls
+            vals = [vs[j] for j in frame]
+            live = [x for x in vals if x is not None]
+            if op == "count_star":
+                out[i] = len(vals)
+            elif op == "count":
+                out[i] = len(live)
+            elif op == "sum":
+                out[i] = sum(live) if live else None
+            elif op == "avg":
+                out[i] = sum(live) / len(live) if live else None
+            elif op == "min":
+                out[i] = min(live) if live else None
+            elif op == "max":
+                out[i] = max(live) if live else None
+            elif op == "first_value":
+                out[i] = vals[0] if vals else None
+            elif op == "last_value":
+                out[i] = vals[-1] if vals else None
+    return out
+
+
+def _frame_case(unit, lo_b, hi_b, op, asc=True, null_order=False):
+    rng = np.random.RandomState(7)
+    n = 40
+    ps = [int(x) for x in rng.randint(0, 4, n)]
+    os_ = [int(x) for x in rng.randint(0, 12, n)]
+    if null_order:
+        for i in range(0, n, 9):
+            os_[i] = None
+    vs = [None if rng.rand() < 0.2 else float(int(x))
+          for i, x in enumerate(rng.randint(-5, 20, n))]
+    b = ColumnBatch.from_arrow(pa.table({
+        "p": pa.array(ps, type=pa.int64()),
+        "o": pa.array(os_, type=pa.int64()),
+        "v": pa.array(vs, type=pa.float64()),
+        "i": pa.array(list(range(n)), type=pa.int64()),
+    }))
+    inp = None if op == "count_star" else "v"
+    spec_op = "count" if op == "count_star" else op
+    out = window_compute(b, ["p"], [SortKey("o", asc)],
+                         [WinSpec(spec_op, inp, "w",
+                                  frame=(unit, lo_b, hi_b))])
+    got = {r["i"]: r["w"] for r in out.to_arrow().to_pylist()}
+    want = _ref_framed(ps, os_, vs, asc, unit, lo_b, hi_b, op)
+    for i in range(n):
+        g, w = got[i], want[i]
+        if isinstance(w, float):
+            assert g is not None and abs(g - w) < 1e-9, (i, g, w)
+        else:
+            assert g == w, (i, g, w)
+
+
+def test_rows_frames_golden():
+    for lo_b, hi_b in [(("p", 2), ("c",)), (("p", 1), ("f", 1)),
+                       (("up",), ("f", 1)), (("c",), ("uf",)),
+                       (("f", 1), ("f", 2)), (("p", 3), ("p", 1))]:
+        for op in ("sum", "count", "count_star", "avg", "min", "max",
+                   "first_value", "last_value"):
+            _frame_case("rows", lo_b, hi_b, op)
+
+
+def test_rows_frames_desc():
+    _frame_case("rows", ("p", 2), ("f", 1), "sum", asc=False)
+    _frame_case("rows", ("p", 1), ("c",), "min", asc=False)
+
+
+def test_range_frames_golden():
+    for lo_b, hi_b in [(("p", 3), ("f", 3)), (("p", 2), ("c",)),
+                       (("c",), ("f", 4)), (("up",), ("f", 2)),
+                       (("p", 5), ("uf",))]:
+        for op in ("sum", "count", "min", "max"):
+            _frame_case("range", lo_b, hi_b, op)
+
+
+def test_range_frames_one_sided():
+    """n PRECEDING as the UPPER bound / n FOLLOWING as the LOWER bound:
+    the search direction comes from the frame side, not the bound kind."""
+    for lo_b, hi_b in [(("p", 6), ("p", 2)), (("f", 1), ("f", 4)),
+                       (("up",), ("p", 3)), (("f", 2), ("uf",))]:
+        for op in ("sum", "count", "min", "max"):
+            _frame_case("range", lo_b, hi_b, op)
+            _frame_case("range", lo_b, hi_b, op, asc=False)
+
+
+def test_range_frames_desc_and_nulls():
+    _frame_case("range", ("p", 3), ("f", 3), "sum", asc=False)
+    _frame_case("range", ("p", 2), ("c",), "max", asc=False)
+    _frame_case("range", ("p", 3), ("f", 3), "sum", null_order=True)
+    _frame_case("range", ("up",), ("f", 2), "count", null_order=True)
+
+
+def test_range_current_row_includes_peers():
+    """RANGE ... CURRENT ROW spans the current row's full peer group."""
+    b = ColumnBatch.from_arrow(pa.table({
+        "p": pa.array([1, 1, 1, 1], type=pa.int64()),
+        "o": pa.array([1, 2, 2, 3], type=pa.int64()),
+        "v": pa.array([1.0, 10.0, 100.0, 1000.0], type=pa.float64()),
+        "i": pa.array([0, 1, 2, 3], type=pa.int64()),
+    }))
+    out = window_compute(
+        b, ["p"], [SortKey("o", True)],
+        [WinSpec("sum", "v", "w", frame=("range", ("c",), ("c",)))])
+    got = {r["i"]: r["w"] for r in out.to_arrow().to_pylist()}
+    assert got == {0: 1.0, 1: 110.0, 2: 110.0, 3: 1000.0}
