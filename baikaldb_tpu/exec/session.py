@@ -1763,6 +1763,13 @@ class Session:
         except (PushdownUnsupported, ReplicationError):
             return None          # image path retries / surfaces the error
         names, rows = merge_push_results(push, payloads)
+        return self._host_rows_result(names, rows)
+
+    @staticmethod
+    def _host_rows_result(names: list, rows: list) -> Result:
+        """Host-computed row tuples -> Result (pushdown merge, egress
+        finish).  from_arrays permits duplicate output names (SELECT a, a
+        FROM t) so the wire layer sends the names the client asked for."""
         arrays = []
         for i in range(len(names)):
             vals = [r[i] for r in rows]
@@ -1771,10 +1778,21 @@ class Session:
             except (pa.ArrowInvalid, pa.ArrowTypeError):
                 arrays.append(pa.array([None if v is None else str(v)
                                         for v in vals]))
-        # from_arrays permits duplicate output names (SELECT a, a FROM t)
-        # so the wire layer sends the names the client asked for
         return Result(columns=list(names),
                       arrow=pa.Table.from_arrays(arrays, names=list(names)))
+
+    def _select_egress(self, eg, cache_key) -> Result:
+        """Run the egress-rewritten inner statement, then evaluate the
+        string skeletons host-side over the final-sized result
+        (exec/egress.py)."""
+        from . import egress as egress_mod
+
+        inner_stmt, spec = eg
+        key = None if cache_key is None else \
+            (cache_key[0] + " /*egress*/", cache_key[1])
+        inner = self._select(inner_stmt, cache_key=key)
+        names, rows = egress_mod.finish(spec, inner)
+        return self._host_rows_result(names, rows)
 
     # -- OLTP point-read fast path (reference: primary-index point SELECT
     # through the row path, region.cpp select_normal) ----------------------
@@ -2921,6 +2939,10 @@ class Session:
         pushed = self._try_pushdown(stmt)
         if pushed is not None:
             return pushed
+        from . import egress as egress_mod
+        eg = egress_mod.extract(stmt, self)
+        if eg is not None:
+            return self._select_egress(eg, cache_key)
         point = self._try_point_lookup(stmt)
         if point is not None:
             return point

@@ -110,6 +110,9 @@ SUPPORTED_OPS = frozenset({
     "weekday", "dayofyear", "last_day", "to_days", "date", "datediff",
     "hour", "minute", "second", "date_add_days", "date_sub_days",
     "unix_timestamp", "from_unixtime",
+    # data-dependent string formatting (expr/strfmt — host planes only;
+    # the in-jit compiler cannot mint dictionaries at trace time)
+    "date_format", "format", "hex_str", "bin", "oct",
 })
 
 
@@ -537,4 +540,19 @@ def eval_row(e: Expr, row: dict) -> Any:
         return int((_as_dt(vals[0]) - _DT0).total_seconds())
     if op == "from_unixtime":
         return _DT0 + datetime.timedelta(seconds=int(_num(vals[0])))
+    if op == "date_format":
+        from .strfmt import mysql_date_format
+        return mysql_date_format(vals[0], str(vals[1]))
+    if op == "format":
+        from .strfmt import mysql_format
+        return mysql_format(vals[0], vals[1])
+    if op == "hex_str":
+        from .strfmt import mysql_hex
+        return mysql_hex(vals[0])
+    if op == "bin":
+        from .strfmt import mysql_bin
+        return mysql_bin(vals[0])
+    if op == "oct":
+        from .strfmt import mysql_oct
+        return mysql_oct(vals[0])
     raise RowEvalError(f"unsupported op {op!r}")

@@ -439,7 +439,31 @@ def _one_framed(s: WinSpec, batch, fctx):
         ident = big.max if s.op == "min" else big.min
         xm = jnp.where(xv, x, ident)
         comb = jnp.minimum if s.op == "min" else jnp.maximum
-        table = _sparse_table(xm, comb, n)
-        vals = _range_query(table, comb, lo_c, hi_c, n)
+        # frames anchored at a partition edge (the default frame shape)
+        # use an O(n) segmented scan + gather; the n-log-n sparse table is
+        # only built when BOTH bounds slide
+        if lo_b == ("up",):
+            vals = jnp.take(_seg_running(xm, sid, comb), hi_c)
+        elif hi_b == ("uf",):
+            vals = jnp.take(
+                _seg_running(xm[::-1], sid[::-1], comb)[::-1], lo_c)
+        else:
+            table = _sparse_table(xm, comb, n)
+            vals = _range_query(table, comb, lo_c, hi_c, n)
         return vals, (cnt > 0) & nonempty, c.ltype
     raise ValueError(f"unsupported framed window op {s.op}")
+
+
+def _seg_running(xm, sid, comb):
+    """Running min/max from each segment's start: associative scan that
+    resets at segment boundaries (same shape as the running path in
+    _one)."""
+    import jax.lax as lax
+
+    def combine(a, b):
+        asid, aval = a
+        bsid, bval = b
+        return (bsid, jnp.where(bsid != asid, bval, comb(aval, bval)))
+
+    _, vals = lax.associative_scan(combine, (sid, xm))
+    return vals

@@ -457,6 +457,21 @@ def merge_partial(kind: str, a, b):
     raise RowEvalError(f"bad agg kind {kind!r}")
 
 
+def host_sort_rows(rows: list, order: list) -> list:
+    """MySQL ORDER BY over host rows ``[(vals, env), ...]``: stable
+    per-key passes from the last key to the first, each key evaluated
+    ONCE per row (decorate-sort) — never O(n log n) interpreter calls.
+    NULLs sort first ascending / last descending, like the device sort."""
+    for e, asc in reversed(order):
+        keys = [eval_row(e, env) for _, env in rows]
+        dec = sorted(zip(keys, rows),
+                     key=lambda kv: ((0, 0) if kv[0] is None
+                                     else (1, kv[0])),
+                     reverse=not asc)
+        rows = [r for _, r in dec]
+    return rows
+
+
 # -- frontend merge ---------------------------------------------------------
 
 def merge_push_results(push: PushQuery,
@@ -510,27 +525,10 @@ def merge_push_results(push: PushQuery,
         vals = tuple(eval_row(e, env) for _, e in push.items)
         out_rows.append((vals, env))
     if push.order:
-        import functools
-
-        def cmp(a, b):
-            # order expressions are resolved to env columns at build time
-            # (internal output names / group keys / agg partials), so the
-            # env alone is the sort input — display names never enter it
-            for e, asc in push.order:
-                va = eval_row(e, a[1])
-                vb = eval_row(e, b[1])
-                if va is None and vb is None:
-                    continue
-                if va is None:
-                    return -1 if asc else 1    # NULLs first ASC (MySQL)
-                if vb is None:
-                    return 1 if asc else -1
-                if va == vb:
-                    continue
-                lt = va < vb
-                return (-1 if lt else 1) if asc else (1 if lt else -1)
-            return 0
-        out_rows.sort(key=functools.cmp_to_key(cmp))
+        # order expressions are resolved to env columns at build time
+        # (internal output names / group keys / agg partials), so the
+        # env alone is the sort input — display names never enter it
+        out_rows = host_sort_rows(out_rows, push.order)
     rows = [v for v, _ in out_rows]
     if push.offset:
         rows = rows[push.offset:]

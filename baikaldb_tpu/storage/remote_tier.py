@@ -701,6 +701,11 @@ class RemoteRowTier:
             [a for _, a in region.peers if a != region.leader_addr]
         i = 0
         while time.monotonic() < deadline:
+            if i and i % len(candidates) == 0:
+                # one pause per full rotation: a dead peer is skipped
+                # immediately, but instant-refusal failures (rolling
+                # restart, ECONNREFUSED) must not busy-spin the loop
+                time.sleep(0.1)
             addr = candidates[i % len(candidates)]
             i += 1
             try:
@@ -711,8 +716,6 @@ class RemoteRowTier:
                     raise handler_error(str(exc)) from None
                 resp = None
             except OSError:
-                # dead peer: probe the next one immediately (the connect
-                # timeout already bounded this attempt)
                 continue
             if resp is not None and resp.get("status") == "ok":
                 region.leader_addr = addr
@@ -723,8 +726,6 @@ class RemoteRowTier:
                 if below or above:
                     raise StaleRoutingError(region.region_id)
                 return resp
-            # not_leader / mid-election answer: brief pause, try the next
-            time.sleep(0.1)
         raise ReplicationError(
             f"region {region.region_id} of {self.table_key}: no leader "
             f"served {method}")

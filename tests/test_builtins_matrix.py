@@ -262,3 +262,145 @@ def test_convert_tz_offsets(s):
     got = str(one(s, "CONVERT_TZ('2024-01-01 12:00:00', '+00:00', "
                      "'+05:30')"))
     assert got.startswith("2024-01-01 17:30")
+
+
+# -- data-dependent string formatting (VERDICT r04 missing #4: egress-stage
+# DATE_FORMAT / FORMAT / HEX / BIN; reference: internal_functions.cpp) -----
+
+@pytest.mark.parametrize("expr,want", [
+    ("DATE_FORMAT('2009-10-04 22:23:00', '%W %M %Y')",
+     "Sunday October 2009"),
+    ("DATE_FORMAT('2007-10-04 22:23:00', '%H:%i:%s')", "22:23:00"),
+    ("DATE_FORMAT('1900-10-04 22:23:00', '%D %y %a %d %m %b %j')",
+     "4th 00 Thu 04 10 Oct 277"),
+    ("DATE_FORMAT('1997-10-04 22:23:00', '%H %k %I %r %T %S %w')",
+     "22 22 10 10:23:00 PM 22:23:00 00 6"),
+    ("DATE_FORMAT('2006-06-01', '%d')", "01"),
+    ("DATE_FORMAT('2024-01-15', 'year %Y!')", "year 2024!"),
+    ("DATE_FORMAT(NULL, '%Y')", None),
+    ("FORMAT(12332.123456, 4)", "12,332.1235"),
+    ("FORMAT(12332.1, 4)", "12,332.1000"),
+    ("FORMAT(12332.2, 0)", "12,332"),
+    ("FORMAT(-12332.25, 1)", "-12,332.3"),
+    ("HEX(255)", "FF"),
+    ("HEX(-1)", "FFFFFFFFFFFFFFFF"),
+    ("HEX('abc')", "616263"),
+    ("BIN(12)", "1100"),
+    ("BIN(-1)",
+     "1111111111111111111111111111111111111111111111111111111111111111"),
+    ("OCT(12)", "14"),
+    ("HEX(NULL)", None),
+    ("FORMAT(NULL, 2)", None),
+    ("BIN(NULL)", None),
+    ("CONCAT('0x', HEX(255))", "0xFF"),
+    ("UPPER(DATE_FORMAT('2024-01-15', '%M'))", "JANUARY"),
+])
+def test_string_format_matrix(s, expr, want):
+    assert one(s, expr) == want
+
+
+@pytest.fixture(scope="module")
+def fmt_table():
+    sess = Session(Database())
+    sess.execute("CREATE TABLE fx (id BIGINT, d DATE, ts DATETIME, "
+                 "x BIGINT, v DOUBLE, name VARCHAR(16))")
+    sess.execute(
+        "INSERT INTO fx VALUES "
+        "(1, '2024-01-15', '2024-01-15 10:30:45', 255, 1234567.891, 'ab'),"
+        "(2, '2024-02-20', '2024-02-20 23:05:01', -1, -9876.5, 'cd'),"
+        "(3, '2024-02-28', '2024-02-28 00:00:00', 4096, 0.125, NULL),"
+        "(4, NULL, NULL, NULL, NULL, 'ef')")
+    return sess
+
+
+def test_format_fns_over_columns(fmt_table):
+    rows = fmt_table.query(
+        "SELECT id, DATE_FORMAT(d, '%Y-%m') m, FORMAT(v, 2) f, HEX(x) h, "
+        "BIN(x) b, HEX(name) hn FROM fx ORDER BY id")
+    assert [tuple(r.values()) for r in rows] == [
+        (1, "2024-01", "1,234,567.89", "FF", "11111111", "6162"),
+        (2, "2024-02", "-9,876.50", "FFFFFFFFFFFFFFFF", "1" * 64, "6364"),
+        (3, "2024-02", "0.13", "1000", "1000000000000", None),
+        (4, None, None, None, None, "6566"),
+    ]
+
+
+def test_format_fns_in_where(fmt_table):
+    q = fmt_table.query
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE DATE_FORMAT(d, '%Y-%m') = '2024-02' "
+        "ORDER BY id")] == [2, 3]
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE DATE_FORMAT(ts, '%Y-%m-%d') >= "
+        "'2024-02-20' ORDER BY id")] == [2, 3]
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE DATE_FORMAT(d, '%Y') <> '2024' "
+        "ORDER BY id")] == []
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE HEX(x) = 'FF'")] == [1]
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE HEX(x) = 'FFFFFFFFFFFFFFFF'")] == [2]
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE BIN(x) = '1100'")] == []
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE HEX(x) IN ('FF', '1000') "
+        "ORDER BY id")] == [1, 3]
+    # invalid literal can never match
+    assert q("SELECT id FROM fx WHERE HEX(x) = 'XYZ'") == []
+    # HEX over a string column keeps the in-kernel bytes-hex semantics
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE HEX(name) = '6364'")] == [2]
+
+
+def test_format_fns_group_and_order(fmt_table):
+    rows = fmt_table.query(
+        "SELECT DATE_FORMAT(d, '%Y-%m') m, COUNT(*) n FROM fx "
+        "WHERE d IS NOT NULL GROUP BY DATE_FORMAT(d, '%Y-%m') ORDER BY m")
+    assert [(r["m"], r["n"]) for r in rows] == [("2024-01", 1),
+                                                ("2024-02", 2)]
+    # GROUP BY the select alias resolves to the same bucket rewrite
+    rows = fmt_table.query(
+        "SELECT DATE_FORMAT(d, '%Y') y, COUNT(*) n FROM fx "
+        "WHERE d IS NOT NULL GROUP BY y ORDER BY y")
+    assert [(r["y"], r["n"]) for r in rows] == [("2024", 3)]
+    # ORDER BY a formatted output: host sort with LIMIT applied after
+    rows = fmt_table.query(
+        "SELECT id, HEX(name) h FROM fx ORDER BY h DESC LIMIT 2")
+    assert [(r["id"], r["h"]) for r in rows] == [(4, "6566"), (2, "6364")]
+
+
+def test_format_fns_where_noncanonical_literals(fmt_table):
+    """Binary-collation string comparison: only the formatter's CANONICAL
+    output can be equal, and ordering against arbitrary literals follows
+    lexicographic order of the formatted strings."""
+    q = fmt_table.query
+    # non-canonical equality literals never match
+    assert q("SELECT id FROM fx WHERE HEX(x) = '0xFF'") == []
+    assert q("SELECT id FROM fx WHERE HEX(x) = 'ff'") == []
+    assert q("SELECT id FROM fx WHERE DATE_FORMAT(d, '%Y-%m') = "
+             "'2024-1'") == []
+    # ordering vs a lexicographically-plausible but non-output literal:
+    # '2024-01' <= '2024-13' is a plain string compare -> 2024 rows match
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE DATE_FORMAT(d, '%Y-%m') <= '2024-13' "
+        "ORDER BY id")] == [1, 2, 3]
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE DATE_FORMAT(d, '%Y-%m') > '2024-01x' "
+        "ORDER BY id")] == [2, 3]
+    assert q("SELECT id FROM fx WHERE DATE_FORMAT(d, '%Y') < '1000'") \
+        == []
+    assert [r["id"] for r in q(
+        "SELECT id FROM fx WHERE DATE_FORMAT(d, '%Y') >= '' "
+        "ORDER BY id")] == [1, 2, 3]
+
+
+def test_format_fns_unsupported_positions(fmt_table):
+    from baikaldb_tpu.plan.planner import PlanError
+
+    with pytest.raises(PlanError):
+        fmt_table.query("SELECT id FROM fx WHERE "
+                        "DATE_FORMAT(d, '%M') = 'January'")
+    with pytest.raises(PlanError):
+        fmt_table.query("SELECT MIN(DATE_FORMAT(d, '%Y')) FROM fx")
+    with pytest.raises(PlanError):
+        fmt_table.query("SELECT HEX(x) h FROM fx GROUP BY h")

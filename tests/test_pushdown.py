@@ -60,6 +60,10 @@ QUERIES = [
     "FROM t GROUP BY grp ORDER BY grp",
     "SELECT id, v FROM t WHERE id < 30 ORDER BY 2 DESC, 1 ASC",
     "SELECT grp, SUM(v) s FROM t GROUP BY grp ORDER BY 2 DESC",
+    # egress-class builtins run natively in store fragments (roweval);
+    # the image path evaluates them at result egress — both must agree
+    "SELECT id, HEX(id) h, BIN(grp) b FROM t WHERE id IN (1, 2, 17) "
+    "ORDER BY id",
 ]
 
 
