@@ -1459,6 +1459,7 @@ class Session:
         """Logical+physical planning, plus the distribution pass (the
         Separate/MppAnalyzer analog) when this session is mesh-bound."""
         plan = self._planner().plan_select(stmt)
+        self._annotate_ann(stmt, plan)
         if self.mesh is not None:
             from ..plan.distribute import distribute
 
@@ -1468,6 +1469,73 @@ class Session:
 
             plan = distribute(plan, int(self.mesh.devices.size), rows_fn)
         return plan
+
+    def _annotate_ann(self, stmt: SelectStmt, plan: PlanNode) -> None:
+        """When the statement is the ANN shape over a table with an ANN
+        index, mark its ScanNode: the batch builder reduces the scan to
+        the IVF candidate set (index/annindex) and the unchanged plan
+        re-ranks exactly."""
+        from ..index import annindex
+        from ..plan.nodes import ScanNode
+
+        t = stmt.table
+        if t is None or t.subquery is not None or self.mesh is not None:
+            return
+        dbname = t.database or self.current_db
+        try:
+            info = self.db.catalog.get_table(dbname, t.name)
+        except Exception:       # noqa: BLE001 — planner already validated
+            return
+        m = annindex.match_ann_query(stmt, info, t.label)
+        if m is None:
+            return
+        ix, col, metric, qvec, k = m
+        key = f"{dbname}.{t.name}"
+        scans = []
+
+        def walk(n):
+            if isinstance(n, ScanNode) and n.table_key == key:
+                scans.append(n)
+            for c in n.children:
+                walk(c)
+        walk(plan)
+        if len(scans) == 1:
+            scans[0].ann = (ix.name, col, metric, qvec, int(k))
+
+    def _ann_batch(self, n, store) -> Optional[ColumnBatch]:
+        """IVF candidate batch for an ANN-annotated scan: positions from
+        the trained index, sliced out of the store snapshot (same row
+        source the full scan would read)."""
+        from ..index import annindex
+
+        ix_name, col, metric, qvec, k = n.ann
+        dim = (store.info.options or {}).get("vector_cols", {}).get(col)
+        if dim is None:
+            return None
+        cache = getattr(self, "_access_batches", None)
+        if cache is None:
+            cache = self._access_batches = {}
+        ck = (n.table_key, store.version, "ann", col, qvec, k)
+        hit = cache.get(ck)
+        if hit is not None:
+            b, desc = hit
+            n.access_desc = desc
+            return b
+        res = annindex.manager(self.db).candidates(
+            n.table_key, store, col, int(dim), qvec, metric, k)
+        if res is None:
+            n.access_desc = "full"
+            return None
+        positions, nprobe = res
+        import pyarrow as _pa
+        b = ColumnBatch.from_arrow(
+            store.snapshot().take(_pa.array(positions)))
+        n.access_desc = (f"ann({ix_name} nprobe={nprobe}, "
+                         f"cand={len(positions)})")
+        self._evict_access(n.table_key, store.version)
+        cache[ck] = (b, n.access_desc)
+        metrics.index_scans.add(1)
+        return b
 
     def _store(self, tref) -> TableStore:
         db = tref.database or self.current_db
@@ -1612,6 +1680,13 @@ class Session:
         if s.primary_key:
             indexes.append(IndexInfo("PRIMARY", "primary", list(s.primary_key)))
         for kind, name, cols in s.indexes:
+            if kind == "ann":
+                if len(cols) != 1 or cols[0] not in vector_cols:
+                    raise PlanError("ANN INDEX needs exactly one VECTOR "
+                                    "column")
+                indexes.append(IndexInfo(name or f"ann_{cols[0]}", kind,
+                                         cols))
+                continue
             indexes.append(IndexInfo(name or f"idx_{'_'.join(cols)}", kind, cols))
         info = self.db.catalog.create_table(db, s.table.name, schema, indexes,
                                             options=options,
@@ -1927,7 +2002,7 @@ class Session:
             # schema-bound); dropping them here would orphan state
             kept = [ix for ix in info.indexes
                     if not (ix.name == s.index_name and
-                            ix.kind in ("key", "unique", "fulltext",
+                            ix.kind in ("key", "unique", "fulltext", "ann",
                                         "global", "global_unique"))]
             if len(kept) == len(info.indexes):
                 raise PlanError(f"unknown index {s.index_name!r}")
@@ -1941,9 +2016,15 @@ class Session:
                     self._drop_global_backing(db, info, ix)
             self.db.save_catalog()
             return Result()
-        self._validate_index_cols(s, info)
+        if s.index_kind == "ann":
+            vcols = (info.options or {}).get("vector_cols") or {}
+            if len(s.index_cols) != 1 or s.index_cols[0] not in vcols:
+                raise PlanError("ANN INDEX needs exactly one VECTOR column")
+        else:
+            self._validate_index_cols(s, info)
         prefix = {"fulltext": "ft", "global": "gidx",
-                  "global_unique": "gidx"}.get(s.index_kind, "idx")
+                  "global_unique": "gidx", "ann": "ann"}.get(s.index_kind,
+                                                            "idx")
         name = s.index_name or f"{prefix}_{'_'.join(s.index_cols)}"
         if any(ix.name == name for ix in info.indexes):
             raise PlanError(f"index {name!r} exists")
@@ -1967,6 +2048,14 @@ class Session:
             info.indexes.append(IndexInfo(name, "fulltext",
                                           list(s.index_cols)))
             info.version += 1
+            self.db.save_catalog()
+            return Result()
+        if s.index_kind == "ann":
+            # trained lazily from the current snapshot on first ANN query
+            # (index/annindex drift policy) — no backfill artifact
+            info.indexes.append(IndexInfo(name, "ann", list(s.index_cols)))
+            info.version += 1
+            self._store(s.table)._mutations += 1    # cached plans re-plan
             self.db.save_catalog()
             return Result()
         ix = IndexInfo(name, s.index_kind, list(s.index_cols),
@@ -3072,7 +3161,10 @@ class Session:
                     store = self.db.stores[n.table_key] = self.db.make_store(info)
                 b = None
                 if self.mesh is None and scan_count[n.table_key] == 1:
-                    b = self._access_path_batch(n, db, name, store)
+                    if n.ann is not None:
+                        b = self._ann_batch(n, store)
+                    if b is None:
+                        b = self._access_path_batch(n, db, name, store)
                 if b is None:
                     if self.mesh is not None:
                         b = self._sharded_batch(n.table_key, store)
@@ -3220,6 +3312,10 @@ class Session:
         from ..plan.nodes import ScanNode
 
         def walk(n):
+            if isinstance(n, ScanNode) and getattr(n, "ann", None):
+                n.access_desc = (f"ann({n.ann[0]} "
+                                 f"nprobe={int(FLAGS.ann_nprobe)})")
+                return
             if isinstance(n, ScanNode) and "." in n.table_key:
                 db, name = n.table_key.split(".", 1)
                 store = self.db.stores.get(n.table_key)

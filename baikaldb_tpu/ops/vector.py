@@ -77,6 +77,73 @@ def ivf_topk(queries, base, valid, centroids, assign, k: int, nprobe: int,
     return jax.lax.top_k(s, k)
 
 
+def pack_ivf(vectors: np.ndarray, assign: np.ndarray,
+             n_clusters: int | None = None):
+    """Cluster-sorted layout for the gather-based IVF path: rows of one
+    cluster are contiguous, so probing nprobe clusters gathers nprobe
+    ranges instead of scoring the whole base (the faiss inverted-list
+    layout).  -> (order, starts, counts, max_count); base rows must be
+    reindexed by ``order``.
+
+    ``n_clusters`` MUST be the centroid count when clusters can be empty
+    (k-means keeps old centroids for empty clusters): the search scores
+    every centroid, so starts/counts must cover them all."""
+    order = np.argsort(assign, kind="stable")
+    sa = assign[order]
+    nc = n_clusters if n_clusters is not None else \
+        (int(assign.max()) + 1 if len(assign) else 1)
+    counts = np.bincount(sa, minlength=nc)
+    starts = np.zeros(nc, np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    return (order.astype(np.int64), starts,
+            counts.astype(np.int64), int(counts.max() if len(counts) else 1))
+
+
+def _np_scores(q: np.ndarray, rows: np.ndarray, metric: str,
+               norms=None) -> np.ndarray:
+    dots = rows @ q
+    if metric == "ip":
+        return dots
+    if metric == "cosine":
+        qn = np.linalg.norm(q)
+        rn = np.sqrt(norms) if norms is not None \
+            else np.linalg.norm(rows, axis=1)
+        return dots / np.maximum(rn * qn, 1e-30)
+    if norms is None:
+        norms = (rows * rows).sum(1)
+    return -(norms - 2.0 * dots + float(q @ q))                  # l2
+
+
+def ivf_search_host(qvec: np.ndarray, matrix_sorted: np.ndarray,
+                    valid_sorted, centroids: np.ndarray,
+                    starts: np.ndarray, counts: np.ndarray,
+                    k: int, nprobe: int, metric: str = "l2",
+                    norms_sorted=None):
+    """Host-side IVF over the packed layout: gather EXACTLY the probed
+    clusters' rows (variable length is free outside jit) and score with
+    BLAS.  This is the frontend's candidate-generation path — the work
+    scales with the probed fraction, so it beats the full matmul on CPU
+    hosts; the jitted re-rank of the candidates then runs on the
+    accelerator.  -> (scores, positions-into-sorted-order)."""
+    q = np.asarray(qvec, np.float32)
+    cs = _np_scores(q, centroids, metric)
+    nprobe = min(nprobe, len(centroids))
+    probe = np.argpartition(cs, -nprobe)[-nprobe:]
+    idx = np.concatenate([np.arange(starts[p], starts[p] + counts[p])
+                          for p in probe]) if len(probe) else \
+        np.zeros(0, np.int64)
+    if len(idx) == 0:
+        return np.zeros(0, np.float32), np.zeros(0, np.int64)
+    s = _np_scores(q, matrix_sorted[idx], metric,
+                   norms_sorted[idx] if norms_sorted is not None else None)
+    if valid_sorted is not None:
+        s = np.where(valid_sorted[idx], s, -np.inf)
+    kk = min(k, len(idx))
+    top = np.argpartition(s, -kk)[-kk:]
+    top = top[np.argsort(-s[top])]
+    return s[top], idx[top]
+
+
 def kmeans(vectors: np.ndarray, n_clusters: int, iters: int = 10,
            seed: int = 0):
     """Lloyd's k-means on device (for IVF training — the faiss train analog).
@@ -87,13 +154,15 @@ def kmeans(vectors: np.ndarray, n_clusters: int, iters: int = 10,
     centroids = jnp.asarray(vectors[idx], jnp.float32)
     x = jnp.asarray(vectors, jnp.float32)
 
-    @jax.jit
-    def step(c):
-        d = _scores(x, c, "l2", "f32")                # [n, cclusters] (neg dist)
+    # x rides as an ARGUMENT, never a closure capture: a captured array
+    # becomes an XLA constant and constant-folding grinds through the
+    # whole base matrix at compile time (minutes at 1M rows)
+    @partial(jax.jit, static_argnames=("nc",))
+    def step(x, c, nc):
+        d = _scores(x, c, "l2", "f32")                # [n, nc] (neg dist)
         a = jnp.argmax(d, axis=1)
-        sums = seg_sum(x, a, num_segments=n_clusters)
-        cnt = seg_sum(jnp.ones((x.shape[0],)), a,
-                                  num_segments=n_clusters)
+        sums = seg_sum(x, a, num_segments=nc)
+        cnt = seg_sum(jnp.ones((x.shape[0],)), a, num_segments=nc)
         newc = sums / jnp.maximum(cnt[:, None], 1.0)
         # keep old centroid for empty clusters
         newc = jnp.where(cnt[:, None] > 0, newc, c)
@@ -101,7 +170,7 @@ def kmeans(vectors: np.ndarray, n_clusters: int, iters: int = 10,
 
     assign = None
     for _ in range(iters):
-        centroids, assign = step(centroids)
+        centroids, assign = step(x, centroids, n_clusters)
     return np.asarray(centroids), np.asarray(assign)
 
 

@@ -53,6 +53,10 @@ class ScanNode(PlanNode):
     columns: list[str] = field(default_factory=list)   # pruned physical columns
     pushed_filter: Optional[Expr] = None               # PredicatePushDown result
     access_desc: str = ""      # IndexSelector choice (EXPLAIN display)
+    # ANN candidate reduction (index/annindex): (ix_name, vec_col, metric,
+    # qvec tuple, k) — the batch builder prunes the scan to the IVF
+    # candidate set; the plan re-ranks exactly
+    ann: Optional[tuple] = None
 
     def _label(self):
         f = f" filter={self.pushed_filter!r}" if self.pushed_filter else ""

@@ -587,6 +587,16 @@ class Parser:
                 name = self.ident() if self.peek().kind == "IDENT" else ""
                 indexes.append(("fulltext", name, self._paren_name_list()))
             elif self.peek().kind == "IDENT" and \
+                    self.peek().value.lower() == "ann" and \
+                    self.peek(1).kind == "KW" and \
+                    self.peek(1).value in ("index", "key"):
+                # ANN INDEX [name] (vector_col) — the IVF access path
+                # (reference: vector_index per-region index)
+                self.advance()
+                self.advance()
+                name = self.ident() if self.peek().kind == "IDENT" else ""
+                indexes.append(("ann", name, self._paren_name_list()))
+            elif self.peek().kind == "IDENT" and \
                     self.peek().value.lower() == "global" and \
                     self.peek(1).kind == "KW" and \
                     self.peek(1).value in ("unique", "index", "key"):
@@ -784,11 +794,15 @@ class Parser:
                             self.peek().value.lower() == "global" and
                             self.peek(1).kind == "KW" and
                             self.peek(1).value in ("unique", "index", "key"))
-            if is_global_ix or (
+            is_ann_ix = (self.peek().kind == "IDENT" and
+                         self.peek().value.lower() == "ann" and
+                         self.peek(1).kind == "KW" and
+                         self.peek(1).value in ("index", "key"))
+            if is_global_ix or is_ann_ix or (
                     self.peek().kind == "KW" and
                     self.peek().value in ("index", "key", "unique",
                                           "fulltext")):
-                # ADD [GLOBAL] [UNIQUE|FULLTEXT] INDEX|KEY [name] (col, ...)
+                # ADD [GLOBAL|ANN] [UNIQUE|FULLTEXT] INDEX|KEY [name] (...)
                 kind = "key"
                 if is_global_ix:
                     self.advance()          # GLOBAL
@@ -797,6 +811,10 @@ class Parser:
                     if self.peek().kind == "KW" and \
                             self.peek().value in ("index", "key"):
                         self.advance()
+                elif is_ann_ix:
+                    self.advance()          # ANN
+                    self.advance()          # INDEX | KEY
+                    kind = "ann"
                 elif self.peek().value in ("unique", "fulltext"):
                     kind = self.advance().value
                     if self.peek().kind == "KW" and \

@@ -1,0 +1,158 @@
+"""SQL-reachable ANN index (VERDICT r04 missing #3 / next #4).
+
+Reference parity target: vector_index.cpp capability — index choice via the
+planner, delete visibility, rebuild-on-change — not its faiss internals.
+The TPU shape: IVF candidate pruning feeds the unchanged compiled plan,
+which re-ranks exactly (filters + MVCC apply as usual).
+"""
+
+import numpy as np
+import pytest
+
+from baikaldb_tpu.exec.session import Database, Session
+from baikaldb_tpu.index import annindex  # noqa: F401 — registers ann flags
+from baikaldb_tpu.utils.flags import set_flag
+
+
+@pytest.fixture(autouse=True)
+def small_ann_threshold():
+    set_flag("ann_min_rows", 512)
+    yield
+    set_flag("ann_min_rows", 4096)
+
+
+def _vec_lit(v):
+    return "[" + ",".join(f"{x:.5f}" for x in v) + "]"
+
+
+def _load(s, vecs, table="vt"):
+    for i in range(0, len(vecs), 400):
+        vals = ", ".join(f"({j}, '{_vec_lit(vecs[j])}')"
+                         for j in range(i, min(i + 400, len(vecs))))
+        s.execute(f"INSERT INTO {table} VALUES {vals}")
+
+
+def test_ann_ddl_and_explain():
+    s = Session(Database())
+    s.execute("CREATE TABLE vt (id BIGINT, v VECTOR(4), ANN INDEX av (v))")
+    info = s.db.catalog.get_table("default", "vt")
+    assert any(ix.kind == "ann" and ix.columns == ["v"]
+               for ix in info.indexes)
+    plan = s.execute("EXPLAIN SELECT id FROM vt ORDER BY "
+                     "l2_distance(v, '[0,0,0,0]') LIMIT 3").plan_text
+    assert "ann(av" in plan
+    s.execute("ALTER TABLE vt DROP INDEX av")
+    plan = s.execute("EXPLAIN SELECT id FROM vt ORDER BY "
+                     "l2_distance(v, '[0,0,0,0]') LIMIT 3").plan_text
+    assert "ann(" not in plan
+    s.execute("ALTER TABLE vt ADD ANN INDEX av2 (v)")
+    plan = s.execute("EXPLAIN SELECT id FROM vt ORDER BY "
+                     "l2_distance(v, '[0,0,0,0]') LIMIT 3").plan_text
+    assert "ann(av2" in plan
+    with pytest.raises(Exception):
+        s.execute("ALTER TABLE vt ADD ANN INDEX bad (id)")   # not a vector
+
+
+def test_ann_recall_vs_exact():
+    """recall@10 >= 0.95 against the exact answer over clustered data."""
+    rng = np.random.RandomState(11)
+    centers = rng.randn(32, 16) * 4
+    vecs = (centers[rng.randint(0, 32, 8000)]
+            + rng.randn(8000, 16) * 0.5).astype(np.float32)
+    s = Session(Database())
+    s.execute("CREATE TABLE vt (id BIGINT, v VECTOR(16), ANN INDEX a (v))")
+    _load(s, vecs)
+    hits = total = 0
+    for qi in rng.randint(0, len(vecs), 12):
+        q = vecs[qi] + rng.randn(16).astype(np.float32) * 0.05
+        got = [r["id"] for r in s.query(
+            f"SELECT id FROM vt ORDER BY l2_distance(v, '{_vec_lit(q)}') "
+            f"LIMIT 10")]
+        exact = set(np.argsort(((vecs - q) ** 2).sum(1))[:10].tolist())
+        hits += len(set(got) & exact)
+        total += 10
+    assert hits / total >= 0.95, f"recall {hits / total}"
+
+
+def test_ann_sees_deletes_and_new_rows():
+    rng = np.random.RandomState(5)
+    vecs = rng.randn(1500, 4).astype(np.float32)
+    s = Session(Database())
+    s.execute("CREATE TABLE vt (id BIGINT, v VECTOR(4), ANN INDEX a (v))")
+    _load(s, vecs)
+    q = vecs[7]
+    sql = (f"SELECT id FROM vt ORDER BY l2_distance(v, '{_vec_lit(q)}') "
+           f"LIMIT 3")
+    assert s.query(sql)[0]["id"] == 7
+    s.execute("DELETE FROM vt WHERE id = 7")
+    got = [r["id"] for r in s.query(sql)]
+    assert 7 not in got                      # delete visibility
+    # new rows are searchable without an explicit rebuild (drift policy
+    # re-assigns against the kept centroids)
+    s.execute(f"INSERT INTO vt VALUES (9001, '{_vec_lit(q)}')")
+    assert s.query(sql)[0]["id"] == 9001
+
+
+def test_ann_where_filter_composes():
+    rng = np.random.RandomState(9)
+    vecs = rng.randn(2000, 4).astype(np.float32)
+    s = Session(Database())
+    s.execute("CREATE TABLE vt (id BIGINT, v VECTOR(4), ANN INDEX a (v))")
+    _load(s, vecs)
+    q = vecs[42]
+    got = [r["id"] for r in s.query(
+        f"SELECT id FROM vt WHERE id >= 1000 ORDER BY "
+        f"l2_distance(v, '{_vec_lit(q)}') LIMIT 5")]
+    assert all(i >= 1000 for i in got) and len(got) == 5
+
+
+def test_ann_small_table_falls_back_to_brute_force():
+    set_flag("ann_min_rows", 4096)
+    s = Session(Database())
+    s.execute("CREATE TABLE vt (id BIGINT, v VECTOR(4), ANN INDEX a (v))")
+    rng = np.random.RandomState(2)
+    vecs = rng.randn(600, 4).astype(np.float32)
+    _load(s, vecs)
+    q = vecs[3]
+    got = [r["id"] for r in s.query(
+        f"SELECT id FROM vt ORDER BY l2_distance(v, '{_vec_lit(q)}') "
+        f"LIMIT 3")]
+    assert got[0] == 3                       # exact path still serves
+
+
+def test_empty_clusters_are_probeable():
+    """kmeans keeps old centroids for empty clusters; probing one must not
+    crash the packed search (regression: starts/counts sized by
+    assign.max instead of the centroid count)."""
+    from baikaldb_tpu.ops.vector import ivf_search_host, pack_ivf
+
+    vecs = np.asarray([[0.0, 0], [0.1, 0], [5, 5], [5.1, 5]], np.float32)
+    assign = np.asarray([0, 0, 1, 1])
+    cents = np.asarray([[0, 0], [5, 5], [99, 99]], np.float32)  # 2 empty-ish
+    order, starts, counts, _ = pack_ivf(vecs, assign, n_clusters=3)
+    s, idx = ivf_search_host(np.asarray([99, 99], np.float32), vecs[order],
+                             None, cents, starts, counts, 2, 3)
+    assert len(idx) == 2                     # all live clusters probed
+
+
+def test_window_functions_block_ann_reduction():
+    s = Session(Database())
+    s.execute("CREATE TABLE vt (id BIGINT, v VECTOR(4), ANN INDEX a (v))")
+    s.execute("INSERT INTO vt VALUES (1, '[0,0,0,1]'), (2, '[0,0,1,0]')")
+    plan = s.execute(
+        "EXPLAIN SELECT id, COUNT(*) OVER () n FROM vt ORDER BY "
+        "l2_distance(v, '[0,0,0,0]') LIMIT 1").plan_text
+    assert "ann(" not in plan
+
+
+def test_ann_not_used_for_wrong_shapes():
+    s = Session(Database())
+    s.execute("CREATE TABLE vt (id BIGINT, v VECTOR(4), ANN INDEX a (v))")
+    s.execute("INSERT INTO vt VALUES (1, '[0,0,0,1]')")
+    # DESC over a distance, no LIMIT, group by: all brute force
+    for sql in [
+        "SELECT id FROM vt ORDER BY l2_distance(v, '[0,0,0,0]') DESC "
+        "LIMIT 3",
+        "SELECT id FROM vt ORDER BY l2_distance(v, '[0,0,0,0]')",
+    ]:
+        assert "ann(" not in s.execute("EXPLAIN " + sql).plan_text
