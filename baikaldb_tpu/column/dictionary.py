@@ -31,13 +31,14 @@ NULL_CODE = np.int32(-1)
 class Dictionary:
     """An immutable sorted dictionary for one string column."""
 
-    __slots__ = ("values", "_id", "_ft_index", "_hash_cache")
+    __slots__ = ("values", "_id", "_ft_index", "_ft_state", "_hash_cache")
 
     def __init__(self, values: np.ndarray):
         # values must be sorted unique unicode/objects
         self.values = values
         self._id = id(values)
         self._ft_index = None   # lazily-built fulltext index (index/fulltext)
+        self._ft_state = None   # per-dictionary BM25 state (fulltext)
         self._hash_cache = None
 
     # -- construction ---------------------------------------------------

@@ -479,7 +479,7 @@ def _default_type_rule(op, argts):
         "is_not_null": LType.BOOL, "like": LType.BOOL, "not_like": LType.BOOL,
         "__row_index": LType.INT64,
         "in": LType.BOOL, "not_in": LType.BOOL, "between": LType.BOOL,
-        "match_against": LType.BOOL,
+        "match_against": LType.FLOAT32,
         "case_when": argts[1] if len(argts) > 1 else LType.NULL,
         "if": argts[1] if len(argts) > 1 else LType.NULL,
         "ifnull": argts[0] if argts else LType.NULL,
@@ -869,9 +869,11 @@ def _match_against(e, batch):
     """MATCH(col) AGAINST('query' [IN BOOLEAN MODE]) — fulltext search.
 
     Compiles exactly like LIKE: the inverted index (index/fulltext.py) over
-    the column's dictionary answers the boolean query host-side as a
-    per-code mask, gathered by code on device (reference: reverse index +
-    boolean executor, include/reverse/)."""
+    the column's dictionary answers the query host-side as a per-code
+    BM25 relevance array, gathered by code on device (reference: reverse
+    index + weighted boolean executor, include/reverse/).  The value is the
+    MySQL relevance FLOAT — >0 means match, so WHERE truth falls out of
+    eval_predicate's nonzero coercion and ORDER BY MATCH(..) ranks."""
     a = _eval(e.args[0], batch)
     q = e.args[1]
     if not (isinstance(q, Lit) and isinstance(q.value, str)):
@@ -880,12 +882,13 @@ def _match_against(e, batch):
     if not (isinstance(a, Column) and a.ltype is LType.STRING
             and a.dictionary is not None):
         raise ExprError("MATCH requires a dictionary-encoded string column")
-    from ..index.fulltext import match_mask
+    from ..index.fulltext import match_scores
 
-    mask = match_mask(a.dictionary, q.value, boolean_mode=boolean_mode)
-    hit = jnp.take(jnp.asarray(mask), jnp.clip(a.data, 0, None), mode="clip")
-    hit = jnp.where(a.data >= 0, hit, False)
-    return Column(hit, a.validity, LType.BOOL)
+    scores = match_scores(a.dictionary, q.value, boolean_mode=boolean_mode)
+    hit = jnp.take(jnp.asarray(scores), jnp.clip(a.data, 0, None),
+                   mode="clip")
+    hit = jnp.where(a.data >= 0, hit, jnp.float32(0.0))
+    return Column(hit, a.validity, LType.FLOAT32)
 
 
 @_raw("cast")
