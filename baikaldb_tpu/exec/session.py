@@ -90,6 +90,14 @@ _ENV_FNS = ("database", "schema", "user", "current_user", "session_user",
             "system_user", "connection_id", "version")
 
 
+def _opt_on(v) -> bool:
+    """Table-option truth: parser option values arrive as strings, so
+    BINLOG=0 / BINLOG=false must read as OFF."""
+    if v is None:
+        return False
+    return str(v).strip().lower() not in ("", "0", "false", "off", "no")
+
+
 def _env_alias(e):
     """MySQL column captions for environment expressions: SELECT @@version
     titles the column '@@version', DATABASE() titles it 'DATABASE()'."""
@@ -304,6 +312,20 @@ class Database:
     def store(self, key: str) -> TableStore:
         return self.stores[key]
 
+    def dist_binlog(self):
+        """The cluster's distributed binlog writer (storage/binlog_regions)
+        — None off the daemon plane or when binlog_regions is off."""
+        if self.cluster is None:
+            return None
+        from ..storage.binlog_regions import DistributedBinlog
+
+        if not FLAGS.binlog_regions:
+            return None
+        dl = getattr(self, "_dist_binlog", None)
+        if dl is None:
+            dl = self._dist_binlog = DistributedBinlog(self.cluster)
+        return dl
+
     def cold_fs(self, required: bool = False):
         """The external cold-storage FS, or None when unconfigured."""
         if self._cold_fs is None:
@@ -346,6 +368,14 @@ class Database:
             # cannot read the cold tier must refuse the table at attach,
             # not at first query
             check_cold_readable(tier, fs, key)
+            if not info.name.startswith("__") and \
+                    _opt_on((info.options or {}).get("binlog")):
+                # binlog is opt-in per table, like the reference's
+                # link-to-binlog option (CREATE TABLE ... BINLOG=1):
+                # unlinked tables keep 1PC write latency.  Hidden backing
+                # tables (global-index, rollups) ride their main table's
+                # events — a sink there would double-log
+                st.binlog_sink = self.dist_binlog()
             if str(FLAGS.pushdown_reads) != "off":
                 # defer the full-region pull: eligible SELECTs execute as
                 # pushed fragments ON the store daemons (the reference's
@@ -1579,6 +1609,12 @@ class Session:
                 # spanning all their region groups (global-index writes and
                 # cross-table transactions commit or abort together)
                 commit_group(list(self._sql_txn.values()))
+            except BaseException:
+                # the txn did NOT commit: its buffered events must never
+                # publish (a later successful commit would otherwise emit
+                # them as phantom CDC rows)
+                self._txn_binlog.clear()
+                raise
             finally:
                 # even a failed WAL write must not trap the session in the
                 # transaction (the contexts released their leases already)
@@ -1586,11 +1622,33 @@ class Session:
         self._flush_txn_binlog()
 
     def _flush_txn_binlog(self):
+        from ..storage.binlog_regions import DistributedBinlog
+
+        dist = self.db.dist_binlog()
+        per_table: OrderedDict = OrderedDict()
         for ev in self._txn_binlog:
             event_type, db_name, table, rows, statement, affected = ev
             self.db.binlog.append(event_type, db_name, table, rows=rows,
                                   statement=statement, affected=affected)
+            if dist is not None and self._table_binlogged(db_name, table):
+                per_table.setdefault(f"{db_name}.{table}", []).extend(
+                    DistributedBinlog.events_from_statement(
+                        event_type, rows, statement, affected))
+        # one prewrite/commit round per table, not per statement (the
+        # autocommit path instead joins the data's own 2PC in _write_hot)
+        for table_key, events in per_table.items():
+            try:
+                dist.append(table_key, events)
+            except Exception:       # noqa: BLE001 — CDC must not fail
+                pass                # the txn the user already committed
         self._txn_binlog.clear()
+
+    def _table_binlogged(self, db_name: str, table: str) -> bool:
+        try:
+            info = self.db.catalog.get_table(db_name, table)
+        except Exception:       # noqa: BLE001
+            return False
+        return _opt_on((info.options or {}).get("binlog"))
 
     def _tctx(self, store: TableStore):
         """The open transaction's per-table context (created on first touch),

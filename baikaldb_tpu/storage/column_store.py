@@ -284,6 +284,9 @@ class TableStore:
         # raft-replicated hot tier (storage/replicated.py); when set, DML
         # replicates through region raft groups instead of the local WAL
         self.replicated = None
+        # distributed binlog writer (storage/binlog_regions): autocommit
+        # DML events join the data's cross-tier 2PC when set
+        self.binlog_sink = None
         self._writer: Optional[TxnContext] = None
         # AUTO_INCREMENT high-water mark, lazily seeded from max(col)+1 (the
         # reference allocates ranges from meta's auto_incr_state_machine;
@@ -1306,8 +1309,20 @@ class TableStore:
             # through raft BEFORE the column tier reflects it (the dml_1pc
             # path, region.cpp:2301); no quorum -> the statement fails
             kc, rc = self.row_table.key_codec, self.row_table.row_codec
-            self.replicated.write_ops(
-                [(0, kc.encode_one(rec), rc.encode(rec)) for rec in recs])
+            ops = [(0, kc.encode_one(rec), rc.encode(rec)) for rec in recs]
+            sink = getattr(self, "binlog_sink", None)
+            if sink is not None:
+                # distributed binlog: the CDC event rides the data's own
+                # cross-tier 2PC — present iff the data committed
+                # (storage/binlog_regions, the region_binlog analog)
+                from .binlog_regions import DistributedBinlog
+
+                sink.write_with_data(
+                    self.replicated, ops,
+                    f"{self.info.database}.{self.info.name}",
+                    DistributedBinlog.events_of(recs))
+            else:
+                self.replicated.write_ops(ops)
             return
         if self.wal_path is None:
             return      # non-durable autocommit: nothing would ever read it

@@ -1,0 +1,184 @@
+"""Distributed binlog: replicated binlog regions with TSO ordering
+(VERDICT r04 missing #2 / next #3).
+
+Done bar: two frontends write one table; one capturer sees a single
+gapless commit-ts-ordered stream; kill-9 of a binlog-region leader loses
+nothing.  Reference: region_binlog.cpp:1420 (prewrite/commit with TSO),
+baikal_capturer.h:104-123 (multi-region merge by commit_ts).
+"""
+
+import os
+import time
+
+import pytest
+
+from baikaldb_tpu.raft.core import raft_available
+from baikaldb_tpu.utils.flags import set_flag
+
+pytestmark = pytest.mark.skipif(not raft_available(),
+                                reason="native raft core unavailable")
+
+BASE_PORT = 9800 + (os.getpid() % 140) * 10
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from baikaldb_tpu.tools.deploy_cluster import spawn_cluster, teardown
+
+    meta_addr, procs = spawn_cluster(n_stores=3, base_port=BASE_PORT)
+    yield meta_addr, procs
+    teardown(procs)
+
+
+def _session(meta_addr):
+    from baikaldb_tpu.exec.session import Database, Session
+
+    s = Session(Database(cluster=meta_addr))
+    # binlog is opt-in per table, like the reference's link-binlog option
+    s.execute("CREATE TABLE bt (id BIGINT NOT NULL, v DOUBLE, "
+              "PRIMARY KEY (id)) BINLOG=1")
+    return s
+
+
+def test_two_frontends_one_ordered_stream(cluster):
+    meta_addr, procs = cluster
+    from baikaldb_tpu.storage.binlog_regions import BinlogCapturer
+    from baikaldb_tpu.storage.remote_tier import ClusterClient
+
+    a = _session(meta_addr)
+    b = _session(meta_addr)
+    cap = BinlogCapturer(ClusterClient(meta_addr))
+    # interleave writes from two frontend processes' worth of state
+    for i in range(6):
+        (a if i % 2 == 0 else b).execute(
+            f"INSERT INTO bt VALUES ({i}, {float(i)})")
+    deadline = time.monotonic() + 20
+    got = []
+    while time.monotonic() < deadline and len(got) < 6:
+        got.extend(cap.poll())
+        time.sleep(0.2)
+    assert len(got) == 6
+    ts = [e["commit_ts"] for e in got]
+    assert ts == sorted(ts) and len(set(ts)) == 6     # ordered, distinct
+    assert {e["src"] for e in got} == {a.db._dist_binlog.src,
+                                       b.db._dist_binlog.src}
+    ids = sorted(ev["row"]["id"] for e in got for ev in e["events"])
+    assert ids == [0, 1, 2, 3, 4, 5]
+    # every event's start_ts precedes its commit_ts (TSO 2PC)
+    assert all(e["start_ts"] < e["commit_ts"] for e in got)
+
+
+def test_leader_kill_loses_nothing(cluster):
+    meta_addr, procs = cluster
+    from baikaldb_tpu.storage.binlog_regions import BinlogCapturer
+    from baikaldb_tpu.storage.remote_tier import ClusterClient
+
+    s = _session(meta_addr)
+    cap = BinlogCapturer(ClusterClient(meta_addr))
+    drained = cap.poll()        # skip earlier tests' events
+    s.execute("INSERT INTO bt VALUES (100, 1.0)")
+    # SIGKILL one store: binlog regions keep quorum 2/3
+    victim = procs["stores"][1]
+    victim.kill()
+    victim.wait(timeout=10)
+    s.execute("INSERT INTO bt VALUES (101, 2.0)")
+    deadline = time.monotonic() + 25
+    got = []
+    while time.monotonic() < deadline and len(got) < 2:
+        got.extend(cap.poll())
+        time.sleep(0.3)
+    ids = sorted(ev["row"]["id"] for e in got for ev in e["events"])
+    assert ids == [100, 101]
+    assert [e["commit_ts"] for e in got] == \
+        sorted(e["commit_ts"] for e in got)
+
+
+def test_orphan_prewrite_stalls_then_expires(cluster):
+    meta_addr, procs = cluster
+    from baikaldb_tpu.storage.binlog_regions import (BinlogCapturer,
+                                                     DistributedBinlog)
+    from baikaldb_tpu.storage.remote_tier import ClusterClient
+
+    s = _session(meta_addr)
+    cap = BinlogCapturer(ClusterClient(meta_addr))
+    cap.poll()
+    # a writer dies between prewrite and commit
+    dead = DistributedBinlog(ClusterClient(meta_addr))
+    dead.prewrite("default.bt")
+    s.execute("INSERT INTO bt VALUES (200, 1.0)")
+    # the later commit sits ABOVE the orphan's start_ts: the capturer must
+    # hold it back (gapless guarantee) ...
+    assert cap.poll() == []
+    # ... until the grace window expires the orphan
+    set_flag("binlog_prewrite_grace_s", 0.2)
+    try:
+        time.sleep(0.4)
+        deadline = time.monotonic() + 10
+        got = []
+        while time.monotonic() < deadline and not got:
+            got = cap.poll()
+            time.sleep(0.2)
+    finally:
+        set_flag("binlog_prewrite_grace_s", 30.0)
+    assert [ev["row"]["id"] for e in got for ev in e["events"]] == [200]
+
+
+def test_unlinked_tables_and_txn_path(cluster):
+    meta_addr, procs = cluster
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.storage.binlog_regions import BinlogCapturer
+    from baikaldb_tpu.storage.remote_tier import ClusterClient
+
+    s = _session(meta_addr)
+    cap = BinlogCapturer(ClusterClient(meta_addr))
+    cap.poll()
+    # a table WITHOUT the binlog option never reaches the binlog regions
+    s.execute("CREATE TABLE quiet (id BIGINT NOT NULL, PRIMARY KEY (id))")
+    s.execute("INSERT INTO quiet VALUES (1)")
+    assert cap.poll() == []
+    # explicit transactions flush their buffered events at COMMIT
+    s.execute("BEGIN")
+    s.execute("INSERT INTO bt VALUES (250, 2.5)")
+    s.execute("INSERT INTO bt VALUES (251, 2.5)")
+    assert cap.poll() == []              # nothing visible before COMMIT
+    s.execute("COMMIT")
+    deadline = time.monotonic() + 15
+    got = []
+    while time.monotonic() < deadline and not got:
+        got.extend(cap.poll())
+        time.sleep(0.2)
+    # txn-path events share the autocommit schema (kind/row)
+    ids = sorted(ev["row"]["id"] for e in got for ev in e["events"])
+    assert ids == [250, 251]
+    assert {ev["kind"] for e in got for ev in e["events"]} == {"write"}
+
+
+def test_capturer_gc_and_resume(cluster):
+    meta_addr, procs = cluster
+    from baikaldb_tpu.storage.binlog_regions import BinlogCapturer
+    from baikaldb_tpu.storage.remote_tier import ClusterClient
+
+    def ids_of(entries):
+        out = []
+        for e in entries:
+            for ev in e["events"]:
+                if "row" in ev:
+                    out.append(ev["row"]["id"])
+                for r in (ev.get("rows") or []):
+                    out.append(r["id"])
+        return out
+
+    s = _session(meta_addr)
+    cap = BinlogCapturer(ClusterClient(meta_addr))
+    s.execute("INSERT INTO bt VALUES (300, 3.0)")
+    deadline = time.monotonic() + 15
+    got = []
+    while time.monotonic() < deadline and 300 not in ids_of(got):
+        got.extend(cap.poll())
+        time.sleep(0.2)
+    assert got
+    reclaimed = cap.gc()
+    assert reclaimed >= 1
+    # a fresh capturer resuming from the checkpoint sees nothing old
+    cap2 = BinlogCapturer(ClusterClient(meta_addr), since_ts=cap.checkpoint)
+    assert cap2.poll() == []
