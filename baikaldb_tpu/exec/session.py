@@ -1622,25 +1622,30 @@ class Session:
         self._flush_txn_binlog()
 
     def _flush_txn_binlog(self):
+        if not self._txn_binlog:
+            return
         from ..storage.binlog_regions import DistributedBinlog
 
-        dist = self.db.dist_binlog()
         per_table: OrderedDict = OrderedDict()
         for ev in self._txn_binlog:
             event_type, db_name, table, rows, statement, affected = ev
             self.db.binlog.append(event_type, db_name, table, rows=rows,
                                   statement=statement, affected=affected)
-            if dist is not None and self._table_binlogged(db_name, table):
+            if self._table_binlogged(db_name, table):
                 per_table.setdefault(f"{db_name}.{table}", []).extend(
                     DistributedBinlog.events_from_statement(
                         event_type, rows, statement, affected))
         # one prewrite/commit round per table, not per statement (the
-        # autocommit path instead joins the data's own 2PC in _write_hot)
-        for table_key, events in per_table.items():
-            try:
-                dist.append(table_key, events)
-            except Exception:       # noqa: BLE001 — CDC must not fail
-                pass                # the txn the user already committed
+        # autocommit path instead joins the data's own 2PC in _write_hot).
+        # dist_binlog() resolves only when a binlogged event exists: it
+        # creates the __binlog__ regions cluster-wide on first use
+        dist = self.db.dist_binlog() if per_table else None
+        if dist is not None:
+            for table_key, events in per_table.items():
+                try:
+                    dist.append(table_key, events)
+                except Exception:   # noqa: BLE001 — CDC must not fail
+                    pass            # the txn the user already committed
         self._txn_binlog.clear()
 
     def _table_binlogged(self, db_name: str, table: str) -> bool:

@@ -1,0 +1,186 @@
+"""Column statistics: equi-depth histograms + most-common values.
+
+The reference feeds IndexSelector and join sizing from real sketches —
+CM-sketch for equality, equi-depth histograms for ranges, t-digest for
+quantiles (/root/reference/include/common/cmsketch.h:243,
+include/common/histogram.h, src/common/tdigest.cpp) — collected by ANALYZE
+and shipped in statistics.proto.  Until round 5 this repo estimated with
+fixed constants (eq = 0.1, range = 0.3), which goes wrong on skew
+(VERDICT r04 missing #6).
+
+Re-design: statistics are DERIVED state computed lazily per table version
+from the store snapshot (the lazy-cache discipline every other derived
+artifact here follows — rebuilding on ANALYZE only would go stale between
+runs).  A bounded sample keeps collection O(sample log sample):
+
+- equi-depth histogram (numeric/temporal): bucket bounds at quantiles, so
+  range selectivity is bucket counting + linear interpolation within the
+  boundary buckets.
+- most-common values (any type): exact top-k of the sample — the
+  CM-sketch's job (heavy-hitter equality) done directly, since the sample
+  already fits in memory.
+- ndv estimate for join fanout (distinct count of the sample).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..utils.flags import FLAGS, define
+
+define("histogram_stats", True,
+       "planner selectivity from equi-depth histograms + MCVs instead of "
+       "fixed constants")
+define("histogram_buckets", 64, "equi-depth histogram bucket count")
+define("histogram_mcv", 16, "most-common values kept per column")
+define("histogram_sample", 200_000,
+       "stats sample cap (rows) per column collection")
+
+# the pre-histogram fixed constants, kept as the fallback
+DEFAULT_EQ_SEL = 0.1
+DEFAULT_RANGE_SEL = 0.3
+
+
+def collect(values: np.ndarray, n_total: int, n_nulls: int,
+            numeric: bool) -> dict:
+    """Build the stats payload from a (non-null) value sample."""
+    out: dict = {"n": int(n_total), "nulls": int(n_nulls)}
+    if not len(values):
+        out["ndv"] = 0
+        return out
+    sample = values
+    cap = int(FLAGS.histogram_sample)
+    if len(sample) > cap:
+        idx = np.random.RandomState(0).choice(len(sample), cap,
+                                              replace=False)
+        sample = sample[idx]
+    uniq, counts = np.unique(sample, return_counts=True)
+    # scale sample ndv up to the population conservatively: values seen
+    # once in the sample hint at unseen ones (a Chao-style floor)
+    scale = max(len(values), 1) / len(sample)
+    singletons = int((counts == 1).sum())
+    out["ndv"] = int(min(len(uniq) + singletons * (scale - 1.0),
+                         n_total - n_nulls)) or 1
+    k = int(FLAGS.histogram_mcv)
+    if len(uniq) <= k:
+        mcv_idx = np.argsort(-counts)
+    else:
+        mcv_idx = np.argpartition(-counts, k)[:k]
+        mcv_idx = mcv_idx[np.argsort(-counts[mcv_idx])]
+    out["mcv"] = [(uniq[i].item() if hasattr(uniq[i], "item")
+                   else uniq[i], float(counts[i] * scale))
+                  for i in mcv_idx]
+    if numeric:
+        b = int(FLAGS.histogram_buckets)
+        qs = np.quantile(sample.astype(np.float64),
+                         np.linspace(0.0, 1.0, b + 1))
+        out["hist"] = [float(x) for x in qs]
+    return out
+
+
+def _hist_frac_below(hist: list, v: float, inclusive: bool) -> float:
+    """Fraction of non-null values < v (<= v when inclusive), by
+    equi-depth bucket counting + linear interpolation."""
+    b = len(hist) - 1
+    if b <= 0:
+        return 0.5
+    if v < hist[0]:
+        return 0.0
+    if v > hist[-1]:
+        return 1.0
+    pos = float(np.searchsorted(np.asarray(hist), v, side="right") - 1)
+    pos = min(pos, b - 1)
+    lo, hi = hist[int(pos)], hist[int(pos) + 1]
+    inner = 0.5 if hi <= lo else (v - lo) / (hi - lo)
+    frac = (pos + inner) / b
+    if inclusive:
+        frac += 1.0 / b * 0.01      # nudge: <= includes the boundary mass
+    return min(max(frac, 0.0), 1.0)
+
+
+def eq_selectivity(st: dict, value) -> Optional[float]:
+    if "mcv" not in st:
+        return None                 # no collected payload: no basis
+    n = st.get("n", 0)
+    live = n - st.get("nulls", 0)
+    if n <= 0 or live <= 0:
+        return 0.0
+    mcv = st.get("mcv") or []
+    mcv_total = 0.0
+    for v, cnt in mcv:
+        try:
+            if v == value or (isinstance(v, (int, float))
+                              and isinstance(value, (int, float))
+                              and float(v) == float(value)):
+                return min(cnt / n, 1.0)
+        except TypeError:
+            pass
+        mcv_total += cnt
+    ndv = st.get("ndv") or 1
+    rest_vals = max(ndv - len(mcv), 1)
+    rest_rows = max(live - mcv_total, 0.0)
+    return min(max(rest_rows / rest_vals / n, 1.0 / max(n, 1)), 1.0)
+
+
+def range_selectivity(st: dict, op: str, value) -> Optional[float]:
+    hist = st.get("hist")
+    n = st.get("n", 0)
+    if not hist or n <= 0:
+        return None
+    try:
+        v = float(value)
+    except (TypeError, ValueError):
+        return None
+    live_frac = (n - st.get("nulls", 0)) / n
+    if op == "lt":
+        f = _hist_frac_below(hist, v, False)
+    elif op == "le":
+        f = _hist_frac_below(hist, v, True)
+    elif op == "gt":
+        f = 1.0 - _hist_frac_below(hist, v, True)
+    elif op == "ge":
+        f = 1.0 - _hist_frac_below(hist, v, False)
+    else:
+        return None
+    return min(max(f * live_frac, 0.0), 1.0)
+
+
+def _coerce_value(st: dict, value):
+    """Temporal literals compare against the histogram's integer space
+    (days / microseconds since epoch)."""
+    kind = st.get("kind")
+    if kind and isinstance(value, str):
+        import datetime
+
+        try:
+            s = value.strip()
+            if kind == "date" and len(s) <= 10:
+                return (datetime.date.fromisoformat(s)
+                        - datetime.date(1970, 1, 1)).days
+            dt = datetime.datetime.fromisoformat(s.replace("T", " "))
+            if kind == "date":
+                return (dt.date() - datetime.date(1970, 1, 1)).days
+            return int((dt - datetime.datetime(1970, 1, 1))
+                       .total_seconds() * 1e6)
+        except ValueError:
+            return value
+    return value
+
+
+def conjunct_selectivity(st: Optional[dict], op: str,
+                         value) -> Optional[float]:
+    """Selectivity of ``col OP literal`` under ``st``; None = no basis
+    (caller falls back to the fixed defaults)."""
+    if not st or not FLAGS.histogram_stats:
+        return None
+    if "mcv" not in st and "hist" not in st:
+        return None                 # min/max-only dict (collection failed)
+    value = _coerce_value(st, value)
+    if op == "eq":
+        return eq_selectivity(st, value)
+    if op == "ne":
+        s = eq_selectivity(st, value)
+        return None if s is None else min(max(1.0 - s, 0.0), 1.0)
+    return range_selectivity(st, op, value)

@@ -59,6 +59,29 @@ ROW_SCHEMA = Schema((Field(ROWID, LType.INT64, False),
                      Field("__del", LType.BOOL, True)) + _FIELDS)
 
 
+_KC = None
+
+
+def _key_codec():
+    global _KC
+    if _KC is None:
+        from .rowstore import KeyCodec
+
+        _KC = KeyCodec(ROW_SCHEMA, [ROWID])
+    return _KC
+
+
+def encode_op(tier, row: dict):
+    """One binlog-tier write op (shared by writer, capturer expiry, gc —
+    one encoding, no drift)."""
+    return (0, _key_codec().encode_one(row), tier.row_codec.encode(row))
+
+
+def tombstone_op(tier, rowid: int, ts: int, state: int):
+    return encode_op(tier, {ROWID: int(rowid), "__del": True,
+                            "ts": int(ts), "state": int(state)})
+
+
 def _json_safe(v):
     import datetime
 
@@ -83,19 +106,8 @@ class DistributedBinlog:
         return int(self.cluster.meta.call("tso")["ts"])
 
     # -- writer protocol --------------------------------------------------
-    _KEY_CODEC = None
-
-    @classmethod
-    def _kc(cls):
-        if cls._KEY_CODEC is None:
-            from .rowstore import KeyCodec
-
-            cls._KEY_CODEC = KeyCodec(ROW_SCHEMA, [ROWID])
-        return cls._KEY_CODEC
-
     def _encode(self, row: dict):
-        return (0, self._kc().encode_one(row),
-                self.tier.row_codec.encode(row))
+        return encode_op(self.tier, row)
 
     def prewrite(self, table_key: str) -> tuple[int, tuple]:
         """Reserve ordering: P row at start_ts.  Returns (start_ts,
@@ -105,8 +117,7 @@ class DistributedBinlog:
         row = {ROWID: rowid, "ts": start_ts, "state": 0,
                "table_key": table_key, "src": self.src}
         self.tier.write_ops([self._encode(row)])
-        tomb = self._encode({ROWID: rowid, "__del": True,
-                             "ts": start_ts, "state": 0})
+        tomb = tombstone_op(self.tier, rowid, start_ts, 0)
         return start_ts, tomb
 
     def commit_ops(self, start_ts: int, tomb, table_key: str,
@@ -259,15 +270,8 @@ class BinlogCapturer:
             # the matching prepares back.  (A writer stalled longer than
             # the grace window is the documented resolution boundary —
             # the reference expires binlog prewrites on a timer too.)
-            from .rowstore import KeyCodec
-
-            kc = KeyCodec(ROW_SCHEMA, [ROWID])
-            ops = []
-            for r in expired:
-                row = {ROWID: int(r[ROWID]), "__del": True,
-                       "ts": int(r["ts"]), "state": 0}
-                ops.append((0, kc.encode_one(row),
-                            self.tier.row_codec.encode(row)))
+            ops = [tombstone_op(self.tier, r[ROWID], r["ts"], 0)
+                   for r in expired]
             try:
                 self.tier.write_ops(ops)
             except Exception:       # noqa: BLE001 — next poll retries
@@ -297,18 +301,11 @@ class BinlogCapturer:
         """Tombstone emitted commit rows below ``before_ts`` (default: the
         capturer checkpoint) — the binlog's bounded-retention story."""
         limit = self.checkpoint if before_ts is None else int(before_ts)
-        from .rowstore import KeyCodec
-
-        kc = KeyCodec(ROW_SCHEMA, [ROWID])
         victims = [r for r in self.tier.scan_rows()
                    if not r.get("__del") and r["state"] == 1
                    and int(r["ts"]) <= limit]
-        ops = []
-        for r in victims:
-            row = {ROWID: int(r[ROWID]), "__del": True,
-                   "ts": int(r["ts"]), "state": 1}
-            ops.append((0, kc.encode_one(row),
-                        self.tier.row_codec.encode(row)))
+        ops = [tombstone_op(self.tier, r[ROWID], r["ts"], 1)
+               for r in victims]
         if ops:
             self.tier.write_ops(ops)
         return len(ops)

@@ -576,8 +576,49 @@ class TableStore:
                         st["min"], st["max"] = mn, mx
                 except Exception:
                     pass
+            st.update(self._histogram_stats(col, f) or {})
             cache[1][column] = st
             return st
+
+    def _histogram_stats(self, col, f) -> Optional[dict]:
+        """Equi-depth histogram + MCVs per column version (index/stats —
+        the reference's ANALYZE-time CM-sketch/histogram collection done
+        lazily, like every other derived artifact here)."""
+        from ..index.stats import collect
+        from ..utils.flags import FLAGS
+
+        try:
+            if not FLAGS.histogram_stats:
+                return None
+            n_total = len(col)
+            if n_total == 0:
+                return None
+            import pyarrow.compute as pc
+            n_nulls = col.null_count
+            vals = pc.drop_null(col).combine_chunks() \
+                .to_numpy(zero_copy_only=False)
+            kind = None
+            if f.ltype is LType.STRING:
+                vals = np.asarray(vals, dtype=object)
+                numeric = False
+            else:
+                if vals.dtype.kind == "M":        # date/datetime
+                    if f.ltype is LType.DATE:
+                        vals = vals.astype("datetime64[D]")
+                        kind = "date"
+                    else:
+                        vals = vals.astype("datetime64[us]")
+                        kind = "datetime"
+                    vals = vals.astype(np.int64)
+                elif vals.dtype.kind == "O":
+                    return None                   # decimals etc.
+                numeric = True
+            st = collect(vals, n_total, n_nulls, numeric)
+            if kind:
+                st["kind"] = kind
+            return st
+        except Exception:       # noqa: BLE001 — stats are advisory
+            return None
 
     def next_auto_incr(self, col: str, n: int) -> list[int]:
         """Allocate n consecutive AUTO_INCREMENT ids (monotonic; rollback

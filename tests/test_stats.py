@@ -1,0 +1,80 @@
+"""Histogram/MCV statistics feeding the planner (VERDICT r04 missing #6).
+
+Reference: ANALYZE-time CM-sketch + equi-depth histograms consumed by the
+IndexSelector and join sizing (include/common/cmsketch.h:243,
+include/common/histogram.h).  Done bar: a skewed-predicate plan flip —
+the join order changes with stats on vs off — and no TPC-H regression
+(covered by the existing TPC-H suites running with the flag default-on).
+"""
+
+import numpy as np
+import pytest
+
+from baikaldb_tpu.exec.session import Database, Session
+from baikaldb_tpu.index.stats import (collect, conjunct_selectivity)
+from baikaldb_tpu.utils.flags import set_flag
+
+
+def test_equi_depth_histogram_range_estimates():
+    rng = np.random.RandomState(0)
+    vals = rng.exponential(100.0, 50_000)       # skewed distribution
+    st = collect(vals, len(vals), 0, True)
+    for cut in (10.0, 50.0, 200.0, 700.0):
+        true = float((vals < cut).mean())
+        est = conjunct_selectivity(st, "lt", cut)
+        assert est is not None and abs(est - true) < 0.05, (cut, est, true)
+    assert conjunct_selectivity(st, "ge", float(vals.max()) + 1) \
+        <= 1.0 / 64 + 0.02
+
+
+def test_mcv_equality_estimates_heavy_hitters():
+    vals = np.concatenate([np.full(9_000, 7), np.arange(1_000)])
+    st = collect(vals, len(vals), 0, True)
+    hot = conjunct_selectivity(st, "eq", 7)
+    cold = conjunct_selectivity(st, "eq", 123)
+    assert hot == pytest.approx(0.9, abs=0.05)
+    assert cold < 0.01                          # rest spread over ndv
+    # defaults said 0.1 for both — the skew failure mode
+
+
+def test_null_fraction_discounts_ranges():
+    vals = np.arange(1_000, dtype=np.float64)
+    st = collect(vals, 2_000, 1_000, True)      # half the column is NULL
+    est = conjunct_selectivity(st, "lt", 1_000.0)
+    assert est == pytest.approx(0.5, abs=0.05)
+
+
+def test_skewed_predicate_flips_join_order():
+    """With fixed constants the eq-on-a-heavy-value table looks tiny and
+    joins first; the MCV estimate sees 90% survival and defers it."""
+    s = Session(Database())
+    s.execute("CREATE TABLE a (id BIGINT, PRIMARY KEY (id))")
+    s.execute("CREATE TABLE b (aid BIGINT, k BIGINT)")
+    s.execute("CREATE TABLE c (aid BIGINT, v BIGINT)")
+    s.execute("INSERT INTO a VALUES " +
+              ", ".join(f"({i})" for i in range(200)))
+    rows_b = [(i % 200, 7 if i < 1800 else i) for i in range(2000)]
+    s.execute("INSERT INTO b VALUES " +
+              ", ".join(f"({a}, {k})" for a, k in rows_b))
+    rows_c = [(i % 200, i % 1000) for i in range(2000)]
+    s.execute("INSERT INTO c VALUES " +
+              ", ".join(f"({a}, {v})" for a, v in rows_c))
+    sql = ("EXPLAIN SELECT COUNT(*) FROM a, b, c "
+           "WHERE a.id = b.aid AND a.id = c.aid "
+           "AND b.k = 7 AND c.v < 50")
+
+    def order(plan_text):
+        return (plan_text.index(" as b "), plan_text.index(" as c "))
+
+    with_stats = s.execute(sql).plan_text
+    set_flag("histogram_stats", False)
+    try:
+        without = s.execute(sql).plan_text
+    finally:
+        set_flag("histogram_stats", True)
+    pb1, pc1 = order(with_stats)
+    pb0, pc0 = order(without)
+    # fixed constants: b (eq, "0.1") joins before c (range, "0.3");
+    # histograms: b survives at 90%, c at 5% -> c joins first
+    assert pb0 < pc0, without
+    assert pc1 < pb1, with_stats
