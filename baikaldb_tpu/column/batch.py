@@ -87,20 +87,28 @@ class ColumnBatch:
     of the reference's filtered RowBatch.  ``num_rows`` when set is a *traced
     scalar* giving the count of live rows among the leading prefix (set by
     ``compact``); None means sel/all rows are authoritative.
+
+    ``live_prefix`` (static) is the capacity-bucketing promise: every live row
+    sits in a leading prefix and ``sel`` equals ``arange(capacity) < live``
+    (set by ``pad_batch`` on bucketed store batches).  Consumers may then skip
+    the stable-partition gather that ``compact`` otherwise needs.
     """
 
     names: tuple  # static
     columns: list  # list[Column]
     sel: Optional[Any] = None
     num_rows: Optional[Any] = None
+    live_prefix: bool = False  # static
 
     def tree_flatten(self):
-        return (self.columns, self.sel, self.num_rows), (self.names,)
+        return (self.columns, self.sel, self.num_rows), \
+            (self.names, self.live_prefix)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         columns, sel, num_rows = children
-        return cls(names=aux[0], columns=list(columns), sel=sel, num_rows=num_rows)
+        return cls(names=aux[0], columns=list(columns), sel=sel,
+                   num_rows=num_rows, live_prefix=aux[1])
 
     # -- accessors ------------------------------------------------------
     def __len__(self) -> int:
@@ -139,13 +147,17 @@ class ColumnBatch:
 
     def select(self, names: list[str]) -> "ColumnBatch":
         cols = [self.column(n) for n in names]
-        return ColumnBatch(tuple(names), cols, self.sel, self.num_rows)
+        return ColumnBatch(tuple(names), cols, self.sel, self.num_rows,
+                           live_prefix=self.live_prefix)
 
     def append_column(self, name: str, col: Column) -> "ColumnBatch":
-        return ColumnBatch(self.names + (name,), self.columns + [col], self.sel, self.num_rows)
+        return ColumnBatch(self.names + (name,), self.columns + [col],
+                           self.sel, self.num_rows,
+                           live_prefix=self.live_prefix)
 
     def rename(self, names: list[str]) -> "ColumnBatch":
-        return ColumnBatch(tuple(names), self.columns, self.sel, self.num_rows)
+        return ColumnBatch(tuple(names), self.columns, self.sel,
+                           self.num_rows, live_prefix=self.live_prefix)
 
     def gather(self, idx, valid=None) -> "ColumnBatch":
         """Row gather; idx traced int array, valid optional mask for out rows."""
@@ -293,6 +305,49 @@ def _column_to_arrow(c: Column, data: np.ndarray, valid: np.ndarray | None):
     if c.ltype in (LType.DATETIME, LType.TIMESTAMP):
         return pa.array(data.astype("int64"), type=pa.timestamp("us"), mask=mask)
     return pa.array(data, mask=mask)
+
+
+def bucket_capacity(n: int, minimum: int = 1) -> int:
+    """Smallest power-of-two >= max(n, minimum, 1): the capacity bucket a
+    batch of ``n`` rows pads into.  A table growing inside one bucket keeps
+    its device shape, so every executable compiled against it stays valid;
+    only a bucket crossing (or shrink below the previous bucket) retraces."""
+    return 1 << (max(int(n), int(minimum), 1) - 1).bit_length()
+
+
+def pad_batch(batch: ColumnBatch, capacity: int) -> ColumnBatch:
+    """Pad to ``capacity`` rows with dead rows (``sel=False`` tail).
+
+    The fill is NULL-safe per dtype — zeros / False / code 0 — the same
+    "real-looking but dead" payload filtered-out rows already carry, so any
+    kernel correct under sel masks is correct over the padded tail.  When the
+    input had no sel (all rows live) the result is marked ``live_prefix``:
+    live rows form a leading prefix, which lets ``compact`` skip its gather.
+    """
+    n = len(batch)
+    if capacity < n:
+        raise ValueError(f"pad_batch: capacity {capacity} < {n} rows")
+    prefix = batch.sel is None
+    if capacity == n:
+        if batch.sel is None:
+            # attach an explicit all-live mask: the pytree structure must not
+            # flip between sel=None and sel=array as the row count moves
+            # through an exact power of two (that flip alone would retrace)
+            return ColumnBatch(batch.names, batch.columns,
+                               jnp.ones(n, dtype=bool), batch.num_rows,
+                               live_prefix=True)
+        return batch
+    pad = capacity - n
+    cols = []
+    for c in batch.columns:
+        data = jnp.concatenate(
+            [c.data, jnp.zeros((pad,) + c.data.shape[1:], c.data.dtype)])
+        validity = None
+        if c.validity is not None:
+            validity = jnp.concatenate([c.validity, jnp.zeros((pad,), bool)])
+        cols.append(Column(data, validity, c.ltype, c.dictionary))
+    sel = jnp.concatenate([batch.sel_mask(), jnp.zeros((pad,), bool)])
+    return ColumnBatch(batch.names, cols, sel, None, live_prefix=prefix)
 
 
 def concat_batches(batches: list[ColumnBatch]) -> ColumnBatch:

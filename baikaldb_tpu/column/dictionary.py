@@ -31,7 +31,8 @@ NULL_CODE = np.int32(-1)
 class Dictionary:
     """An immutable sorted dictionary for one string column."""
 
-    __slots__ = ("values", "_id", "_ft_index", "_ft_state", "_hash_cache")
+    __slots__ = ("values", "_id", "_ft_index", "_ft_state", "_hash_cache",
+                 "_fp")
 
     def __init__(self, values: np.ndarray):
         # values must be sorted unique unicode/objects
@@ -40,6 +41,44 @@ class Dictionary:
         self._ft_index = None   # lazily-built fulltext index (index/fulltext)
         self._ft_state = None   # per-dictionary BM25 state (fulltext)
         self._hash_cache = None
+        self._fp = None         # lazy content fingerprint (see __eq__)
+
+    # -- value equality ---------------------------------------------------
+    # Dictionaries ride pytree aux data (column/batch.Column), so jax.jit
+    # keys compiled executables on them.  Identity semantics would retrace
+    # every query on a string column after ANY table mutation (each rebuild
+    # allocates a fresh Dictionary even when the distinct values are
+    # unchanged) — the recompile storm capacity bucketing exists to end.
+    # Content equality via a cached digest keeps aux comparison O(1) after
+    # the first hash, and a changed value set (which really does invalidate
+    # traced code constants) still misses.
+    def _fingerprint(self) -> bytes:
+        if self._fp is None:
+            import hashlib
+
+            h = hashlib.blake2b(digest_size=16)
+            arr = self.values
+            h.update(str(len(arr)).encode())
+            if arr.dtype.kind == "U":
+                h.update(str(arr.dtype).encode())
+                h.update(arr.tobytes())
+            else:
+                for v in arr:
+                    b = str(v).encode("utf-8")
+                    h.update(len(b).to_bytes(4, "little"))
+                    h.update(b)
+            self._fp = h.digest()
+        return self._fp
+
+    def __eq__(self, other):
+        if self is other:
+            return True
+        if not isinstance(other, Dictionary):
+            return NotImplemented
+        return self._fingerprint() == other._fingerprint()
+
+    def __hash__(self):
+        return hash(self._fingerprint())
 
     # -- construction ---------------------------------------------------
     @staticmethod

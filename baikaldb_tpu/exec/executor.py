@@ -45,6 +45,7 @@ class ExecError(RuntimeError):
     pass
 
 
+from ..utils import metrics  # noqa: E402
 from ..utils.flags import FLAGS, define  # noqa: E402
 
 define("radix_join_buckets", 0,
@@ -83,8 +84,15 @@ def compile_plan(plan: PlanNode, trace: bool = False, mesh=None) -> Callable:
     join_order: list = []
     trace_order: list = []
     n_shards = int(mesh.devices.size) if mesh is not None else 0
+    # Python-side-effect trace counter: run_local's body only executes when
+    # jax (re)traces — a steady-state cached execution never enters it.  The
+    # session's compile telemetry (metrics.xla_retraces / compile_ms) and the
+    # bucketing regression tests key off this.
+    trace_count = [0]
 
     def run_local(batches: dict):
+        trace_count[0] += 1
+        metrics.xla_retraces.add(1)
         overflows: list = []
         counts: list = []
         trace_order.clear()
@@ -116,6 +124,7 @@ def compile_plan(plan: PlanNode, trace: bool = False, mesh=None) -> Callable:
 
     run.join_order = join_order
     run.trace_order = trace_order
+    run.trace_count = trace_count
     return run
 
 
@@ -146,7 +155,11 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         b = batches[node.table_key]
         names = tuple(f"{node.label}.{c}" for c in node.columns)
         cols = [b.column(c) for c in node.columns]
-        out = ColumnBatch(names, cols, b.sel, b.num_rows)
+        # bucket-padded store batches arrive with a live-prefix sel mask;
+        # the static promise survives the scan (and dies at the first
+        # and_sel), letting compact skip its gather on unfiltered scans
+        out = ColumnBatch(names, cols, b.sel, b.num_rows,
+                          live_prefix=b.live_prefix)
         if node.pushed_filter is not None:
             out = out.and_sel(eval_predicate(node.pushed_filter, out))
         return out

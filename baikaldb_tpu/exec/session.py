@@ -26,7 +26,8 @@ from ..column.batch import ColumnBatch
 from ..expr.compile import eval_expr, eval_output, eval_predicate
 from ..meta.catalog import Catalog, IndexInfo, parse_type
 from ..ops.compact import compact
-from ..plan.nodes import JoinNode, PlanNode, ScalarSourceNode
+from ..plan.nodes import (JoinNode, PlanNode, ScalarSourceNode,
+                          plan_signature)
 from ..plan.planner import PlanError, Planner
 from ..sql.lexer import SqlError
 from ..sql.parser import parse_sql
@@ -286,6 +287,15 @@ class Database:
         # live connections for SHOW PROCESSLIST (id -> dict), kept by the
         # wire server (reference: show processlist over NetworkServer conns)
         self.processlist: dict[int, dict] = {}
+        # committed-txn CDC batches whose distributed-binlog append failed:
+        # queued (table_key, events) pairs retried on later flushes instead
+        # of silently dropped (bounded; overflow counts in
+        # metrics.binlog_events_dropped).  The lock serializes drain/append
+        # rounds across thread-per-connection sessions — concurrent commits
+        # would otherwise pop an empty deque and reorder a table's stream
+        import threading
+        self.binlog_retry: deque = deque()
+        self.binlog_retry_mu = threading.Lock()
         self.data_dir = data_dir
         # external cold-storage FS (AFS stand-in, storage/coldfs): segment
         # bytes live here, manifests replicate through the region groups
@@ -311,6 +321,39 @@ class Database:
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]
+
+    _BINLOG_RETRY_MAX = 1024    # queued batches; beyond this, oldest drop
+
+    def drain_binlog_retry(self, dist) -> None:
+        """Re-attempt queued distributed-binlog appends.  Thread-safe; the
+        autocommit DML path (TableStore._write_hot) calls this before its
+        own CDC append so queued batches land first and the per-table
+        stream order holds."""
+        with self.binlog_retry_mu:
+            self._drain_binlog_retry_locked(dist)
+
+    def _drain_binlog_retry_locked(self, dist) -> None:
+        """Arrival-order drain; the first failure stops it (the backend is
+        likely still down — later batches must not jump the queue).
+        Caller holds binlog_retry_mu."""
+        q = self.binlog_retry
+        for _ in range(len(q)):
+            table_key, events = q.popleft()
+            try:
+                dist.append(table_key, events)
+            except Exception:   # noqa: BLE001
+                q.appendleft((table_key, events))
+                break
+
+    def _queue_binlog_retry_locked(self, table_key: str,
+                                   events: list) -> None:
+        """Caller holds binlog_retry_mu."""
+        q = self.binlog_retry
+        q.append((table_key, events))
+        metrics.binlog_retry_queued.add(len(events))
+        while len(q) > self._BINLOG_RETRY_MAX:
+            _, dropped = q.popleft()
+            metrics.binlog_events_dropped.add(len(dropped))
 
     def dist_binlog(self):
         """The cluster's distributed binlog writer (storage/binlog_regions)
@@ -376,6 +419,10 @@ class Database:
                 # tables (global-index, rollups) ride their main table's
                 # events — a sink there would double-log
                 st.binlog_sink = self.dist_binlog()
+                # back-reference for the autocommit ordering guard: queued
+                # retry batches must drain before a fresh autocommit CDC
+                # event for the same table lands (column_store._write_hot)
+                st.binlog_db = self
             if str(FLAGS.pushdown_reads) != "off":
                 # defer the full-region pull: eligible SELECTs execute as
                 # pushed fragments ON the store daemons (the reference's
@@ -1530,7 +1577,11 @@ class Session:
                 walk(c)
         walk(plan)
         if len(scans) == 1:
-            scans[0].ann = (ix.name, col, metric, qvec, int(k))
+            # the WHERE flag rides along: filters re-apply AFTER candidate
+            # reduction, so the batch builder must widen the pre-filter pool
+            # (or fall back to brute force) to still fill LIMIT k
+            scans[0].ann = (ix.name, col, metric, qvec, int(k),
+                            stmt.where is not None)
 
     def _ann_batch(self, n, store) -> Optional[ColumnBatch]:
         """IVF candidate batch for an ANN-annotated scan: positions from
@@ -1538,21 +1589,23 @@ class Session:
         source the full scan would read)."""
         from ..index import annindex
 
-        ix_name, col, metric, qvec, k = n.ann
+        ix_name, col, metric, qvec, k, has_where = n.ann
+        filtered = has_where or n.pushed_filter is not None
         dim = (store.info.options or {}).get("vector_cols", {}).get(col)
         if dim is None:
             return None
         cache = getattr(self, "_access_batches", None)
         if cache is None:
             cache = self._access_batches = {}
-        ck = (n.table_key, store.version, "ann", col, qvec, k)
+        ck = (n.table_key, store.version, "ann", col, qvec, k, filtered)
         hit = cache.get(ck)
         if hit is not None:
             b, desc = hit
             n.access_desc = desc
             return b
         res = annindex.manager(self.db).candidates(
-            n.table_key, store, col, int(dim), qvec, metric, k)
+            n.table_key, store, col, int(dim), qvec, metric, k,
+            filtered=filtered)
         if res is None:
             n.access_desc = "full"
             return None
@@ -1622,7 +1675,9 @@ class Session:
         self._flush_txn_binlog()
 
     def _flush_txn_binlog(self):
-        if not self._txn_binlog:
+        # an empty commit still flows through: pending retry batches (failed
+        # appends of EARLIER commits) piggyback a drain on any commit
+        if not self._txn_binlog and not self.db.binlog_retry:
             return
         from ..storage.binlog_regions import DistributedBinlog
 
@@ -1639,13 +1694,28 @@ class Session:
         # autocommit path instead joins the data's own 2PC in _write_hot).
         # dist_binlog() resolves only when a binlogged event exists: it
         # creates the __binlog__ regions cluster-wide on first use
-        dist = self.db.dist_binlog() if per_table else None
+        dist = self.db.dist_binlog() \
+            if per_table or self.db.binlog_retry else None
         if dist is not None:
-            for table_key, events in per_table.items():
-                try:
-                    dist.append(table_key, events)
-                except Exception:   # noqa: BLE001 — CDC must not fail
-                    pass            # the txn the user already committed
+            # CDC must not fail the txn the user already committed — but a
+            # failed append is COMMITTED data subscribers would silently
+            # lose.  Queue it durably in-process and retry on later flushes;
+            # only a bounded-queue overflow drops events, and that shows in
+            # metrics.binlog_events_dropped
+            db = self.db
+            with db.binlog_retry_mu:
+                db._drain_binlog_retry_locked(dist)
+                blocked = {tk for tk, _ in db.binlog_retry}
+                for table_key, events in per_table.items():
+                    if table_key in blocked:
+                        # an older batch for this table is still queued:
+                        # appending now would reorder the table's CDC stream
+                        db._queue_binlog_retry_locked(table_key, events)
+                        continue
+                    try:
+                        dist.append(table_key, events)
+                    except Exception:   # noqa: BLE001
+                        db._queue_binlog_retry_locked(table_key, events)
         self._txn_binlog.clear()
 
     def _table_binlogged(self, db_name: str, table: str) -> bool:
@@ -3117,6 +3187,7 @@ class Session:
                 or any(_has_gc(o.expr) for o in stmt.order_by):
             return self._select_group_concat(stmt)
         entry = self._plan_cache.get(cache_key) if cache_key else None
+        replanned = False
         if entry is not None:
             self._plan_cache.move_to_end(cache_key)
             # stats-derived plan choices (dense group-by domains, key shifts)
@@ -3127,14 +3198,30 @@ class Session:
             # view redefinitions (possibly by ANOTHER session) change plans
             # without touching any table store version
             if entry.get("view_gen") != self.db.catalog.view_gen:
-                stale = True
-            if stale:
                 entry = None
-        (metrics.plan_cache_hits if entry is not None
+            elif stale:
+                # version gates the PLAN only, the capacity bucket gates the
+                # executable: replan (stats may have moved), and when the
+                # fresh plan is structurally identical keep the old entry —
+                # its settled join caps AND its compiled executables, which
+                # stay valid because bucketed shapes survive the DML.  Only
+                # a genuinely different plan drops the executables.
+                plan = self._plan_select(stmt)
+                sig = plan_signature(plan)
+                if sig != entry.get("plan_sig"):
+                    entry["plan"] = plan
+                    entry["plan_sig"] = sig
+                    entry["compiled"] = {}
+                    # the plan AND every executable were just rebuilt: in
+                    # cost terms this is a miss, and the hit/miss split is
+                    # how recompile churn shows on dashboards
+                    replanned = True
+        (metrics.plan_cache_hits if entry is not None and not replanned
          else metrics.plan_cache_misses).add(1)
         if entry is None:
             plan = self._plan_select(stmt)
-            entry = {"plan": plan, "compiled": {}, "versions": {},
+            entry = {"plan": plan, "plan_sig": plan_signature(plan),
+                     "compiled": {}, "versions": {},
                      "view_gen": self.db.catalog.view_gen}
             cap = int(FLAGS.plan_cache_size)
             if cache_key and cap > 0:
@@ -3187,6 +3274,18 @@ class Session:
         render(plan, 0)
         lines.append(f"-- run: {run_time * 1e3:.2f} ms "
                      f"(first incl. compile: {compile_and_run * 1e3:.2f} ms)")
+        # capacity buckets + compile telemetry: which shapes this query
+        # compiled against, and the engine-wide retrace/compile counters
+        # (steady state = xla_retraces stops moving between identical runs)
+        for tk, _v, cap in sorted(shape_key):
+            b = batches.get(tk)
+            if isinstance(b, ColumnBatch):
+                lines.append(f"-- batch: {tk} capacity={cap} "
+                             f"live={int(b.live_count())}")
+        cstats = metrics.compile_ms.stats()
+        lines.append(f"-- xla: retraces_total={metrics.xla_retraces.value} "
+                     f"compiles={cstats['count']} "
+                     f"compile_avg_ms={cstats['avg_ms']}")
         txt = "\n".join(lines)
         return Result(columns=["plan"], plan_text=txt,
                       arrow=pa.table({"plan": lines}))
@@ -3197,6 +3296,12 @@ class Session:
         batches: dict[str, ColumnBatch] = {}
         key_parts = []
         scan_count: dict[str, int] = {}
+        # tables whose batch IS the store's full device image (not an
+        # index-gathered subset): the only inputs host presort permutations
+        # may apply to.  Tracked explicitly — with capacity bucketing the
+        # padded batch length no longer equals store.num_rows, so the old
+        # length comparison can't identify a full scan
+        full_scan: set = set()
 
         def count(n: PlanNode):
             if isinstance(n, ScanNode):
@@ -3233,6 +3338,7 @@ class Session:
                         b = self._sharded_batch(n.table_key, store)
                     else:
                         b = store.device_table_batch()
+                        full_scan.add(n.table_key)
                 batches[n.table_key] = b
                 key_parts.append((n.table_key, store.version,
                                   len(batches[n.table_key])))
@@ -3256,7 +3362,7 @@ class Session:
                 # captured at — a permutation computed over newer data
                 # applied to an older batch would be silently unsorted
                 if store is not None and base is not None and \
-                        len(base) == store.num_rows and \
+                        table_key in full_scan and \
                         store.version == captured.get(table_key):
                     pkey = f"__presort__{kind}|{table_key}|{','.join(cols)}"
                     if pkey not in batches:
@@ -3416,7 +3522,11 @@ class Session:
         horizontal slice, padded to SPMD-equal length."""
         from ..parallel.mesh import shard_batch
 
-        ck = (table_key, store.version)
+        # bucket config joins the key: flipping batch_bucketing (or the
+        # bucket floor) mid-session must re-shard, not serve a cached batch
+        # of the other shape discipline
+        ck = (table_key, store.version, bool(FLAGS.batch_bucketing),
+              int(FLAGS.batch_bucket_min))
         b = self._mesh_batches.get(ck)
         if b is None:
             # drop stale versions of this table before caching the new one
@@ -3592,10 +3702,15 @@ class Session:
         # a plan with no scans has no sharded state (distribute leaves it
         # fully replicated) — run it as a plain single-device program
         mesh = self.mesh if batches else None
-        # trace-time execution flags join the executable key: flipping
-        # SET GLOBAL radix_join_buckets must re-trace, not silently reuse
-        # an executable compiled under the other strategy
-        shape_key = (shape_key, int(FLAGS.radix_join_buckets),
+        # executables key on per-table (table_key, capacity bucket) — NOT
+        # the store version: a version bump whose row count stays inside the
+        # capacity bucket reuses the executable outright (version gates plan
+        # staleness in _select; shape gates compilation here).  Trace-time
+        # execution flags join the key: flipping SET GLOBAL
+        # radix_join_buckets must re-trace, not silently reuse an executable
+        # compiled under the other strategy
+        shape_key = (tuple((tk, cap) for tk, _v, cap in shape_key),
+                     int(FLAGS.radix_join_buckets),
                      int(FLAGS.radix_join_min_build))
         for _ in range(int(FLAGS.join_retry_max) + 1):
             pair = entry["compiled"].get(shape_key)
@@ -3603,14 +3718,21 @@ class Session:
                 raw = compile_plan(plan, mesh=mesh)
                 pair = (jax.jit(raw), raw)
                 comp = entry["compiled"]
-                # growing tables produce a new shape_key per version bump;
-                # without a cap one hot query would pin every executable it
-                # ever compiled
+                # distinct shapes (bucket crossings, access-path batches)
+                # each pin an executable; without a cap one hot query would
+                # pin every executable it ever compiled
                 while len(comp) >= max(1, int(FLAGS.plan_cache_shapes)):
                     comp.pop(next(iter(comp)))
                 comp[shape_key] = pair
             fn, raw = pair
+            traces_before = raw.trace_count[0]
+            t0 = time.perf_counter()
             out, flags = fn(batches)
+            if raw.trace_count[0] > traces_before:
+                # this execution paid a trace+compile (first run / bucket
+                # crossing / overflow retry): record it so first-run vs
+                # steady-state shows up in SHOW metrics
+                metrics.compile_ms.observe((time.perf_counter() - t0) * 1e3)
             grew = False
             for node, flag in zip(raw.join_order, flags):
                 needed = int(flag)

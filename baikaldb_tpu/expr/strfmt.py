@@ -121,21 +121,32 @@ def mysql_date_format(v, fmt: str) -> Optional[str]:
 
 
 def mysql_format(n, dec) -> Optional[str]:
-    """FORMAT(n, d): round half away at d decimals, thousands commas."""
+    """FORMAT(n, d): round half away from zero at d decimals, thousands
+    commas.  Rounds through Decimal(str(n)) — scaling the binary float
+    directly printed FORMAT(0.145, 2) as 0.14, because 0.145 stores as
+    0.14499... and the +0.5 trick truncates it."""
     if n is None or dec is None:
         return None
     if isinstance(n, str):
         from .roweval import _str_num
         n = _str_num(n)
-    d = max(int(dec), 0)
-    neg = float(n) < 0
-    scale = 10 ** d
-    scaled = int(abs(float(n)) * scale + 0.5)
-    whole, frac = divmod(scaled, scale)
+    d = min(max(int(dec), 0), 30)   # MySQL clamps FORMAT decimals at 30
+    from decimal import ROUND_HALF_UP, Decimal, localcontext
+    with localcontext() as ctx:
+        x = Decimal(str(n))
+        # quantize needs room for every integer digit plus d fractionals,
+        # or it raises InvalidOperation instead of returning the result
+        ctx.prec = max(1, x.adjusted() + 1) + d + 5
+        q = x.quantize(Decimal(1).scaleb(-d), rounding=ROUND_HALF_UP)
+        neg = q < 0
+        if neg:
+            q = -q
+        whole = int(q)
+        frac = int((q - whole).scaleb(d)) if d else 0
     s = f"{whole:,d}"
     if d:
         s += f".{frac:0{d}d}"
-    return ("-" if neg and scaled else "") + s
+    return ("-" if neg and q != 0 else "") + s
 
 
 _I64_MASK = (1 << 64) - 1

@@ -39,6 +39,11 @@ define("ann_min_rows", 4096,
 define("ann_rebuild_drift", 0.2,
        "fraction of row-count drift that triggers k-means retraining "
        "(smaller drifts only re-assign rows to existing centroids)")
+define("ann_where_widen", 8,
+       "WHERE-filtered ANN queries multiply oversample and nprobe by this: "
+       "the filter drops candidates AFTER reduction, so the pre-filter pool "
+       "must run deeper or LIMIT k silently under-fills; once the widened "
+       "pool approaches the table the scan falls back to brute force")
 define("ann_nlist", 0, "IVF cluster count; 0 = sqrt(n)")
 
 # distance fn -> (ops.vector metric, ascending order expected)
@@ -205,9 +210,23 @@ class AnnManager:
         return True
 
     def candidates(self, table_key: str, store, col: str, dim: int,
-                   qvec: tuple, metric: str, k: int):
+                   qvec: tuple, metric: str, k: int,
+                   filtered: bool = False):
         """(positions ndarray, nprobe) into the store snapshot row order,
-        or None when brute force should run instead."""
+        or None when brute force should run instead.
+
+        ``filtered``: the statement carries a WHERE clause, which re-applies
+        AFTER the candidate reduction — a selective filter over a plain
+        k*oversample pool silently returns fewer than LIMIT rows.  The pool
+        deepens by ann_where_widen (oversample AND nprobe); when the widened
+        pool approaches the table size the sublinear path concedes and the
+        exact brute-force scan runs (correctness beats sublinearity).
+
+        Best-effort, like every post-filtered ANN engine: selectivity is
+        unknown at reduction time, so a filter more selective than roughly
+        1/ann_where_widen of the table can still under-fill LIMIT on large
+        tables.  Raise ann_where_widen (or drop the ANN index) when a
+        workload's filters are sharper than that."""
         from ..ops.vector import ivf_search_host
 
         # _mu only guards the registry; training/search serialize PER
@@ -221,8 +240,13 @@ class AnnManager:
             if not self._refresh(st, store, col, dim):
                 return None
             n = st.matrix.shape[0]
-            k2 = min(n, max(k * int(FLAGS.ann_oversample), 64))
-            nprobe = min(int(FLAGS.ann_nprobe), st.centroids.shape[0])
+            widen = max(1, int(FLAGS.ann_where_widen)) if filtered else 1
+            k2 = min(n, max(k * int(FLAGS.ann_oversample) * widen,
+                            64 * widen))
+            if filtered and 2 * k2 >= n:
+                return None     # pool ~ the table: brute force is exact
+            nprobe = min(int(FLAGS.ann_nprobe) * widen,
+                         st.centroids.shape[0])
             scores, idx = ivf_search_host(
                 np.asarray(qvec, np.float32), st.matrix, st.valid,
                 st.centroids, st.starts, st.counts, k2, nprobe, metric,

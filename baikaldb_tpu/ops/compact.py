@@ -21,6 +21,13 @@ def compact(batch: ColumnBatch) -> ColumnBatch:
         return batch
     if batch.sel is None:
         return batch
+    if batch.live_prefix:
+        # bucket-padded batches promise live rows already form a leading
+        # prefix (sel == arange < live), so the argsort+gather is the
+        # identity — just surface the count
+        n = batch.live_count()
+        return ColumnBatch(batch.names, batch.columns,
+                           jnp.arange(len(batch)) < n, n, live_prefix=True)
     sel = batch.sel
     n = jnp.sum(sel).astype(jnp.int32)
     order = jnp.argsort(~sel, stable=True)

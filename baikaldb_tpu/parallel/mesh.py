@@ -27,7 +27,7 @@ try:  # jax>=0.6 promotes shard_map out of experimental
 except (ImportError, AttributeError):  # pragma: no cover
     from jax.experimental.shard_map import shard_map  # type: ignore
 
-from ..column.batch import Column, ColumnBatch
+from ..column.batch import Column, ColumnBatch, bucket_capacity, pad_batch
 
 AXIS = "shard"
 
@@ -44,25 +44,25 @@ def pad_rows(batch: ColumnBatch, multiple: int) -> ColumnBatch:
     """Pad to a row-count multiple with dead rows (sel=False)."""
     n = len(batch)
     target = max(multiple, math.ceil(n / multiple) * multiple)
-    if target == n:
-        return batch if batch.sel is not None else batch.with_sel(
-            jnp.ones(n, dtype=bool))
-    pad = target - n
-    cols = []
-    for c in batch.columns:
-        data = jnp.concatenate([c.data, jnp.zeros((pad,), c.data.dtype)])
-        validity = None
-        if c.validity is not None:
-            validity = jnp.concatenate([c.validity, jnp.zeros((pad,), bool)])
-        cols.append(Column(data, validity, c.ltype, c.dictionary))
-    sel = jnp.concatenate([batch.sel_mask(), jnp.zeros((pad,), bool)])
-    return ColumnBatch(batch.names, cols, sel, None)
+    return pad_batch(batch, target)
 
 
 def shard_batch(batch: ColumnBatch, mesh: Mesh) -> ColumnBatch:
-    """Row-shard a batch across the mesh (device_put with NamedSharding)."""
+    """Row-shard a batch across the mesh (device_put with NamedSharding).
+
+    With ``FLAGS.batch_bucketing`` each per-device slice pads to a
+    power-of-two capacity bucket, so a sharded table growing inside one
+    bucket keeps the shard_map program's shapes (the single-device
+    executable-reuse story, per mesh device)."""
+    from ..utils.flags import FLAGS
+
     n = mesh.devices.size
-    b = pad_rows(batch, n)
+    if FLAGS.batch_bucketing:
+        per = -(-max(len(batch), 1) // n)
+        per = bucket_capacity(per, max(1, int(FLAGS.batch_bucket_min) // n))
+        b = pad_batch(batch, per * n)
+    else:
+        b = pad_rows(batch, n)
     sharding = NamedSharding(mesh, P(AXIS))
     cols = [Column(jax.device_put(c.data, sharding),
                    None if c.validity is None else jax.device_put(c.validity, sharding),

@@ -271,3 +271,40 @@ class ValuesNode(PlanNode):
 
     def _label(self):
         return f"Values({len(self.rows)} rows)"
+
+
+# -- plan fingerprinting ----------------------------------------------------
+
+# runtime-settled / display-only attributes: NOT part of what the executor
+# traces as a fixed program choice.  Caps settle through the overflow-retry
+# protocol (keeping an old plan keeps its settled caps — a feature);
+# presort_input is rebound per execution; access_desc is EXPLAIN text.
+_SIG_SKIP = frozenset({"children", "cap", "radix_width", "presort_input",
+                       "access_desc"})
+
+
+def _sig_value(v):
+    if isinstance(v, Expr):
+        return v.key()
+    if isinstance(v, (list, tuple)):
+        return tuple(_sig_value(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _sig_value(x)) for k, x in v.items()))
+    return repr(v)
+
+
+def plan_signature(node: PlanNode) -> tuple:
+    """Structural fingerprint of everything trace-relevant in a plan.
+
+    Two plans with equal signatures lower to the same XLA program for equal
+    input shapes, so the session's plan cache can replan on a table-version
+    bump (stats-derived choices — dense domains, key shifts — may be stale)
+    while KEEPING the compiled executables whenever the fresh plan came out
+    structurally identical.  That split — version gates the plan, capacity
+    bucket gates the executable — is what makes DML inside one capacity
+    bucket cost zero retraces."""
+    fields_sig = tuple(
+        (k, _sig_value(v)) for k, v in sorted(vars(node).items())
+        if k not in _SIG_SKIP)
+    return (type(node).__name__, fields_sig,
+            tuple(plan_signature(c) for c in node.children))

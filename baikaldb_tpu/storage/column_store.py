@@ -532,14 +532,30 @@ class TableStore:
 
         Built from the concatenated snapshot so every string column has ONE
         dictionary (regions sharing dictionaries is what lets per-region
-        partial aggregates merge by code).  Cached until any region mutates."""
+        partial aggregates merge by code).  Cached until any region mutates.
+
+        With ``FLAGS.batch_bucketing`` the batch pads to a power-of-two
+        capacity bucket (column/batch.bucket_capacity) with a dead-row tail
+        (``sel=False``), so DML that moves the row count inside one bucket
+        keeps the device shape — compiled executables scanning this table
+        stay valid and only a bucket crossing retraces."""
+        from ..column.batch import bucket_capacity, pad_batch
+        from ..utils.flags import FLAGS
+
         with self._lock:
             v = self.version
+            bucketing = bool(FLAGS.batch_bucketing)
+            key = (v, bucketing,
+                   int(FLAGS.batch_bucket_min) if bucketing else 0)
             if getattr(self, "_table_device", None) is not None and \
-                    getattr(self, "_table_device_version", -1) == v:
+                    getattr(self, "_table_device_key", None) == key:
                 return self._table_device
-            self._table_device = ColumnBatch.from_arrow(self.snapshot())
-            self._table_device_version = v
+            b = ColumnBatch.from_arrow(self.snapshot())
+            if bucketing:
+                b = pad_batch(b, bucket_capacity(
+                    len(b), int(FLAGS.batch_bucket_min)))
+            self._table_device = b
+            self._table_device_key = key
             return self._table_device
 
     def column_stats(self, column: str) -> dict:
@@ -742,6 +758,16 @@ class TableStore:
             cache[1][column] = entry
             return entry
 
+    def _perm_cache_key(self) -> tuple:
+        """Permutations are computed over the (flag-dependent) padded device
+        batch, so the bucket config joins the version in the cache key —
+        flipping batch_bucketing must not serve a wrong-length permutation
+        for the same version."""
+        from ..utils.flags import FLAGS
+
+        return (self.version, bool(FLAGS.batch_bucketing),
+                int(FLAGS.batch_bucket_min))
+
     def sort_permutation(self, cols: tuple) -> "np.ndarray":
         """Host-side permutation sorting the DEVICE-VISIBLE arrays of
         ``cols`` (last = secondary key), packed the way the join kernels
@@ -750,7 +776,7 @@ class TableStore:
         joins skip the on-device bitonic sort entirely (the reference
         reads pre-sorted secondary indexes from RocksDB the same way)."""
         with self._lock:
-            v = self.version
+            v = self._perm_cache_key()
             cache = getattr(self, "_perm_cache", None)
             if cache is None or cache[0] != v:
                 cache = (v, {})
@@ -777,7 +803,7 @@ class TableStore:
         an O(n) liveness partition instead of a multi-key bitonic sort.
         Cached per table version."""
         with self._lock:
-            v = self.version
+            v = self._perm_cache_key()
             cache = getattr(self, "_perm_cache", None)
             if cache is None or cache[0] != v:
                 cache = (v, {})
@@ -1353,6 +1379,14 @@ class TableStore:
             ops = [(0, kc.encode_one(rec), rc.encode(rec)) for rec in recs]
             sink = getattr(self, "binlog_sink", None)
             if sink is not None:
+                guard = getattr(self, "binlog_db", None)
+                if guard is not None and guard.binlog_retry:
+                    # queued CDC batches of earlier (txn-path) commits must
+                    # land before this autocommit event or the table's
+                    # stream reorders.  Best-effort: if the backend is
+                    # still down the drain stops and write_with_data below
+                    # fails the statement itself, so no event jumps ahead
+                    guard.drain_binlog_retry(sink)
                 # distributed binlog: the CDC event rides the data's own
                 # cross-tier 2PC — present iff the data committed
                 # (storage/binlog_regions, the region_binlog analog)

@@ -196,5 +196,12 @@ define("plan_cache_size", 256,
        "state_machine.cpp:1984); 0 disables caching")
 define("plan_cache_shapes", 8,
        "compiled executables kept per cached plan (distinct data shapes)")
+define("batch_bucketing", True,
+       "pad device table batches to power-of-two capacity buckets (with a "
+       "validity mask over the padded tail) so row-count changes inside one "
+       "bucket reuse compiled executables instead of retracing; 0 restores "
+       "exact-shape batches")
+define("batch_bucket_min", 1024,
+       "smallest capacity bucket for padded device table batches")
 define("ttl_interval_s", 60.0, "background TTL sweep period (store daemons)")
 define("heartbeat_interval_s", 3.0, "store->meta heartbeat period")

@@ -188,3 +188,15 @@ connections_total = Counter("connections_total")
 point_lookups = Counter("point_lookups")
 index_scans = Counter("index_scans")
 regions_pruned = Counter("regions_pruned")
+# XLA (re)traces of query programs: each count is one compile.  With capacity
+# bucketing on, an identical SELECT repeated across DML that stays inside one
+# bucket must not move this counter (tests/test_shape_buckets.py pins that).
+xla_retraces = Counter("xla_retraces")
+# wall time of executions that included a trace+compile (first run / bucket
+# crossing) — compare its percentiles against query_latency for the
+# steady-state-vs-first-run split
+compile_ms = LatencyRecorder("compile_ms")
+# distributed-binlog appends that failed and were queued for retry / dropped
+# after the retry queue overflowed (counted in EVENTS, not batches)
+binlog_retry_queued = Counter("binlog_retry_queued")
+binlog_events_dropped = Counter("binlog_events_dropped")

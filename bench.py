@@ -300,6 +300,105 @@ def run_bench() -> dict:
     return result
 
 
+def run_mixed_bench() -> dict:
+    """Mixed read/write steady state (the capacity-bucketing headline):
+    one SELECT repeated across interleaved single-row INSERTs.
+
+    Without capacity buckets every insert changes the scan's device shape,
+    so the cached plan retraces+recompiles per statement and compile time
+    dominates; with buckets (the default) the executable is reused until a
+    power-of-two boundary.  Reports steady-state scanned rows/sec with
+    bucketing on, the per-query speedup over bucketing off, and the retrace
+    counts observed in each phase."""
+    import pyarrow as pa
+
+    from baikaldb_tpu.exec.session import Session
+    from baikaldb_tpu.utils import metrics as _m
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    n_rows = int(os.environ.get("BENCH_MIXED_ROWS", 60_000))
+    iters = int(os.environ.get("BENCH_MIXED_ITERS", 24))
+    off_iters = int(os.environ.get("BENCH_MIXED_OFF_ITERS", 6))
+    rng = np.random.default_rng(11)
+    base = pa.table({
+        "id": np.arange(n_rows, dtype=np.int64),
+        "g": rng.integers(0, 16, n_rows).astype(np.int64),
+        "v": rng.normal(size=n_rows).astype(np.float64),
+    })
+    q = ("SELECT g, COUNT(*) AS n, SUM(v) AS sv FROM mx "
+         "WHERE v > 0.25 GROUP BY g ORDER BY g")
+
+    def phase(bucketing: bool, its: int):
+        set_flag("batch_bucketing", bucketing)
+        s = Session()
+        s.execute("CREATE TABLE mx (id BIGINT, g BIGINT, v DOUBLE)")
+        s.load_arrow("mx", base)
+        s.execute(q)                      # plan + first compile
+        s.execute(q)
+        r0 = _m.xla_retraces.value
+        t0 = time.perf_counter()
+        for i in range(its):
+            s.execute(f"INSERT INTO mx VALUES ({n_rows + i}, {i % 16}, 0.5)")
+            s.execute(q)
+        return (time.perf_counter() - t0, _m.xla_retraces.value - r0)
+
+    prev = bool(FLAGS.batch_bucketing)
+    try:
+        on_dt, on_retraces = phase(True, iters)
+        off_dt, off_retraces = phase(False, off_iters)
+    finally:
+        set_flag("batch_bucketing", prev)
+    on_per_query = on_dt / iters
+    off_per_query = off_dt / off_iters
+    platform = None
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:                                   # noqa: BLE001
+        pass
+    return {
+        "metric": f"mixed read/write steady-state rows/sec "
+                  f"({n_rows / 1e3:.0f}k rows, {platform})",
+        "value": round(n_rows * iters / on_dt, 1),
+        "unit": "rows/sec",
+        "vs_baseline": round(off_per_query / on_per_query, 3),
+        "platform": platform,
+        "rows": n_rows,
+        "queries": iters,
+        "per_query_ms": round(on_per_query * 1e3, 2),
+        "per_query_ms_unbucketed": round(off_per_query * 1e3, 2),
+        "xla_retraces_bucketed": on_retraces,
+        "xla_retraces_unbucketed": off_retraces,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
+def _emit_mixed_line(skip_reason: str | None = None):
+    """Second JSON line: the mixed read/write steady-state metric (recompile
+    overhead across rounds).  Same robustness contract as the headline —
+    always prints a line, never raises.  ``skip_reason``: emit a failure
+    line WITHOUT touching the backend (a wedged accelerator must not be
+    poked from this process)."""
+    if os.environ.get("BENCH_SKIP_MIXED") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "mixed read/write steady-state rows/sec (skipped)",
+            "value": 0, "unit": "rows/sec", "vs_baseline": 0.0,
+            "platform": "none", "error": skip_reason}))
+        return
+    try:
+        result = run_mixed_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "mixed read/write steady-state rows/sec (failed)",
+                  "value": 0, "unit": "rows/sec", "vs_baseline": 0.0,
+                  "platform": "none",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def main():
     forced = os.environ.get(_FORCED_FLAG) == "1"
     no_fallback = os.environ.get("BENCH_NO_CPU_FALLBACK") == "1"
@@ -315,6 +414,9 @@ def main():
                              "end-of-round accelerator probe failed; "
                              "emitting on-chip result cached at "
                              f"{cached.get('captured_at')}")
+                # never touch the wedged backend from this process
+                _emit_mixed_line(skip_reason="accelerator probe failed; "
+                                 "mixed phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -348,8 +450,10 @@ def main():
                          "accelerator unavailable at round end; emitting "
                          f"on-chip result cached at "
                          f"{cached.get('captured_at')}", cpu_result=result)
+            _emit_mixed_line()      # backend already ran here: measure
             return 0
     print(json.dumps(result))
+    _emit_mixed_line()
     return 0
 
 

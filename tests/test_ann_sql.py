@@ -106,6 +106,28 @@ def test_ann_where_filter_composes():
     assert all(i >= 1000 for i in got) and len(got) == 5
 
 
+def test_ann_selective_where_still_fills_limit():
+    """A HIGHLY selective WHERE (1% of rows) must not silently return fewer
+    than LIMIT rows: the filter re-applies after candidate reduction, so the
+    engine widens the pool by ann_where_widen — and when the widened pool
+    approaches the table it falls back to the exact brute-force scan
+    (ADVICE r5 medium)."""
+    rng = np.random.RandomState(17)
+    vecs = rng.randn(1000, 4).astype(np.float32)
+    s = Session(Database())
+    s.execute("CREATE TABLE vt (id BIGINT, v VECTOR(4), ANN INDEX a (v))")
+    _load(s, vecs)
+    q = vecs[5]
+    got = [r["id"] for r in s.query(
+        f"SELECT id FROM vt WHERE id >= 990 ORDER BY "
+        f"l2_distance(v, '{_vec_lit(q)}') LIMIT 8")]
+    assert len(got) == 8 and all(i >= 990 for i in got)
+    # and the result must be the EXACT filtered top-8
+    d = ((vecs[990:] - q) ** 2).sum(axis=1)
+    want = [990 + int(i) for i in np.argsort(d, kind="stable")[:8]]
+    assert got == want
+
+
 def test_ann_small_table_falls_back_to_brute_force():
     set_flag("ann_min_rows", 4096)
     s = Session(Database())

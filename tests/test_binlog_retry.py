@@ -1,0 +1,113 @@
+"""Committed-txn CDC must survive a flaky distributed-binlog backend.
+
+``_flush_txn_binlog`` used to swallow every append exception — a committed
+transaction's CDC events vanished silently.  Now failures queue on the
+Database and retry on later flushes, per-table order is preserved, and only
+a bounded-queue overflow drops events (counted in
+metrics.binlog_events_dropped).
+"""
+
+from baikaldb_tpu.exec.session import Session
+from baikaldb_tpu.utils import metrics
+
+
+class FlakyDist:
+    def __init__(self):
+        self.fail = True
+        self.appended = []
+
+    def append(self, table_key, events):
+        if self.fail:
+            raise RuntimeError("binlog backend down")
+        self.appended.append((table_key, list(events)))
+
+    def write_with_data(self, tier, ops, table_key, events):
+        # the autocommit path: CDC rides the data write
+        if self.fail:
+            raise RuntimeError("binlog backend down")
+        tier.write_ops(ops)
+        self.appended.append(("autocommit:" + table_key, list(events)))
+
+
+def _binlogged_session():
+    s = Session()
+    s.execute("CREATE TABLE bl (id BIGINT PRIMARY KEY, v DOUBLE) BINLOG=1")
+    # stand in for the daemon plane: a cluster handle + a fake dist writer
+    s.db.cluster = object()
+    s.db._dist_binlog = FlakyDist()
+    return s, s.db._dist_binlog
+
+
+def test_failed_append_queues_and_retries():
+    s, dist = _binlogged_session()
+    q0 = metrics.binlog_retry_queued.value
+    s.execute("BEGIN")
+    s.execute("INSERT INTO bl VALUES (1, 1.0)")
+    s.execute("COMMIT")                       # append fails -> queued
+    assert len(s.db.binlog_retry) == 1
+    assert metrics.binlog_retry_queued.value > q0
+    assert dist.appended == []
+
+    dist.fail = False
+    s.execute("BEGIN")                        # empty commit still drains
+    s.execute("COMMIT")
+    assert len(s.db.binlog_retry) == 0
+    assert len(dist.appended) == 1
+    assert dist.appended[0][0] == "default.bl"
+
+
+def test_order_preserved_while_backend_down():
+    s, dist = _binlogged_session()
+    for i in range(3):
+        s.execute("BEGIN")
+        s.execute(f"INSERT INTO bl VALUES ({10 + i}, {float(i)})")
+        s.execute("COMMIT")
+    assert len(s.db.binlog_retry) == 3        # all queued, none reordered
+    dist.fail = False
+    s.execute("BEGIN")
+    s.execute("INSERT INTO bl VALUES (99, 9.0)")
+    s.execute("COMMIT")                       # drains queue THEN appends new
+    assert len(dist.appended) == 4
+    # the queued batches replay in commit order, the fresh one last
+    assert [tk for tk, _ in dist.appended] == ["default.bl"] * 4
+
+
+def test_autocommit_drains_queue_first():
+    """An autocommit CDC append must not jump ahead of queued (failed)
+    txn batches for the same table — the store drains the retry queue
+    before its own event rides the data write."""
+    s, dist = _binlogged_session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO bl VALUES (1, 1.0)")
+    s.execute("COMMIT")                       # backend down -> queued
+    assert len(s.db.binlog_retry) == 1
+
+    class FakeTier:
+        def write_ops(self, ops):
+            pass
+
+        def alloc_rowids(self, n, floor=0):
+            return floor
+
+    store = s.db.stores["default.bl"]
+    store.replicated = FakeTier()
+    store.binlog_sink = dist
+    store.binlog_db = s.db
+    dist.fail = False
+    s.execute("INSERT INTO bl VALUES (2, 2.0)")   # autocommit CDC
+    # queued txn batch landed FIRST, then the autocommit event
+    assert [tk for tk, _ in dist.appended] == \
+        ["default.bl", "autocommit:default.bl"]
+    assert len(s.db.binlog_retry) == 0
+
+
+def test_overflow_drops_are_counted(monkeypatch):
+    s, dist = _binlogged_session()
+    monkeypatch.setattr(s.db, "_BINLOG_RETRY_MAX", 2)
+    d0 = metrics.binlog_events_dropped.value
+    for i in range(4):
+        s.execute("BEGIN")
+        s.execute(f"INSERT INTO bl VALUES ({20 + i}, 0.5)")
+        s.execute("COMMIT")
+    assert len(s.db.binlog_retry) == 2        # bounded
+    assert metrics.binlog_events_dropped.value > d0

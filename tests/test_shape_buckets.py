@@ -1,0 +1,177 @@
+"""Shape-bucketed execution (capacity buckets, ISSUE 1 tentpole).
+
+Device table batches pad to power-of-two capacity buckets with a dead-row
+tail, so DML that moves a table's row count INSIDE one bucket reuses every
+compiled executable (zero XLA retraces) and only a bucket crossing retraces
+— exactly once.  The padded tail must be provably inert: every query answer
+over a padded batch is bit-identical to the unbucketed (batch_bucketing=0)
+path.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from baikaldb_tpu.column.batch import bucket_capacity
+from baikaldb_tpu.exec.session import Session
+from baikaldb_tpu.utils import metrics
+from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+
+@pytest.fixture(autouse=True)
+def _small_buckets():
+    """Small buckets so crossings are cheap to construct; restore after."""
+    prev = bool(FLAGS.batch_bucketing)
+    prev_min = int(FLAGS.batch_bucket_min)
+    set_flag("batch_bucketing", True)
+    set_flag("batch_bucket_min", 64)
+    yield
+    set_flag("batch_bucketing", prev)
+    set_flag("batch_bucket_min", prev_min)
+
+
+def _mk_session(n=50):
+    s = Session()
+    s.execute("CREATE TABLE bt (id BIGINT, g VARCHAR(8), v DOUBLE)")
+    s.execute("INSERT INTO bt VALUES " +
+              ",".join(f"({i},'g{i % 3}',{i * 1.5})" for i in range(n)))
+    return s
+
+
+GROUP_Q = "SELECT g, COUNT(*) AS n, SUM(v) AS sv FROM bt GROUP BY g ORDER BY g"
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 1
+    assert bucket_capacity(1) == 1
+    assert bucket_capacity(3) == 4
+    assert bucket_capacity(1024) == 1024
+    assert bucket_capacity(1025) == 2048
+    assert bucket_capacity(5, minimum=64) == 64
+    assert bucket_capacity(100, minimum=64) == 128
+
+
+def test_no_retrace_within_bucket():
+    s = _mk_session(50)                       # bucket 64
+    s.execute(GROUP_Q)
+    s.execute(GROUP_Q)                        # warm: plan + executable cached
+    before = metrics.xla_retraces.value
+    rows = None
+    for i in range(8):                        # 50 -> 58 rows, still bucket 64
+        s.execute(f"INSERT INTO bt VALUES ({100 + i}, 'g0', 1.0)")
+        rows = s.query(GROUP_Q)
+    assert metrics.xla_retraces.value == before, \
+        "row-count changes inside one capacity bucket must not retrace"
+    # the reused executable must still read the NEW data
+    assert sum(r["n"] for r in rows) == 58
+
+
+def test_bucket_crossing_retraces_exactly_once():
+    s = _mk_session(60)                       # bucket 64
+    s.execute(GROUP_Q)
+    s.execute(GROUP_Q)
+    # cross 64: 60 -> 70 rows -> bucket 128
+    s.execute("INSERT INTO bt VALUES " +
+              ",".join(f"({200 + i},'g1',2.0)" for i in range(10)))
+    before = metrics.xla_retraces.value
+    s.execute(GROUP_Q)
+    assert metrics.xla_retraces.value == before + 1, \
+        "a bucket crossing must retrace exactly once"
+    before = metrics.xla_retraces.value
+    rows = s.query(GROUP_Q)
+    assert metrics.xla_retraces.value == before, \
+        "steady state after the crossing must not retrace"
+    assert sum(r["n"] for r in rows) == 70
+
+
+def test_compile_metrics_surface():
+    s = _mk_session(10)
+    s.execute(GROUP_Q)
+    assert metrics.compile_ms.stats()["count"] >= 1
+    got = s.query("SELECT name, field, value FROM information_schema.metrics "
+                  "WHERE name = 'xla_retraces' AND field = 'value'")
+    assert got and got[0]["value"] >= 1
+
+
+def test_explain_analyze_shows_buckets():
+    s = _mk_session(10)
+    txt = "\n".join(r["plan"] for r in
+                    s.query("EXPLAIN ANALYZE " + GROUP_Q))
+    assert "capacity=64" in txt
+    assert "live=10" in txt
+    assert "retraces_total=" in txt
+
+
+# -- padded-tail inertness: bucketed answers == unbucketed answers ----------
+
+PADDED_QUERIES = [
+    "SELECT COUNT(*) AS c FROM bt",
+    "SELECT COUNT(v) AS c, SUM(v) AS s, AVG(v) AS a, MIN(v) AS mn, "
+    "MAX(v) AS mx FROM bt",
+    GROUP_Q,
+    "SELECT id, v FROM bt WHERE v > 30 ORDER BY v DESC, id LIMIT 7",
+    "SELECT g, COUNT(DISTINCT id) AS d FROM bt GROUP BY g ORDER BY g",
+    "SELECT a.id, b.id AS bid FROM bt a JOIN bt b ON a.id = b.id "
+    "WHERE a.v > 10 ORDER BY a.id LIMIT 9",
+    "SELECT bt.id, r.label FROM bt LEFT JOIN r ON bt.g = r.g "
+    "ORDER BY bt.id LIMIT 11",
+    "SELECT id FROM bt WHERE g IN (SELECT g FROM r) ORDER BY id",
+    "SELECT DISTINCT g FROM bt ORDER BY g",
+]
+
+
+def _answers(bucketing: bool):
+    set_flag("batch_bucketing", bucketing)
+    s = _mk_session(45)
+    s.execute("CREATE TABLE r (g VARCHAR(8), label VARCHAR(16))")
+    s.execute("INSERT INTO r VALUES ('g0','zero'),('g1','one')")
+    # NULLs in play: the padded tail must not be confused with NULL rows
+    s.execute("INSERT INTO bt VALUES (900, NULL, NULL)")
+    return [s.query(q) for q in PADDED_QUERIES]
+
+
+def test_padded_tail_inert():
+    got = _answers(True)
+    want = _answers(False)
+    for q, g, w in zip(PADDED_QUERIES, got, want):
+        assert g == w, f"bucketed result differs for: {q}\n{g}\nvs\n{w}"
+
+
+def test_empty_table_padded():
+    s = Session()
+    s.execute("CREATE TABLE e (id BIGINT, v DOUBLE)")
+    assert s.execute("SELECT COUNT(*) FROM e").scalar() == 0
+    assert s.query("SELECT id FROM e WHERE v > 0") == []
+    s.execute("INSERT INTO e VALUES (1, 2.0)")
+    assert s.execute("SELECT COUNT(*) FROM e").scalar() == 1
+
+
+def test_off_switch_restores_exact_shapes():
+    set_flag("batch_bucketing", False)
+    s = _mk_session(50)
+    from baikaldb_tpu.storage.column_store import TableStore  # noqa: F401
+    store = s.db.stores["default.bt"]
+    b = store.device_table_batch()
+    assert len(b) == 50 and b.sel is None
+
+    set_flag("batch_bucketing", True)
+    b = store.device_table_batch()        # flag flip invalidates the cache
+    assert len(b) == 64 and b.sel is not None
+    assert int(np.asarray(b.sel).sum()) == 50
+    assert b.live_prefix
+
+
+def test_mixed_insert_select_correctness_across_buckets():
+    """March a table across two bucket boundaries with interleaved reads;
+    every read must see exactly the rows inserted so far."""
+    s = Session()
+    s.execute("CREATE TABLE m (id BIGINT, v DOUBLE)")
+    total = 0
+    q = "SELECT COUNT(*) AS c, SUM(v) AS s FROM m"
+    for step in range(30):                # 30*5 = 150 rows: crosses 64, 128
+        s.execute("INSERT INTO m VALUES " + ",".join(
+            f"({total + j}, {float(total + j)})" for j in range(5)))
+        total += 5
+        row = s.query(q)[0]
+        assert row["c"] == total
+        assert row["s"] == float(total * (total - 1) // 2)
