@@ -1,0 +1,19 @@
+"""tpulint — engine-specific static analysis for trace/transfer hygiene.
+
+The TPU query engine lives or dies by three invariants a Python reader
+cannot see locally (PAPERS.md: "Query Processing on Tensor Computation
+Runtimes" — tensor-runtime engines keep data-dependent control flow and
+host round-trips out of compiled paths):
+
+- no silent host syncs on the hot path (HOSTSYNC),
+- no trace-key churn / data-dependent shapes outside the sel-mask
+  machinery (RETRACE, TRACERLEAK),
+- a cycle-free, sync-free lock discipline (LOCKORDER),
+
+plus BAREEXC for swallow-all exception handlers.  ``lint.run_lint`` drives
+the per-file rules (rules.py, over the taint engine in taint.py) and the
+package-wide lock-graph pass (locks.py); ``tools/tpulint.py`` is the CLI
+and ``tests/test_lint.py`` pins the tree at zero violations.
+"""
+
+from .lint import LintConfig, Violation, run_lint  # noqa: F401

@@ -1,0 +1,178 @@
+"""Per-file rule drivers: taint-based HOSTSYNC/RETRACE/TRACERLEAK plus the
+syntactic BAREEXC and jit-misuse RETRACE checks.
+
+Scope model: a function is *traced scope* when it is jit-decorated (directly
+or through ``functools.partial(jax.jit, ...)``) or lives in a configured hot
+module (the modules whose functions execute inside ``compile_plan``'s
+traces).  Traced scope arms the traced-only sinks (device_get /
+block_until_ready / data-dependent shapes / tracer leaks); the implicit
+conversion sinks (``int()``/``np.asarray``/``.item()``) fire everywhere.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from .taint import FunctionTaint, ModuleIndex
+
+
+@dataclass(frozen=True)
+class RawViolation:
+    rule: str
+    line: int
+    col: int
+    msg: str
+    qualname: str
+
+
+def _decorator_paths(fnode, mi: ModuleIndex):
+    for d in fnode.decorator_list:
+        yield d, mi.resolve(d.func if isinstance(d, ast.Call) else d)
+
+
+def is_jit_decorated(fnode, mi: ModuleIndex) -> bool:
+    for d, path in _decorator_paths(fnode, mi):
+        if path is None:
+            continue
+        if "jax.jit" in path or path.endswith("pallas_call") or \
+                path.endswith("pjit"):
+            return True
+        if path.endswith("partial") and isinstance(d, ast.Call) and d.args:
+            first = mi.resolve(d.args[0])
+            if first is not None and ("jax.jit" in first or
+                                      first.endswith("pjit")):
+                return True
+    return False
+
+
+def _static_argnames(fnode, mi: ModuleIndex) -> set[str]:
+    """Names marked static on a jit decorator (hashability matters there)."""
+    names: set[str] = set()
+    for d, path in _decorator_paths(fnode, mi):
+        if not isinstance(d, ast.Call) or path is None:
+            continue
+        if not (path.endswith("partial") or "jit" in path):
+            continue
+        for kw in d.keywords:
+            if kw.arg == "static_argnames" and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                names.update(el.value for el in kw.value.elts
+                             if isinstance(el, ast.Constant))
+            if kw.arg == "static_argnames" and isinstance(
+                    kw.value, ast.Constant):
+                names.add(kw.value.value)
+    return names
+
+
+class _JitMisuse(ast.NodeVisitor):
+    """RETRACE: jit caches defeated at the call site — a fresh jit wrapper
+    per loop iteration / an immediately-invoked jit both recompile every
+    execution; unhashable defaults on static params fail the cache key."""
+
+    def __init__(self, mi: ModuleIndex, report):
+        self.mi = mi
+        self.report = report
+        self.loop_depth = 0
+
+    def visit_For(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_While = visit_For
+
+    def visit_Call(self, node):
+        path = self.mi.resolve(node.func)
+        if path is not None and path.endswith("jax.jit"):
+            if self.loop_depth:
+                self.report("RETRACE", node,
+                            "jax.jit inside a loop builds a fresh compile "
+                            "cache every iteration — hoist and reuse")
+            if isinstance(node.func, ast.Attribute) or \
+                    isinstance(node.func, ast.Name):
+                pass
+        # jax.jit(f)(args): the wrapper (and its cache) dies immediately
+        if isinstance(node.func, ast.Call):
+            inner = self.mi.resolve(node.func.func)
+            if inner is not None and inner.endswith("jax.jit"):
+                self.report("RETRACE", node,
+                            "immediately-invoked jax.jit(f)(...) recompiles "
+                            "on every call — cache the jitted callable")
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        if is_jit_decorated(node, self.mi):
+            static = _static_argnames(node, self.mi)
+            args = node.args
+            pos = [*args.posonlyargs, *args.args]
+            defaults = args.defaults
+            for arg, default in zip(pos[len(pos) - len(defaults):], defaults):
+                if arg.arg in static and isinstance(
+                        default, (ast.List, ast.Dict, ast.Set)):
+                    self.report("RETRACE", default,
+                                f"static arg {arg.arg!r} has an unhashable "
+                                "default: every call misses the jit cache")
+            for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None and arg.arg in static and isinstance(
+                        default, (ast.List, ast.Dict, ast.Set)):
+                    self.report("RETRACE", default,
+                                f"static arg {arg.arg!r} has an unhashable "
+                                "default: every call misses the jit cache")
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _BareExc(ast.NodeVisitor):
+    """BAREEXC: handlers that swallow everything.  A bare ``except:`` (or
+    ``except BaseException:``) traps KeyboardInterrupt/SystemExit; an
+    ``except Exception: pass`` hides real failures from operators — narrow
+    the type, or count it in metrics so the swallow is observable."""
+
+    def __init__(self, mi: ModuleIndex, report):
+        self.mi = mi
+        self.report = report
+
+    def visit_ExceptHandler(self, node):
+        reraises = any(isinstance(n, ast.Raise)
+                       for n in ast.walk(ast.Module(body=node.body,
+                                                    type_ignores=[])))
+        path = None if node.type is None else self.mi.resolve(node.type)
+        broad = node.type is None or (
+            path is not None and path.endswith("BaseException"))
+        swallowed = len(node.body) == 1 and isinstance(
+            node.body[0], (ast.Pass, ast.Continue))
+        if broad and not reraises:
+            # cleanup-then-reraise unwind blocks legitimately catch
+            # BaseException; SWALLOWING one traps KeyboardInterrupt/SystemExit
+            self.report("BAREEXC", node,
+                        "swallowed bare/BaseException handler traps "
+                        "KeyboardInterrupt/SystemExit — catch Exception "
+                        "(or narrower), or re-raise")
+        elif swallowed and path is not None and path.endswith("Exception"):
+            self.report("BAREEXC", node,
+                        "except Exception: pass swallows every failure "
+                        "invisibly — narrow the type or count it in "
+                        "metrics")
+        self.generic_visit(node)
+
+
+def lint_tree(tree: ast.AST, hot_module: bool, report) -> None:
+    """Run all per-file rules over one parsed module.
+
+    ``report(rule, node, msg)`` receives every raw finding (suppression is
+    the driver's job)."""
+    mi = ModuleIndex(tree)
+    _JitMisuse(mi, report).visit(tree)
+    _BareExc(mi, report).visit(tree)
+
+    def walk_defs(body, in_class: bool):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                traced = hot_module or is_jit_decorated(node, mi)
+                FunctionTaint(node, mi, traced, report).run()
+            elif isinstance(node, ast.ClassDef):
+                walk_defs(node.body, True)
+
+    walk_defs(tree.body, False)

@@ -1,0 +1,177 @@
+"""Runtime enforcement of the statically-linted invariants (debug_guards).
+
+tpulint proves the *source* clean; this module catches what static analysis
+cannot see — dynamically-dispatched host syncs and lock acquisitions — by
+arming two guards when the ``debug_guards`` flag is "log" or "disallow":
+
+- ``hot_path_guard()`` wraps compiled-plan execution in a
+  ``jax.transfer_guard_device_to_host`` scope: any implicit device->host
+  transfer inside the hot path (a stray ``int(x)`` / ``np.asarray``) logs or
+  raises instead of silently stalling the pipeline.  Host->device constant
+  uploads stay allowed — they are part of tracing.
+- ``GuardedLock`` is a drop-in threading.Lock/RLock whose acquisitions
+  assert the statically-derived lock ORDER (tools/tpulint.py --lock-order):
+  every lock carries a rank, and acquiring a lower/equal rank while holding
+  a higher one is an inversion — the dynamic half of LOCKORDER.
+  tests/test_lint.py cross-checks the declared ranks against the static
+  acquisition graph, so the two layers cannot drift apart.
+
+Trips surface in ``metrics`` (``guard_transfer_trips`` /
+``guard_lock_trips``) and on the EXPLAIN ANALYZE ``-- guards:`` line.
+
+CPU caveat: on the CPU backend device->host reads are zero-copy views, so
+jax's transfer guard never fires there — the transfer half of debug_guards
+is a no-op under JAX_PLATFORMS=cpu and bites on real accelerators, which is
+exactly where the sync costs a round-trip.  The lock half is
+backend-independent.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from ..utils import metrics
+from ..utils.flags import FLAGS, define
+
+define("debug_guards", "off",
+       "runtime trace/transfer/lock guards on the hot path: off | log "
+       "(transfers logged by jax to stderr, lock trips counted) | disallow "
+       "(fail the query/acquisition; trips counted) — the dynamic half of "
+       "tools/tpulint.py")
+
+guard_transfer_trips = metrics.Counter("guard_transfer_trips")
+guard_lock_trips = metrics.Counter("guard_lock_trips")
+
+# the flag is re-read on every lock acquisition of the hottest paths:
+# cache the resolved mode and refresh through the flag listener instead
+_MODE = "off"
+
+
+def _refresh_mode(value=None) -> None:
+    global _MODE
+    mode = str(FLAGS.debug_guards if value is None else value).lower()
+    _MODE = mode if mode in ("log", "disallow") else "off"
+
+
+_refresh_mode()
+FLAGS.on_change("debug_guards", _refresh_mode)
+
+
+def guard_mode() -> str:
+    return _MODE
+
+
+@contextmanager
+def hot_path_guard():
+    """Execution scope for compiled query programs: no implicit
+    device->host transfer may happen inside.  Egress/flag reads belong
+    AFTER this scope, spelled ``jax.device_get``."""
+    mode = guard_mode()
+    if mode == "off":
+        yield
+        return
+    import jax
+
+    # log mode defers to jax's own stderr logging (the C++ guard offers no
+    # python hook to count), so guard_transfer_trips only moves in
+    # disallow mode — where the failed query makes the trip loud anyway
+    try:
+        with jax.transfer_guard_device_to_host(
+                "log" if mode == "log" else "disallow"):
+            yield
+    except Exception as e:
+        if "transfer" in str(e).lower():
+            guard_transfer_trips.add(1)
+        raise
+
+
+# declared lock ranks, validated against the static graph by
+# tests/test_lint.py (every static edge A->B must have rank[A] < rank[B])
+LOCK_RANKS: dict[str, int] = {}
+
+
+class GuardedLock:
+    """threading.Lock/RLock + rank-ordered acquisition assertion.
+
+    With debug_guards off, acquire() is one module-global read plus the
+    underlying C lock — no stack bookkeeping, no flag parse.  Arming the
+    flag mid-hold therefore starts with an empty view of already-held
+    locks (checks engage on the next full acquisition chain); that
+    best-effort window is the price of a zero-cost production path."""
+
+    _tls = threading.local()
+
+    def __init__(self, name: str, rank: int, reentrant: bool = False):
+        self._lk = threading.RLock() if reentrant else threading.Lock()
+        self.name = name
+        self.rank = rank
+        LOCK_RANKS[name] = rank
+
+    @classmethod
+    def _stack(cls) -> list:
+        st = getattr(cls._tls, "stack", None)
+        if st is None:
+            st = cls._tls.stack = []
+        return st
+
+    def _check_order(self) -> None:
+        st = self._stack()
+        # re-entering a lock this thread ALREADY holds is always safe
+        # (RLock semantics) even if higher-rank locks were taken since
+        if self in st:
+            return
+        # strict >: same-rank locks (two tables' store locks) may nest
+        # freely — give locks DISTINCT ranks when their order matters
+        if st and st[-1].rank > self.rank:
+            guard_lock_trips.add(1)
+            msg = (f"lock order violation: acquiring {self.name} "
+                   f"(rank {self.rank}) while holding {st[-1].name} "
+                   f"(rank {st[-1].rank}) — the static order "
+                   "(tools/tpulint.py --lock-order) forbids this nesting")
+            if _MODE == "disallow":
+                raise RuntimeError(msg)
+            import sys
+            print(f"tpulint-guard: {msg}", file=sys.stderr)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _MODE == "off":      # production fast path: no bookkeeping
+            return self._lk.acquire(blocking, timeout)
+        self._check_order()
+        ok = self._lk.acquire(blocking, timeout)
+        if ok:
+            self._stack().append(self)
+        return ok
+
+    def release(self) -> None:
+        if _MODE != "off":
+            st = self._stack()
+            if st and st[-1] is self:
+                st.pop()
+            elif self in st:    # out-of-order release: still unwind
+                st.remove(self)
+        elif getattr(self._tls, "stack", None):
+            # flag flipped off mid-hold: drain stale entries lazily
+            st = self._tls.stack
+            if self in st:
+                st.remove(self)
+        self._lk.release()
+
+    def __enter__(self) -> "GuardedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        lk = self._lk
+        return lk.locked() if hasattr(lk, "locked") else False
+
+
+def guard_stats() -> dict:
+    """The EXPLAIN ANALYZE / SHOW METRICS payload."""
+    return {"mode": guard_mode(),
+            "transfer_trips": guard_transfer_trips.value,
+            "lock_trips": guard_lock_trips.value}

@@ -1,0 +1,578 @@
+"""Device-value taint analysis (the core of HOSTSYNC / RETRACE / TRACERLEAK).
+
+A lightweight intra-function dataflow pass over the AST: values produced by
+``jnp.*`` / ``jax.lax.*`` calls, ``.data`` / ``.validity`` / ``.sel``
+attribute reads, and ``sel_mask()`` / ``valid_mask()`` / ``live_count()``
+method calls are *device values* (tracers under jit).  Taint propagates
+through arithmetic, comparisons, subscripts and helper calls; sinks are the
+places a device value crosses back to the host:
+
+- ``int(x)`` / ``float(x)`` / ``bool(x)`` on a device value    -> HOSTSYNC
+- ``np.asarray(x)`` / any ``np.*`` call on a device value      -> HOSTSYNC
+- ``x.item()`` / ``x.tolist()``                                -> HOSTSYNC
+- ``jax.device_get`` / ``block_until_ready`` in traced scope   -> HOSTSYNC
+- ``if`` / ``while`` / ternary / ``and`` / ``or`` on a device
+  value (data-dependent control flow)                          -> RETRACE
+- iterating a device array (python loop unroll)                -> RETRACE
+- ``jnp.nonzero``-family without ``size=`` in traced scope
+  (data-dependent output shape)                                -> RETRACE
+- boolean-mask subscripts in traced scope                      -> RETRACE
+- storing a device value on ``self`` / an object attribute /
+  a ``global`` from traced scope                               -> TRACERLEAK
+
+"Traced scope" = functions the engine jit-traces: anything decorated with
+``jax.jit`` (directly or through ``functools.partial``) plus every function
+in the configured hot modules (ops/, parallel/, column/, exec/executor.py,
+expr compile layer).  Host-only sinks (the sanctioned ``jax.device_get``
+spelling) only fire inside traced scope; implicit-conversion sinks fire
+everywhere — a host-side ``int(device_scalar)`` is still a blocking
+round-trip per call site.
+
+The pass is deliberately conservative-but-quiet: taint starts only from the
+explicit device sources above, so host-side planner/catalog code stays
+silent without per-file configuration.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+
+# attributes that read host metadata off device containers (never tracers)
+HOST_ATTRS = {
+    "dtype", "shape", "ndim", "size", "ltype", "names", "name", "columns",
+    "num_rows", "live_prefix", "dictionary", "values", "kind", "at",
+    "weak_type", "aval",
+}
+# engine attributes that ARE device arrays (column/batch.py containers)
+DEVICE_ATTRS = {"data", "validity", "sel"}
+# engine methods returning device values
+DEVICE_METHODS = {"sel_mask", "valid_mask", "live_count"}
+# device-array methods that stay on device
+_ARRAY_METHODS = {
+    "astype", "sum", "any", "all", "max", "min", "mean", "reshape", "ravel",
+    "take", "clip", "cumsum", "argmax", "argmin", "transpose", "squeeze",
+    "flatten", "round", "view", "bit_length",
+}
+# jnp/lax ops whose output shape depends on data unless size= is given
+_DDSHAPE_FNS = {"nonzero", "flatnonzero", "argwhere", "unique",
+                "unique_values", "extract", "compress"}
+# jnp/jax functions that return HOST metadata (dtype/shape predicates) —
+# calling them is not a device computation
+_JNP_HOST_FNS = {"issubdtype", "isdtype", "iinfo", "finfo", "result_type",
+                 "promote_types", "can_cast", "ndim", "shape", "size",
+                 "dtype", "isscalar"}
+# engine methods that build device-value containers even off a host object
+# (store/table handles): the result's .data/.sel re-taint downstream
+_CONTAINER_METHODS = {"device_table_batch", "from_arrow", "gather",
+                      "and_sel", "rename"}
+
+# namespace roots whose calls produce device values
+_DEVICE_CALL_ROOTS = ("jax.numpy.", "jax.lax.", "jax.nn.", "jax.random.",
+                      "jax.ops.", "jax.scipy.")
+# jax entry points that RETURN HOST data / callables (not device values)
+_JAX_HOST_FNS = {
+    "jax.device_get", "jax.block_until_ready", "jax.jit", "jax.vmap",
+    "jax.pmap", "jax.grad", "jax.eval_shape", "jax.devices",
+    "jax.local_devices", "jax.device_count", "jax.default_backend",
+    "jax.transfer_guard", "jax.checking_leaks", "jax.debug_nans",
+}
+# engine constructors: results are device-value CONTAINERS, not arrays
+_CONTAINER_CTORS = {"ColumnBatch", "Column", "dreplace", "replace"}
+
+
+@dataclass(frozen=True)
+class T:
+    """Taint value: ``array`` = is (or may be) a device array / tracer;
+    ``container`` = host object that may hold device arrays (ColumnBatch,
+    tuple of arrays); ``boolish`` = array known boolean-valued (mask)."""
+    array: bool = False
+    container: bool = False
+    boolish: bool = False
+
+    @property
+    def tainted(self) -> bool:
+        return self.array or self.container
+
+    def __or__(self, other: "T") -> "T":
+        return T(self.array or other.array,
+                 self.container or other.container,
+                 self.boolish or other.boolish)
+
+
+UNT = T()
+ARR = T(array=True)
+BOOLARR = T(array=True, boolish=True)
+CONT = T(container=True)
+
+
+class ModuleIndex:
+    """Alias table for one file: resolves dotted call targets through
+    ``import``/``from`` aliases (collected file-wide, including imports
+    inside function bodies)."""
+
+    def __init__(self, tree: ast.AST):
+        self.alias: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.alias[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self.alias.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom):
+                mod = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.alias[a.asname or a.name] = f"{mod}.{a.name}"
+
+    def resolve(self, expr: ast.expr) -> str | None:
+        """Dotted path of a Name/Attribute chain with the root alias
+        expanded (``jnp.where`` -> ``jax.numpy.where``), else None."""
+        parts: list[str] = []
+        while isinstance(expr, ast.Attribute):
+            parts.append(expr.attr)
+            expr = expr.value
+        if not isinstance(expr, ast.Name):
+            return None
+        root = self.alias.get(expr.id, expr.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def is_module_alias(self, name: str) -> bool:
+        resolved = self.alias.get(name)
+        return resolved is not None and not resolved.startswith(".") \
+            and "." not in name
+
+
+def param_taint(arg: ast.arg) -> T:
+    """Initial taint of a parameter, from its annotation: engine containers
+    taint as CONT (their ``.data`` etc. re-taints), explicit array types as
+    ARR, everything else starts clean (host scalars/strings dominate)."""
+    if arg.annotation is None:
+        return UNT
+    try:
+        ann = ast.unparse(arg.annotation)
+    except Exception:
+        return UNT
+    if "ColumnBatch" in ann or ann.strip() in ("Column", "Optional[Column]"):
+        return CONT
+    # jax arrays only: np.ndarray / pa.Array annotations are HOST data
+    if "jnp." in ann or "jax.Array" in ann or "jax.numpy" in ann:
+        return ARR
+    return UNT
+
+
+def merge_env(a: dict[str, T], b: dict[str, T]) -> dict[str, T]:
+    out = dict(a)
+    for k, v in b.items():
+        out[k] = out.get(k, UNT) | v
+    return out
+
+
+class FunctionTaint:
+    """Run the taint pass over one function body, reporting sinks through
+    ``report(rule, node, msg)``.  Nested defs/lambdas/classes are analyzed
+    inline with the enclosing environment as closure state."""
+
+    def __init__(self, fnode, modindex: ModuleIndex, traced: bool, report,
+                 closure: dict[str, T] | None = None):
+        self.f = fnode
+        self.mi = modindex
+        self.traced = traced
+        self.report = report
+        self.env: dict[str, T] = dict(closure or {})
+        self.globals_decl: set[str] = set()
+        # names bound to objects constructed IN this function (call results):
+        # storing a tracer on those builds the return value, not a leak
+        self.fresh: set[str] = set()
+        a = fnode.args
+        for arg in [*a.posonlyargs, *a.args, *a.kwonlyargs,
+                    *( [a.vararg] if a.vararg else []),
+                    *( [a.kwarg] if a.kwarg else [])]:
+            self.env[arg.arg] = param_taint(arg)
+
+    def run(self) -> None:
+        self.exec_body(self.f.body)
+
+    # ---- statements -------------------------------------------------------
+
+    def exec_body(self, stmts) -> None:
+        for s in stmts:
+            self.exec_stmt(s)
+
+    def exec_stmt(self, s) -> None:  # noqa: C901 — flat dispatch
+        if isinstance(s, ast.Assign):
+            t = self.eval(s.value)
+            fresh = isinstance(s.value, ast.Call)
+            for tgt in s.targets:
+                self.assign(tgt, t, s, fresh=fresh)
+        elif isinstance(s, ast.AugAssign):
+            t = self.eval(s.value) | self.eval_target_load(s.target)
+            self.assign(s.target, t, s)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self.assign(s.target, self.eval(s.value), s,
+                            fresh=isinstance(s.value, ast.Call))
+        elif isinstance(s, ast.Expr):
+            self.eval(s.value)
+        elif isinstance(s, ast.If):
+            self.branch_test(s.test)
+            e0 = dict(self.env)
+            self.exec_body(s.body)
+            e1, self.env = self.env, dict(e0)
+            self.exec_body(s.orelse)
+            self.env = merge_env(e1, self.env)
+        elif isinstance(s, ast.While):
+            self.branch_test(s.test)
+            self.loop_body(s.body)
+            self.exec_body(s.orelse)
+        elif isinstance(s, (ast.For, ast.AsyncFor)):
+            it = self.eval(s.iter)
+            if it.array:
+                self.report("RETRACE", s.iter,
+                            "python loop over a device array unrolls into "
+                            "the trace (or host-syncs per element)")
+            elem = CONT if it.container else (ARR if it.array else UNT)
+            self.assign(s.target, elem, s)
+            self.loop_body(s.body)
+            self.exec_body(s.orelse)
+        elif isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                t = self.eval(item.context_expr)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, t, s)
+            self.exec_body(s.body)
+        elif isinstance(s, ast.Try):
+            self.exec_body(s.body)
+            base = dict(self.env)
+            for h in s.handlers:
+                self.env = dict(base)
+                self.exec_body(h.body)
+                base = merge_env(base, self.env)
+            self.env = base
+            self.exec_body(s.orelse)
+            self.exec_body(s.finalbody)
+        elif isinstance(s, ast.Return):
+            if s.value is not None:
+                self.eval(s.value)
+        elif isinstance(s, ast.Raise):
+            if s.exc is not None:
+                self.eval(s.exc)
+        elif isinstance(s, ast.Assert):
+            self.eval(s.test)
+        elif isinstance(s, ast.Global):
+            self.globals_decl.update(s.names)
+        elif isinstance(s, ast.Nonlocal):
+            self.globals_decl.update(s.names)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: traced-ness inherits (compile_plan's run_local)
+            FunctionTaint(s, self.mi, self.traced, self.report,
+                          closure=dict(self.env)).run()
+            self.env[s.name] = UNT
+        elif isinstance(s, ast.ClassDef):
+            for b in s.body:
+                if isinstance(b, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    FunctionTaint(b, self.mi, self.traced, self.report,
+                                  closure=dict(self.env)).run()
+            self.env[s.name] = UNT
+        elif isinstance(s, ast.Delete):
+            for tgt in s.targets:
+                if isinstance(tgt, ast.Name):
+                    self.env.pop(tgt.id, None)
+        # Pass/Break/Continue/Import: no dataflow
+
+    def loop_body(self, body) -> None:
+        """Two passes approximate the loop fixpoint (taint only grows)."""
+        snapshot = dict(self.env)
+        self.exec_body(body)
+        self.env = merge_env(snapshot, self.env)
+        self.exec_body(body)
+
+    def branch_test(self, test) -> None:
+        t = self.eval(test)
+        if t.array:
+            self.report("RETRACE", test,
+                        "python branch on a device value: concretizes the "
+                        "tracer (error under jit, blocking sync outside)")
+
+    # ---- assignment targets ----------------------------------------------
+
+    def assign(self, tgt, t: T, stmt, fresh: bool = False) -> None:
+        if isinstance(tgt, ast.Name):
+            if tgt.id in self.globals_decl and t.tainted and self.traced:
+                self.report("TRACERLEAK", stmt,
+                            f"device value stored in global {tgt.id!r} from "
+                            "traced scope: the tracer outlives its trace")
+            self.env[tgt.id] = t
+            (self.fresh.add if fresh else self.fresh.discard)(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self.assign(el, t, stmt, fresh=fresh)
+        elif isinstance(tgt, ast.Starred):
+            self.assign(tgt.value, t, stmt, fresh=fresh)
+        elif isinstance(tgt, ast.Attribute):
+            root = tgt.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            escapes = not (isinstance(root, ast.Name)
+                           and root.id in self.fresh)
+            if t.tainted and self.traced and escapes:
+                owner = ast.unparse(tgt.value) if hasattr(ast, "unparse") \
+                    else "<obj>"
+                self.report("TRACERLEAK", stmt,
+                            f"device value stored on {owner}.{tgt.attr} from "
+                            "traced scope: the tracer outlives its trace "
+                            "(and silently pins stale state outside)")
+            self.eval(tgt.value)
+        elif isinstance(tgt, ast.Subscript):
+            self.eval(tgt.slice)
+            if isinstance(tgt.value, ast.Name) and t.tainted:
+                base = self.env.get(tgt.value.id, UNT)
+                self.env[tgt.value.id] = base | CONT
+
+    def eval_target_load(self, tgt) -> T:
+        if isinstance(tgt, (ast.Name, ast.Attribute, ast.Subscript)):
+            return self.eval(tgt)
+        return UNT
+
+    # ---- expressions ------------------------------------------------------
+
+    def eval(self, e) -> T:  # noqa: C901 — flat dispatch
+        if e is None or isinstance(e, ast.Constant):
+            return UNT
+        if isinstance(e, ast.Name):
+            return self.env.get(e.id, UNT)
+        if isinstance(e, ast.Attribute):
+            if isinstance(e.value, ast.Name) and \
+                    self.mi.is_module_alias(e.value.id):
+                return UNT          # jnp.int32, np.float64, module constants
+            vt = self.eval(e.value)
+            # .data/.validity/.sel are device arrays only on engine column
+            # containers — gate on owner taint (or hot scope, where any
+            # unannotated container flows through) so arrow RegionData.data
+            # / raft LogEntry.data stay host
+            if e.attr in DEVICE_ATTRS and (self.traced or vt.tainted):
+                return ARR
+            if e.attr in HOST_ATTRS:
+                return UNT
+            if vt.tainted:
+                return T(vt.array, vt.container, False)
+            return UNT
+        if isinstance(e, ast.Call):
+            return self.eval_call(e)
+        if isinstance(e, ast.BinOp):
+            lt, rt = self.eval(e.left), self.eval(e.right)
+            return ARR if (lt.array or rt.array) else UNT
+        if isinstance(e, ast.UnaryOp):
+            t = self.eval(e.operand)
+            if isinstance(e.op, ast.Not) and t.array:
+                self.report("RETRACE", e,
+                            "python `not` on a device value concretizes the "
+                            "tracer (use ~ / jnp.logical_not)")
+                return UNT
+            return T(t.array, False, t.boolish) if t.array else UNT
+        if isinstance(e, ast.BoolOp):
+            ts = [self.eval(v) for v in e.values]
+            if any(t.array for t in ts):
+                self.report("RETRACE", e,
+                            "python and/or on a device value concretizes the "
+                            "tracer (use & / | or jnp.where)")
+                return BOOLARR
+            return UNT
+        if isinstance(e, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                self.eval(e.left)
+                for c in e.comparators:
+                    self.eval(c)
+                return UNT
+            ts = [self.eval(e.left)] + [self.eval(c) for c in e.comparators]
+            return BOOLARR if any(t.array for t in ts) else UNT
+        if isinstance(e, ast.IfExp):
+            self.branch_test(e.test)
+            return self.eval(e.body) | self.eval(e.orelse)
+        if isinstance(e, ast.Subscript):
+            vt = self.eval(e.value)
+            st = self.eval(e.slice)
+            if st.array and st.boolish and vt.array and self.traced:
+                self.report("RETRACE", e,
+                            "boolean-mask subscript: data-dependent output "
+                            "shape outside the sel-mask machinery (use "
+                            "jnp.where / a sel mask)")
+            if vt.array or vt.container:
+                return ARR
+            return ARR if st.array else UNT
+        if isinstance(e, (ast.Tuple, ast.List, ast.Set)):
+            ts = [self.eval(el) for el in e.elts]
+            return CONT if any(t.tainted for t in ts) else UNT
+        if isinstance(e, ast.Dict):
+            ts = [self.eval(v) for v in (*e.keys, *e.values) if v is not None]
+            return CONT if any(t.tainted for t in ts) else UNT
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                          ast.DictComp)):
+            return self.eval_comp(e)
+        if isinstance(e, ast.Lambda):
+            sub = FunctionTaint(_LambdaShim(e), self.mi, self.traced,
+                                self.report, closure=dict(self.env))
+            sub.eval(e.body)
+            return UNT
+        if isinstance(e, ast.JoinedStr):
+            for v in e.values:
+                if isinstance(v, ast.FormattedValue):
+                    self.eval(v.value)
+            return UNT
+        if isinstance(e, ast.FormattedValue):
+            self.eval(e.value)
+            return UNT
+        if isinstance(e, ast.Starred):
+            return self.eval(e.value)
+        if isinstance(e, ast.NamedExpr):
+            t = self.eval(e.value)
+            self.assign(e.target, t, e)
+            return t
+        if isinstance(e, (ast.Await, ast.Yield, ast.YieldFrom)):
+            return self.eval(e.value) if e.value is not None else UNT
+        if isinstance(e, ast.Slice):
+            for part in (e.lower, e.upper, e.step):
+                if part is not None:
+                    self.eval(part)
+            return UNT
+        return UNT
+
+    def eval_comp(self, e) -> T:
+        saved = dict(self.env)
+        elem_t = UNT
+        for gen in e.generators:
+            it = self.eval(gen.iter)
+            if it.array:
+                self.report("RETRACE", gen.iter,
+                            "comprehension over a device array unrolls into "
+                            "the trace (or host-syncs per element)")
+            self.assign(gen.target,
+                        CONT if it.container else (ARR if it.array else UNT),
+                        e)
+            for cond in gen.ifs:
+                self.eval(cond)
+        if isinstance(e, ast.DictComp):
+            elem_t = self.eval(e.key) | self.eval(e.value)
+        else:
+            elem_t = self.eval(e.elt)
+        self.env = saved
+        return CONT if elem_t.tainted else UNT
+
+    # ---- calls ------------------------------------------------------------
+
+    def call_args(self, e: ast.Call) -> T:
+        t = UNT
+        for a in e.args:
+            t = t | self.eval(a)
+        for kw in e.keywords:
+            t = t | self.eval(kw.value)
+        return t
+
+    def eval_call(self, e: ast.Call) -> T:  # noqa: C901
+        path = self.mi.resolve(e.func)
+
+        # builtins that force a host value out of a device scalar
+        if path in ("int", "float", "bool", "complex"):
+            t = self.call_args(e)
+            if t.array:
+                self.report("HOSTSYNC", e,
+                            f"{path}() on a device value: blocking "
+                            "device->host round-trip (error under jit); "
+                            "keep it on device or jax.device_get explicitly")
+            return UNT
+        if path in ("len", "str", "repr", "format", "hash", "id", "type",
+                    "isinstance", "issubclass", "print", "getattr", "hasattr",
+                    "sorted", "range", "zip", "enumerate", "iter", "next",
+                    "abs", "min", "max", "sum"):
+            at = self.call_args(e)
+            if path in ("abs", "min", "max", "sum") and at.array:
+                return ARR          # these stay lazy on jax arrays
+            return UNT
+
+        if path is not None:
+            root = path.split(".")[0]
+            if root == "numpy":
+                t = self.call_args(e)
+                if t.array:
+                    self.report("HOSTSYNC", e,
+                                f"{ast.unparse(e.func)}() on a device value "
+                                "materializes it on host (blocking sync; "
+                                "error under jit) — use the jnp equivalent "
+                                "or an explicit jax.device_get at egress")
+                return UNT
+            if path in _JAX_HOST_FNS or path.endswith(".block_until_ready"):
+                t = self.call_args(e)
+                if self.traced and path in ("jax.device_get",
+                                            "jax.block_until_ready"):
+                    self.report("HOSTSYNC", e,
+                                f"{path.split('.')[-1]} inside traced scope: "
+                                "host sync baked into the compiled path")
+                return UNT
+            if path.startswith(_DEVICE_CALL_ROOTS) or root == "jax":
+                self.call_args(e)
+                fn = path.split(".")[-1]
+                if fn in _JNP_HOST_FNS:
+                    return UNT      # dtype/shape predicates are host values
+                if fn in _DDSHAPE_FNS and self.traced and \
+                        not any(kw.arg == "size" for kw in e.keywords):
+                    self.report("RETRACE", e,
+                                f"{fn}() without size=: data-dependent "
+                                "output shape (errors under jit, retraces "
+                                "otherwise)")
+                if fn == "where" and len(e.args) == 1 and self.traced:
+                    self.report("RETRACE", e,
+                                "one-argument where(): data-dependent "
+                                "output shape (use the three-argument form)")
+                return ARR
+            last = path.split(".")[-1]
+            if last in _CONTAINER_CTORS:
+                t = self.call_args(e)
+                return CONT
+
+        # method calls: obj.meth(...)
+        if isinstance(e.func, ast.Attribute):
+            owner_t = self.eval(e.func.value)
+            meth = e.func.attr
+            args_t = self.call_args(e)
+            if meth in DEVICE_METHODS:
+                return ARR
+            if meth in _CONTAINER_METHODS:
+                return CONT
+            if meth in ("item", "tolist", "to_py") and owner_t.array:
+                self.report("HOSTSYNC", e,
+                            f".{meth}() on a device value: blocking "
+                            "device->host round-trip (error under jit)")
+                return UNT
+            if meth == "block_until_ready":
+                if self.traced:
+                    self.report("HOSTSYNC", e,
+                                "block_until_ready inside traced scope: host "
+                                "sync baked into the compiled path")
+                return owner_t
+            if owner_t.array:
+                return ARR if meth in _ARRAY_METHODS else ARR
+            if owner_t.container:
+                return CONT
+            return ARR if args_t.array else UNT
+
+        # plain / unresolved calls: conservative propagation through helpers
+        args_t = self.call_args(e)
+        if isinstance(e.func, ast.Name):
+            self.eval(e.func)
+        else:
+            self.eval(e.func)
+        return ARR if args_t.array else (CONT if args_t.container else UNT)
+
+
+class _LambdaShim:
+    """Adapter so FunctionTaint can bind a Lambda's params."""
+
+    def __init__(self, lam: ast.Lambda):
+        self.args = lam.args
+        self.body = []
+        self.name = "<lambda>"

@@ -85,7 +85,7 @@ class _Rewriter:
             db = r.database or self.session.current_db
             try:
                 info = self.session.db.catalog.get_table(db, r.name)
-            except Exception:       # noqa: BLE001 — planner reports it
+            except ValueError:      # unknown table — planner reports it
                 continue
             for f in info.schema.fields:
                 out[(r.label, f.name)] = f.ltype

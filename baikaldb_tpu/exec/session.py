@@ -43,6 +43,7 @@ from ..storage.column_store import ROWID as ROWID_COL
 from ..storage.column_store import (TableStore, check_cold_readable,
                                     schema_to_arrow)
 from ..types import Field, LType, Schema
+from ..analysis.runtime import guard_stats, hot_path_guard
 from ..utils import metrics
 from ..utils.flags import FLAGS, define
 
@@ -293,9 +294,12 @@ class Database:
         # metrics.binlog_events_dropped).  The lock serializes drain/append
         # rounds across thread-per-connection sessions — concurrent commits
         # would otherwise pop an empty deque and reorder a table's stream
-        import threading
+        from ..analysis.runtime import GuardedLock
         self.binlog_retry: deque = deque()
-        self.binlog_retry_mu = threading.Lock()
+        # rank 20: acquired INSIDE the store lock (10) by the autocommit
+        # drain, and BEFORE the replicated tier's lock (30) when a queued
+        # append retries through the distributed binlog
+        self.binlog_retry_mu = GuardedLock("db.binlog_retry_mu", rank=20)
         self.data_dir = data_dir
         # external cold-storage FS (AFS stand-in, storage/coldfs): segment
         # bytes live here, manifests replicate through the region groups
@@ -2436,8 +2440,8 @@ class Session:
             for t in tctxs:
                 try:
                     t.rollback()
-                except Exception:   # noqa: BLE001 — best-effort unwind
-                    pass
+                except Exception:   # best-effort unwind; keep it countable
+                    metrics.count_swallowed("session.coupled_rollback")
             raise
         commit_group(tctxs)
         return r
@@ -3229,7 +3233,7 @@ class Session:
                 while len(self._plan_cache) > cap:
                     self._plan_cache.popitem(last=False)
         plan = entry["plan"]
-        batches, shape_key = self._collect_batches(plan)
+        batches, shape_key, _full = self._collect_batches(plan)
         entry["versions"] = {tk: v for tk, v, _ in shape_key}
         t0 = time.perf_counter()
         result = self._run_plan(entry, batches, shape_key)
@@ -3244,7 +3248,7 @@ class Session:
         counts + compile/run wall time (reference: EXPLAIN FORMAT='analyze'
         over the TraceNode tree, trace_state.h)."""
         plan = self._plan_select(stmt)
-        batches, shape_key = self._collect_batches(plan)
+        batches, shape_key, full_scan = self._collect_batches(plan)
         # settle join caps first (the overflow-retry loop), so traced counts
         # describe the plan that actually runs, not a truncated first attempt
         entry = {"plan": plan, "compiled": {}, "versions": {}}
@@ -3253,14 +3257,19 @@ class Session:
                            mesh=self.mesh if batches else None)
         fn = jax.jit(raw)
         t0 = time.perf_counter()
-        out, flags, counts = fn(batches)
+        with hot_path_guard():
+            out, flags, counts = fn(batches)
         jax.block_until_ready(jax.tree.leaves(counts))
         compile_and_run = time.perf_counter() - t0
         t1 = time.perf_counter()
-        out, flags, counts = fn(batches)
+        with hot_path_guard():
+            out, flags, counts = fn(batches)
         jax.block_until_ready(jax.tree.leaves(counts))
         run_time = time.perf_counter() - t1
-        by_node = {id(n): int(c) for n, c in zip(raw.trace_order, counts)}
+        # materialize every per-node counter in one explicit transfer —
+        # int(c) per operator is a device round-trip each (tpulint HOSTSYNC)
+        by_node = {id(n): int(c) for n, c in
+                   zip(raw.trace_order, jax.device_get(counts))}
 
         lines: list[str] = []
 
@@ -3277,15 +3286,25 @@ class Session:
         # capacity buckets + compile telemetry: which shapes this query
         # compiled against, and the engine-wide retrace/compile counters
         # (steady state = xla_retraces stops moving between identical runs)
-        for tk, _v, cap in sorted(shape_key):
-            b = batches.get(tk)
-            if isinstance(b, ColumnBatch):
-                lines.append(f"-- batch: {tk} capacity={cap} "
-                             f"live={int(b.live_count())}")
+        scans = [(tk, cap, batches[tk]) for tk, _v, cap in sorted(shape_key)
+                 if isinstance(batches.get(tk), ColumnBatch)]
+        # one fused transfer for all live counts (not an int() per table)
+        lives = jax.device_get([b.live_count() for _, _, b in scans])
+        for (tk, cap, _b), live in zip(scans, lives):
+            # only full-table scans carry pow2 capacity buckets; an index/
+            # ANN access-path batch's shape is just its candidate count
+            # (and DOES retrace per version) — label it honestly
+            kind = "capacity" if tk in full_scan else "gathered"
+            lines.append(f"-- batch: {tk} {kind}={cap} "
+                         f"live={int(live)}")
         cstats = metrics.compile_ms.stats()
         lines.append(f"-- xla: retraces_total={metrics.xla_retraces.value} "
                      f"compiles={cstats['count']} "
                      f"compile_avg_ms={cstats['avg_ms']}")
+        gs = guard_stats()
+        lines.append(f"-- guards: mode={gs['mode']} "
+                     f"transfer_trips={gs['transfer_trips']} "
+                     f"lock_trips={gs['lock_trips']}")
         txt = "\n".join(lines)
         return Result(columns=["plan"], plan_text=txt,
                       arrow=pa.table({"plan": lines}))
@@ -3379,7 +3398,7 @@ class Session:
             for c in n.children:
                 walk_presort(c)
         walk_presort(plan)
-        return batches, tuple(sorted(key_parts))
+        return batches, tuple(sorted(key_parts)), full_scan
 
     def _access_path_batch(self, n, db: str, name: str, store):
         """IndexSelector-driven scan input (index/selector.py): a secondary
@@ -3511,7 +3530,9 @@ class Session:
                         else:
                             n.access_desc = "full"
                     except Exception:
-                        pass
+                        # EXPLAIN display stays best-effort; the real scan
+                        # path reports its own errors
+                        metrics.count_swallowed("session.annotate_access")
             for c in n.children:
                 walk(c)
         walk(plan)
@@ -3647,7 +3668,8 @@ class Session:
                                 src.leader()].cold_manifest
                         else:
                             manifest = tier._region_manifest(src)
-                    except Exception:   # noqa: BLE001
+                    except Exception:
+                        metrics.count_swallowed("session.cold_manifest")
                         continue
                     for seq, f, w in manifest:
                         rows.append((db, tname, rid, seq, f, w))
@@ -3716,7 +3738,9 @@ class Session:
             pair = entry["compiled"].get(shape_key)
             if pair is None:
                 raw = compile_plan(plan, mesh=mesh)
-                pair = (jax.jit(raw), raw)
+                # not a per-iteration wrapper: built only on a shape-cache
+                # miss and cached in entry["compiled"] keyed by shape_key
+                pair = (jax.jit(raw), raw)  # tpulint: disable=RETRACE
                 comp = entry["compiled"]
                 # distinct shapes (bucket crossings, access-path batches)
                 # each pin an executable; without a cap one hot query would
@@ -3727,14 +3751,22 @@ class Session:
             fn, raw = pair
             traces_before = raw.trace_count[0]
             t0 = time.perf_counter()
-            out, flags = fn(batches)
+            # debug_guards: no implicit device->host transfer may hide in
+            # the compiled path; the explicit flag egress happens below,
+            # OUTSIDE the guard scope
+            with hot_path_guard():
+                out, flags = fn(batches)
             if raw.trace_count[0] > traces_before:
                 # this execution paid a trace+compile (first run / bucket
                 # crossing / overflow retry): record it so first-run vs
                 # steady-state shows up in SHOW metrics
                 metrics.compile_ms.observe((time.perf_counter() - t0) * 1e3)
             grew = False
-            for node, flag in zip(raw.join_order, flags):
+            # ONE explicit transfer for every overflow flag: int(flag) per
+            # join would block on a device round-trip once per node
+            # (tpulint HOSTSYNC)
+            host_flags = jax.device_get(flags)
+            for node, flag in zip(raw.join_order, host_flags):
                 needed = int(flag)
                 if isinstance(node, ScalarSourceNode):
                     if needed > 1:

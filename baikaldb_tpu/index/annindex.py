@@ -193,13 +193,15 @@ class AnnManager:
         else:
             # drift within budget: keep the trained centroids, re-assign
             # every row (one [n, c] matmul — the faiss add() analog)
+            import jax
             import jax.numpy as jnp
 
             from ..ops.vector import _scores
 
             s = _scores(jnp.asarray(m), jnp.asarray(st.centroids),
                         "l2", "f32")
-            assign = np.asarray(jnp.argmax(s, axis=1))
+            # explicit device->host egress of the jitted assignment
+            assign = jax.device_get(jnp.argmax(s, axis=1))
         order, st.starts, st.counts, st.max_count = pack_ivf(
             m, assign, n_clusters=len(st.centroids))
         st.order = order

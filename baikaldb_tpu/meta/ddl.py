@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
+from ..utils import metrics
 
 
 @dataclass
@@ -126,7 +127,9 @@ class DdlManager:
                 ix.params["error"] = msg
             self.db.save_catalog()
         except Exception:
-            pass
+            # the work record still flips to failed; catalog persistence
+            # is retried by the next DDL
+            metrics.count_swallowed("ddl.fail_persist")
         w.done.set()
 
     def _backfill(self, w: DdlWork):

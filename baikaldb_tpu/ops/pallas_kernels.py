@@ -129,8 +129,11 @@ def _agg_kernel(g_ref, v_ref, o_ref, comp_ref, *, ng: int):
               + jnp.dot(vc, oh, preferred_element_type=jnp.float32))
         _kahan_add(o_ref, comp_ref, 8 + r, r, sm)
         vcol = vt[:, r:r + 1]                             # (LANE, 1)
-        mins = jnp.min(jnp.where(hit, vcol, _BIG), axis=0, keepdims=True)
-        maxs = jnp.max(jnp.where(hit, vcol, -_BIG), axis=0, keepdims=True)
+        # typed f32 sentinel: the weak python float would promote the select
+        # to f64 under the enclosing x64 program (Mosaic verifier rejects it)
+        big = jnp.asarray(_BIG, jnp.float32)
+        mins = jnp.min(jnp.where(hit, vcol, big), axis=0, keepdims=True)
+        maxs = jnp.max(jnp.where(hit, vcol, -big), axis=0, keepdims=True)
         o_ref[16 + r:17 + r, :] = jnp.minimum(o_ref[16 + r:17 + r, :], mins)
         o_ref[24 + r:25 + r, :] = jnp.maximum(o_ref[24 + r:25 + r, :], maxs)
 
@@ -160,13 +163,17 @@ def _prep(codes, mask, num_groups, values=None):
     target = max(flat, -(-n // flat) * flat)
     g = codes.astype(jnp.int32)
     live = mask & (g >= 0) & (g < num_groups)
-    g = jnp.where(live, g, ng_pad)
+    # ng_pad must be a typed i32 constant: a weak python int promotes to i64
+    # under the enclosing x64 program, and Mosaic's verifier rejects the
+    # mixed-width select
+    g = jnp.where(live, g, jnp.asarray(ng_pad, jnp.int32))
     if target != n:
         g = jnp.concatenate([g, jnp.full((target - n,), ng_pad, jnp.int32)])
     rows = target // LANE
     out = [g.reshape(rows, LANE)]
     if values is not None:
-        v = jnp.where(live, values.astype(jnp.float32), 0.0)
+        v = jnp.where(live, values.astype(jnp.float32),
+                      jnp.zeros((), jnp.float32))
         if target != n:
             v = jnp.concatenate([v, jnp.zeros((target - n,), jnp.float32)])
         out.append(v.reshape(rows, LANE))

@@ -171,7 +171,9 @@ def kmeans(vectors: np.ndarray, n_clusters: int, iters: int = 10,
     assign = None
     for _ in range(iters):
         centroids, assign = step(x, centroids, n_clusters)
-    return np.asarray(centroids), np.asarray(assign)
+    # egress of the jitted k-means: ONE fused explicit transfer (two
+    # np.asarray calls would each block on their own device round-trip)
+    return jax.device_get((centroids, assign))
 
 
 class VectorIndex:
@@ -240,7 +242,9 @@ class VectorIndex:
         else:
             scores, idx = ivf_topk(q, base, valid, cent, assign, kk,
                                    min(self.nprobe, cent.shape[0]), self.metric)
-        scores = np.asarray(scores, np.float64)
+        # result egress: one fused explicit transfer for both arrays
+        scores, idx = jax.device_get((scores, idx))
+        scores = scores.astype(np.float64)
         idx = np.asarray(idx)
         ids = self._ids[idx]
         ids = np.where(np.isfinite(scores), ids, -1)

@@ -38,6 +38,7 @@ from typing import Optional
 from ..types import Field, LType, Schema
 from ..utils.flags import FLAGS, define
 from .column_store import ROWID
+from ..utils import metrics
 
 define("binlog_regions", True,
        "cluster mode: replicate DML binlog events through dedicated "
@@ -137,8 +138,8 @@ class DistributedBinlog:
         capturer's grace expiry is the backstop)."""
         try:
             self.tier.write_ops([tomb])
-        except Exception:       # noqa: BLE001
-            pass
+        except Exception:   # grace expiry is the backstop; keep it visible
+            metrics.count_swallowed("binlog_regions.abort")
 
     def write_with_data(self, data_tier, data_ops: list, table_key: str,
                         events: list) -> None:

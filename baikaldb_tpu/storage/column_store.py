@@ -39,6 +39,7 @@ from ..column.batch import ColumnBatch
 from ..meta.catalog import TableInfo
 from ..types import Field, LType, Schema
 from .rowstore import ConflictError, KeyCodec, RowTable, Txn
+from ..utils import metrics
 
 DEFAULT_REGION_ROWS = 1 << 20  # split threshold on the row axis
 ROWID = "__rowid"              # hidden parquet column carrying row identity
@@ -266,7 +267,13 @@ class TableStore:
         self.info = info
         self.region_rows = region_rows
         self.arrow_schema = schema_to_arrow(info.schema)
-        self._lock = threading.RLock()
+        # guarded: rank 10 — acquired FIRST on the write path; _write_hot
+        # (under this lock) takes the binlog retry lock (20) for the CDC
+        # drain and the replicated tier's lock (30) via write_ops.  The
+        # statically-derived order (tools/tpulint.py --lock-order),
+        # asserted when debug_guards is on
+        from ..analysis.runtime import GuardedLock
+        self._lock = GuardedLock("store.table_lock", rank=10, reentrant=True)
         self._mutations = 0
         self._next_region = 1
         self._next_rowid = 1
@@ -591,7 +598,8 @@ class TableStore:
                     if isinstance(mn, (int,)) or f.ltype.is_integer or f.ltype is LType.DATE:
                         st["min"], st["max"] = mn, mx
                 except Exception:
-                    pass
+                    # stats stay partial; planner falls back to defaults
+                    metrics.count_swallowed("column_store.zone_stats")
             st.update(self._histogram_stats(col, f) or {})
             cache[1][column] = st
             return st
@@ -775,6 +783,8 @@ class TableStore:
         per table version — the 'index build' that lets a static table's
         joins skip the on-device bitonic sort entirely (the reference
         reads pre-sorted secondary indexes from RocksDB the same way)."""
+        import jax
+
         with self._lock:
             v = self._perm_cache_key()
             cache = getattr(self, "_perm_cache", None)
@@ -785,16 +795,26 @@ class TableStore:
             if ck in cache[1]:
                 return cache[1][ck]
             batch = self.device_table_batch()
-            arrs = [np.asarray(batch.column(c).data).astype(np.int64)
-                    for c in cols]
-            if len(arrs) == 1:
-                order = np.argsort(arrs[0], kind="stable")
-            else:
-                packed = (arrs[0] << 32) | (arrs[1] & 0xFFFFFFFF)
-                order = np.argsort(packed, kind="stable")
-            order = order.astype(np.int32)
-            cache[1][ck] = order
-            return order
+        # device->host materialization + argsort OUTSIDE the lock: a
+        # blocking transfer under self._lock stalls every writer queued on
+        # it (tpulint LOCKORDER); the batch is an immutable snapshot, and
+        # one fused device_get replaces per-column implicit transfers
+        arrs = [np.asarray(a).astype(np.int64) for a in
+                jax.device_get([batch.column(c).data for c in cols])]
+        if len(arrs) == 1:
+            order = np.argsort(arrs[0], kind="stable")
+        else:
+            packed = (arrs[0] << 32) | (arrs[1] & 0xFFFFFFFF)
+            order = np.argsort(packed, kind="stable")
+        order = order.astype(np.int32)
+        with self._lock:
+            # install only while the table still sits at the captured
+            # version — a permutation over an older snapshot must never
+            # serve a newer table
+            cache = getattr(self, "_perm_cache", None)
+            if cache is not None and cache[0] == v:
+                cache[1][ck] = order
+        return order
 
     def agg_sort_permutation(self, cols: tuple) -> "np.ndarray":
         """Host-side permutation replicating group_aggregate_sorted's key
@@ -802,6 +822,8 @@ class TableStore:
         per key, NULLs-first per key): the device kernel then needs only
         an O(n) liveness partition instead of a multi-key bitonic sort.
         Cached per table version."""
+        import jax
+
         with self._lock:
             v = self._perm_cache_key()
             cache = getattr(self, "_perm_cache", None)
@@ -812,22 +834,28 @@ class TableStore:
             if ck in cache[1]:
                 return cache[1][ck]
             batch = self.device_table_batch()
-            perm = np.arange(len(batch))
-            for c in reversed(cols):
-                col = batch.column(c)
-                d = np.asarray(col.data)
-                if d.dtype == np.bool_:
-                    d = d.astype(np.int32)
-                vmask = None if col.validity is None \
-                    else np.asarray(col.validity)
-                if vmask is not None:
-                    d = np.where(vmask, d, np.zeros((), d.dtype))
-                perm = perm[np.argsort(d[perm], kind="stable")]
-                if vmask is not None:
-                    perm = perm[np.argsort(vmask[perm], kind="stable")]
-            perm = perm.astype(np.int32)
-            cache[1][ck] = perm
-            return perm
+        # materialize every key column (+validity) in ONE fused device_get,
+        # outside the lock — same LOCKORDER discipline as sort_permutation
+        host = jax.device_get(
+            [(batch.column(c).data, batch.column(c).validity)
+             for c in cols])
+        perm = np.arange(len(batch))
+        for d, vmask in reversed(host):
+            d = np.asarray(d)
+            if d.dtype == np.bool_:
+                d = d.astype(np.int32)
+            if vmask is not None:
+                vmask = np.asarray(vmask)
+                d = np.where(vmask, d, np.zeros((), d.dtype))
+            perm = perm[np.argsort(d[perm], kind="stable")]
+            if vmask is not None:
+                perm = perm[np.argsort(vmask[perm], kind="stable")]
+        perm = perm.astype(np.int32)
+        with self._lock:
+            cache = getattr(self, "_perm_cache", None)
+            if cache is not None and cache[0] == v:
+                cache[1][ck] = perm
+        return perm
 
     def secondary_count(self, column: str, value):
         """How many rows match column = value (None if unindexable)."""
@@ -1380,21 +1408,33 @@ class TableStore:
             sink = getattr(self, "binlog_sink", None)
             if sink is not None:
                 guard = getattr(self, "binlog_db", None)
+                from .binlog_regions import DistributedBinlog
+
+                table_key = f"{self.info.database}.{self.info.name}"
                 if guard is not None and guard.binlog_retry:
                     # queued CDC batches of earlier (txn-path) commits must
                     # land before this autocommit event or the table's
-                    # stream reorders.  Best-effort: if the backend is
-                    # still down the drain stops and write_with_data below
-                    # fails the statement itself, so no event jumps ahead
-                    guard.drain_binlog_retry(sink)
+                    # stream reorders
+                    with guard.binlog_retry_mu:
+                        guard._drain_binlog_retry_locked(sink)
+                        blocked = {tk for tk, _ in guard.binlog_retry}
+                        if table_key in blocked:
+                            # the drain stopped with one of THIS table's
+                            # batches still queued (another table's append
+                            # failed first, or the backend re-broke):
+                            # appending now would jump the queue.  Commit
+                            # the data and queue the event BEHIND the older
+                            # batch — the txn path's discipline
+                            # (session._flush_txn_binlog)
+                            self.replicated.write_ops(ops)
+                            guard._queue_binlog_retry_locked(
+                                table_key, DistributedBinlog.events_of(recs))
+                            return
                 # distributed binlog: the CDC event rides the data's own
                 # cross-tier 2PC — present iff the data committed
                 # (storage/binlog_regions, the region_binlog analog)
-                from .binlog_regions import DistributedBinlog
-
                 sink.write_with_data(
-                    self.replicated, ops,
-                    f"{self.info.database}.{self.info.name}",
+                    self.replicated, ops, table_key,
                     DistributedBinlog.events_of(recs))
             else:
                 self.replicated.write_ops(ops)

@@ -29,6 +29,7 @@ from .rowstore import RowCodec
 
 
 from ..utils.flags import define
+from ..utils import metrics
 
 define("pushdown_reads", "auto",
        "daemon-plane fragment pushdown: 'auto' (push eligible SELECTs of "
@@ -180,8 +181,8 @@ def write_ops_atomic_remote(pairs: list) -> None:
     for t in tiers:
         try:
             t.maybe_split()
-        except Exception:       # noqa: BLE001 — split is maintenance
-            pass
+        except Exception:       # split is maintenance; count, don't die
+            metrics.count_swallowed("remote_tier.maybe_split")
 
 
 class _RemoteRegion:
@@ -642,9 +643,10 @@ class RemoteRowTier:
             self._writes_since_check = 0
             try:
                 self.maybe_split()
-            except Exception:     # noqa: BLE001
-                pass              # split is maintenance (meta down, quorum
-                #                   loss, anything): the write already ACKed
+            except Exception:
+                # split is maintenance (meta down, quorum loss, anything):
+                # the write already ACKed — count so stalled splits show up
+                metrics.count_swallowed("remote_tier.split_after_write")
 
     def _route_ops(self, ops: list[tuple[int, bytes, bytes]]) -> dict:
         """region_id -> op batch.  Rightmost start <= key over the sorted
@@ -834,7 +836,7 @@ class RemoteRowTier:
                                        left_id=parent.region_id,
                                        right_id=child.region_id)
             except Exception:
-                pass
+                metrics.count_swallowed("remote_tier.merge_regions")
             for _, addr in child.peers:
                 self.cluster.store(addr).try_call(
                     "drop_region", region_id=child.region_id)

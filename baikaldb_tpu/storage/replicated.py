@@ -38,6 +38,7 @@ from ..raft.core import LEADER
 from ..raft.twopc import TwoPhaseCoordinator, TwoPhaseError, next_txn_id
 from ..types import Schema
 from ..utils.flags import FLAGS, define
+from ..utils import metrics
 
 if TYPE_CHECKING:  # pragma: no cover
     from ..raft.fleet import StoreFleet
@@ -141,8 +142,13 @@ class ReplicatedRowTier:
         self._ends: list[bytes] = [b""]
         # the tier is SHARED across every Session over this fleet: writes
         # and split/merge bookkeeping serialize here (two threads mid-split
-        # would interleave the parallel list updates)
-        self._mu = threading.RLock()
+        # would interleave the parallel list updates).  Rank 30: the
+        # INNERMOST lock of the write path — TableStore._lock (10) and the
+        # binlog retry lock (20) are both held when write_ops lands here,
+        # and code under this lock never takes either of them back
+        from ..analysis.runtime import GuardedLock
+        self._mu = GuardedLock("replicated.tier_mu", rank=30,
+                               reentrant=True)
 
     @classmethod
     def get_or_create(cls, fleet: "StoreFleet", table_id: int, table_key: str,
@@ -380,8 +386,8 @@ class ReplicatedRowTier:
             self.fleet.groups.pop(new_m.region_id, None)
             try:
                 meta.merge_regions_key(m.region_id, new_m.region_id)
-            except Exception:
-                pass               # meta may itself be quorumless
+            except Exception:  # meta may itself be quorumless
+                metrics.count_swallowed("replicated.split_unwind")
             raise SplitError(
                 f"split of region {m.region_id} aborted (no quorum)")
         self.metas.insert(idx + 1, new_m)

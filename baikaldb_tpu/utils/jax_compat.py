@@ -1,0 +1,55 @@
+"""Version guards over the moving jax API surface.
+
+The engine tracks two jax API migrations that landed between 0.4.x and
+0.6.x; every call site goes through this module so the tree runs on both
+sides of the break:
+
+- ``shard_map`` moved from ``jax.experimental.shard_map`` to ``jax.shard_map``
+  and renamed its replication-check kwarg ``check_rep`` -> ``check_vma``.
+  The wrapper here accepts the new-world spelling (``check_vma``) and maps it
+  onto whichever kwarg the installed jax understands.
+- ``jnp.maximum`` grew numpy's ufunc methods (``.accumulate``) only in newer
+  jax; ``lax.cummax``/``lax.cummin``/``lax.cumsum`` are the spellings that
+  exist on both sides, so ``cummax`` routes through the ufunc when present
+  and falls back to the lax primitive otherwise (identical lowering).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # jax>=0.6 promotes shard_map out of experimental
+    _shard_map = jax.shard_map  # type: ignore[attr-defined]
+except AttributeError:  # pragma: no cover — depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map  # type: ignore
+
+# check_rep (jax<0.6) vs check_vma (jax>=0.6): same knob, renamed
+_SM_KWARGS = frozenset(inspect.signature(_shard_map).parameters)
+if "check_vma" in _SM_KWARGS:
+    _CHECK_KW = "check_vma"
+elif "check_rep" in _SM_KWARGS:
+    _CHECK_KW = "check_rep"
+else:  # pragma: no cover — future jax dropped the knob entirely
+    _CHECK_KW = None
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """`jax.shard_map` with the replication-check kwarg version-adapted."""
+    kwargs = {} if _CHECK_KW is None else {_CHECK_KW: check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kwargs)
+
+
+# which branch runs depends on the installed jax; both lower identically
+if hasattr(jnp.maximum, "accumulate"):  # jnp ufunc methods (newer jax)
+    def cummax(x, axis: int = 0):
+        """Running maximum along ``axis`` (``jnp.maximum.accumulate``)."""
+        return jnp.maximum.accumulate(x, axis=axis)
+else:
+    def cummax(x, axis: int = 0):
+        """Running maximum along ``axis`` (``lax.cummax`` fallback)."""
+        return lax.cummax(x, axis=axis)

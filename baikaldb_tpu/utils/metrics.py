@@ -200,3 +200,13 @@ compile_ms = LatencyRecorder("compile_ms")
 # after the retry queue overflowed (counted in EVENTS, not batches)
 binlog_retry_queued = Counter("binlog_retry_queued")
 binlog_events_dropped = Counter("binlog_events_dropped")
+# intentionally-swallowed exceptions on best-effort paths (tpulint BAREEXC
+# policy: a swallow must at least be countable) — total plus a per-site
+# counter so SHOW METRICS points at the failing subsystem
+swallowed_exceptions = Counter("swallowed_exceptions")
+
+
+def count_swallowed(site: str) -> None:
+    """Record an intentionally-swallowed exception at ``site``."""
+    swallowed_exceptions.add(1)
+    REGISTRY.counter(f"swallowed.{site}").add(1)

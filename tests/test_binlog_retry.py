@@ -111,3 +111,56 @@ def test_overflow_drops_are_counted(monkeypatch):
         s.execute("COMMIT")
     assert len(s.db.binlog_retry) == 2        # bounded
     assert metrics.binlog_events_dropped.value > d0
+
+
+def test_autocommit_blocked_table_queues_behind_older_batch():
+    """Partial backend recovery: the drain stops on ANOTHER table's failed
+    batch while this table's own older batch is still queued.  The
+    autocommit event must queue BEHIND it (data still commits), never
+    append directly — a direct append would reorder the table's stream."""
+    s, dist = _binlogged_session()
+    # create the second store before the fake cluster handle is consulted
+    saved_cluster, s.db.cluster = s.db.cluster, None
+    s.execute("CREATE TABLE bl2 (id BIGINT PRIMARY KEY, v DOUBLE) BINLOG=1")
+    s.execute("INSERT INTO bl2 VALUES (0, 0.0)")
+    s.db.cluster = saved_cluster
+    for t in ("bl", "bl2"):                   # backend down: both queue
+        s.execute("BEGIN")
+        s.execute(f"INSERT INTO {t} VALUES (1, 1.0)")
+        s.execute("COMMIT")
+    assert [tk for tk, _ in s.db.binlog_retry] == \
+        ["default.bl", "default.bl2"]
+
+    class FakeTier:
+        def write_ops(self, ops):
+            pass
+
+        def alloc_rowids(self, n, floor=0):
+            return floor
+
+    store = s.db.stores["default.bl2"]
+    store.replicated = FakeTier()
+    store.binlog_sink = dist
+    store.binlog_db = s.db
+    # partial recovery: bl's binlog region is still leaderless, bl2 is fine
+    dist.fail = False
+    real_append = dist.append
+
+    def partial_append(table_key, events):
+        if table_key == "default.bl":
+            raise RuntimeError("bl's binlog region still leaderless")
+        real_append(table_key, events)
+    dist.append = partial_append
+
+    s.execute("INSERT INTO bl2 VALUES (2, 2.0)")   # autocommit on bl2
+    # nothing may land for bl2 yet: its txn batch is still queued behind
+    # bl's; the autocommit event joins the queue instead
+    assert dist.appended == []
+    assert [tk for tk, _ in s.db.binlog_retry] == \
+        ["default.bl", "default.bl2", "default.bl2"]
+
+    dist.append = real_append                  # full recovery
+    s.db.drain_binlog_retry(dist)
+    assert [tk for tk, _ in dist.appended] == \
+        ["default.bl", "default.bl2", "default.bl2"]
+    assert len(s.db.binlog_retry) == 0

@@ -1,0 +1,424 @@
+"""tpulint: per-rule fixtures + the tree-wide zero-violation invariant.
+
+The fixtures pin exact rule IDs AND line numbers, so a rule that drifts
+(fires on the wrong line, stops firing, or floods a clean counterpart)
+fails loudly.  test_tree_is_clean is the CI policy: the package stays at
+zero violations against tools/tpulint_suppressions.txt forever.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from baikaldb_tpu.analysis import LintConfig, run_lint  # noqa: E402
+from baikaldb_tpu.analysis.runtime import (  # noqa: E402
+    LOCK_RANKS, GuardedLock)
+from baikaldb_tpu.utils.flags import set_flag  # noqa: E402
+
+
+def lint_src(tmp_path, src, rel="baikaldb_tpu/ops/fixture.py",
+             suppression_file=None):
+    """Write ``src`` at ``rel`` under tmp_path and lint it; returns
+    [(rule, line), ...] sorted."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(src))
+    cfg = LintConfig(suppression_file=suppression_file)
+    vs = run_lint([str(path)], cfg, root=str(tmp_path))
+    return sorted((v.rule, v.line) for v in vs)
+
+
+# ---- HOSTSYNC -------------------------------------------------------------
+
+def test_hostsync_int_on_device(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        def f(x):
+            y = jnp.sum(x)
+            return int(y)
+        """)
+    assert out == [("HOSTSYNC", 4)]
+
+
+def test_hostsync_item_and_np(tmp_path):
+    out = lint_src(tmp_path, """\
+        import numpy as np
+        import jax.numpy as jnp
+        def f(x):
+            y = jnp.abs(x)
+            a = y.item()
+            b = np.asarray(y)
+            return a, b
+        """)
+    assert out == [("HOSTSYNC", 5), ("HOSTSYNC", 6)]
+
+
+def test_hostsync_clean_counterpart(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        def f(x, cap: int):
+            y = jnp.sum(x)
+            n = int(cap)          # host int: no device value involved
+            return jnp.where(y > n, y, 0)
+        """)
+    assert out == []
+
+
+def test_hostsync_device_get_only_in_traced_scope(tmp_path):
+    src = """\
+        import jax
+        import jax.numpy as jnp
+        def f(x):
+            return jax.device_get(jnp.sum(x))
+        """
+    # hot module: flagged; host module: the sanctioned egress spelling
+    assert lint_src(tmp_path, src) == [("HOSTSYNC", 4)]
+    assert lint_src(tmp_path, src,
+                    rel="baikaldb_tpu/server/fixture.py") == []
+
+
+# ---- RETRACE --------------------------------------------------------------
+
+def test_retrace_branch_on_traced(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        def f(x):
+            if jnp.sum(x) > 0:
+                return x
+            return -x
+        """)
+    assert out == [("RETRACE", 3)]
+
+
+def test_retrace_data_dependent_shape(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        def f(m):
+            a = jnp.nonzero(m)
+            b = jnp.nonzero(m, size=8)
+            return a, b
+        """)
+    assert out == [("RETRACE", 3)]
+
+
+def test_retrace_jit_misuse(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax
+        def g(x):
+            return x
+        def f(xs):
+            out = []
+            for x in xs:
+                h = jax.jit(g)
+                out.append(h(x))
+            y = jax.jit(g)(xs)
+            return out, y
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == [("RETRACE", 7), ("RETRACE", 9)]
+
+
+def test_retrace_unhashable_static_default(tmp_path):
+    out = lint_src(tmp_path, """\
+        import functools
+        import jax
+        @functools.partial(jax.jit, static_argnames=("ks",))
+        def f(x, ks=[1, 2]):
+            return x
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == [("RETRACE", 4)]
+
+
+def test_retrace_loop_over_device_array(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        def f(x):
+            acc = 0
+            for v in jnp.cumsum(x):
+                acc = acc + v
+            return acc
+        """)
+    assert out == [("RETRACE", 4)]
+
+
+# ---- TRACERLEAK -----------------------------------------------------------
+
+def test_tracerleak_self_attr(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        class C:
+            def m(self, x):
+                self.cache = jnp.sum(x)
+                return self.cache
+        """)
+    assert out == [("TRACERLEAK", 4)]
+
+
+def test_tracerleak_fresh_local_is_fine(tmp_path):
+    # mutating an object CONSTRUCTED here builds the return value
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        def f(batch):
+            out = batch.gather(jnp.argsort(batch.sel))
+            out.sel = jnp.cumsum(out.sel) < 3
+            return out
+        """)
+    assert out == []
+
+
+# ---- LOCKORDER ------------------------------------------------------------
+
+def test_lockorder_cycle(tmp_path):
+    out = lint_src(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+            def f(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+            def g(self):
+                with self.b_lock:
+                    with self.a_lock:
+                        pass
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert ("LOCKORDER", 12) in out
+
+
+def test_lockorder_consistent_order_clean(tmp_path):
+    out = lint_src(tmp_path, """\
+        import threading
+        class C:
+            def __init__(self):
+                self.a_lock = threading.Lock()
+                self.b_lock = threading.Lock()
+            def f(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+            def g(self):
+                with self.a_lock:
+                    with self.b_lock:
+                        pass
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == []
+
+
+def test_lockorder_sync_under_lock(tmp_path):
+    out = lint_src(tmp_path, """\
+        import threading
+        import jax.numpy as jnp
+        class C:
+            def __init__(self):
+                self.c_lock = threading.Lock()
+            def m(self, x):
+                with self.c_lock:
+                    return int(jnp.sum(x))
+        """)
+    assert out == [("HOSTSYNC", 8), ("LOCKORDER", 8)]
+
+
+# ---- BAREEXC --------------------------------------------------------------
+
+def test_bareexc(tmp_path):
+    out = lint_src(tmp_path, """\
+        def f(g):
+            try:
+                g()
+            except Exception:
+                pass
+            try:
+                g()
+            except ValueError:
+                pass
+            try:
+                g()
+            except BaseException:
+                raise
+            try:
+                g()
+            except:
+                pass
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == [("BAREEXC", 4), ("BAREEXC", 16)]
+
+
+# ---- suppression channels -------------------------------------------------
+
+def test_inline_suppression(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        def f(x):
+            return int(jnp.sum(x))  # tpulint: disable=HOSTSYNC
+        def g(x):
+            # tpulint: disable-next-line=HOSTSYNC
+            return int(jnp.sum(x))
+        """)
+    assert out == []
+
+
+def test_suppression_file_by_qualname(tmp_path):
+    sup = tmp_path / "sup.txt"
+    sup.write_text(
+        "# egress fixture: the sync is the point\n"
+        "baikaldb_tpu/ops/fixture.py HOSTSYNC f\n")
+    src = """\
+        import jax.numpy as jnp
+        def f(x):
+            return int(jnp.sum(x))
+        def g(x):
+            return int(jnp.sum(x))
+        """
+    out = lint_src(tmp_path, src, suppression_file=str(sup))
+    assert out == [("HOSTSYNC", 5)]    # only g's violation survives
+
+
+# ---- the CI policy: the tree stays clean ----------------------------------
+
+def test_tree_is_clean():
+    cfg = LintConfig(suppression_file=os.path.join(
+        REPO, "tools", "tpulint_suppressions.txt"))
+    vs = run_lint([os.path.join(REPO, "baikaldb_tpu")], cfg, root=REPO)
+    assert vs == [], "tpulint violations crept in:\n" + \
+        "\n".join(v.render() for v in vs)
+
+
+def test_cli_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint",
+         os.path.join(REPO, "baikaldb_tpu")],
+        cwd=REPO, capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    # a dirty fixture exits 1
+    bad = tmp_path / "baikaldb_tpu" / "ops" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text("import jax.numpy as jnp\n"
+                   "def f(x):\n"
+                   "    return int(jnp.sum(x))\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", str(bad)],
+        cwd=REPO, capture_output=True, text=True, env=env)
+    assert r.returncode == 1
+    assert "HOSTSYNC" in r.stdout
+
+
+# ---- static order <-> runtime ranks stay consistent -----------------------
+
+# static lock ids (module:Class.attr) -> runtime GuardedLock names
+_STATIC_TO_RUNTIME = {
+    "baikaldb_tpu/exec/session.py:Database.binlog_retry_mu":
+        "db.binlog_retry_mu",
+    "baikaldb_tpu/storage/column_store.py:TableStore._lock":
+        "store.table_lock",
+    "baikaldb_tpu/storage/replicated.py:ReplicatedRowTier._mu":
+        "replicated.tier_mu",
+}
+
+
+def test_declared_ranks_match_static_graph():
+    """Every statically-derived acquisition edge A->B between locks that
+    carry runtime ranks must satisfy rank[A] < rank[B] — the static and
+    dynamic halves of LOCKORDER cannot drift apart."""
+    # ranks register at lock construction: build a live Database + store
+    from baikaldb_tpu.exec.session import Database, Session
+    db = Database()
+    s = Session(db)
+    s.execute("CREATE DATABASE lintdb")
+    s.execute("USE lintdb")
+    s.execute("CREATE TABLE lint_t (a BIGINT)")
+    s.execute("INSERT INTO lint_t VALUES (1)")
+
+    cfg = LintConfig(suppression_file=os.path.join(
+        REPO, "tools", "tpulint_suppressions.txt"))
+    run_lint([os.path.join(REPO, "baikaldb_tpu")], cfg, root=REPO)
+    edges = run_lint.last_lock_edges
+    assert edges, "static lock pass found no acquisition edges"
+    checked = 0
+    for a, b in edges:
+        ra = LOCK_RANKS.get(_STATIC_TO_RUNTIME.get(a, ""))
+        rb = LOCK_RANKS.get(_STATIC_TO_RUNTIME.get(b, ""))
+        if ra is not None and rb is not None:
+            assert ra < rb, f"declared ranks contradict static edge {a}->{b}"
+            checked += 1
+    assert checked >= 1, "no ranked edge was cross-checked"
+
+
+def test_guarded_lock_runtime_trips():
+    set_flag("debug_guards", "disallow")
+    try:
+        a = GuardedLock("t.low", rank=1)
+        b = GuardedLock("t.high", rank=2)
+        with a:
+            with b:
+                pass               # forward order: fine
+        with b:
+            with pytest.raises(RuntimeError, match="lock order violation"):
+                with a:
+                    pass
+        # reentrant acquire of the SAME lock never trips
+        r = GuardedLock("t.re", rank=3, reentrant=True)
+        with r:
+            with r:
+                pass
+    finally:
+        set_flag("debug_guards", "off")
+
+
+def test_guards_allow_replicated_write_path():
+    """Regression: the real write path nests store.table_lock ->
+    binlog_retry_mu -> replicated.tier_mu; armed guards must accept that
+    order (the declared ranks must match the code, not an imagined
+    hierarchy)."""
+    from baikaldb_tpu.raft.core import raft_available
+    if not raft_available():
+        pytest.skip("native raft core unavailable")
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.fleet import StoreFleet
+
+    fleet = StoreFleet(MetaService(peer_count=3),
+                       ["g1:1", "g2:1", "g3:1"], seed=7)
+    s = Session(Database(fleet=fleet))
+    set_flag("debug_guards", "disallow")
+    try:
+        s.execute("CREATE DATABASE gd")
+        s.execute("USE gd")
+        s.execute("CREATE TABLE t (a BIGINT, b BIGINT)")
+        s.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+        s.execute("UPDATE t SET b = 25 WHERE a = 2")
+        assert s.execute("SELECT SUM(b) FROM t").rows == [(35,)]
+    finally:
+        set_flag("debug_guards", "off")
+
+
+def test_guarded_lock_reentry_after_higher_rank():
+    """Re-entering an already-held reentrant lock is legal even when a
+    higher-rank lock was taken in between (RLock semantics — deadlock is
+    impossible against a lock the thread already owns)."""
+    set_flag("debug_guards", "disallow")
+    try:
+        low = GuardedLock("t.re_low", rank=1, reentrant=True)
+        high = GuardedLock("t.re_high", rank=2)
+        with low:
+            with high:
+                with low:          # re-entry: must not trip
+                    pass
+    finally:
+        set_flag("debug_guards", "off")
+
+
+def test_guarded_lock_off_mode_is_silent():
+    set_flag("debug_guards", "off")
+    lo = GuardedLock("t.off_low", rank=1)
+    hi = GuardedLock("t.off_high", rank=2)
+    with hi:
+        with lo:                   # inversion, but guards are off
+            pass

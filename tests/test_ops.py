@@ -341,7 +341,7 @@ def test_presort_paths_match_device_sort():
         plan = sess._plan_select(__import__(
             "baikaldb_tpu.sql.parser", fromlist=["parse_sql"]
         ).parse_sql(q)[0])
-        batches, _ = sess._collect_batches(plan)
+        batches, _, _full = sess._collect_batches(plan)
         return any(k.startswith("__presort__") for k in batches)
 
     assert engaged(s, q_exists), "presort not engaged for EXISTS<>"
@@ -353,9 +353,9 @@ def test_presort_paths_match_device_sort():
     orig = s2._collect_batches
 
     def no_presort(plan):
-        b, k = orig(plan)
+        b, k, full = orig(plan)
         return {kk: v for kk, v in b.items()
-                if not kk.startswith("__presort__")}, k
+                if not kk.startswith("__presort__")}, k, full
     s2._collect_batches = no_presort
     without = (s2.query(q_exists), s2.query(q_agg))
     assert with_presort == without

@@ -99,7 +99,7 @@ def test_shrink_cap_retry_grows_to_exact_need():
             clamp(c)
     clamp(plan)
     entry = {"plan": plan, "compiled": {}, "versions": {}}
-    batches, shape_key = s._collect_batches(plan)
+    batches, shape_key, _full = s._collect_batches(plan)
     out = s._run_plan(entry, batches, shape_key)
     got = int(out.to_arrow().to_pylist()[0]["n"])
     t = _arrow_big(5000).to_pandas()

@@ -1,0 +1,126 @@
+"""tpulint CLI — trace/transfer-hygiene and lock-discipline lint.
+
+Usage:
+    python -m tools.tpulint baikaldb_tpu/            # lint the tree
+    python -m tools.tpulint --diff-only              # lint git-changed files
+    python -m tools.tpulint --list-rules
+    python -m tools.tpulint --lock-order baikaldb_tpu/
+
+Exit code 0 when clean, 1 when violations survive suppression, 2 on usage
+errors.  The suppression registry lives in tools/tpulint_suppressions.txt
+(each entry commented with WHY the sync/exception is intentional); inline
+``# tpulint: disable=RULE`` comments work too.  docs/LINT.md has the rule
+catalog.  tests/test_lint.py runs the same entry point, so CI keeps the
+tree at zero.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from baikaldb_tpu.analysis import LintConfig, run_lint  # noqa: E402
+from baikaldb_tpu.analysis.lint import RULES  # noqa: E402
+
+DEFAULT_SUPPRESSIONS = os.path.join(_REPO, "tools",
+                                    "tpulint_suppressions.txt")
+
+_RULE_HELP = {
+    "HOSTSYNC": "silent device->host round-trips (int()/np.asarray/.item())",
+    "RETRACE": "trace-cache churn: data-dependent control flow/shapes, "
+               "per-call jit wrappers, unhashable static args",
+    "TRACERLEAK": "tracers stored on self/globals from traced scope",
+    "LOCKORDER": "lock acquisition cycles; host syncs under a held lock",
+    "BAREEXC": "swallow-all exception handlers",
+}
+
+
+def _git_changed_files() -> list[str]:
+    """Changed .py files vs HEAD (staged + unstaged + untracked) — the
+    builder-loop fast path."""
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=_REPO, check=True,
+            capture_output=True, text=True).stdout
+    except (OSError, subprocess.CalledProcessError) as e:
+        print(f"tpulint: --diff-only needs git: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    files = []
+    for line in out.splitlines():
+        if len(line) < 4 or line[0] == "D" or line[1] == "D":
+            continue
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        if path.endswith(".py") and os.path.exists(os.path.join(_REPO, path)):
+            files.append(os.path.join(_REPO, path))
+    return files
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--diff-only", action="store_true",
+                    help="lint only files changed vs git HEAD")
+    ap.add_argument("--suppressions", default=DEFAULT_SUPPRESSIONS,
+                    help="suppression registry (default: %(default)s)")
+    ap.add_argument("--no-suppressions", action="store_true",
+                    help="report raw findings, ignoring every suppression "
+                         "channel except inline comments")
+    ap.add_argument("--rules", default=",".join(RULES),
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--lock-order", action="store_true",
+                    help="print the statically-derived lock order and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="print only the summary line")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES:
+            print(f"{r:<11} {_RULE_HELP[r]}")
+        return 0
+
+    rules = tuple(r.strip().upper() for r in args.rules.split(",") if r)
+    bad = [r for r in rules if r not in RULES]
+    if bad:
+        print(f"tpulint: unknown rule(s): {', '.join(bad)}", file=sys.stderr)
+        return 2
+
+    if args.diff_only:
+        paths = _git_changed_files()
+        if not paths:
+            print("tpulint: no changed python files")
+            return 0
+    else:
+        paths = args.paths or [os.path.join(_REPO, "baikaldb_tpu")]
+
+    sup = None if args.no_suppressions else (
+        args.suppressions if os.path.exists(args.suppressions) else None)
+    config = LintConfig(suppression_file=sup, rules=rules)
+    violations = run_lint(paths, config, root=_REPO)
+
+    if args.lock_order:
+        for name in run_lint.last_lock_order:
+            print(name)
+        return 0
+
+    if not args.quiet:
+        for v in violations:
+            print(v.render())
+    counts: dict[str, int] = {}
+    for v in violations:
+        counts[v.rule] = counts.get(v.rule, 0) + 1
+    detail = ", ".join(f"{r}={n}" for r, n in sorted(counts.items()))
+    print(f"tpulint: {len(violations)} violation(s)"
+          + (f" ({detail})" if detail else ""))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
