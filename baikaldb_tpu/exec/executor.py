@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from ..column.batch import Column, ColumnBatch
 from ..expr.ast import ColRef, Lit
 from ..expr.compile import eval_expr, eval_output, eval_predicate, infer_type
+from ..expr.params import PARAMS_KEY, bind_params
 from ..ops import join as join_ops
 from ..ops.compact import compact, head
 from ..ops.hashagg import (AggSpec, MERGE_OP, finalize_partials,
@@ -97,7 +98,10 @@ def compile_plan(plan: PlanNode, trace: bool = False, mesh=None) -> Callable:
         counts: list = []
         trace_order.clear()
         ctx = (overflows, counts if trace else None, trace_order, n_shards)
-        out = _sub(plan, batches, overflows, ctx)
+        # hoisted-literal params (plan/paramize.py) ride the batches pytree;
+        # Param expr nodes read their slots from this trace-scoped binding
+        with bind_params(batches.get(PARAMS_KEY, ())):
+            out = _sub(plan, batches, overflows, ctx)
         # nodes are host objects: expose them on the closure (filled at trace
         # time), return only the traced flags
         join_order.clear()

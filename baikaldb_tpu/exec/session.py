@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import itertools
+import threading
 import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass, field
@@ -36,9 +37,11 @@ from ..sql.stmt import (AlterTableStmt, CreateDatabaseStmt, CreateTableStmt, Del
                         ExplainStmt, InsertStmt, SelectStmt, ShowStmt,
                         SetStmt, TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
 from ..meta.privileges import READ, WRITE, AccessError, PrivilegeManager
-from ..sql.stmt import (CreateUserStmt, CreateViewStmt, DropUserStmt,
-                        DropViewStmt, GrantStmt, HandleStmt,
-                        LoadDataStmt, RevokeStmt)
+from ..sql.stmt import (CreateUserStmt, CreateViewStmt, DeallocateStmt,
+                        DropUserStmt,
+                        DropViewStmt, ExecuteStmt, GrantStmt, HandleStmt,
+                        LoadDataStmt, PrepareStmt, RevokeStmt)
+from ..plan import paramize
 from ..storage.column_store import ROWID as ROWID_COL
 from ..storage.column_store import (TableStore, check_cold_readable,
                                     schema_to_arrow)
@@ -50,6 +53,10 @@ from ..utils.flags import FLAGS, define
 define("cold_fs_dir", "",
        "external cold-storage root (posix AFS stand-in); empty = cold "
        "tier disabled")
+define("param_queries", True,
+       "auto-parameterize WHERE literals (plan/paramize.py): one plan-cache "
+       "entry and one compiled executable serve every literal variant of a "
+       "query shape; 0 restores SQL-text-keyed caching with baked literals")
 from .executor import compile_plan
 
 # join overflow retry budget lives in FLAGS.join_retry_max: retries settle
@@ -239,6 +246,31 @@ class Result:
         return r[0][0] if r else None
 
 
+class _TableBinlogRetry:
+    """One table's CDC retry state: a queue of failed distributed-binlog
+    event batches plus the lock that serializes this table's drain/append
+    rounds.  Rank 20: acquired INSIDE the store lock (10) by the autocommit
+    CDC path and BEFORE the replicated tier's lock (30) when a queued append
+    retries through the distributed binlog.  Every instance shares the
+    runtime name ``db.binlog_retry_mu`` — one rank covers the per-table
+    family, and two tables' locks (same rank) are never nested."""
+
+    __slots__ = ("mu", "q")
+    RANK = 20
+
+    def __init__(self):
+        from ..analysis.runtime import GuardedLock
+        self.mu = GuardedLock("db.binlog_retry_mu", rank=self.RANK)
+        self.q: deque = deque()
+
+
+# instances are lazy (first binlogged table), but the declared rank must be
+# visible to the static<->runtime consistency check from import time
+from ..analysis.runtime import LOCK_RANKS as _LOCK_RANKS  # noqa: E402
+
+_LOCK_RANKS.setdefault("db.binlog_retry_mu", _TableBinlogRetry.RANK)
+
+
 class Database:
     """Shared engine state: catalog + table stores (one per server).
 
@@ -289,17 +321,16 @@ class Database:
         # wire server (reference: show processlist over NetworkServer conns)
         self.processlist: dict[int, dict] = {}
         # committed-txn CDC batches whose distributed-binlog append failed:
-        # queued (table_key, events) pairs retried on later flushes instead
+        # PER-TABLE queues of event batches retried on later flushes instead
         # of silently dropped (bounded; overflow counts in
-        # metrics.binlog_events_dropped).  The lock serializes drain/append
-        # rounds across thread-per-connection sessions — concurrent commits
-        # would otherwise pop an empty deque and reorder a table's stream
-        from ..analysis.runtime import GuardedLock
-        self.binlog_retry: deque = deque()
-        # rank 20: acquired INSIDE the store lock (10) by the autocommit
-        # drain, and BEFORE the replicated tier's lock (30) when a queued
-        # append retries through the distributed binlog
-        self.binlog_retry_mu = GuardedLock("db.binlog_retry_mu", rank=20)
+        # metrics.binlog_events_dropped).  CDC ordering is a per-table
+        # contract, so each table gets its own queue+lock: one table's dead
+        # binlog region no longer convoys every other table's commits (the
+        # old engine-wide db.binlog_retry_mu), and holding the table's lock
+        # across the drain-check AND the append closes the release-to-append
+        # race the global design had in column_store._write_hot
+        self._binlog_retry: dict[str, _TableBinlogRetry] = {}
+        self._binlog_retry_reg_mu = threading.Lock()    # registry dict only
         self.data_dir = data_dir
         # external cold-storage FS (AFS stand-in, storage/coldfs): segment
         # bytes live here, manifests replicate through the region groups
@@ -326,37 +357,71 @@ class Database:
     def store(self, key: str) -> TableStore:
         return self.stores[key]
 
-    _BINLOG_RETRY_MAX = 1024    # queued batches; beyond this, oldest drop
+    _BINLOG_RETRY_MAX = 1024    # queued batches PER TABLE; beyond, oldest drop
+
+    def binlog_retry_queue(self, table_key: str) -> _TableBinlogRetry:
+        """This table's retry state (created on first use)."""
+        rq = self._binlog_retry.get(table_key)
+        if rq is None:
+            with self._binlog_retry_reg_mu:
+                rq = self._binlog_retry.setdefault(table_key,
+                                                   _TableBinlogRetry())
+        return rq
+
+    def binlog_retry_pending(self) -> list[str]:
+        """Tables with queued retry batches (unlocked snapshot — callers
+        take the per-table lock before acting)."""
+        return [tk for tk, rq in list(self._binlog_retry.items()) if rq.q]
+
+    def binlog_retry_depth(self, table_key: Optional[str] = None) -> int:
+        """Queued batch count, per table or engine-wide (tests/metrics)."""
+        if table_key is not None:
+            rq = self._binlog_retry.get(table_key)
+            return len(rq.q) if rq is not None else 0
+        return sum(len(rq.q) for rq in list(self._binlog_retry.values()))
+
+    def discard_binlog_retry(self, table_key: str) -> None:
+        """Forget a DROPPED table's retry state: queued batches count as
+        dropped (no table, no subscribers to replay to — retrying them
+        forever against dist.append would be phantom CDC), and the registry
+        entry goes away so the per-commit pending scan stays O(live tables)
+        under create/drop churn."""
+        with self._binlog_retry_reg_mu:
+            rq = self._binlog_retry.pop(table_key, None)
+        if rq is not None:
+            with rq.mu:
+                while rq.q:
+                    metrics.binlog_events_dropped.add(len(rq.q.popleft()))
 
     def drain_binlog_retry(self, dist) -> None:
-        """Re-attempt queued distributed-binlog appends.  Thread-safe; the
-        autocommit DML path (TableStore._write_hot) calls this before its
-        own CDC append so queued batches land first and the per-table
-        stream order holds."""
-        with self.binlog_retry_mu:
-            self._drain_binlog_retry_locked(dist)
+        """Re-attempt queued distributed-binlog appends, table by table.
+        Thread-safe; tables are independent — one table's dead binlog
+        region stops only ITS queue, never another table's."""
+        for tk in self.binlog_retry_pending():
+            rq = self.binlog_retry_queue(tk)
+            with rq.mu:
+                self._drain_rq_locked(rq, tk, dist)
 
-    def _drain_binlog_retry_locked(self, dist) -> None:
-        """Arrival-order drain; the first failure stops it (the backend is
-        likely still down — later batches must not jump the queue).
-        Caller holds binlog_retry_mu."""
-        q = self.binlog_retry
+    def _drain_rq_locked(self, rq: _TableBinlogRetry, table_key: str,
+                         dist) -> None:
+        """Arrival-order drain of ONE table's queue; the first failure stops
+        it (the region is likely still down — later batches of this table
+        must not jump the queue).  Caller holds rq.mu."""
+        q = rq.q
         for _ in range(len(q)):
-            table_key, events = q.popleft()
+            events = q.popleft()
             try:
                 dist.append(table_key, events)
             except Exception:   # noqa: BLE001
-                q.appendleft((table_key, events))
+                q.appendleft(events)
                 break
 
-    def _queue_binlog_retry_locked(self, table_key: str,
-                                   events: list) -> None:
-        """Caller holds binlog_retry_mu."""
-        q = self.binlog_retry
-        q.append((table_key, events))
+    def _queue_rq_locked(self, rq: _TableBinlogRetry, events: list) -> None:
+        """Caller holds rq.mu."""
+        rq.q.append(events)
         metrics.binlog_retry_queued.add(len(events))
-        while len(q) > self._BINLOG_RETRY_MAX:
-            _, dropped = q.popleft()
+        while len(rq.q) > self._BINLOG_RETRY_MAX:
+            dropped = rq.q.popleft()
             metrics.binlog_events_dropped.add(len(dropped))
 
     def dist_binlog(self):
@@ -558,6 +623,9 @@ class Session:
         # binlog events buffered until COMMIT (discarded on ROLLBACK) so CDC
         # subscribers never see uncommitted changes
         self._txn_binlog: list = []
+        # PREPARE name FROM '...' bodies (text, re-parsed per EXECUTE; the
+        # auto-parameterized plan cache dedups the compiled executables)
+        self._prepared: dict[str, str] = {}
 
     def _log_binlog(self, event_type, db_name, table, rows=None, statement="",
                     affected=0):
@@ -847,6 +915,42 @@ class Session:
                 self.session_vars[name] = value
         return Result()
 
+    # -- prepared statements (textual PREPARE/EXECUTE; the wire server's
+    # COM_STMT_* path binds ?s into text and rides the same normalizer) ----
+    def _prepare_stmt(self, s: PrepareStmt) -> Result:
+        stmts = parse_sql(s.sql)
+        if len(stmts) != 1:
+            raise PlanError("PREPARE body must be a single statement")
+        if not isinstance(stmts[0], (SelectStmt, InsertStmt, UpdateStmt,
+                                     DeleteStmt)):
+            raise PlanError("PREPARE supports SELECT/INSERT/UPDATE/DELETE")
+        self._prepared[s.name] = s.sql
+        return Result()
+
+    def _execute_prepared(self, s: ExecuteStmt) -> Result:
+        sql = self._prepared.get(s.name)
+        if sql is None:
+            raise PlanError(f"unknown prepared statement {s.name!r}")
+        vals = [self.session_vars.get("@" + v) if kind == "var" else v
+                for kind, v in s.params]
+        stmt = parse_sql(sql)[0]
+        need = paramize.count_placeholders(stmt)
+        if need != len(vals):
+            raise PlanError(f"prepared statement {s.name!r} needs {need} "
+                            f"parameters, got {len(vals)}")
+        bound = paramize.substitute_placeholders(stmt, vals)
+        metrics.prepared_executes.add(1)
+        self._access_check(bound)
+        if isinstance(bound, SelectStmt):
+            bound, env = self._resolve_session_exprs(bound)
+            # the text key carries the bound values: distinct values that
+            # land in PINNED positions (IN lists, LIMIT) must not collide;
+            # hoistable values collapse onto one normalized entry anyway
+            key = None if env else \
+                (f"{sql} /*execute:{vals!r}*/", self.current_db)
+            return self._select(bound, cache_key=key)
+        return self._execute_stmt(bound)
+
     # -- dispatch -----------------------------------------------------------
     def _execute_stmt(self, s) -> Result:
         # DDL implicitly commits any open transaction (MySQL semantics);
@@ -855,6 +959,15 @@ class Session:
                           DropDatabaseStmt, TruncateStmt, AlterTableStmt,
                           CreateViewStmt, DropViewStmt)):
             self._commit_txn()
+        if isinstance(s, PrepareStmt):
+            return self._prepare_stmt(s)
+        if isinstance(s, ExecuteStmt):
+            return self._execute_prepared(s)
+        if isinstance(s, DeallocateStmt):
+            if s.name not in self._prepared:
+                raise PlanError(f"unknown prepared statement {s.name!r}")
+            del self._prepared[s.name]
+            return Result()
         if isinstance(s, (SelectStmt, UpdateStmt, DeleteStmt, InsertStmt)):
             # connection-env expressions are legal anywhere MySQL allows
             # an expression — DML included
@@ -937,6 +1050,7 @@ class Session:
             self.db.catalog.drop_table(db, s.table.name, s.if_exists)
             st = self.db.stores.pop(f"{db}.{s.table.name}", None)
             self._drop_durable(f"{db}.{s.table.name}", st)
+            self.db.discard_binlog_retry(f"{db}.{s.table.name}")
             for rn in rollups:
                 rt = rollup_table_name(s.table.name, rn)
                 self.db.catalog.drop_table(db, rt, if_exists=True)
@@ -963,6 +1077,7 @@ class Session:
             self.db.catalog.drop_database(s.name, s.if_exists)
             for k in [k for k in self.db.stores if k.startswith(s.name + ".")]:
                 self._drop_durable(k, self.db.stores.pop(k))
+                self.db.discard_binlog_retry(k)
             self.db.save_catalog()
             return Result()
         if isinstance(s, UseStmt):
@@ -1681,7 +1796,7 @@ class Session:
     def _flush_txn_binlog(self):
         # an empty commit still flows through: pending retry batches (failed
         # appends of EARLIER commits) piggyback a drain on any commit
-        if not self._txn_binlog and not self.db.binlog_retry:
+        if not self._txn_binlog and not self.db.binlog_retry_pending():
             return
         from ..storage.binlog_regions import DistributedBinlog
 
@@ -1699,27 +1814,37 @@ class Session:
         # dist_binlog() resolves only when a binlogged event exists: it
         # creates the __binlog__ regions cluster-wide on first use
         dist = self.db.dist_binlog() \
-            if per_table or self.db.binlog_retry else None
+            if per_table or self.db.binlog_retry_pending() else None
         if dist is not None:
             # CDC must not fail the txn the user already committed — but a
             # failed append is COMMITTED data subscribers would silently
             # lose.  Queue it durably in-process and retry on later flushes;
             # only a bounded-queue overflow drops events, and that shows in
-            # metrics.binlog_events_dropped
+            # metrics.binlog_events_dropped.  Per-table locks: each table's
+            # drain-then-append is atomic vs concurrent commits/autocommits
+            # of THAT table (the stream-order contract), while other tables
+            # proceed in parallel — no engine-wide convoy.  Locks are taken
+            # one table at a time, never nested.
             db = self.db
-            with db.binlog_retry_mu:
-                db._drain_binlog_retry_locked(dist)
-                blocked = {tk for tk, _ in db.binlog_retry}
-                for table_key, events in per_table.items():
-                    if table_key in blocked:
+            # piggyback: retry other tables' queued batches on any commit
+            for tk in db.binlog_retry_pending():
+                if tk not in per_table:
+                    rq = db.binlog_retry_queue(tk)
+                    with rq.mu:
+                        db._drain_rq_locked(rq, tk, dist)
+            for table_key, events in per_table.items():
+                rq = db.binlog_retry_queue(table_key)
+                with rq.mu:
+                    db._drain_rq_locked(rq, table_key, dist)
+                    if rq.q:
                         # an older batch for this table is still queued:
                         # appending now would reorder the table's CDC stream
-                        db._queue_binlog_retry_locked(table_key, events)
+                        db._queue_rq_locked(rq, events)
                         continue
                     try:
                         dist.append(table_key, events)
                     except Exception:   # noqa: BLE001
-                        db._queue_binlog_retry_locked(table_key, events)
+                        db._queue_rq_locked(rq, events)
         self._txn_binlog.clear()
 
     def _table_binlogged(self, db_name: str, table: str) -> bool:
@@ -3190,10 +3315,57 @@ class Session:
         if any(_has_gc(it.expr) for it in stmt.items) or _has_gc(stmt.having) \
                 or any(_has_gc(o.expr) for o in stmt.order_by):
             return self._select_group_concat(stmt)
-        entry = self._plan_cache.get(cache_key) if cache_key else None
+        # auto-parameterization (plan/paramize.py): hoist WHERE literals
+        # into a runtime params vector and key the plan cache on the
+        # canonical statement structure — WHERE id = 42 and WHERE id = 43
+        # share one entry AND one compiled executable.  Mesh programs stay
+        # text-keyed: shard_map's in_specs partition every batches leaf and
+        # scalar params cannot ride that pytree.
+        norm = None
+        lookup_key = cache_key
+        stmt_run = stmt
+        if cache_key is not None and self.mesh is None \
+                and bool(FLAGS.param_queries):
+            try:
+                n = paramize.normalize(stmt, self._param_resolver(stmt))
+            except Exception:   # noqa: BLE001 — normalization is an
+                #                 optimization; a bug must not fail the query
+                metrics.count_swallowed("session.paramize")
+                n = None
+            if n is not None and n.slots:
+                norm = n
+                lookup_key = ("//params", self.current_db, n.key)
+                stmt_run = n.stmt
+                metrics.params_hoisted.add(len(n.slots))
+        if norm is None:
+            return self._select_cached(stmt, cache_key, cache_key, None)
+        from ..expr.compile import ExprError
+        from ..expr.params import ParamError
+        self._param_counted = False
+        try:
+            return self._select_cached(stmt_run, cache_key, lookup_key, norm)
+        except (paramize.BindError, ExprError, ParamError, PlanError):
+            # conservative valve: anything the parameterized path cannot
+            # express replans with baked literals (a genuine user error
+            # re-raises identically from the baked run)
+            self._plan_cache.pop(lookup_key, None)
+            # hold the one-count-per-SELECT invariant: the baked re-run
+            # only counts if the param attempt died before its counter
+            res = self._select_cached(stmt, cache_key, cache_key, None,
+                                      count=not self._param_counted)
+            # counted only when the baked run SUCCEEDED: a genuine user
+            # error (unknown column, bad subquery) re-raised above and is
+            # not a param-machinery fallback — the metric stays an alarm
+            # for the parameterized path itself
+            metrics.plan_cache_param_fallbacks.add(1)
+            return res
+
+    def _select_cached(self, stmt: SelectStmt, text_key, lookup_key,
+                       norm, count: bool = True) -> Result:
+        entry = self._plan_cache.get(lookup_key) if lookup_key else None
         replanned = False
         if entry is not None:
-            self._plan_cache.move_to_end(cache_key)
+            self._plan_cache.move_to_end(lookup_key)
             # stats-derived plan choices (dense group-by domains, key shifts)
             # go stale when data changes: replan on any version bump
             stale = any(self.db.stores.get(tk) is None or
@@ -3220,28 +3392,84 @@ class Session:
                     # cost terms this is a miss, and the hit/miss split is
                     # how recompile churn shows on dashboards
                     replanned = True
-        (metrics.plan_cache_hits if entry is not None and not replanned
-         else metrics.plan_cache_misses).add(1)
+        hit = entry is not None and not replanned
+        hit_text = entry.get("text") if entry is not None else None
         if entry is None:
             plan = self._plan_select(stmt)
             entry = {"plan": plan, "plan_sig": plan_signature(plan),
                      "compiled": {}, "versions": {},
-                     "view_gen": self.db.catalog.view_gen}
+                     "view_gen": self.db.catalog.view_gen,
+                     "text": text_key[0] if text_key else None}
             cap = int(FLAGS.plan_cache_size)
-            if cache_key and cap > 0:
-                self._plan_cache[cache_key] = entry
+            if lookup_key and cap > 0:
+                self._plan_cache[lookup_key] = entry
                 while len(self._plan_cache) > cap:
                     self._plan_cache.popitem(last=False)
+        # accounting invariant (tests/test_param_cache.py): each SELECT
+        # counts exactly one of {hit, param_hit, miss} — counted AFTER the
+        # fallible planning so a param-path fallback can re-count iff this
+        # attempt never did.  A hit that still re-traces downstream
+        # (capacity-bucket crossing) is a plan-level HIT — the trace shows
+        # in xla_retraces/compile_ms, never as a plan-cache miss
+        if count:
+            if hit:
+                if norm is not None and text_key is not None \
+                        and hit_text != text_key[0]:
+                    metrics.plan_cache_param_hits.add(1)
+                else:
+                    metrics.plan_cache_hits.add(1)
+            else:
+                metrics.plan_cache_misses.add(1)
+            self._param_counted = True
         plan = entry["plan"]
-        batches, shape_key, _full = self._collect_batches(plan)
+        # host-side access paths (index gather, zonemap/partition pruning)
+        # see this execution's literal values even though the compiled plan
+        # does not: _access_path_batch substitutes them into pushed filters
+        self._param_subst = {s.index: s for s in norm.slots} \
+            if norm is not None else None
+        try:
+            batches, shape_key, _full = self._collect_batches(plan)
+        finally:
+            self._param_subst = None
         entry["versions"] = {tk: v for tk, v, _ in shape_key}
+        if norm is not None:
+            from ..expr.params import PARAMS_KEY
+            batches[PARAMS_KEY] = paramize.bind(norm.slots, batches)
         t0 = time.perf_counter()
         result = self._run_plan(entry, batches, shape_key)
         table = result.to_arrow()
         dur_ms = (time.perf_counter() - t0) * 1e3
-        if cache_key is not None:
-            self.db.query_log.append((cache_key[0], dur_ms, table.num_rows))
+        if text_key is not None:
+            self.db.query_log.append((text_key[0], dur_ms, table.num_rows))
         return Result(columns=list(table.column_names), arrow=table)
+
+    def _param_resolver(self, stmt: SelectStmt):
+        """(table_label, column) -> (table_key, LType) against the live
+        catalog, for paramize's string-literal binder analysis.  Only plain
+        base tables resolve; derived tables/views/ambiguous names return
+        None, pinning their comparands."""
+        tables: dict = {}
+        for r in [stmt.table] + [j.table for j in stmt.joins]:
+            if r is None or r.subquery is not None:
+                continue
+            db = r.database or self.current_db
+            try:
+                info = self.db.catalog.get_table(db, r.name)
+            except (ValueError, KeyError):      # view/unknown name: pin
+                continue
+            tables[r.label] = (f"{db}.{r.name}", info.schema)
+
+        def resolve(tlabel, col):
+            cname = col.split(".")[-1]
+            if tlabel is not None:
+                ent = tables.get(tlabel)
+                if ent is not None and cname in ent[1]:
+                    return (ent[0], ent[1].field(cname).ltype)
+                return None
+            hits = [(tk, sch.field(cname).ltype)
+                    for tk, sch in tables.values() if cname in sch]
+            return hits[0] if len(hits) == 1 else None
+        return resolve
 
     def _explain_analyze(self, stmt: SelectStmt) -> Result:
         """EXPLAIN ANALYZE: run the query once, report per-operator live-row
@@ -3301,6 +3529,19 @@ class Session:
         lines.append(f"-- xla: retraces_total={metrics.xla_retraces.value} "
                      f"compiles={cstats['count']} "
                      f"compile_avg_ms={cstats['avg_ms']}")
+        # literal auto-parameterization: how many literals the normalizer
+        # hoists into runtime params vs pins into the cache key for this
+        # statement (plan/paramize.py; pinned = shape/trace-time feeders)
+        try:
+            nz = paramize.normalize(stmt, self._param_resolver(stmt)) \
+                if bool(FLAGS.param_queries) and self.mesh is None else None
+        except Exception:   # noqa: BLE001 — display stays best-effort
+            metrics.count_swallowed("session.explain_paramize")
+            nz = None
+        hoisted = nz.hoisted if nz is not None else 0
+        pinned = nz.pinned if nz is not None else paramize._count_lits(stmt)
+        lines.append(f"-- params: hoisted={hoisted} pinned={pinned} "
+                     f"param_hits_total={metrics.plan_cache_param_hits.value}")
         gs = guard_stats()
         lines.append(f"-- guards: mode={gs['mode']} "
                      f"transfer_trips={gs['transfer_trips']} "
@@ -3410,9 +3651,16 @@ class Session:
 
         if n.pushed_filter is None:
             return None
+        pf = n.pushed_filter
+        subst = getattr(self, "_param_subst", None)
+        if subst:
+            # parameterized plan: the filter carries Param markers; the
+            # access-path analysis is host-side and per-execution, so it
+            # gets this execution's literal values substituted back in
+            pf = paramize.substitute_params(pf, subst)
         try:
             info = self.db.catalog.get_table(db, name)
-            pred = analyze_conjuncts(n.pushed_filter)
+            pred = analyze_conjuncts(pf)
             access = choose_access(info, store, pred, db=self.db)
         except Exception:
             return None
@@ -3739,7 +3987,11 @@ class Session:
             if pair is None:
                 raw = compile_plan(plan, mesh=mesh)
                 # not a per-iteration wrapper: built only on a shape-cache
-                # miss and cached in entry["compiled"] keyed by shape_key
+                # miss and cached in entry["compiled"] keyed by shape_key.
+                # The final compact stays EAGER (outside the jit): its
+                # partition scatter is expensive to compile, and the eager
+                # op cache pays that once per capacity shape process-wide
+                # instead of once per cached executable
                 pair = (jax.jit(raw), raw)  # tpulint: disable=RETRACE
                 comp = entry["compiled"]
                 # distinct shapes (bucket crossings, access-path batches)
@@ -3780,6 +4032,33 @@ class Session:
                     node.cap = max(16, 1 << (needed - 1).bit_length())
                     grew = True
             if not grew:
-                return compact(out)
+                return self._egress_compact(out)
             entry["compiled"].pop(shape_key, None)  # caps changed: re-trace
         raise RuntimeError("join output cap still overflowing after retries")
+
+    def _egress_compact(self, batch: ColumnBatch) -> ColumnBatch:
+        """Densify the finished result for egress, O(live) not O(capacity).
+
+        The generic compact permutes every lane of the batch — for a
+        selective point read that is a full-capacity scatter+gather to
+        surface a handful of rows, and it dominated steady-state latency.
+        Egress is the sanctioned sync point, so fetch the (scalar) live
+        count first and gather just the live indices into a pow2-padded
+        batch: the eager nonzero/gather kernels cache per (capacity, cap)
+        pair, and num_rows trims the padding at to_arrow time."""
+        import jax.numpy as jnp
+
+        if batch.sel is None or batch.live_prefix or len(batch) == 0:
+            return compact(batch)
+        sel = batch.sel_mask()
+        cs = jnp.cumsum(sel.astype(jnp.int32))
+        n = int(jax.device_get(cs[-1]))         # egress: one scalar fetch
+        cap = min(len(batch), max(16, 1 << max(0, n - 1).bit_length()))
+        # index of the k-th live row = first i with cumsum[i] >= k; a
+        # vectorized binary search, not jnp.nonzero (whose CPU lowering is
+        # an order of magnitude slower at this capacity)
+        idx = jnp.searchsorted(cs, jnp.arange(1, cap + 1, dtype=jnp.int32))
+        out = batch.gather(jnp.clip(idx, 0, len(batch) - 1))
+        out.num_rows = jnp.asarray(n, jnp.int32)
+        out.sel = jnp.arange(cap) < n
+        return out

@@ -20,7 +20,8 @@ from typing import Any, Optional
 
 from ..types import LType
 
-__all__ = ["Expr", "ColRef", "Lit", "Call", "AggCall", "col", "lit", "call"]
+__all__ = ["Expr", "ColRef", "Lit", "Call", "AggCall", "Param",
+           "Placeholder", "col", "lit", "call"]
 
 
 class Expr:
@@ -81,6 +82,44 @@ class Lit(Expr):
 
     def __repr__(self):
         return repr(self.value)
+
+
+@dataclass(frozen=True, eq=False)
+class Param(Expr):
+    """A hoisted literal: slot ``index`` of the runtime parameter vector.
+
+    Produced by plan/paramize.py when a statement auto-parameterizes
+    (BaikalDB's prepared-statement plan reuse mapped onto jit): the traced
+    program reads the value from the params pytree passed alongside the
+    table batches, so one compiled executable serves every literal variant.
+    ``kind`` selects the device encoding: "scalar" is one typed scalar;
+    "strcmp" is a (lo, hi) dictionary-code range bound per execution against
+    the compared column's dictionary (string identity never enters the
+    trace)."""
+
+    index: int
+    ltype: Optional[LType] = None
+    kind: str = "scalar"        # scalar | strcmp
+
+    def key(self):
+        return ("param", self.index, self.ltype, self.kind)
+
+    def __repr__(self):
+        return f"?p{self.index}"
+
+
+@dataclass(frozen=True, eq=False)
+class Placeholder(Expr):
+    """A ``?`` marker from the parser (PREPARE/COM_STMT text).  Never reaches
+    the planner: EXECUTE substitutes a Lit per slot before planning."""
+
+    index: int
+
+    def key(self):
+        return ("?", self.index)
+
+    def __repr__(self):
+        return "?"
 
 
 @dataclass(frozen=True, eq=False)

@@ -33,7 +33,8 @@ from ..column.batch import Column, ColumnBatch
 from ..column.dictionary import NULL_CODE, Dictionary, merge as dict_merge
 from ..types import LType, promote
 from ..utils import datetime_kernels as dtk
-from .ast import AggCall, Call, ColRef, Expr, Lit
+from .ast import AggCall, Call, ColRef, Expr, Lit, Param
+from .params import ParamStrBounds, current_param
 
 
 class HostStr(str):
@@ -55,6 +56,9 @@ def eval_expr(e: Expr, batch: ColumnBatch) -> Column:
     if isinstance(r, HostStr):
         raise ExprError(f"string-valued expression {e!r} must be consumed by a "
                         "string-aware operator (comparison/LIKE/IN) or egress")
+    if isinstance(r, ParamStrBounds):
+        raise ExprError(f"string param in {e!r} is only valid as a direct "
+                        "comparison operand (plan/paramize.py must pin it)")
     return r
 
 
@@ -85,6 +89,10 @@ def infer_type(e: Expr, schema) -> LType:
         return schema.field(e.name).ltype
     if isinstance(e, Lit):
         return _lit_type(e)
+    if isinstance(e, Param):
+        if e.ltype is None:
+            raise ExprError(f"untyped param {e!r}")
+        return e.ltype
     if isinstance(e, AggCall):
         from ..ops.hashagg import agg_result_type
         at = infer_type(e.args[0], schema) if e.args else LType.INT64
@@ -136,6 +144,13 @@ def _eval(e: Expr, batch: ColumnBatch):
         if lt is LType.STRING:
             return HostStr(v)
         return Column(jnp.asarray(v, lt.np_dtype), None, lt)
+    if isinstance(e, Param):
+        v = current_param(e.index)
+        if e.kind == "strcmp":
+            # (lo, hi) dictionary-code bounds, computed at bind time against
+            # the compared column's dictionary (exec/session.py _bind_params)
+            return ParamStrBounds(v[0], v[1])
+        return Column(v, None, e.ltype)
     if isinstance(e, AggCall):
         raise ExprError(f"aggregate {e!r} must be hoisted by the planner")
     if isinstance(e, Call):
@@ -164,6 +179,9 @@ _TEMPORAL_ARG_FNS = {
 
 
 def _devalue_hoststr(a, op):
+    if isinstance(a, ParamStrBounds):
+        raise ExprError(f"string param not supported as argument of {op!r}; "
+                        "valid only as a direct comparison operand")
     if isinstance(a, HostStr):
         if op in _TEMPORAL_ARG_FNS:
             c = _temporal_hoststr(a)
@@ -526,6 +544,16 @@ for _op in _CMP:
 
 
 def _compare(op, a, b, batch) -> Column:
+    if isinstance(a, ParamStrBounds) or isinstance(b, ParamStrBounds):
+        flip = isinstance(a, ParamStrBounds)
+        colc, pb = (b, a) if flip else (a, b)
+        if flip:
+            op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(op, op)
+        if not (isinstance(colc, Column) and colc.ltype is LType.STRING
+                and colc.dictionary is not None):
+            raise ExprError("string param requires a dictionary-encoded "
+                            "string column operand")
+        return _cmp_code_bounds(op, colc, pb.lo, pb.hi)
     if isinstance(a, HostStr) and isinstance(b, HostStr):
         r = {"eq": a == b, "ne": a != b, "lt": a < b, "le": a <= b,
              "gt": a > b, "ge": a >= b}[op]
@@ -566,7 +594,12 @@ def _compare(op, a, b, batch) -> Column:
 
 def _cmp_code_literal(op, c: Column, s: str) -> Column:
     d = c.dictionary
-    lo, hi = d.lower_bound(s), d.upper_bound(s)
+    return _cmp_code_bounds(op, c, d.lower_bound(s), d.upper_bound(s))
+
+
+def _cmp_code_bounds(op, c: Column, lo, hi) -> Column:
+    """Range test over dictionary codes; lo/hi may be trace-time host ints
+    (baked literal) or traced scalars (strcmp param)."""
     codes = c.data
     if op == "eq":
         data = (codes >= lo) & (codes < hi)

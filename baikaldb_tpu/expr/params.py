@@ -1,0 +1,63 @@
+"""Trace-time parameter feed for auto-parameterized plans.
+
+The executor's traced body binds the params pytree (riding the batches dict
+under ``PARAMS_KEY``) here before lowering the plan; expr/compile.py's
+``Param`` handler reads slots back out.  The values are jax tracers during
+tracing and device scalars during eager debugging — never host python
+scalars, so the compiled executable stays literal-independent.
+
+Thread-local: sessions are thread-per-connection and two threads may trace
+concurrently.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+# reserved key in the batches dict fed to the jitted plan; ScanNodes look up
+# real table keys ("db.table") so a dunder name can never collide
+PARAMS_KEY = "__params__"
+
+_tls = threading.local()
+
+
+class ParamError(Exception):
+    """A Param slot could not be served from the bound params pytree.
+    Deliberately NOT a LookupError: the session's baked-literal fallback
+    catches this type specifically, and must never swallow an unrelated
+    KeyError/IndexError from the execution stack."""
+
+
+class ParamStrBounds:
+    """A strcmp param travelling through the expr compiler: traced (lo, hi)
+    dictionary-code bounds, consumed by comparison handlers the way a host
+    string literal's searched bounds are."""
+
+    __slots__ = ("lo", "hi")
+
+    def __init__(self, lo, hi):
+        self.lo = lo
+        self.hi = hi
+
+
+@contextmanager
+def bind_params(values):
+    """Make ``values`` (tuple of jnp scalars / (2,) code-bound arrays)
+    visible to Param evaluation for the duration of a trace."""
+    prev = getattr(_tls, "values", None)
+    _tls.values = values
+    try:
+        yield
+    finally:
+        _tls.values = prev
+
+
+def current_param(index: int):
+    values = getattr(_tls, "values", None)
+    if values is None or index >= len(values):
+        raise ParamError(
+            f"param slot {index} unbound: the plan was compiled from a "
+            "parameterized statement but no params pytree was fed "
+            f"({0 if values is None else len(values)} slots bound)")
+    return values[index]

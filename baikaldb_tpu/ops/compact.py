@@ -30,7 +30,15 @@ def compact(batch: ColumnBatch) -> ColumnBatch:
                            jnp.arange(len(batch)) < n, n, live_prefix=True)
     sel = batch.sel
     n = jnp.sum(sel).astype(jnp.int32)
-    order = jnp.argsort(~sel, stable=True)
+    if len(batch) == 0:
+        out = batch.gather(jnp.zeros((0,), jnp.int32))
+        out.num_rows = n
+        out.sel = jnp.zeros((0,), bool)
+        return out
+    # O(n) prefix-sum partition, not an O(n log n) stable argsort — same
+    # live-first stable order, and the dominant cost of a selective point
+    # read's final compact at full capacity
+    order = stable_partition(sel)
     out = batch.gather(order)
     out.num_rows = n
     # rows past n keep stale data; mark them dead for any mask-aware consumer

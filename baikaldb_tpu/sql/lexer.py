@@ -125,7 +125,7 @@ def tokenize(sql: str) -> list[Token]:
             out.append(Token("OP", two, i))
             i += 2
             continue
-        if c in "+-*/%(),.;=<>!@:":
+        if c in "+-*/%(),.;=<>!@:?":
             out.append(Token("OP", c, i))
             i += 1
             continue

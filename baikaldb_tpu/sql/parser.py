@@ -13,14 +13,17 @@ from __future__ import annotations
 
 from typing import Optional
 
-from ..expr.ast import AggCall, Call, ColRef, Expr, Lit, Subquery, WindowCall
+from ..expr.ast import (AggCall, Call, ColRef, Expr, Lit, Placeholder,
+                        Subquery, WindowCall)
 from .lexer import SqlError, Token, tokenize
 from .stmt import (AlterTableStmt, ColumnDef, CreateDatabaseStmt,
                    CreateTableStmt, CreateUserStmt, CreateViewStmt,
-                   DeleteStmt, DescribeStmt, DropDatabaseStmt, DropTableStmt,
-                   DropUserStmt, DropViewStmt, ExplainStmt,
+                   DeallocateStmt, DeleteStmt, DescribeStmt,
+                   DropDatabaseStmt, DropTableStmt,
+                   DropUserStmt, DropViewStmt, ExecuteStmt, ExplainStmt,
                    GrantStmt, HandleStmt, InsertStmt, JoinClause,
-                   LoadDataStmt, OrderItem, RevokeStmt, SelectItem,
+                   LoadDataStmt, OrderItem, PrepareStmt, RevokeStmt,
+                   SelectItem,
                    SelectStmt, SetStmt, ShowStmt, TableRef, TruncateStmt, TxnStmt,
                    UpdateStmt, UseStmt)
 
@@ -65,6 +68,7 @@ class Parser:
         self.toks = tokens
         self.i = 0
         self.sql = ""
+        self._n_placeholders = 0    # ? slots, numbered in parse order
 
     # -- token helpers ---------------------------------------------------
     def peek(self, k: int = 0) -> Token:
@@ -132,6 +136,16 @@ class Parser:
                 while not self.at_end() and self.peek().value != ";":
                     args.append(self.advance().value)
                 return HandleStmt(cmd.lower(), args)
+            if w == "prepare":
+                return self.prepare_stmt()
+            if w == "execute":
+                return self.execute_stmt()
+            if w == "deallocate":
+                self.advance()
+                p = self.ident()
+                if p.lower() != "prepare":
+                    raise SqlError(f"expected PREPARE, got {p!r}")
+                return DeallocateStmt(self.ident())
         if t.kind != "KW":
             raise SqlError(f"expected statement, got {t.value!r} at {t.pos}")
         if t.value in ("select", "with"):
@@ -423,8 +437,14 @@ class Parser:
         return out
 
     def literal_value(self):
-        """A literal (or signed literal / NULL) inside VALUES(...)."""
+        """A literal (or signed literal / NULL / ? placeholder) inside
+        VALUES(...)."""
         t = self.peek()
+        if t.kind == "OP" and t.value == "?":
+            self.advance()
+            ph = Placeholder(self._n_placeholders)
+            self._n_placeholders += 1
+            return ph
         if t.kind == "NUM":
             self.advance()
             return _num(t.value)
@@ -495,7 +515,10 @@ class Parser:
         t = self.peek()
         if t.kind == "OP" and t.value == "@":
             self.advance()
-            name = "@" + self.ident()
+            # MySQL user variables are case-insensitive; every read site
+            # (@var expressions, EXECUTE USING) lowercases, so the store
+            # must too or SET @Pid / EXECUTE USING @Pid silently binds NULL
+            name = "@" + self.ident().lower()
         else:
             name = self.ident()
         self.expect_op("=")
@@ -905,9 +928,43 @@ class Parser:
             self.advance()
             ie = self._if_exists()
             return DropViewStmt(self.table_name(), ie)
+        if self.peek().kind == "IDENT" and \
+                self.peek().value.lower() == "prepare":
+            self.advance()
+            return DeallocateStmt(self.ident())
         self.expect_kw("table")
         ie = self._if_exists()
         return DropTableStmt(self.table_name(), ie)
+
+    # -- prepared statements (textual protocol; reference: the PREPARE/
+    # EXECUTE branch of state_machine.cpp's query dispatch) -----------------
+    def prepare_stmt(self) -> PrepareStmt:
+        self.advance()                      # PREPARE
+        name = self.ident()
+        self.expect_kw("from")
+        t = self.advance()
+        if t.kind != "STR":
+            raise SqlError(f"PREPARE body must be a string literal, got "
+                           f"{t.value!r} at {t.pos}")
+        return PrepareStmt(name, t.value)
+
+    def execute_stmt(self) -> ExecuteStmt:
+        self.advance()                      # EXECUTE
+        name = self.ident()
+        params: list = []
+        if self.peek().kind == "KW" and self.peek().value == "using" or \
+                (self.peek().kind == "IDENT" and
+                 self.peek().value.lower() == "using"):
+            self.advance()
+            params.append(self._execute_param())
+            while self.try_op(","):
+                params.append(self._execute_param())
+        return ExecuteStmt(name, params)
+
+    def _execute_param(self):
+        if self.try_op("@"):
+            return ("var", self.ident().lower())
+        return ("lit", self.literal_value())
 
     def _user_name(self) -> str:
         t = self.advance()
@@ -1268,6 +1325,11 @@ class Parser:
 
     def _primary(self) -> Expr:
         t = self.peek()
+        if t.kind == "OP" and t.value == "?":
+            self.advance()
+            ph = Placeholder(self._n_placeholders)
+            self._n_placeholders += 1
+            return ph
         if t.kind == "IDENT" and t.value.lower() == "match" and \
                 self.peek(1).kind == "OP" and self.peek(1).value == "(":
             return self._match_against()

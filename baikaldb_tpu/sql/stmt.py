@@ -242,3 +242,26 @@ class HandleStmt:
     """Operator admin command (reference: handle_helper.cpp command map)."""
     command: str
     args: list = field(default_factory=list)
+
+
+@dataclass
+class PrepareStmt:
+    """PREPARE name FROM 'sql' (reference: COM_STMT_PREPARE and the textual
+    PREPARE of state_machine.cpp).  The body is stored as text and re-parsed
+    per EXECUTE; the auto-parameterized plan cache (plan/paramize.py) makes
+    every EXECUTE of one shape share a single compiled executable."""
+    name: str
+    sql: str
+
+
+@dataclass
+class ExecuteStmt:
+    """EXECUTE name [USING @var | literal, ...]."""
+    name: str
+    params: list = field(default_factory=list)  # ("var", name) | ("lit", v)
+
+
+@dataclass
+class DeallocateStmt:
+    """DEALLOCATE | DROP PREPARE name."""
+    name: str

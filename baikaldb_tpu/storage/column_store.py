@@ -1411,28 +1411,38 @@ class TableStore:
                 from .binlog_regions import DistributedBinlog
 
                 table_key = f"{self.info.database}.{self.info.name}"
-                if guard is not None and guard.binlog_retry:
-                    # queued CDC batches of earlier (txn-path) commits must
-                    # land before this autocommit event or the table's
-                    # stream reorders
-                    with guard.binlog_retry_mu:
-                        guard._drain_binlog_retry_locked(sink)
-                        blocked = {tk for tk, _ in guard.binlog_retry}
-                        if table_key in blocked:
-                            # the drain stopped with one of THIS table's
-                            # batches still queued (another table's append
-                            # failed first, or the backend re-broke):
+                if guard is not None:
+                    # THIS table's retry lock held across the drain-check
+                    # AND the append: a concurrent txn flush can no longer
+                    # queue a batch for this table between our check and our
+                    # write (the release-to-append race of the old global
+                    # queue).  Per-table lock, so only same-table CDC
+                    # serializes — which the stream-order contract requires
+                    # anyway — and other tables' commits proceed in parallel
+                    rq = guard.binlog_retry_queue(table_key)
+                    with rq.mu:
+                        if rq.q:
+                            # queued CDC batches of earlier (txn-path)
+                            # commits must land before this autocommit
+                            # event or the table's stream reorders
+                            guard._drain_rq_locked(rq, table_key, sink)
+                        if rq.q:
+                            # this table's binlog region is still down:
                             # appending now would jump the queue.  Commit
                             # the data and queue the event BEHIND the older
                             # batch — the txn path's discipline
                             # (session._flush_txn_binlog)
                             self.replicated.write_ops(ops)
-                            guard._queue_binlog_retry_locked(
-                                table_key, DistributedBinlog.events_of(recs))
+                            guard._queue_rq_locked(
+                                rq, DistributedBinlog.events_of(recs))
                             return
-                # distributed binlog: the CDC event rides the data's own
-                # cross-tier 2PC — present iff the data committed
-                # (storage/binlog_regions, the region_binlog analog)
+                        # distributed binlog: the CDC event rides the
+                        # data's own cross-tier 2PC — present iff the data
+                        # committed (storage/binlog_regions)
+                        sink.write_with_data(
+                            self.replicated, ops, table_key,
+                            DistributedBinlog.events_of(recs))
+                        return
                 sink.write_with_data(
                     self.replicated, ops, table_key,
                     DistributedBinlog.events_of(recs))

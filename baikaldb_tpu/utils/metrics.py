@@ -181,6 +181,22 @@ dml_rows = Counter("dml_rows")
 query_latency = LatencyRecorder("query_latency")
 plan_cache_hits = Counter("plan_cache_hits")
 plan_cache_misses = Counter("plan_cache_misses")
+# normalized-key plan-cache hits whose SQL text differs from the text that
+# built the entry: literal auto-parameterization (plan/paramize.py) serving
+# a new literal variant from an existing executable.  Split from exact-text
+# hits so dashboards show how much of the hit rate parameterization buys.
+# Accounting invariant (tests/test_param_cache.py): every cached-path SELECT
+# counts exactly one of {hits, param_hits, misses} — a hit that still
+# re-traces (capacity-bucket crossing) is a HIT at the plan level, the
+# retrace shows in xla_retraces/compile_ms only.
+plan_cache_param_hits = Counter("plan_cache_param_hits")
+# parameterized planning/binding that had to fall back to baked-literal
+# execution (unresolvable schema, bind failure, trace error): correctness
+# valve, should stay ~0
+plan_cache_param_fallbacks = Counter("plan_cache_param_fallbacks")
+# literals hoisted into runtime params across all statements
+params_hoisted = Counter("params_hoisted")
+prepared_executes = Counter("prepared_executes")
 txn_commits = Counter("txn_commits")
 txn_rollbacks = Counter("txn_rollbacks")
 wal_appends = Counter("wal_appends")

@@ -375,6 +375,102 @@ def run_mixed_bench() -> dict:
     }
 
 
+def run_point_bench() -> dict:
+    """Point-query steady state (the auto-parameterization headline): ONE
+    query shape, N distinct literals.
+
+    With param_queries off every literal is a new SQL text — full parse ->
+    plan -> trace -> XLA compile per query, the recompilation pathology of
+    TCR-backed engines.  With the normalizer on (the default) the literals
+    hoist into runtime params of one cached executable: compiles-per-query
+    drops to ~0 and throughput is bounded by dispatch, not compilation.
+    Reports steady-state queries/sec with parameterization on, the
+    per-query speedup over parameterization off, and compiles-per-query
+    observed in each phase."""
+    import pyarrow as pa
+
+    from baikaldb_tpu.exec.session import Session
+    from baikaldb_tpu.utils import metrics as _m
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    n_rows = int(os.environ.get("BENCH_POINT_ROWS", 100_000))
+    n_q = int(os.environ.get("BENCH_POINT_QUERIES", 64))
+    off_q = int(os.environ.get("BENCH_POINT_OFF_QUERIES", 8))
+    rng = np.random.default_rng(7)
+    base = pa.table({
+        # deliberately NOT a primary key: the PK point read is served by
+        # the host row tier without any device program — this measures the
+        # compiled-plan path that every non-key predicate takes
+        "id": np.arange(n_rows, dtype=np.int64),
+        "v": rng.normal(size=n_rows).astype(np.float64),
+    })
+
+    def phase(flag_on: bool, its: int):
+        set_flag("param_queries", flag_on)
+        s = Session()
+        s.execute("CREATE TABLE pt (id BIGINT, v DOUBLE)")
+        s.load_arrow("pt", base)
+        s.query("SELECT v FROM pt WHERE id = 0")      # plan + first compile
+        r0 = _m.xla_retraces.value
+        t0 = time.perf_counter()
+        for i in range(its):
+            s.query(f"SELECT v FROM pt WHERE id = {1 + (i * 9173) % n_rows}")
+        return (time.perf_counter() - t0, _m.xla_retraces.value - r0)
+
+    prev = bool(FLAGS.param_queries)
+    try:
+        on_dt, on_re = phase(True, n_q)
+        off_dt, off_re = phase(False, off_q)
+    finally:
+        set_flag("param_queries", prev)
+    on_per_query = on_dt / n_q
+    off_per_query = off_dt / off_q
+    platform = None
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:                                   # noqa: BLE001
+        pass
+    return {
+        "metric": f"point-query steady-state queries/sec "
+                  f"({n_rows / 1e3:.0f}k rows, {n_q} literals, {platform})",
+        "value": round(n_q / on_dt, 1),
+        "unit": "queries/sec",
+        "vs_baseline": round(off_per_query / on_per_query, 3),
+        "platform": platform,
+        "rows": n_rows,
+        "queries": n_q,
+        "per_query_ms": round(on_per_query * 1e3, 2),
+        "per_query_ms_unparameterized": round(off_per_query * 1e3, 2),
+        "compiles_per_query": round(on_re / n_q, 3),
+        "compiles_per_query_unparameterized": round(off_re / off_q, 3),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
+def _emit_point_line(skip_reason: str | None = None):
+    """Third JSON line: point-query steady state (parameterized plan-cache
+    reuse).  Same robustness contract: always prints a line, never raises."""
+    if os.environ.get("BENCH_SKIP_POINT") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "point-query steady-state queries/sec (skipped)",
+            "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+            "platform": "none", "error": skip_reason}))
+        return
+    try:
+        result = run_point_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "point-query steady-state queries/sec (failed)",
+                  "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+                  "platform": "none",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_mixed_line(skip_reason: str | None = None):
     """Second JSON line: the mixed read/write steady-state metric (recompile
     overhead across rounds).  Same robustness contract as the headline —
@@ -417,6 +513,8 @@ def main():
                 # never touch the wedged backend from this process
                 _emit_mixed_line(skip_reason="accelerator probe failed; "
                                  "mixed phase skipped")
+                _emit_point_line(skip_reason="accelerator probe failed; "
+                                 "point phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -451,9 +549,11 @@ def main():
                          f"on-chip result cached at "
                          f"{cached.get('captured_at')}", cpu_result=result)
             _emit_mixed_line()      # backend already ran here: measure
+            _emit_point_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
+    _emit_point_line()
     return 0
 
 

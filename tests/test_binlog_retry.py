@@ -1,10 +1,16 @@
 """Committed-txn CDC must survive a flaky distributed-binlog backend.
 
 ``_flush_txn_binlog`` used to swallow every append exception — a committed
-transaction's CDC events vanished silently.  Now failures queue on the
-Database and retry on later flushes, per-table order is preserved, and only
-a bounded-queue overflow drops events (counted in
+transaction's CDC events vanished silently.  Failures queue on the Database
+and retry on later flushes, per-table order is preserved, and only a
+bounded-queue overflow drops events (counted in
 metrics.binlog_events_dropped).
+
+The retry state is PER TABLE (queue + lock): one table's dead binlog region
+stops only that table's stream, it no longer convoys every other table's
+commits through a global lock, and the autocommit path holds the table's
+lock across its drain-check AND append — closing the release-to-append
+reorder race of the old global design.
 """
 
 from baikaldb_tpu.exec.session import Session
@@ -44,14 +50,14 @@ def test_failed_append_queues_and_retries():
     s.execute("BEGIN")
     s.execute("INSERT INTO bl VALUES (1, 1.0)")
     s.execute("COMMIT")                       # append fails -> queued
-    assert len(s.db.binlog_retry) == 1
+    assert s.db.binlog_retry_depth("default.bl") == 1
     assert metrics.binlog_retry_queued.value > q0
     assert dist.appended == []
 
     dist.fail = False
     s.execute("BEGIN")                        # empty commit still drains
     s.execute("COMMIT")
-    assert len(s.db.binlog_retry) == 0
+    assert s.db.binlog_retry_depth() == 0
     assert len(dist.appended) == 1
     assert dist.appended[0][0] == "default.bl"
 
@@ -62,7 +68,7 @@ def test_order_preserved_while_backend_down():
         s.execute("BEGIN")
         s.execute(f"INSERT INTO bl VALUES ({10 + i}, {float(i)})")
         s.execute("COMMIT")
-    assert len(s.db.binlog_retry) == 3        # all queued, none reordered
+    assert s.db.binlog_retry_depth("default.bl") == 3   # queued, in order
     dist.fail = False
     s.execute("BEGIN")
     s.execute("INSERT INTO bl VALUES (99, 9.0)")
@@ -75,12 +81,13 @@ def test_order_preserved_while_backend_down():
 def test_autocommit_drains_queue_first():
     """An autocommit CDC append must not jump ahead of queued (failed)
     txn batches for the same table — the store drains the retry queue
-    before its own event rides the data write."""
+    before its own event rides the data write (and holds the table's
+    retry lock across both, so a concurrent flush cannot interleave)."""
     s, dist = _binlogged_session()
     s.execute("BEGIN")
     s.execute("INSERT INTO bl VALUES (1, 1.0)")
     s.execute("COMMIT")                       # backend down -> queued
-    assert len(s.db.binlog_retry) == 1
+    assert s.db.binlog_retry_depth("default.bl") == 1
 
     class FakeTier:
         def write_ops(self, ops):
@@ -98,7 +105,7 @@ def test_autocommit_drains_queue_first():
     # queued txn batch landed FIRST, then the autocommit event
     assert [tk for tk, _ in dist.appended] == \
         ["default.bl", "autocommit:default.bl"]
-    assert len(s.db.binlog_retry) == 0
+    assert s.db.binlog_retry_depth() == 0
 
 
 def test_overflow_drops_are_counted(monkeypatch):
@@ -109,15 +116,15 @@ def test_overflow_drops_are_counted(monkeypatch):
         s.execute("BEGIN")
         s.execute(f"INSERT INTO bl VALUES ({20 + i}, 0.5)")
         s.execute("COMMIT")
-    assert len(s.db.binlog_retry) == 2        # bounded
+    assert s.db.binlog_retry_depth("default.bl") == 2   # bounded per table
     assert metrics.binlog_events_dropped.value > d0
 
 
-def test_autocommit_blocked_table_queues_behind_older_batch():
-    """Partial backend recovery: the drain stops on ANOTHER table's failed
-    batch while this table's own older batch is still queued.  The
-    autocommit event must queue BEHIND it (data still commits), never
-    append directly — a direct append would reorder the table's stream."""
+def test_one_dead_table_does_not_convoy_others():
+    """Partial backend recovery: bl's binlog region is still leaderless
+    while bl2's works.  With per-table queues, bl2's stream drains and
+    proceeds — in order — while bl's stays queued.  (The old global queue
+    stopped the drain at bl's batch and convoyed bl2 behind it.)"""
     s, dist = _binlogged_session()
     # create the second store before the fake cluster handle is consulted
     saved_cluster, s.db.cluster = s.db.cluster, None
@@ -128,8 +135,8 @@ def test_autocommit_blocked_table_queues_behind_older_batch():
         s.execute("BEGIN")
         s.execute(f"INSERT INTO {t} VALUES (1, 1.0)")
         s.execute("COMMIT")
-    assert [tk for tk, _ in s.db.binlog_retry] == \
-        ["default.bl", "default.bl2"]
+    assert s.db.binlog_retry_depth("default.bl") == 1
+    assert s.db.binlog_retry_depth("default.bl2") == 1
 
     class FakeTier:
         def write_ops(self, ops):
@@ -153,14 +160,68 @@ def test_autocommit_blocked_table_queues_behind_older_batch():
     dist.append = partial_append
 
     s.execute("INSERT INTO bl2 VALUES (2, 2.0)")   # autocommit on bl2
-    # nothing may land for bl2 yet: its txn batch is still queued behind
-    # bl's; the autocommit event joins the queue instead
-    assert dist.appended == []
-    assert [tk for tk, _ in s.db.binlog_retry] == \
-        ["default.bl", "default.bl2", "default.bl2"]
+    # bl2's queued txn batch lands first, then the autocommit event — bl2
+    # is NOT held hostage by bl's dead region; bl's batch stays queued
+    assert [tk for tk, _ in dist.appended] == \
+        ["default.bl2", "autocommit:default.bl2"]
+    assert s.db.binlog_retry_depth("default.bl") == 1
+    assert s.db.binlog_retry_depth("default.bl2") == 0
 
     dist.append = real_append                  # full recovery
     s.db.drain_binlog_retry(dist)
-    assert [tk for tk, _ in dist.appended] == \
-        ["default.bl", "default.bl2", "default.bl2"]
-    assert len(s.db.binlog_retry) == 0
+    assert [tk for tk, _ in dist.appended][-1] == "default.bl"
+    assert s.db.binlog_retry_depth() == 0
+
+
+def test_drop_table_discards_retry_state():
+    """DROP TABLE forgets the table's retry queue+lock: the queued batches
+    count as dropped (no table to replay for) and later flushes stop
+    re-attempting them — the registry stays O(live tables) under
+    create/drop churn."""
+    s, dist = _binlogged_session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO bl VALUES (1, 1.0)")
+    s.execute("COMMIT")                       # backend down -> queued
+    assert s.db.binlog_retry_depth("default.bl") == 1
+    d0 = metrics.binlog_events_dropped.value
+    saved_cluster, s.db.cluster = s.db.cluster, None    # drop is local
+    s.execute("DROP TABLE bl")
+    s.db.cluster = saved_cluster
+    assert s.db.binlog_retry_depth() == 0
+    assert "default.bl" not in s.db._binlog_retry
+    assert metrics.binlog_events_dropped.value > d0
+    dist.fail = False
+    s.db.drain_binlog_retry(dist)             # nothing phantom replays
+    assert dist.appended == []
+
+
+def test_autocommit_blocked_table_queues_behind_own_batch():
+    """The per-table blocked check: when THIS table's own older batch is
+    still queued (its region re-broke mid-drain), the autocommit event
+    queues behind it — data still commits, the stream never reorders."""
+    s, dist = _binlogged_session()
+    s.execute("BEGIN")
+    s.execute("INSERT INTO bl VALUES (1, 1.0)")
+    s.execute("COMMIT")                       # backend down -> queued
+    assert s.db.binlog_retry_depth("default.bl") == 1
+
+    class FakeTier:
+        def write_ops(self, ops):
+            pass
+
+        def alloc_rowids(self, n, floor=0):
+            return floor
+
+    store = s.db.stores["default.bl"]
+    store.replicated = FakeTier()
+    store.binlog_sink = dist
+    store.binlog_db = s.db
+    # backend still down: drain fails, autocommit event must queue BEHIND
+    s.execute("INSERT INTO bl VALUES (2, 2.0)")
+    assert dist.appended == []
+    assert s.db.binlog_retry_depth("default.bl") == 2
+
+    dist.fail = False
+    s.db.drain_binlog_retry(dist)
+    assert [tk for tk, _ in dist.appended] == ["default.bl"] * 2
+    assert s.db.binlog_retry_depth() == 0

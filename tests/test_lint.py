@@ -280,6 +280,38 @@ def test_suppression_file_by_qualname(tmp_path):
     assert out == [("HOSTSYNC", 5)]    # only g's violation survives
 
 
+# ---- the param-feed path stays trace/transfer-clean -----------------------
+
+def test_param_feed_path_is_clean():
+    """The auto-parameterization modules (plan/paramize.py, expr/params.py)
+    and the executor's param binding sit on the hot query path: they must
+    never introduce a HOSTSYNC or RETRACE violation.  A focused run (not
+    just the tree-wide sweep) so a future suppression added for another
+    module cannot mask a regression here."""
+    cfg = LintConfig()      # NO suppression file: zero tolerance
+    vs = run_lint([os.path.join(REPO, "baikaldb_tpu", "plan", "paramize.py"),
+                   os.path.join(REPO, "baikaldb_tpu", "expr", "params.py")],
+                  cfg, root=REPO)
+    assert vs == [], "param-feed violations:\n" + \
+        "\n".join(v.render() for v in vs)
+
+
+def test_param_feed_fixture_hostsync_flagged(tmp_path):
+    """Counterpart fixture: a param binder that forces a device->host sync
+    per slot (int() on a traced bound) IS flagged — the clean result above
+    is meaningful."""
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        def bind_bad(slots, lo_table):
+            out = []
+            for s in slots:
+                lo = jnp.take(lo_table, s)
+                out.append(int(lo))
+            return tuple(out)
+        """, rel="baikaldb_tpu/plan/fixture.py")
+    assert out == [("HOSTSYNC", 6)]
+
+
 # ---- the CI policy: the tree stays clean ----------------------------------
 
 def test_tree_is_clean():
@@ -314,7 +346,9 @@ def test_cli_exit_codes(tmp_path):
 
 # static lock ids (module:Class.attr) -> runtime GuardedLock names
 _STATIC_TO_RUNTIME = {
-    "baikaldb_tpu/exec/session.py:Database.binlog_retry_mu":
+    # per-table binlog retry locks: one static id, one shared runtime
+    # name/rank for the whole family (two tables' locks never nest)
+    "baikaldb_tpu/exec/session.py:_TableBinlogRetry.mu":
         "db.binlog_retry_mu",
     "baikaldb_tpu/storage/column_store.py:TableStore._lock":
         "store.table_lock",

@@ -164,31 +164,42 @@ def test_plan_cache():
 def test_plan_cache_bounded():
     """A long-lived server must hold memory flat under a stream of DISTINCT
     query texts (VERDICT r02 weak #6): the plan cache is an LRU and each
-    entry keeps a bounded number of compiled shapes."""
-    from baikaldb_tpu.utils.flags import FLAGS
+    entry keeps a bounded number of compiled shapes.  With literal
+    auto-parameterization ON (the default) a literal-only flood collapses
+    to ONE normalized entry; the LRU discipline itself is pinned with the
+    flag off, where every text is its own entry."""
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
 
     s = Session()
     s.execute("CREATE TABLE pb (a BIGINT)")
     s.execute("INSERT INTO pb VALUES (1),(2),(3)")
     cap = int(FLAGS.plan_cache_size)
-    for i in range(cap + 300):
+    # parameterized: distinct literals of one shape share one entry
+    for i in range(40):
         s.query(f"SELECT COUNT(*) c FROM pb WHERE a <> {i}")
-    assert len(s._plan_cache) <= cap
-    # LRU, not FIFO: keep touching a RESIDENT hot entry while cap-1 cold
-    # texts flood past it — the touches must keep it alive
-    hot = "SELECT COUNT(*) c FROM pb WHERE a <> 777777"
-    s.query(hot)
-    for i in range(cap + 10):      # > cap floods: FIFO would evict hot
-        s.query(hot)               # touch while resident
-        s.query(f"SELECT COUNT(*) c FROM pb WHERE a > {i + 10_000}")
-    assert (hot, "default") in s._plan_cache
-    # per-entry compiled shapes stay bounded as the table grows
-    q = "SELECT SUM(a) s FROM pb"
-    for i in range(int(FLAGS.plan_cache_shapes) + 5):
-        s.execute(f"INSERT INTO pb VALUES ({i + 100})")
-        s.query(q)
-    assert len(s._plan_cache[(q, "default")]["compiled"]) <= \
-        int(FLAGS.plan_cache_shapes)
+    assert len([k for k in s._plan_cache if k[0] == "//params"]) == 1
+    set_flag("param_queries", False)
+    try:
+        for i in range(cap + 300):
+            s.query(f"SELECT COUNT(*) c FROM pb WHERE a <> {i}")
+        assert len(s._plan_cache) <= cap
+        # LRU, not FIFO: keep touching a RESIDENT hot entry while cap-1 cold
+        # texts flood past it — the touches must keep it alive
+        hot = "SELECT COUNT(*) c FROM pb WHERE a <> 777777"
+        s.query(hot)
+        for i in range(cap + 10):      # > cap floods: FIFO would evict hot
+            s.query(hot)               # touch while resident
+            s.query(f"SELECT COUNT(*) c FROM pb WHERE a > {i + 10_000}")
+        assert (hot, "default") in s._plan_cache
+        # per-entry compiled shapes stay bounded as the table grows
+        q = "SELECT SUM(a) s FROM pb"
+        for i in range(int(FLAGS.plan_cache_shapes) + 5):
+            s.execute(f"INSERT INTO pb VALUES ({i + 100})")
+            s.query(q)
+        assert len(s._plan_cache[(q, "default")]["compiled"]) <= \
+            int(FLAGS.plan_cache_shapes)
+    finally:
+        set_flag("param_queries", True)
 
 
 def test_errors():
