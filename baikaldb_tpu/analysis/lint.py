@@ -27,7 +27,8 @@ _INLINE_RE = re.compile(
     r"#\s*tpulint:\s*(disable|disable-next-line|disable-file)="
     r"([A-Z]+(?:\s*,\s*[A-Z]+)*)")
 
-RULES = ("HOSTSYNC", "RETRACE", "TRACERLEAK", "LOCKORDER", "BAREEXC")
+RULES = ("HOSTSYNC", "RETRACE", "TRACERLEAK", "LOCKORDER", "BAREEXC",
+         "SPANINJIT")
 
 
 @dataclass(frozen=True)

@@ -124,6 +124,43 @@ class _JitMisuse(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
 
+# tracer entry points (obs/trace.py) that must stay host-side: inside a
+# jit trace a span either bakes into the compiled program — its timing is
+# trace-time, not run-time, i.e. it measures nothing — or captures tracers
+# in the host-side span store (a leak).  Instrumentation belongs at the
+# dispatch layer AROUND fn(batches), never inside the traced function.
+_TRACER_FNS = frozenset({"span", "root", "event", "adopt"})
+
+
+def _is_tracer_call(path: str | None) -> bool:
+    if path is None or "." not in path:
+        return False
+    head, _, last = path.rpartition(".")
+    if last not in _TRACER_FNS:
+        return False
+    h = head.lower()
+    return "trace" in h or "tracer" in h or h.endswith("obs") or ".obs" in h
+
+
+class _SpanInJit(ast.NodeVisitor):
+    """SPANINJIT: tracer span calls inside traced scope (hot modules /
+    jit-decorated functions).  Spans are host-side; in a trace they bake
+    or leak — move them to the dispatch layer."""
+
+    def __init__(self, mi: ModuleIndex, report):
+        self.mi = mi
+        self.report = report
+
+    def visit_Call(self, node):
+        if _is_tracer_call(self.mi.resolve(node.func)):
+            self.report("SPANINJIT", node,
+                        "tracer span inside jit-traced scope: spans are "
+                        "host-side — under a trace they bake into the "
+                        "program (timing nothing) or leak tracers; "
+                        "instrument the dispatch layer instead")
+        self.generic_visit(node)
+
+
 class _BareExc(ast.NodeVisitor):
     """BAREEXC: handlers that swallow everything.  A bare ``except:`` (or
     ``except BaseException:``) traps KeyboardInterrupt/SystemExit; an
@@ -172,6 +209,10 @@ def lint_tree(tree: ast.AST, hot_module: bool, report) -> None:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 traced = hot_module or is_jit_decorated(node, mi)
                 FunctionTaint(node, mi, traced, report).run()
+                if traced:
+                    # nested defs inherit traced-ness (compile_plan's
+                    # run_local pattern), so the whole subtree is checked
+                    _SpanInJit(mi, report).visit(node)
             elif isinstance(node, ast.ClassDef):
                 walk_defs(node.body, True)
 

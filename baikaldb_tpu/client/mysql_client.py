@@ -48,6 +48,7 @@ class QueryResult:
 class Connection:
     def __init__(self, host: str = "127.0.0.1", port: int = 3306,
                  user: str = "root", database: str = "", password: str = ""):
+        self.host, self.port = host, port
         self.sock = socket.create_connection((host, port), timeout=30)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.p = Packets(self.sock)
@@ -186,6 +187,15 @@ class Connection:
         return QueryResult(columns, rows)
 
     def query(self, sql: str) -> QueryResult:
+        from ..obs import trace
+
+        # client-observed wall time (queueing + wire + server); a child
+        # span only when the CALLING process has a live trace — the wire
+        # protocol itself carries no trace header (MySQL compatibility)
+        with trace.span("client.query", peer=f"{self.host}:{self.port}"):
+            return self._query(sql)
+
+    def _query(self, sql: str) -> QueryResult:
         self.p.reset()
         self.p.write(b"\x03" + sql.encode())
         first = self.p.read()

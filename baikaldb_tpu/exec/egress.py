@@ -422,6 +422,15 @@ def extract(stmt: SelectStmt, session):
 def finish(spec: EgressSpec, inner_result):
     """Evaluate the skeletons over the inner result and produce the final
     (names, row tuples)."""
+    from ..obs import trace
+
+    with trace.span("egress.host_eval",
+                    rows=0 if inner_result.arrow is None
+                    else inner_result.arrow.num_rows):
+        return _finish(spec, inner_result)
+
+
+def _finish(spec: EgressSpec, inner_result):
     from ..expr.roweval import eval_row
     from ..plan.fragment import host_sort_rows
 

@@ -47,6 +47,8 @@ from ..storage.column_store import (TableStore, check_cold_readable,
                                     schema_to_arrow)
 from ..types import Field, LType, Schema
 from ..analysis.runtime import guard_stats, hot_path_guard
+from ..obs import trace
+from ..obs.trace import TRACER
 from ..utils import metrics
 from ..utils.flags import FLAGS, define
 
@@ -747,7 +749,12 @@ class Session:
         metrics.queries_total.add(1)
         t0 = time.perf_counter()
         try:
-            res = self._execute(sql)
+            # the per-query trace roots here (or at the wire server's
+            # _query, whichever ran first); stage spans nest under it and
+            # the keep/drop decision (sampling + slow always-keep) lands
+            # when this scope closes (obs/trace.py)
+            with trace.root("query", sql):
+                res = self._execute(sql)
         except Exception:
             metrics.queries_failed.add(1)
             raise
@@ -763,7 +770,8 @@ class Session:
         return res
 
     def _execute(self, sql: str) -> Result:
-        stmts = parse_sql(sql)
+        with trace.span("parse"):
+            stmts = parse_sql(sql)
         if self.db.qos is not None:
             # COMMIT/ROLLBACK are exempt: shedding load must never pin open
             # transactions; batches are charged per statement
@@ -1148,6 +1156,42 @@ class Session:
         raise SqlError(f"unsupported statement {type(s).__name__}")
 
     # -- SHOW / admin surface ---------------------------------------------
+    def _show_profile(self, s: ShowStmt) -> Result:
+        """SHOW PROFILES / SHOW PROFILE [FOR QUERY n] over the kept trace
+        store (obs/trace.py) — the per-stage answer to "where did this
+        query's time go", reading the SAME span records EXPLAIN ANALYZE
+        renders from."""
+        # introspection must not pollute the store it reads: never keep
+        # the trace of the SHOW statement itself
+        trace.discard()
+        if s.what == "profiles":
+            recs = TRACER.list()
+            return Result(
+                columns=["Query_ID", "Duration_ms", "Kind", "Query"],
+                arrow=pa.table({
+                    "Query_ID": pa.array([r["query_id"] for r in recs],
+                                         pa.int64()),
+                    "Duration_ms": pa.array([r["duration_ms"] for r in recs],
+                                            pa.float64()),
+                    "Kind": [r["kind"] for r in recs],
+                    "Query": [r["text"] for r in recs]}))
+        rec = TRACER.get(s.query_id) if s.query_id is not None \
+            else TRACER.last()
+        if rec is None:
+            where = f"query {s.query_id}" if s.query_id is not None \
+                else "any query"
+            raise PlanError(
+                f"no kept trace for {where} (enable tracing: "
+                "SET GLOBAL tracing = 1; see SHOW PROFILES)")
+        rows = trace.span_tree(rec)
+        return Result(
+            columns=["Status", "Duration_ms", "Node"],
+            arrow=pa.table({
+                "Status": ["  " * d + sp["name"] for d, sp in rows],
+                "Duration_ms": pa.array([sp["dur_ms"] for _, sp in rows],
+                                        pa.float64()),
+                "Node": [sp.get("node") or "frontend" for _, sp in rows]}))
+
     def _show(self, s: ShowStmt) -> Result:
         """SHOW command family (reference: show_helper.cpp's registry)."""
         def like(name: str, pat: str) -> bool:
@@ -1164,6 +1208,8 @@ class Session:
                      and not is_backing_table(n)], list(cat.views(db)))
 
         cat = self.db.catalog
+        if s.what in ("profile", "profiles"):
+            return self._show_profile(s)
         if s.what == "databases":
             names = cat.databases()
             return Result(columns=["Database"],
@@ -1654,6 +1700,10 @@ class Session:
     def _plan_select(self, stmt: SelectStmt) -> PlanNode:
         """Logical+physical planning, plus the distribution pass (the
         Separate/MppAnalyzer analog) when this session is mesh-bound."""
+        with trace.span("plan.build"):
+            return self._plan_select_inner(stmt)
+
+    def _plan_select_inner(self, stmt: SelectStmt) -> PlanNode:
         plan = self._planner().plan_select(stmt)
         self._annotate_ann(stmt, plan)
         if self.mesh is not None:
@@ -1798,6 +1848,10 @@ class Session:
         # appends of EARLIER commits) piggyback a drain on any commit
         if not self._txn_binlog and not self.db.binlog_retry_pending():
             return
+        with trace.span("binlog.flush", events=len(self._txn_binlog)):
+            self._flush_txn_binlog_inner()
+
+    def _flush_txn_binlog_inner(self):
         from ..storage.binlog_regions import DistributedBinlog
 
         per_table: OrderedDict = OrderedDict()
@@ -3327,7 +3381,8 @@ class Session:
         if cache_key is not None and self.mesh is None \
                 and bool(FLAGS.param_queries):
             try:
-                n = paramize.normalize(stmt, self._param_resolver(stmt))
+                with trace.span("plan.paramize"):
+                    n = paramize.normalize(stmt, self._param_resolver(stmt))
             except Exception:   # noqa: BLE001 — normalization is an
                 #                 optimization; a bug must not fail the query
                 metrics.count_swallowed("session.paramize")
@@ -3351,8 +3406,12 @@ class Session:
             self._plan_cache.pop(lookup_key, None)
             # hold the one-count-per-SELECT invariant: the baked re-run
             # only counts if the param attempt died before its counter
-            res = self._select_cached(stmt, cache_key, cache_key, None,
-                                      count=not self._param_counted)
+            self._qlog_outcome = "fallback"   # query_log: WHY it was slow
+            try:
+                res = self._select_cached(stmt, cache_key, cache_key, None,
+                                          count=not self._param_counted)
+            finally:
+                self._qlog_outcome = None
             # counted only when the baked run SUCCEEDED: a genuine user
             # error (unknown column, bad subquery) re-raised above and is
             # not a param-machinery fallback — the metric stays an alarm
@@ -3411,16 +3470,25 @@ class Session:
         # attempt never did.  A hit that still re-traces downstream
         # (capacity-bucket crossing) is a plan-level HIT — the trace shows
         # in xla_retraces/compile_ms, never as a plan-cache miss
+        if hit and norm is not None and text_key is not None \
+                and hit_text != text_key[0]:
+            outcome = "param_hit"
+        elif hit:
+            outcome = "hit"
+        else:
+            outcome = "miss"
         if count:
-            if hit:
-                if norm is not None and text_key is not None \
-                        and hit_text != text_key[0]:
-                    metrics.plan_cache_param_hits.add(1)
-                else:
-                    metrics.plan_cache_hits.add(1)
+            if outcome == "param_hit":
+                metrics.plan_cache_param_hits.add(1)
+            elif outcome == "hit":
+                metrics.plan_cache_hits.add(1)
             else:
                 metrics.plan_cache_misses.add(1)
             self._param_counted = True
+        # the query_log row reports the param-machinery fallback, not the
+        # baked re-run's own hit/miss — that's the "why was it slow" signal
+        qlog_outcome = getattr(self, "_qlog_outcome", None) or outcome
+        trace.event("plan.cache", outcome=qlog_outcome)
         plan = entry["plan"]
         # host-side access paths (index gather, zonemap/partition pruning)
         # see this execution's literal values even though the compiled plan
@@ -3428,19 +3496,27 @@ class Session:
         self._param_subst = {s.index: s for s in norm.slots} \
             if norm is not None else None
         try:
-            batches, shape_key, _full = self._collect_batches(plan)
+            with trace.span("exec.batches"):
+                batches, shape_key, _full = self._collect_batches(plan)
         finally:
             self._param_subst = None
         entry["versions"] = {tk: v for tk, v, _ in shape_key}
         if norm is not None:
             from ..expr.params import PARAMS_KEY
-            batches[PARAMS_KEY] = paramize.bind(norm.slots, batches)
+            with trace.span("plan.bind"):
+                batches[PARAMS_KEY] = paramize.bind(norm.slots, batches)
         t0 = time.perf_counter()
         result = self._run_plan(entry, batches, shape_key)
-        table = result.to_arrow()
+        with trace.span("egress.arrow"):
+            table = result.to_arrow()
         dur_ms = (time.perf_counter() - t0) * 1e3
         if text_key is not None:
-            self.db.query_log.append((text_key[0], dur_ms, table.num_rows))
+            # slow-query rows explain WHY: plan-cache outcome + the
+            # capacity buckets the scan batches compiled against
+            buckets = ";".join(f"{tk}={cap}"
+                               for tk, _v, cap in sorted(shape_key))
+            self.db.query_log.append((text_key[0], dur_ms, table.num_rows,
+                                      qlog_outcome, buckets))
         return Result(columns=list(table.column_names), arrow=table)
 
     def _param_resolver(self, stmt: SelectStmt):
@@ -3474,7 +3550,24 @@ class Session:
     def _explain_analyze(self, stmt: SelectStmt) -> Result:
         """EXPLAIN ANALYZE: run the query once, report per-operator live-row
         counts + compile/run wall time (reference: EXPLAIN FORMAT='analyze'
-        over the TraceNode tree, trace_state.h)."""
+        over the TraceNode tree, trace_state.h).
+
+        One timing truth: every measurement records as spans/events in the
+        query's trace (forced — EXPLAIN ANALYZE always traces, sampler or
+        no), and the ``--`` telemetry lines below render FROM those span
+        records.  SHOW PROFILE over the same trace shows the same numbers;
+        there is no second timing path."""
+        with trace.root("explain_analyze", force=True):
+            m = trace.mark()
+            self._explain_analyze_measure(stmt)
+            spans = trace.since(m)
+        lines = self._render_analyze(spans)
+        txt = "\n".join(lines)
+        return Result(columns=["plan"], plan_text=txt,
+                      arrow=pa.table({"plan": lines}))
+
+    def _explain_analyze_measure(self, stmt: SelectStmt) -> None:
+        """Run + instrument; all output lands in the active trace."""
         plan = self._plan_select(stmt)
         batches, shape_key, full_scan = self._collect_batches(plan)
         # settle join caps first (the overflow-retry loop), so traced counts
@@ -3484,33 +3577,27 @@ class Session:
         raw = compile_plan(plan, trace=True,
                            mesh=self.mesh if batches else None)
         fn = jax.jit(raw)
-        t0 = time.perf_counter()
-        with hot_path_guard():
-            out, flags, counts = fn(batches)
-        jax.block_until_ready(jax.tree.leaves(counts))
-        compile_and_run = time.perf_counter() - t0
-        t1 = time.perf_counter()
-        with hot_path_guard():
-            out, flags, counts = fn(batches)
-        jax.block_until_ready(jax.tree.leaves(counts))
-        run_time = time.perf_counter() - t1
+        with trace.span("exec.first"):
+            with hot_path_guard():
+                out, flags, counts = fn(batches)
+            jax.block_until_ready(jax.tree.leaves(counts))
+        with trace.span("exec.steady"):
+            with hot_path_guard():
+                out, flags, counts = fn(batches)
+            jax.block_until_ready(jax.tree.leaves(counts))
         # materialize every per-node counter in one explicit transfer —
         # int(c) per operator is a device round-trip each (tpulint HOSTSYNC)
         by_node = {id(n): int(c) for n, c in
                    zip(raw.trace_order, jax.device_get(counts))}
 
-        lines: list[str] = []
-
         def render(node: PlanNode, indent: int):
             rows = by_node.get(id(node))
-            suffix = f"  rows={rows}" if rows is not None else ""
-            lines.append("  " * indent + node._label() + suffix)
+            attrs = {} if rows is None else {"rows": rows}
+            trace.event("op", label="  " * indent + node._label(), **attrs)
             for c in node.children:
                 render(c, indent + 1)
 
         render(plan, 0)
-        lines.append(f"-- run: {run_time * 1e3:.2f} ms "
-                     f"(first incl. compile: {compile_and_run * 1e3:.2f} ms)")
         # capacity buckets + compile telemetry: which shapes this query
         # compiled against, and the engine-wide retrace/compile counters
         # (steady state = xla_retraces stops moving between identical runs)
@@ -3523,12 +3610,12 @@ class Session:
             # ANN access-path batch's shape is just its candidate count
             # (and DOES retrace per version) — label it honestly
             kind = "capacity" if tk in full_scan else "gathered"
-            lines.append(f"-- batch: {tk} {kind}={cap} "
-                         f"live={int(live)}")
+            trace.event("batch", table=tk, kind=kind, capacity=int(cap),
+                        live=int(live))
         cstats = metrics.compile_ms.stats()
-        lines.append(f"-- xla: retraces_total={metrics.xla_retraces.value} "
-                     f"compiles={cstats['count']} "
-                     f"compile_avg_ms={cstats['avg_ms']}")
+        trace.event("xla", retraces_total=metrics.xla_retraces.value,
+                    compiles=cstats["count"],
+                    compile_avg_ms=cstats["avg_ms"])
         # literal auto-parameterization: how many literals the normalizer
         # hoists into runtime params vs pins into the cache key for this
         # statement (plan/paramize.py; pinned = shape/trace-time feeders)
@@ -3540,15 +3627,53 @@ class Session:
             nz = None
         hoisted = nz.hoisted if nz is not None else 0
         pinned = nz.pinned if nz is not None else paramize._count_lits(stmt)
-        lines.append(f"-- params: hoisted={hoisted} pinned={pinned} "
-                     f"param_hits_total={metrics.plan_cache_param_hits.value}")
+        trace.event("params", hoisted=hoisted, pinned=pinned,
+                    param_hits_total=metrics.plan_cache_param_hits.value)
         gs = guard_stats()
-        lines.append(f"-- guards: mode={gs['mode']} "
-                     f"transfer_trips={gs['transfer_trips']} "
-                     f"lock_trips={gs['lock_trips']}")
-        txt = "\n".join(lines)
-        return Result(columns=["plan"], plan_text=txt,
-                      arrow=pa.table({"plan": lines}))
+        trace.event("guards", mode=gs["mode"],
+                    transfer_trips=gs["transfer_trips"],
+                    lock_trips=gs["lock_trips"])
+
+    @staticmethod
+    def _render_analyze(spans: list[dict]) -> list[str]:
+        """EXPLAIN ANALYZE display, rendered exclusively from the span
+        records (the same ones SHOW PROFILE / trace_spans read)."""
+        def find(name):
+            return [s for s in spans if s["name"] == name]
+
+        lines: list[str] = []
+        for s in find("op"):
+            a = s["attrs"]
+            suffix = f"  rows={a['rows']}" if "rows" in a else ""
+            lines.append(a["label"] + suffix)
+        first = find("exec.first")
+        steady = find("exec.steady")
+        if first and steady:
+            lines.append(f"-- run: {steady[-1]['dur_ms']:.2f} ms "
+                         f"(first incl. compile: "
+                         f"{first[-1]['dur_ms']:.2f} ms)")
+        for s in find("batch"):
+            a = s["attrs"]
+            lines.append(f"-- batch: {a['table']} {a['kind']}="
+                         f"{a['capacity']} live={a['live']}")
+        for s in find("xla"):
+            a = s["attrs"]
+            lines.append(f"-- xla: retraces_total={a['retraces_total']} "
+                         f"compiles={a['compiles']} "
+                         f"compile_avg_ms={a['compile_avg_ms']}")
+        for s in find("params"):
+            a = s["attrs"]
+            lines.append(f"-- params: hoisted={a['hoisted']} "
+                         f"pinned={a['pinned']} "
+                         f"param_hits_total={a['param_hits_total']}")
+        for s in find("guards"):
+            a = s["attrs"]
+            lines.append(f"-- guards: mode={a['mode']} "
+                         f"transfer_trips={a['transfer_trips']} "
+                         f"lock_trips={a['lock_trips']}")
+        lines.append(f"-- trace: spans={len(spans)} "
+                     "(SHOW PROFILE shows the same span records)")
+        return lines
 
     def _collect_batches(self, plan: PlanNode):
         from ..plan.nodes import ScanNode
@@ -3932,10 +4057,37 @@ class Session:
         if name == "query_log":
             log = list(self.db.query_log)
             return pa.table({
-                "query": [q for q, _, _ in log],
-                "duration_ms": pa.array([m for _, m, _ in log], pa.float64()),
-                "result_rows": pa.array([r for _, _, r in log], pa.int64()),
+                "query": [e[0] for e in log],
+                "duration_ms": pa.array([e[1] for e in log], pa.float64()),
+                "result_rows": pa.array([e[2] for e in log], pa.int64()),
+                # why a slow row was slow: plan-cache outcome
+                # (hit/param_hit/miss/fallback) + the capacity buckets the
+                # scan batches compiled against
+                "cache": [e[3] for e in log],
+                "capacity_bucket": [e[4] for e in log],
             }) if log else _empty_info("query_log")
+        if name == "trace_spans":
+            import json as _json
+            rows = []
+            for rec in TRACER.list():
+                for sp in rec["spans"]:
+                    rows.append((rec["query_id"], rec["trace_id"],
+                                 sp["span_id"], sp["parent_id"], sp["name"],
+                                 sp.get("node") or "frontend",
+                                 float(sp["ts_us"]), float(sp["dur_ms"]),
+                                 _json.dumps(sp["attrs"], default=str)
+                                 if sp["attrs"] else ""))
+            return pa.table({
+                "query_id": pa.array([r[0] for r in rows], pa.int64()),
+                "trace_id": [r[1] for r in rows],
+                "span_id": [r[2] for r in rows],
+                "parent_id": [r[3] for r in rows],
+                "name": [r[4] for r in rows],
+                "node": [r[5] for r in rows],
+                "start_us": pa.array([r[6] for r in rows], pa.float64()),
+                "duration_ms": pa.array([r[7] for r in rows], pa.float64()),
+                "attrs": [r[8] for r in rows],
+            }) if rows else _empty_info("trace_spans")
         if name == "metrics":
             rows = [(mname, k, float(v))
                     for mname, st in metrics.REGISTRY.expose().items()
@@ -4005,14 +4157,20 @@ class Session:
             t0 = time.perf_counter()
             # debug_guards: no implicit device->host transfer may hide in
             # the compiled path; the explicit flag egress happens below,
-            # OUTSIDE the guard scope
-            with hot_path_guard():
-                out, flags = fn(batches)
-            if raw.trace_count[0] > traces_before:
-                # this execution paid a trace+compile (first run / bucket
-                # crossing / overflow retry): record it so first-run vs
-                # steady-state shows up in SHOW metrics
-                metrics.compile_ms.observe((time.perf_counter() - t0) * 1e3)
+            # OUTSIDE the guard scope.  The span wraps the dispatch from
+            # the HOST side — spans inside the traced fn would bake into
+            # the program (tpulint SPANINJIT)
+            with trace.span("exec.run") as sp:
+                with hot_path_guard():
+                    out, flags = fn(batches)
+                if raw.trace_count[0] > traces_before:
+                    # this execution paid a trace+compile (first run /
+                    # bucket crossing / overflow retry): record it so
+                    # first-run vs steady-state shows up in SHOW metrics
+                    # and the trace vs execute split shows in the span
+                    metrics.compile_ms.observe(
+                        (time.perf_counter() - t0) * 1e3)
+                    sp.set(compiled=True)
             grew = False
             # ONE explicit transfer for every overflow flag: int(flag) per
             # join would block on a device round-trip once per node
@@ -4032,7 +4190,8 @@ class Session:
                     node.cap = max(16, 1 << (needed - 1).bit_length())
                     grew = True
             if not grew:
-                return self._egress_compact(out)
+                with trace.span("egress.compact"):
+                    return self._egress_compact(out)
             entry["compiled"].pop(shape_key, None)  # caps changed: re-trace
         raise RuntimeError("join output cap still overflowing after retries")
 

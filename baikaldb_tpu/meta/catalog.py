@@ -191,7 +191,18 @@ class Catalog:
                            Field("is_nullable", LType.STRING))),
         "query_log": Schema((Field("query", LType.STRING),
                              Field("duration_ms", LType.FLOAT64),
-                             Field("result_rows", LType.INT64))),
+                             Field("result_rows", LType.INT64),
+                             Field("cache", LType.STRING),
+                             Field("capacity_bucket", LType.STRING))),
+        "trace_spans": Schema((Field("query_id", LType.INT64),
+                               Field("trace_id", LType.STRING),
+                               Field("span_id", LType.STRING),
+                               Field("parent_id", LType.STRING),
+                               Field("name", LType.STRING),
+                               Field("node", LType.STRING),
+                               Field("start_us", LType.FLOAT64),
+                               Field("duration_ms", LType.FLOAT64),
+                               Field("attrs", LType.STRING))),
         "metrics": Schema((Field("name", LType.STRING),
                            Field("field", LType.STRING),
                            Field("value", LType.FLOAT64))),

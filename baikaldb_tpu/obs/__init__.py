@@ -1,0 +1,10 @@
+"""Observability tier: query-lifecycle tracing (obs/trace.py).
+
+Counters (utils/metrics.py) answer "how much / how fast on average";
+this package answers "where did THIS query's time go" — span trees from
+the wire protocol down to device execution and back, stitched across RPC
+boundaries, surfaced through SHOW PROFILE / information_schema.trace_spans
+and a Chrome trace_event exporter.
+"""
+
+from . import trace  # noqa: F401

@@ -53,19 +53,28 @@ def shard_batch(batch: ColumnBatch, mesh: Mesh) -> ColumnBatch:
     With ``FLAGS.batch_bucketing`` each per-device slice pads to a
     power-of-two capacity bucket, so a sharded table growing inside one
     bucket keeps the shard_map program's shapes (the single-device
-    executable-reuse story, per mesh device)."""
+    executable-reuse story, per mesh device).
+
+    Host-side dispatch seam: runs OUTSIDE any jit trace (device_put is the
+    ingest boundary), so the span here is legal despite this module being
+    tpulint hot scope — registered in tools/tpulint_suppressions.txt."""
+    from ..obs import trace
     from ..utils.flags import FLAGS
 
-    n = mesh.devices.size
-    if FLAGS.batch_bucketing:
-        per = -(-max(len(batch), 1) // n)
-        per = bucket_capacity(per, max(1, int(FLAGS.batch_bucket_min) // n))
-        b = pad_batch(batch, per * n)
-    else:
-        b = pad_rows(batch, n)
-    sharding = NamedSharding(mesh, P(AXIS))
-    cols = [Column(jax.device_put(c.data, sharding),
-                   None if c.validity is None else jax.device_put(c.validity, sharding),
-                   c.ltype, c.dictionary) for c in b.columns]
-    sel = jax.device_put(b.sel_mask(), sharding)
-    return ColumnBatch(b.names, cols, sel, None)
+    with trace.span("mesh.shard", rows=len(batch),
+                    devices=int(mesh.devices.size)):
+        n = mesh.devices.size
+        if FLAGS.batch_bucketing:
+            per = -(-max(len(batch), 1) // n)
+            per = bucket_capacity(per,
+                                  max(1, int(FLAGS.batch_bucket_min) // n))
+            b = pad_batch(batch, per * n)
+        else:
+            b = pad_rows(batch, n)
+        sharding = NamedSharding(mesh, P(AXIS))
+        cols = [Column(jax.device_put(c.data, sharding),
+                       None if c.validity is None
+                       else jax.device_put(c.validity, sharding),
+                       c.ltype, c.dictionary) for c in b.columns]
+        sel = jax.device_put(b.sel_mask(), sharding)
+        return ColumnBatch(b.names, cols, sel, None)

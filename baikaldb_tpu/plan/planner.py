@@ -109,11 +109,14 @@ class Planner:
 
     # ------------------------------------------------------------------
     def plan_select(self, stmt: SelectStmt) -> PlanNode:
-        plan = self._plan_query(stmt)
-        self._prune_columns(plan)
-        plan = self._insert_shrinks(plan)
-        self._mark_sorted_builds(plan)
-        return plan
+        from ..obs import trace
+
+        with trace.span("plan.logical"):
+            plan = self._plan_query(stmt)
+            self._prune_columns(plan)
+            plan = self._insert_shrinks(plan)
+            self._mark_sorted_builds(plan)
+            return plan
 
     def _mark_sorted_builds(self, plan: PlanNode) -> None:
         """Sort-join build sides that are the output of a SORTED group-by on

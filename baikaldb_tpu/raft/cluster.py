@@ -474,6 +474,13 @@ class RaftGroup:
                     max_ticks: int = 400) -> bool:
         """Propose a replicated command and wait for leader commit.  False
         when no quorum exists (the region is unavailable)."""
+        from ..obs import trace
+
+        with trace.span("raft.append", region=self.region_id, cmd=int(cmd)):
+            return self._propose_cmd(cmd, txn_id, ops_bytes, max_ticks)
+
+    def _propose_cmd(self, cmd: int, txn_id: int, ops_bytes: bytes,
+                     max_ticks: int) -> bool:
         payload = encode_cmd(cmd, txn_id, ops_bytes)
         for _ in range(max_ticks):
             try:

@@ -52,25 +52,31 @@ class TwoPhaseCoordinator:
               txn_id: int | None = None) -> int:
         """ops per region_id; returns the txn id.  Raises TwoPhaseError on a
         failed prepare (everything rolled back)."""
+        from ..obs import trace
+
         txn = txn_id or next_txn_id()
         by_region = {g.region_id: g for g in self.groups}
         # phase 1: PREPARE everywhere (each is itself raft-committed)
         prepared = []
-        for rid, ops in per_group_ops.items():
-            g = by_region[rid]
-            if not g.propose_cmd(CMD_PREPARE, txn, encode_ops(ops)):
-                for p in prepared:
-                    p.propose_cmd(CMD_ROLLBACK, txn)
-                raise TwoPhaseError(f"prepare failed on region {rid}")
-            prepared.append(g)
+        with trace.span("2pc.prepare", txn=txn,
+                        regions=len(per_group_ops)):
+            for rid, ops in per_group_ops.items():
+                g = by_region[rid]
+                if not g.propose_cmd(CMD_PREPARE, txn, encode_ops(ops)):
+                    for p in prepared:
+                        p.propose_cmd(CMD_ROLLBACK, txn)
+                    raise TwoPhaseError(f"prepare failed on region {rid}")
+                prepared.append(g)
         if crash_after == "prepare":
             return txn                    # coordinator dies here
         # decision record + commit on the PRIMARY first: once this is in the
         # primary's log the txn is globally COMMITTED.  The decision propose
         # MUST be verified — acking a txn whose decision never reached
         # quorum would lose it (recovery would roll the prepares back).
-        if not self.primary.propose_cmd(CMD_DECIDE, txn,
-                                        bytes([CMD_COMMIT])):
+        with trace.span("2pc.decide", txn=txn):
+            decided = self.primary.propose_cmd(CMD_DECIDE, txn,
+                                               bytes([CMD_COMMIT]))
+        if not decided:
             # A failed propose does NOT mean the decision failed to commit —
             # a timeout can lose the ack, not the entry.  Rolling prepares
             # back here could tear the txn (recovery commits a surviving
@@ -101,12 +107,13 @@ class TwoPhaseCoordinator:
         # past the decision point the txn is committed; the remaining
         # proposals are completion, not consensus — a failure here leaves an
         # in-doubt prepare that resolve_in_doubt finishes from the decision
-        self.primary.propose_cmd(CMD_COMMIT, txn)
-        if crash_after == "primary":
-            return txn                    # coordinator dies here
-        for g in self.secondaries:
-            if g.region_id in per_group_ops:
-                g.propose_cmd(CMD_COMMIT, txn)
+        with trace.span("2pc.commit", txn=txn):
+            self.primary.propose_cmd(CMD_COMMIT, txn)
+            if crash_after == "primary":
+                return txn                # coordinator dies here
+            for g in self.secondaries:
+                if g.region_id in per_group_ops:
+                    g.propose_cmd(CMD_COMMIT, txn)
         return txn
 
 

@@ -298,16 +298,23 @@ class MySQLServer:
         p.write(b"\xfe" + struct.pack("<H", 0) + struct.pack("<H", 0x0002))
 
     def _query(self, p: Packets, session: Session, sql: str):
-        try:
-            res = session.execute(sql)
-        except Exception as e:                         # noqa: BLE001
-            code, state = errno_for(e)
-            self._err(p, code, f"{type(e).__name__}: {e}", state)
-            return
-        if res.arrow is None:
-            self._ok(p, affected=res.affected_rows)
-            return
-        self._result_set(p, res)
+        from ..obs import trace
+
+        # wire-level trace root: session.execute's root degrades to a child
+        # span under it, so a kept trace shows protocol encode time too —
+        # "from wire protocol to device and back"
+        with trace.root("wire.query", sql):
+            try:
+                res = session.execute(sql)
+            except Exception as e:                     # noqa: BLE001
+                code, state = errno_for(e)
+                self._err(p, code, f"{type(e).__name__}: {e}", state)
+                return
+            if res.arrow is None:
+                self._ok(p, affected=res.affected_rows)
+                return
+            with trace.span("wire.result_set"):
+                self._result_set(p, res)
 
     def _result_set(self, p: Packets, res: Result, binary: bool = False):
         """Column defs + text/binary rows (reference: PacketNode encode)."""

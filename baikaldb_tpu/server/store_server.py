@@ -108,6 +108,12 @@ class StoreServer:
         """Leader-side propose + wait-for-commit (the braft apply + closure
         ack, store-side of region.cpp:1961/2301).  Non-leaders answer with a
         redirect hint (the reference's NOT_LEADER + leader_id response)."""
+        from ..obs import trace
+
+        with trace.span("raft.append", region=int(region_id)):
+            return self._rpc_propose(region_id, payload, wait_s)
+
+    def _rpc_propose(self, region_id: int, payload: bytes, wait_s: float):
         from ..raft.cluster import (CMD_PREPARE, CMD_WRITE, decode_cmd,
                                     decode_ops)
 
@@ -191,12 +197,14 @@ class StoreServer:
         rides back for the caller's staleness check.  A fragment the
         row evaluator cannot run raises — the RPC layer returns the error
         and the frontend falls back to the raw path."""
+        from ..obs import trace
         from ..plan.fragment import run_fragment
 
         region = self.regions.get(int(region_id))
         if region is None:
             return {"status": "no_region"}
-        with self._mu:
+        with self._mu, trace.span("store.fragment",
+                                  region=int(region_id)):
             gate = self._read_gate(region)
             if gate is not None:
                 return gate

@@ -1144,6 +1144,29 @@ class Parser:
         if word == "processlist":
             self.advance()
             return ShowStmt("processlist")
+        if word == "profiles":
+            self.advance()
+            return ShowStmt("profiles")
+        if word == "profile":
+            # SHOW PROFILE [FOR QUERY n] (MySQL syntax; reads the kept
+            # trace store, obs/trace.py — n is the Query_ID SHOW PROFILES
+            # lists; omitted = the most recent kept trace)
+            self.advance()
+            qid = None
+            if self.peek().value.lower() == "for":   # IDENT, not a KW
+                self.advance()
+                if self.peek().value.lower() != "query":
+                    t = self.peek()
+                    raise SqlError(f"expected QUERY, got {t.value!r} "
+                                   f"at {t.pos}")
+                self.advance()
+                t = self.peek()
+                if t.kind != "NUM" or "." in t.value:
+                    raise SqlError(
+                        f"expected integer query id at {t.pos}")
+                self.advance()
+                qid = int(t.value)
+            return ShowStmt("profile", query_id=qid)
         if word == "grants":
             self.advance()
             user = None

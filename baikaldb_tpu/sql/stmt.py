@@ -168,11 +168,13 @@ class UseStmt:
 @dataclass
 class ShowStmt:
     what: str   # tables | databases | create_table | columns | index |
-    #             variables | status | processlist | grants | regions
+    #             variables | status | processlist | grants | regions |
+    #             profile | profiles
     database: Optional[str] = None
     table: Optional[TableRef] = None
     pattern: Optional[str] = None
     user: Optional[str] = None
+    query_id: Optional[int] = None    # SHOW PROFILE FOR QUERY n
 
 
 @dataclass

@@ -160,6 +160,15 @@ class Binlog:
     def append(self, event_type: str, database: str, table: str,
                rows: Optional[list] = None, statement: str = "",
                affected: int = 0) -> int:
+        from ..obs import trace
+
+        with trace.span("binlog.append", table=f"{database}.{table}",
+                        event=event_type):
+            return self._append(event_type, database, table, rows,
+                                statement, affected)
+
+    def _append(self, event_type: str, database: str, table: str,
+                rows: Optional[list], statement: str, affected: int) -> int:
         # durable-before-visible, and the write I/O happens OUTSIDE the
         # lock: readers are never stalled behind another append's disk
         # write (only ring insertion and the rare trim hold it)

@@ -146,29 +146,37 @@ class DistributedBinlog:
         """Autocommit DML: binlog C row + P tombstone join the data ops in
         ONE cross-tier transaction (write_ops_atomic_remote) — the event
         exists iff the data committed."""
+        from ..obs import trace
         from .remote_tier import write_ops_atomic_remote
 
-        start_ts, tomb = self.prewrite(table_key)
-        try:
-            _ts, bops = self.commit_ops(start_ts, tomb, table_key, events)
-            write_ops_atomic_remote([(data_tier, data_ops),
-                                     (self.tier, bops)])
-        except Exception:
-            self.abort(tomb)
-            raise
+        with trace.span("binlog.dist_append", table=table_key,
+                        events=len(events), with_data=True):
+            start_ts, tomb = self.prewrite(table_key)
+            try:
+                _ts, bops = self.commit_ops(start_ts, tomb, table_key,
+                                            events)
+                write_ops_atomic_remote([(data_tier, data_ops),
+                                         (self.tier, bops)])
+            except Exception:
+                self.abort(tomb)
+                raise
 
     def append(self, table_key: str, events: list) -> int:
         """Standalone event append (txn-commit flush, DDL): full protocol
         without data ops.  Returns the commit_ts."""
-        start_ts, tomb = self.prewrite(table_key)
-        try:
-            commit_ts, bops = self.commit_ops(start_ts, tomb, table_key,
-                                              events)
-            self.tier.write_ops(bops)
-            return commit_ts
-        except Exception:
-            self.abort(tomb)
-            raise
+        from ..obs import trace
+
+        with trace.span("binlog.dist_append", table=table_key,
+                        events=len(events)):
+            start_ts, tomb = self.prewrite(table_key)
+            try:
+                commit_ts, bops = self.commit_ops(start_ts, tomb, table_key,
+                                                  events)
+                self.tier.write_ops(bops)
+                return commit_ts
+            except Exception:
+                self.abort(tomb)
+                raise
 
     # past this many row images, one statement-summary event replaces the
     # per-row images (mirrors the local binlog's bulk guard)

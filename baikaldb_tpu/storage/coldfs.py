@@ -19,6 +19,8 @@ import os
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from ..obs import trace
+
 
 class ExternalFS:
     """Posix-dir backend; the API is the AFS-client shape (open/read/write/
@@ -34,16 +36,18 @@ class ExternalFS:
 
     def put(self, name: str, data: bytes) -> None:
         """Atomic immutable write (segments are never modified in place)."""
-        tmp = self._path(name) + f".tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(data)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._path(name))
+        with trace.span("coldfs.put", file=name, nbytes=len(data)):
+            tmp = self._path(name) + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(name))
 
     def get(self, name: str) -> bytes:
-        with open(self._path(name), "rb") as f:
-            return f.read()
+        with trace.span("coldfs.get", file=name):
+            with open(self._path(name), "rb") as f:
+                return f.read()
 
     def exists(self, name: str) -> bool:
         return os.path.exists(self._path(name))

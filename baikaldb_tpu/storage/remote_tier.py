@@ -301,6 +301,14 @@ class RemoteRowTier:
         tries the hinted leader first, then EVERY peer — a round-robin that
         can never starve a replica (a hint pointing at a dead or stale
         leader must not pin the retry loop to one follower)."""
+        from ..obs import trace
+
+        with trace.span("region.propose", region=region.region_id,
+                        table=self.table_key):
+            self._propose_routed(region, payload)
+
+    def _propose_routed(self, region: _RemoteRegion,
+                        payload: bytes) -> None:
         deadline = time.monotonic() + self.propose_deadline
         hint = region.leader_addr
         while time.monotonic() < deadline:
@@ -497,7 +505,11 @@ class RemoteRowTier:
         manifest op): a row another frontend rewrote between this scan and
         the apply keeps its newer hot version — concurrent frontends
         cannot lose writes to a flush."""
-        return self._with_routing_retry(lambda: self._flush_cold(fs, upto))
+        from ..obs import trace
+
+        with trace.span("cold.flush", table=self.table_key):
+            return self._with_routing_retry(
+                lambda: self._flush_cold(fs, upto))
 
     def _flush_cold(self, fs, upto: Optional[int]) -> int:
         import json as _json

@@ -197,7 +197,10 @@ class ReplicatedRowTier:
         size trigger, region.cpp:733-787)."""
         if not ops:
             return
-        with self._mu:
+        from ..obs import trace
+
+        with self._mu, trace.span("replicated.write", table=self.table_key,
+                                  ops=len(ops)):
             per = self._split_ops(ops)
             if len(per) == 1:
                 idx, batch = next(iter(per.items()))

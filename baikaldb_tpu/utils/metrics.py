@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
 
@@ -28,7 +29,10 @@ class Counter:
         self.name = name
         self._value = 0
         self._lock = threading.Lock()
-        self._window: list[tuple[float, int]] = []   # (ts, cumulative)
+        # (ts, cumulative) sliding window; deque so the per-add trim is
+        # O(1) popleft — list.pop(0) shifted the whole window on every
+        # hot-path increment
+        self._window: deque[tuple[float, int]] = deque()
         (registry or REGISTRY)._register(self)
 
     def add(self, n: int = 1) -> None:
@@ -38,7 +42,7 @@ class Counter:
             self._window.append((now, self._value))
             cutoff = now - 60.0
             while len(self._window) > 2 and self._window[0][0] < cutoff:
-                self._window.pop(0)
+                self._window.popleft()
 
     @property
     def value(self) -> int:
@@ -220,6 +224,12 @@ binlog_events_dropped = Counter("binlog_events_dropped")
 # policy: a swallow must at least be countable) — total plus a per-site
 # counter so SHOW METRICS points at the failing subsystem
 swallowed_exceptions = Counter("swallowed_exceptions")
+# query-lifecycle tracing (obs/trace.py): traces kept in the bounded store
+# (head-sampled + slow-query always-keep), and spans dropped by the
+# per-trace cap or store eviction — if this moves, raise trace_max_spans /
+# trace_store_max or lower the sampling rate
+traces_sampled = Counter("traces_sampled")
+trace_spans_dropped = Counter("trace_spans_dropped")
 
 
 def count_swallowed(site: str) -> None:

@@ -19,6 +19,8 @@ import struct
 import threading
 from typing import Callable, Optional
 
+from ..obs import trace
+
 _BYTES_TAG = "__b64__"
 
 # process-wide wire accounting (diagnostics + the pushdown transfer tests:
@@ -90,6 +92,9 @@ class RpcServer:
         self._sock.bind((host, port))
         self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()
+        # node label stamped on spans recorded while serving a traced RPC,
+        # so a stitched frontend tree shows WHICH daemon did the work
+        self.trace_node = f"{self.host}:{self.port}"
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -127,14 +132,29 @@ class RpcServer:
                     return
                 method = req.get("method", "")
                 fn = self._handlers.get(method)
-                try:
+                wire = req.get("trace")
+                buf = None
+
+                def run():
                     if fn is None:
                         raise RpcError(f"unknown method {method!r}")
-                    resp = {"ok": True,
+                    return {"ok": True,
                             "result": fn(**req.get("args", {}))}
+                try:
+                    if isinstance(wire, dict):
+                        # caller's sampling decision propagates: record
+                        # handler spans under ITS trace and ship them back
+                        # for the frontend tree (obs/trace.py)
+                        with trace.adopt(wire, f"serve.{method}",
+                                         node=self.trace_node) as buf:
+                            resp = run()
+                    else:
+                        resp = run()
                 except Exception as e:  # noqa: BLE001 — fault isolation per call
                     resp = {"ok": False,
                             "error": f"{type(e).__name__}: {e}"}
+                if buf:
+                    resp["trace_spans"] = list(buf)
                 try:
                     send_msg(conn, resp)
                 except OSError:
@@ -172,13 +192,20 @@ class RpcClient:
     })
 
     def call(self, method: str, **args):
-        with self._mu:
+        with self._mu, trace.span(f"rpc.{method}",
+                                  peer=f"{self.host}:{self.port}"):
+            # wire context captured INSIDE the rpc span: the daemon's
+            # serve.* span nests under it, not beside it
+            wire = trace.wire_context()
+            req = {"method": method, "args": args}
+            if wire is not None:
+                req["trace"] = wire
             for attempt in (0, 1):
                 sent = False
                 try:
                     if self._sock is None:
                         self._sock = self._connect()
-                    send_msg(self._sock, {"method": method, "args": args})
+                    send_msg(self._sock, req)
                     sent = True
                     resp = recv_msg(self._sock)
                     if resp is None:
@@ -192,6 +219,11 @@ class RpcClient:
                         # request may have been executed with the response
                         # lost; a resend could double-execute it
                         raise
+            remote = resp.get("trace_spans")
+            if remote:
+                # the daemon's spans already carry this trace's ids:
+                # stitch them under the rpc span that crossed the wire
+                trace.absorb(remote)
             if not resp.get("ok"):
                 raise RpcError(resp.get("error", "rpc failed"))
             return resp.get("result")

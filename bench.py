@@ -450,6 +450,93 @@ def run_point_bench() -> dict:
     }
 
 
+def run_trace_bench() -> dict:
+    """Tracing overhead on the point-query steady state: the SAME cached
+    one-shape workload as run_point_bench, measured with tracing=off then
+    tracing=on (sampled default: every root kept).  The acceptance contract
+    (docs/OBSERVABILITY.md): off <= 1% overhead (one flag check + the no-op
+    span singleton), on <= 5% (a dozen host-side dict spans per query)."""
+    import pyarrow as pa
+
+    from baikaldb_tpu.exec.session import Session
+    from baikaldb_tpu.obs.trace import TRACER
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    n_rows = int(os.environ.get("BENCH_TRACE_ROWS", 100_000))
+    n_q = int(os.environ.get("BENCH_TRACE_QUERIES", 64))
+    rng = np.random.default_rng(13)
+    base = pa.table({
+        "id": np.arange(n_rows, dtype=np.int64),
+        "v": rng.normal(size=n_rows).astype(np.float64),
+    })
+
+    def phase(tracing_on: bool, its: int) -> float:
+        set_flag("tracing", tracing_on)
+        s = Session()
+        s.execute("CREATE TABLE tr (id BIGINT, v DOUBLE)")
+        s.load_arrow("tr", base)
+        s.query("SELECT v FROM tr WHERE id = 0")      # plan + first compile
+        t0 = time.perf_counter()
+        for i in range(its):
+            s.query(f"SELECT v FROM tr WHERE id = {1 + (i * 9173) % n_rows}")
+        return time.perf_counter() - t0
+
+    prev = bool(FLAGS.tracing)
+    try:
+        off_dt = phase(False, n_q)
+        on_dt = phase(True, n_q)
+    finally:
+        set_flag("tracing", prev)
+        TRACER.clear()
+    off_per, on_per = off_dt / n_q, on_dt / n_q
+    platform = None
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:                                   # noqa: BLE001
+        pass
+    return {
+        "metric": f"point-query steady state with tracing=on vs off "
+                  f"({n_rows / 1e3:.0f}k rows, {n_q} queries, {platform})",
+        "value": round(n_q / on_dt, 1),
+        "unit": "queries/sec",
+        # >1 means tracing made it slower; the CI-visible overhead guard
+        "vs_baseline": round(on_per / off_per, 3),
+        "overhead_pct": round((on_per / off_per - 1.0) * 100, 2),
+        "platform": platform,
+        "rows": n_rows,
+        "queries": n_q,
+        "per_query_ms_tracing_on": round(on_per * 1e3, 2),
+        "per_query_ms_tracing_off": round(off_per * 1e3, 2),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
+def _emit_trace_line(skip_reason: str | None = None):
+    """Fourth JSON line: tracing-overhead regression guard.  Same
+    robustness contract: always prints a line, never raises."""
+    if os.environ.get("BENCH_SKIP_TRACE") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "point-query steady state with tracing=on vs off "
+                      "(skipped)",
+            "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+            "platform": "none", "error": skip_reason}))
+        return
+    try:
+        result = run_trace_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "point-query steady state with tracing=on vs "
+                            "off (failed)",
+                  "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+                  "platform": "none",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_point_line(skip_reason: str | None = None):
     """Third JSON line: point-query steady state (parameterized plan-cache
     reuse).  Same robustness contract: always prints a line, never raises."""
@@ -515,6 +602,8 @@ def main():
                                  "mixed phase skipped")
                 _emit_point_line(skip_reason="accelerator probe failed; "
                                  "point phase skipped")
+                _emit_trace_line(skip_reason="accelerator probe failed; "
+                                 "tracing phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -550,10 +639,12 @@ def main():
                          f"{cached.get('captured_at')}", cpu_result=result)
             _emit_mixed_line()      # backend already ran here: measure
             _emit_point_line()
+            _emit_trace_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
     _emit_point_line()
+    _emit_trace_line()
     return 0
 
 

@@ -250,6 +250,52 @@ def test_bareexc(tmp_path):
     assert out == [("BAREEXC", 4), ("BAREEXC", 16)]
 
 
+# ---- SPANINJIT ------------------------------------------------------------
+
+def test_spaninjit_in_hot_module(tmp_path):
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.obs import trace
+        def f(x):
+            with trace.span("op.filter"):
+                return x
+        """)
+    assert out == [("SPANINJIT", 3)]
+
+
+def test_spaninjit_jit_decorated_host_module(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax
+        from baikaldb_tpu.obs import trace
+        @jax.jit
+        def f(x):
+            trace.event("step", n=1)
+            return x
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == [("SPANINJIT", 5)]
+
+
+def test_spaninjit_host_dispatch_clean(tmp_path):
+    # the sanctioned pattern: the span wraps the jitted call from OUTSIDE
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.obs import trace
+        def dispatch(fn, batches):
+            with trace.span("exec.run"):
+                return fn(batches)
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == []
+
+
+def test_spaninjit_regex_span_not_confused(tmp_path):
+    # m.span() on a regex match is not a tracer call, even in hot scope
+    out = lint_src(tmp_path, """\
+        import re
+        def f(s):
+            m = re.match("a+", s)
+            return m.span()
+        """)
+    assert out == []
+
+
 # ---- suppression channels -------------------------------------------------
 
 def test_inline_suppression(tmp_path):

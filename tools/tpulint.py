@@ -37,6 +37,8 @@ _RULE_HELP = {
     "TRACERLEAK": "tracers stored on self/globals from traced scope",
     "LOCKORDER": "lock acquisition cycles; host syncs under a held lock",
     "BAREEXC": "swallow-all exception handlers",
+    "SPANINJIT": "tracer spans (obs/trace.py) inside jit-traced scope — "
+                 "host-side spans bake or leak under a trace",
 }
 
 
