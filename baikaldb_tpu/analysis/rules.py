@@ -161,6 +161,97 @@ class _SpanInJit(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _is_failpoint_hit(path: str | None) -> bool:
+    if path is None or "." not in path:
+        return False
+    head, _, last = path.rpartition(".")
+    return last == "hit" and "failpoint" in head.lower()
+
+
+def _is_enable_check(mi: ModuleIndex, expr: ast.expr) -> bool:
+    """``failpoint.ENABLED`` (any alias/relative spelling)."""
+    path = mi.resolve(expr)
+    if path is None or "." not in path:
+        return False
+    head, _, last = path.rpartition(".")
+    return last == "ENABLED" and "failpoint" in head.lower()
+
+
+class _FailpointHot(ast.NodeVisitor):
+    """FAILPOINTHOT: every ``failpoint.hit(...)`` site must (a) stay out of
+    jit-traced scope — a host-side sleep/raise inside a trace fires at
+    TRACE time and bakes nothing into the program — and (b) sit behind the
+    module-level enable check (``if failpoint.ENABLED: ...`` or the inline
+    ``failpoint.ENABLED and failpoint.hit(...)``), so a disabled build
+    pays one bool read per site, never a registry lookup."""
+
+    def __init__(self, mi: ModuleIndex, report, hot_module: bool):
+        self.mi = mi
+        self.report = report
+        self.hot_module = hot_module
+        self.guard_depth = 0
+        self.traced_depth = 0
+
+    def _check_call(self, node: ast.Call) -> None:
+        if not _is_failpoint_hit(self.mi.resolve(node.func)):
+            return
+        if self.hot_module or self.traced_depth:
+            self.report("FAILPOINTHOT", node,
+                        "failpoint site inside jit-traced scope: the "
+                        "sleep/raise fires at trace time, not run time — "
+                        "move it to the dispatch layer")
+        elif not self.guard_depth:
+            self.report("FAILPOINTHOT", node,
+                        "failpoint.hit not behind the module-level enable "
+                        "check — guard with `if failpoint.ENABLED:` so a "
+                        "disabled site costs one bool read")
+
+    def visit_Call(self, node):
+        self._check_call(node)
+        self.generic_visit(node)
+
+    def visit_BoolOp(self, node):
+        # `failpoint.ENABLED and failpoint.hit(...)`: values after the
+        # enable check short-circuit behind it — guarded
+        if isinstance(node.op, ast.And) and node.values and \
+                _is_enable_check(self.mi, node.values[0]):
+            self.guard_depth += 1
+            for v in node.values[1:]:
+                self.visit(v)
+            self.guard_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_If(self, node):
+        if _is_enable_check(self.mi, node.test) or (
+                isinstance(node.test, ast.BoolOp) and
+                isinstance(node.test.op, ast.And) and node.test.values and
+                _is_enable_check(self.mi, node.test.values[0])):
+            self.visit(node.test)           # BoolOp handler guards the rest
+            self.guard_depth += 1
+            for n in node.body:
+                self.visit(n)
+            self.guard_depth -= 1
+            for n in node.orelse:
+                self.visit(n)
+        else:
+            self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # the guard is a RUNTIME check: an `if ENABLED:` around a def does
+        # not guard the calls inside it, and traced-ness is per-function
+        traced = is_jit_decorated(node, self.mi)
+        prev_guard, self.guard_depth = self.guard_depth, 0
+        if traced:
+            self.traced_depth += 1
+        self.generic_visit(node)
+        if traced:
+            self.traced_depth -= 1
+        self.guard_depth = prev_guard
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
 class _BareExc(ast.NodeVisitor):
     """BAREEXC: handlers that swallow everything.  A bare ``except:`` (or
     ``except BaseException:``) traps KeyboardInterrupt/SystemExit; an
@@ -203,6 +294,7 @@ def lint_tree(tree: ast.AST, hot_module: bool, report) -> None:
     mi = ModuleIndex(tree)
     _JitMisuse(mi, report).visit(tree)
     _BareExc(mi, report).visit(tree)
+    _FailpointHot(mi, report, hot_module).visit(tree)
 
     def walk_defs(body, in_class: bool):
         for node in body:

@@ -1,0 +1,13 @@
+"""Chaos engineering: failpoint-driven fault injection + seeded scenarios.
+
+The reference survives node loss because its braft/brpc seams are exercised
+under injected faults; this package is that discipline for the repro's
+distributed surface.  ``failpoint`` is the registry (named points wired at
+every distributed seam, programmable actions, deterministic seeded
+triggering); ``scenarios`` is the seeded kill/partition/latency harness
+driven by ``python -m tools.chaos_run``.
+"""
+
+from . import failpoint  # noqa: F401
+
+__all__ = ["failpoint"]

@@ -1,0 +1,298 @@
+"""Seeded chaos scenarios: kill / partition / latency scripts with
+exactly-once and convergence assertions.
+
+Two planes, two guarantees:
+
+- **fleet plane** (``kill_leader``, ``partition``): StoreFleet regions on
+  the deterministic in-process LocalBus.  Everything — the fault schedule,
+  raft elections, apply order, the final table AND binlog state — is a
+  pure function of the seed, so a run replays **bit-identically**
+  (``state_digest`` equality across runs is the acceptance check;
+  wall-clock TSO timestamps are excluded from the digest by design).
+- **daemon plane** (``rpc_chaos``): real in-process meta + store daemons
+  over TCP sockets, seeded ``store.handler`` latency and ``rpc.recv``
+  response drops from chaos/failpoint.py, plus a mid-run crash of the
+  region leader's daemon.  Thread/socket timing is not replayable, but the
+  OUTCOME contract is: every client write lands exactly once (RpcClient
+  retry + idempotency-token dedupe at the daemons), and the final row
+  state digest is seed-deterministic.
+
+Every scenario returns a JSON-able dict: ``fault_schedule`` (the injected
+faults, in order), ``state_digest`` (sha256 over the deterministic final
+state), assertion results, and observed counters (retries, dedupe hits,
+latency percentiles).  ``python -m tools.chaos_run --seed N`` drives them;
+bench.py reuses ``rpc_chaos`` for its seeded latency-injection line.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import time
+
+from . import failpoint
+
+
+def _digest(obj) -> str:
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True, default=str).encode()).hexdigest()[:16]
+
+
+def _p(lat_ms: list, q: float) -> float:
+    if not lat_ms:
+        return 0.0
+    s = sorted(lat_ms)
+    return round(s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))], 3)
+
+
+def _fleet_session(seed: int):
+    from ..exec.session import Database, Session
+    from ..meta.service import MetaService
+    from ..raft.fleet import StoreFleet
+
+    fleet = StoreFleet(MetaService(peer_count=3),
+                       ["c1:1", "c2:1", "c3:1"], seed=7 + seed)
+    db = Database(fleet=fleet)
+    s = Session(db)
+    s.execute("CREATE DATABASE chaos")
+    s.execute("USE chaos")
+    s.execute("CREATE TABLE ck (k BIGINT, v BIGINT, PRIMARY KEY (k))")
+    return fleet, db, s
+
+
+def _check_exactly_once(rows: list[dict], events, writes: int) -> list[str]:
+    """Shared assertions: every acked write visible exactly once in the
+    table AND in the binlog stream (no lost, no duplicated)."""
+    problems = []
+    got = {r["k"]: r["v"] for r in rows}
+    want = {i: i * i for i in range(writes)}
+    if len(rows) != len(got):
+        problems.append("duplicate keys in final table state")
+    if got != want:
+        missing = sorted(set(want) - set(got))
+        extra = sorted(set(got) - set(want))
+        wrong = sorted(k for k in set(got) & set(want)
+                       if got[k] != want[k])
+        problems.append(f"table state diverged (missing={missing[:5]} "
+                        f"extra={extra[:5]} wrong={wrong[:5]})")
+    seen_keys: list[int] = []
+    for e in events:
+        for r in e.rows or []:
+            seen_keys.append(int(r["k"]))
+    if sorted(seen_keys) != sorted(want):
+        problems.append(
+            f"binlog events diverged: {len(seen_keys)} row images for "
+            f"{writes} writes (lost="
+            f"{sorted(set(want) - set(seen_keys))[:5]}, dup="
+            f"{sorted(k for k in set(seen_keys) if seen_keys.count(k) > 1)[:5]})")
+    return problems
+
+
+def kill_leader(seed: int = 1, writes: int = 30) -> dict:
+    """Seeded leader kill/revive churn on the fleet plane while SQL
+    INSERTs flow.  The write path retries through elections
+    (RaftGroup.propose_cmd); 2-of-3 quorum keeps committing.  Asserts
+    exactly-once table rows and binlog events; fully deterministic."""
+    rng = random.Random((seed << 8) ^ 0x6B696C)
+    fleet, db, s = _fleet_session(seed)
+    tier = fleet.row_tiers["chaos.ck"]
+    g = tier.groups[0]
+    schedule: list[list] = []
+    killed = None
+    for i in range(writes):
+        if killed is not None and rng.random() < 0.5:
+            g.bus.revive(killed)
+            schedule.append([i, "revive", killed])
+            killed = None
+        if killed is None and rng.random() < 0.35:
+            try:
+                victim = g.leader()
+            except RuntimeError:
+                victim = None
+            if victim is not None:
+                g.bus.kill(victim)
+                schedule.append([i, "kill_leader", victim])
+                killed = victim
+        s.execute(f"INSERT INTO ck VALUES ({i}, {i * i})")
+    if killed is not None:
+        g.bus.revive(killed)
+        schedule.append([writes, "revive", killed])
+    rows = s.query("SELECT k, v FROM ck ORDER BY k")
+    events = [e for e in db.binlog.read(0, 1 << 20)
+              if e.table == "ck" and e.event_type == "insert"]
+    problems = _check_exactly_once(rows, events, writes)
+    state = {"rows": rows,
+             # commit_ts is wall-clock (TSO): excluded from the digest
+             "binlog": [[e.event_type, e.rows] for e in events]}
+    return {"writes": writes, "fault_schedule": schedule,
+            "faults": len(schedule),
+            "state_digest": _digest({"schedule": schedule, "state": state}),
+            "problems": problems}
+
+
+def partition(seed: int = 2, writes: int = 24) -> dict:
+    """Seeded network partitions on the fleet plane: the current leader is
+    repeatedly isolated from the majority, which elects around it; heals
+    re-join it.  Asserts exactly-once plus full replica convergence after
+    the final heal (every live replica holds identical rows)."""
+    rng = random.Random((seed << 8) ^ 0x706172)
+    fleet, db, s = _fleet_session(seed)
+    tier = fleet.row_tiers["chaos.ck"]
+    g = tier.groups[0]
+    schedule: list[list] = []
+    partitioned = False
+    for i in range(writes):
+        if partitioned and rng.random() < 0.5:
+            g.bus.heal()
+            schedule.append([i, "heal"])
+            partitioned = False
+        if not partitioned and rng.random() < 0.3:
+            try:
+                ldr = g.leader()
+            except RuntimeError:
+                ldr = None
+            if ldr is not None:
+                rest = [n for n in g.bus.nodes if n != ldr]
+                g.bus.partition([ldr], rest)
+                schedule.append([i, "partition_leader", ldr])
+                partitioned = True
+        s.execute(f"INSERT INTO ck VALUES ({i}, {i * i})")
+    if partitioned:
+        g.bus.heal()
+        schedule.append([writes, "heal"])
+    g.bus.advance(30)               # let the isolated replica catch up
+    rows = s.query("SELECT k, v FROM ck ORDER BY k")
+    events = [e for e in db.binlog.read(0, 1 << 20)
+              if e.table == "ck" and e.event_type == "insert"]
+    problems = _check_exactly_once(rows, events, writes)
+    replica_states = []
+    for nid in sorted(g.bus.nodes):
+        node = g.bus.nodes[nid]
+        node.apply_committed()
+        replica_states.append(
+            sorted((r["k"], r["v"]) for r in node.rows_in_range()))
+    if any(st != replica_states[0] for st in replica_states[1:]):
+        problems.append("replicas did not converge after heal")
+    state = {"rows": rows,
+             "binlog": [[e.event_type, e.rows] for e in events],
+             "replicas": replica_states}
+    return {"writes": writes, "fault_schedule": schedule,
+            "faults": len(schedule),
+            "state_digest": _digest({"schedule": schedule, "state": state}),
+            "problems": problems}
+
+
+def rpc_chaos(seed: int = 3, writes: int = 16, delay_ms: float = 10.0,
+              delay_pct: int = 30, drop_pct: int = 15,
+              crash_leader: bool = True) -> dict:
+    """Daemon plane: 1 in-process meta + 3 in-process store daemons over
+    real TCP, with seeded handler latency (``store.handler`` delay) and
+    lost responses (``rpc.recv`` drop — the server executed, the reply
+    died), plus a mid-run crash of the region leader's daemon.  Client
+    writes ride RpcClient's backoff+jitter retries; lost-response resends
+    dedupe at the daemons by idempotency token.  Asserts every write
+    landed exactly once; reports retry/dedupe/timeout counters and write
+    latency percentiles."""
+    from ..server.meta_server import MetaServer
+    from ..server.store_server import StoreServer
+    from ..storage.remote_tier import ClusterClient, RemoteRowTier
+    from ..storage.rowstore import KeyCodec
+    from ..types import Field, LType, Schema
+    from ..utils import metrics
+    from ..utils.flags import FLAGS, set_flag
+
+    prev_seed = int(FLAGS.chaos_seed)
+    set_flag("chaos_seed", int(seed))
+    meta = MetaServer("127.0.0.1:0")
+    meta.start()
+    stores: list[StoreServer] = []
+    schedule: list[list] = []
+    lat_ms: list[float] = []
+    r0 = metrics.rpc_retries.value
+    d0 = metrics.rpc_dedup_hits.value
+    t0 = metrics.rpc_timeouts.value
+    try:
+        meta_addr = f"127.0.0.1:{meta.rpc.port}"
+        for sid in (1, 2, 3):
+            st = StoreServer(sid, "127.0.0.1:0", meta_addr,
+                             tick_interval=0.02, seed=seed * 11 + sid)
+            st.address = f"127.0.0.1:{st.rpc.port}"
+            st.start()
+            stores.append(st)
+        schema = Schema((Field("k", LType.INT64, False),
+                         Field("v", LType.INT64, True)))
+        cluster = ClusterClient(meta_addr)
+        tier = RemoteRowTier.get_or_create(
+            cluster, f"chaos.rpc_s{seed}", schema, ["k"])
+        kc = KeyCodec(schema, ["k"])
+        crash_at = writes // 3
+        try:
+            failpoint.set_failpoint("store.handler",
+                                    f"{delay_pct}%delay({delay_ms})")
+            failpoint.set_failpoint("rpc.recv", f"{drop_pct}%drop")
+            for i in range(writes):
+                if crash_leader and i == crash_at:
+                    victim_addr = tier.regions[0].leader_addr
+                    for st in stores:
+                        if st.address == victim_addr:
+                            st.crash()  # SIGKILL analog: 2/3 quorum remains
+                            schedule.append([i, "crash_store", st.store_id])
+                row = {"k": i, "v": i * i}
+                w0 = time.perf_counter()
+                tier.write_ops([(0, kc.encode_one(row),
+                                 tier.row_codec.encode(row))])
+                lat_ms.append((time.perf_counter() - w0) * 1e3)
+        finally:
+            failpoint.clear("store.handler")
+            failpoint.clear("rpc.recv")
+            set_flag("chaos_seed", prev_seed)
+        problems = []
+        got = {r["k"]: r["v"] for r in tier.scan_rows()
+               if not r.get("__del")}
+        want = {i: i * i for i in range(writes)}
+        if got != want:
+            problems.append(
+                f"writes lost or corrupted (missing="
+                f"{sorted(set(want) - set(got))[:5]})")
+    finally:
+        # a failed write mid-run must NOT leak daemon tick threads and
+        # ports into the process (bench / repeated runs share it)
+        for st in stores:
+            st.stop()
+        meta.stop()
+    return {"writes": writes, "fault_schedule": schedule,
+            "faults": len(schedule),
+            # rows only: WHICH store led at crash time is thread-timing,
+            # so the schedule is informational here — the seed-stable
+            # contract on the daemon plane is the final row state
+            "state_digest": _digest({"rows": sorted(got.items())}),
+            "problems": problems,
+            "rpc_retries": metrics.rpc_retries.value - r0,
+            "rpc_dedup_hits": metrics.rpc_dedup_hits.value - d0,
+            "rpc_timeouts": metrics.rpc_timeouts.value - t0,
+            "p50_ms": _p(lat_ms, 0.50), "p99_ms": _p(lat_ms, 0.99),
+            "max_ms": round(max(lat_ms), 3) if lat_ms else 0.0}
+
+
+SCENARIOS = {
+    "kill_leader": kill_leader,
+    "partition": partition,
+    "rpc_chaos": rpc_chaos,
+}
+
+
+def run_scenario(name: str, seed: int, **kw) -> dict:
+    """Run one scenario; assertion failures and crashes land in the result
+    (``ok`` False + ``problems``/``error``), never as an unhandled raise —
+    the harness must report a broken invariant, not die of it."""
+    fn = SCENARIOS[name]
+    try:
+        out = fn(seed=seed, **kw)
+    except Exception as e:          # noqa: BLE001 — the report IS the point
+        out = {"fault_schedule": [], "problems": [],
+               "error": f"{type(e).__name__}: {e}"}
+    out["scenario"] = name
+    out["seed"] = seed
+    out["ok"] = not out.get("problems") and "error" not in out
+    return out

@@ -909,11 +909,31 @@ class Session:
 
     def _set_stmt(self, s: SetStmt) -> Result:
         """SET (reference: setkv_planner.cpp): GLOBAL names update the flag
-        registry (and fire its listeners); @vars and unknown session names
-        (autocommit, sql_mode, ...) are stored per-session — MySQL clients
-        set those on connect and expect silent success."""
+        registry (and fire its listeners); ``failpoint.<point>`` arms/clears
+        the chaos registry (process-global regardless of scope — fault
+        injection is a deployment property, not a session one); @vars and
+        unknown session names (autocommit, sql_mode, ...) are stored
+        per-session — MySQL clients set those on connect and expect silent
+        success."""
         from ..utils.flags import FlagError
         for name, value in [(s.name, s.value)] + list(s.more):
+            if name.lower().startswith("failpoint."):
+                from ..chaos import failpoint as _fp
+                spec = "" if value is None else str(value)
+                if spec.strip().lower() not in ("", "off") and \
+                        not bool(FLAGS.chaos_enable):
+                    # chaos_enable is the real master switch at the SQL
+                    # surface: any connected client can reach SET, and an
+                    # armed panic/drop is destructive — clearing is always
+                    # allowed, arming needs the deployment to opt in
+                    raise SqlError("failpoints are disabled: "
+                                   "SET GLOBAL chaos_enable = 1 first")
+                try:
+                    _fp.set_failpoint(name.lower()[len("failpoint."):],
+                                      spec)
+                except ValueError as e:
+                    raise SqlError(str(e)) from None
+                continue
             if s.scope == "global":
                 try:
                     FLAGS.set_flag(name, value)
@@ -4088,6 +4108,16 @@ class Session:
                 "duration_ms": pa.array([r[7] for r in rows], pa.float64()),
                 "attrs": [r[8] for r in rows],
             }) if rows else _empty_info("trace_spans")
+        if name == "failpoints":
+            from ..chaos import failpoint as _fp
+            rows = _fp.describe()
+            return pa.table({
+                "name": [r[0] for r in rows],
+                "spec": [r[2] for r in rows],
+                "hits": pa.array([r[3] for r in rows], pa.int64()),
+                "trips": pa.array([r[4] for r in rows], pa.int64()),
+                "site": [r[1] for r in rows],
+            }) if rows else _empty_info("failpoints")
         if name == "metrics":
             rows = [(mname, k, float(v))
                     for mname, st in metrics.REGISTRY.expose().items()

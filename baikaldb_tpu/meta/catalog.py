@@ -234,6 +234,11 @@ class Catalog:
                                  Field("seq", LType.INT64),
                                  Field("file", LType.STRING),
                                  Field("watermark", LType.INT64))),
+        "failpoints": Schema((Field("name", LType.STRING),
+                              Field("spec", LType.STRING),
+                              Field("hits", LType.INT64),
+                              Field("trips", LType.INT64),
+                              Field("site", LType.STRING))),
     }
 
     def get_table(self, database: str, name: str) -> TableInfo:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import struct
 from typing import Callable, Optional
 
+from ..chaos import failpoint
 from ..storage.rowstore import RowTable
 from ..types import Field, LType, Schema
 from .core import CONFIG, DATA, LEADER, SNAPSHOT_KIND, Committed, RaftCore
@@ -141,6 +142,10 @@ class ReplicatedRegion:
     def apply_committed(self) -> list[Committed]:
         """Drain the core's committed entries into the row table (the
         braft on_apply analog, with the store's op_type dispatch)."""
+        if failpoint.ENABLED:
+            if failpoint.hit("raft.commit", node=self.node_id):
+                return []       # drop: defer applying this round — commits
+                #                 stay in the core and apply when cleared
         commits = self.core.drain_commits()
         for c in commits:
             if c.kind == DATA:
@@ -414,6 +419,8 @@ class LocalBus:
         healed); counting it would route writes into a black hole, so a
         candidate only qualifies when a quorum of its config is live and at
         its term following it."""
+        if failpoint.ENABLED and failpoint.hit("raft.leader_step"):
+            return None         # drop: report leaderless — election churn
         best = None
         for nid, node in self.nodes.items():
             if nid in self.down or node.core.role != LEADER:
@@ -477,6 +484,11 @@ class RaftGroup:
         from ..obs import trace
 
         with trace.span("raft.append", region=self.region_id, cmd=int(cmd)):
+            if failpoint.ENABLED:
+                if failpoint.hit("raft.append", region=self.region_id,
+                                 cmd=int(cmd)):
+                    return False    # drop: the append never happens —
+                    #                 callers see it as quorum loss
             return self._propose_cmd(cmd, txn_id, ops_bytes, max_ticks)
 
     def _propose_cmd(self, cmd: int, txn_id: int, ops_bytes: bytes,

@@ -18,6 +18,7 @@ from __future__ import annotations
 import itertools
 import threading
 
+from ..chaos import failpoint
 from .cluster import (CMD_COMMIT, CMD_DECIDE, CMD_PREPARE, CMD_ROLLBACK,
                       RaftGroup, encode_ops)
 
@@ -62,7 +63,15 @@ class TwoPhaseCoordinator:
                         regions=len(per_group_ops)):
             for rid, ops in per_group_ops.items():
                 g = by_region[rid]
-                if not g.propose_cmd(CMD_PREPARE, txn, encode_ops(ops)):
+                injected = False
+                if failpoint.ENABLED:
+                    # drop: this participant's prepare fails (rollback fan-
+                    # out); return/panic raise mid-fan-out, leaving earlier
+                    # prepares in doubt — the recovery protocol's window
+                    injected = failpoint.hit("2pc.prepare", txn=txn,
+                                             region=rid)
+                if injected or \
+                        not g.propose_cmd(CMD_PREPARE, txn, encode_ops(ops)):
                     for p in prepared:
                         p.propose_cmd(CMD_ROLLBACK, txn)
                     raise TwoPhaseError(f"prepare failed on region {rid}")
@@ -74,8 +83,12 @@ class TwoPhaseCoordinator:
         # MUST be verified — acking a txn whose decision never reached
         # quorum would lose it (recovery would roll the prepares back).
         with trace.span("2pc.decide", txn=txn):
-            decided = self.primary.propose_cmd(CMD_DECIDE, txn,
-                                               bytes([CMD_COMMIT]))
+            dropped = False
+            if failpoint.ENABLED:
+                dropped = failpoint.hit("2pc.decide", txn=txn)
+            decided = (not dropped) and \
+                self.primary.propose_cmd(CMD_DECIDE, txn,
+                                         bytes([CMD_COMMIT]))
         if not decided:
             # A failed propose does NOT mean the decision failed to commit —
             # a timeout can lose the ack, not the entry.  Rolling prepares

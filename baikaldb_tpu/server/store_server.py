@@ -22,10 +22,11 @@ import threading
 import time
 from typing import Optional
 
+from ..chaos import failpoint
 from ..raft.cluster import ReplicatedRegion
 from ..raft.core import LEADER
 from ..types import Field, LType, Schema
-from ..utils.net import RpcClient, RpcServer
+from ..utils.net import RpcClient, RpcServer, handler_deadline_s
 
 
 def schema_to_wire(schema: Schema) -> list:
@@ -56,6 +57,9 @@ class StoreServer:
                      "scan_raw", "region_status", "region_size", "ping",
                      "txn_status", "cold_manifest", "exec_fragment"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
+        # the failpoint `panic` action crashes THIS daemon, not just the
+        # serving thread (the chaos harness's kill-9 analog)
+        self.rpc.on_panic = self.crash
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -69,6 +73,16 @@ class StoreServer:
     def stop(self) -> None:
         self._stop.set()
         self.rpc.stop()
+
+    def crash(self) -> None:
+        """Abrupt in-process death: stop the raft clock and HARD-stop the
+        RPC server (live connections severed, so an in-flight handler can
+        never ack after the crash) — what SIGKILL does to a daemon
+        process.  In-memory region state stays with the object; a
+        'restarted' daemon is a NEW StoreServer whose replicas catch up
+        from peers."""
+        self._stop.set()
+        self.rpc.stop(hard=True)
 
     # -- RPC surface ------------------------------------------------------
     def rpc_ping(self):
@@ -120,6 +134,18 @@ class StoreServer:
         region = self.regions.get(int(region_id))
         if region is None:
             return {"status": "no_region"}
+        if failpoint.ENABLED:
+            if failpoint.hit("raft.leader_step", region=int(region_id)):
+                # drop: pretend leadership just moved — the client's
+                # leader-routing retry loop absorbs it
+                return {"status": "not_leader", "leader": -1}
+            if failpoint.hit("raft.append", region=int(region_id)):
+                return {"status": "timeout"}    # drop: append never lands
+        # never wait past the caller's propagated deadline budget: a reply
+        # after the client gave up is work nobody reads
+        budget = handler_deadline_s()
+        if budget is not None:
+            wait_s = min(float(wait_s), budget)
         with self._mu:
             if region.core.role != LEADER:
                 return {"status": "not_leader",

@@ -521,8 +521,40 @@ class Parser:
             name = "@" + self.ident().lower()
         else:
             name = self.ident()
+            # dotted assignment targets exist ONLY for the chaos control
+            # surface (SET failpoint.rpc.send = '...'); any other dotted
+            # name stays a parse error, so a typo in the prefix cannot
+            # silently become a session variable that never fires
+            if name.lower() == "failpoint":
+                name += self._failpoint_name()
         self.expect_op("=")
         return name, self.literal_value()
+
+    def _failpoint_name(self) -> str:
+        """The dotted tail of a failpoint target.  Digit-leading segments
+        (failpoint.2pc.prepare) need care: the lexer reads ``.2`` as ONE
+        NUM token, with the rest of the segment as an adjacent IDENT —
+        re-glue by source position."""
+        out = ""
+        while True:
+            t = self.peek()
+            if t.kind == "OP" and t.value == ".":
+                self.advance()
+                seg = self.advance()
+                if seg.kind not in ("IDENT", "KW", "NUM"):
+                    raise SqlError(f"expected failpoint name segment, got "
+                                   f"{seg.value!r} at {seg.pos}")
+                out += "." + seg.value
+            elif t.kind == "NUM" and t.value.startswith("."):
+                self.advance()
+                out += t.value                       # ".2"
+                nxt = self.peek()
+                if nxt.kind in ("IDENT", "KW") and \
+                        nxt.pos == t.pos + len(t.value):
+                    self.advance()
+                    out += nxt.value                 # "pc" -> ".2pc"
+            else:
+                return out
 
     def update_stmt(self) -> UpdateStmt:
         self.expect_kw("update")

@@ -42,6 +42,7 @@ from bisect import insort
 from dataclasses import asdict, dataclass, field
 from typing import Iterator, Optional
 
+from ..chaos import failpoint
 from ..meta.service import Tso
 
 _EVT = b"e"
@@ -169,6 +170,14 @@ class Binlog:
 
     def _append(self, event_type: str, database: str, table: str,
                 rows: Optional[list], statement: str, affected: int) -> int:
+        if failpoint.ENABLED:
+            # before the TSO draw and before durability: a panic here is
+            # the mid-transaction crash window (the append was never
+            # acked, so recovery owes the caller nothing for it); drop
+            # loses the event outright
+            if failpoint.hit("binlog.append", table=f"{database}.{table}",
+                             event=event_type):
+                return 0
         # durable-before-visible, and the write I/O happens OUTSIDE the
         # lock: readers are never stalled behind another append's disk
         # write (only ring insertion and the rare trim hold it)

@@ -35,6 +35,7 @@ import json
 import time
 from typing import Optional
 
+from ..chaos import failpoint
 from ..types import Field, LType, Schema
 from ..utils.flags import FLAGS, define
 from .column_store import ROWID
@@ -151,6 +152,13 @@ class DistributedBinlog:
 
         with trace.span("binlog.dist_append", table=table_key,
                         events=len(events), with_data=True):
+            if failpoint.ENABLED:
+                if failpoint.hit("binlog.dist_append", table=table_key):
+                    # drop: the CDC append is skipped but the DATA still
+                    # commits — the lost-binlog-event chaos the scenario
+                    # assertions exist to catch
+                    data_tier.write_ops(data_ops)
+                    return
             start_ts, tomb = self.prewrite(table_key)
             try:
                 _ts, bops = self.commit_ops(start_ts, tomb, table_key,
@@ -168,6 +176,9 @@ class DistributedBinlog:
 
         with trace.span("binlog.dist_append", table=table_key,
                         events=len(events)):
+            if failpoint.ENABLED:
+                if failpoint.hit("binlog.dist_append", table=table_key):
+                    return 0        # drop: the events are lost
             start_ts, tomb = self.prewrite(table_key)
             try:
                 commit_ts, bops = self.commit_ops(start_ts, tomb, table_key,

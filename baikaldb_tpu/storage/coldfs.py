@@ -19,6 +19,7 @@ import os
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from ..chaos import failpoint
 from ..obs import trace
 
 
@@ -37,6 +38,10 @@ class ExternalFS:
     def put(self, name: str, data: bytes) -> None:
         """Atomic immutable write (segments are never modified in place)."""
         with trace.span("coldfs.put", file=name, nbytes=len(data)):
+            if failpoint.ENABLED:
+                if failpoint.hit("coldfs.put", file=name):
+                    return      # drop: the bytes never land (a manifest
+                    #             entry without a segment — worst case)
             tmp = self._path(name) + f".tmp.{os.getpid()}"
             with open(tmp, "wb") as f:
                 f.write(data)
@@ -46,6 +51,10 @@ class ExternalFS:
 
     def get(self, name: str) -> bytes:
         with trace.span("coldfs.get", file=name):
+            if failpoint.ENABLED:
+                if failpoint.hit("coldfs.get", file=name):
+                    raise FileNotFoundError(
+                        f"coldfs.get dropped by failpoint: {name}")
             with open(self._path(name), "rb") as f:
                 return f.read()
 

@@ -17,13 +17,14 @@ import time
 from bisect import bisect_right
 from typing import Optional
 
+from ..chaos import failpoint
 from ..raft.cluster import (CMD_COLD, CMD_COMMIT, CMD_DECIDE, CMD_PREPARE,
                             CMD_ROLLBACK,
                             CMD_SET_RANGE, CMD_TRIM, CMD_WRITE, encode_cmd,
                             encode_ops, encode_range)
 from ..types import Schema
 from ..utils.flags import FLAGS
-from ..utils.net import RpcClient, RpcError
+from ..utils.net import RpcClient, RpcError, RpcTimeout
 from .replicated import ReplicationError, SplitError, _fnv64
 from .rowstore import RowCodec
 
@@ -87,6 +88,12 @@ def _twopc_remote(parts: list, txn: int, deadline_s: float) -> None:
     prepared: list = []
     try:
         for t, r, batch in parts:
+            if failpoint.ENABLED:
+                if failpoint.hit("2pc.prepare", txn=txn,
+                                 region=r.region_id):
+                    raise ReplicationError(
+                        f"2pc.prepare dropped by failpoint "
+                        f"(region {r.region_id})")
             t._propose(r, encode_cmd(CMD_PREPARE, txn, encode_ops(batch)))
             prepared.append((t, r))
     except (ReplicationError, StaleRoutingError):
@@ -104,6 +111,9 @@ def _twopc_remote(parts: list, txn: int, deadline_s: float) -> None:
     # ABORT decision instead (apply is first-writer-wins), then act on the
     # WINNING decision read back from the primary (ADVICE r03 medium).
     try:
+        if failpoint.ENABLED:
+            if failpoint.hit("2pc.decide", txn=txn):
+                raise ReplicationError("2pc.decide dropped by failpoint")
         pt._propose(pr, encode_cmd(CMD_DECIDE, txn, bytes([CMD_COMMIT])))
     except ReplicationError:
         try:
@@ -725,6 +735,10 @@ class RemoteRowTier:
             try:
                 resp = self.cluster.store(addr).call(
                     method, region_id=region.region_id, **kw)
+            except RpcTimeout:
+                # transport-level, not handler-level: rotate to the next
+                # peer exactly like any other connection failure
+                continue
             except RpcError as exc:
                 if handler_error is not None:
                     raise handler_error(str(exc)) from None

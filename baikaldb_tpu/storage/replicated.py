@@ -46,6 +46,11 @@ if TYPE_CHECKING:  # pragma: no cover
 define("region_split_rows", 200_000,
        "auto-split a replicated region when it exceeds this many keys "
        "(reference: region_split_lines)")
+define("learner_read_fallback", True,
+       "when a region has no electable quorum, serve reads from the most "
+       "advanced LIVE replica (learners included) instead of failing — a "
+       "bounded-staleness degradation, counted in "
+       "metrics.learner_fallback_reads; off restores fail-fast reads")
 
 
 def _fnv64(data: bytes) -> int:
@@ -287,6 +292,23 @@ class ReplicatedRowTier:
                 return node
         return ldr                        # no qualifying replica: leader read
 
+    def _stale_read_node(self, g: RaftGroup):
+        """Leaderless degradation (reference: learner replicas keep serving
+        reads when the voting quorum is gone): the most advanced LIVE
+        replica — learners included, they replicate everything — serves a
+        best-effort stale read.  None when every replica is down or the
+        fallback flag is off."""
+        if not bool(FLAGS.learner_read_fallback):
+            return None
+        best = None
+        for nid, node in sorted(g.bus.nodes.items()):
+            if nid in g.bus.down:
+                continue
+            node.apply_committed()      # drain anything already delivered
+            if best is None or node.applied_index > best.applied_index:
+                best = node
+        return best
+
     def scan_rows(self) -> list[dict]:
         """Latest committed row versions across all regions (leader reads,
         each filtered to the range the region OWNS so mid-split copies are
@@ -294,11 +316,18 @@ class ReplicatedRowTier:
         needs them; callers counting LIVE rows use num_rows().  Serializes
         with writes/splits: a recovery scan mid-split would double- or
         under-read moved rows, and reads can pump a group bus a writer is
-        also pumping."""
+        also pumping.  A quorumless region degrades to a learner/stale read
+        (learner_read_fallback) instead of failing the whole scan."""
         with self._mu:
             out: list[dict] = []
             for m, g in zip(self.metas, self.groups):
-                node = self._leader_node(m, g)
+                try:
+                    node = self._leader_node(m, g)
+                except RuntimeError:
+                    node = self._stale_read_node(g)
+                    if node is None:
+                        raise
+                    metrics.learner_fallback_reads.add(1)
                 out.extend(node.rows_in_range())
             return out
 

@@ -230,6 +230,20 @@ swallowed_exceptions = Counter("swallowed_exceptions")
 # trace_store_max or lower the sampling rate
 traces_sampled = Counter("traces_sampled")
 trace_spans_dropped = Counter("trace_spans_dropped")
+# RPC plane (utils/net.py): calls that exhausted their per-call deadline
+# budget (typed RpcTimeout), transport-failure resends under the
+# backoff+jitter policy, and daemon-side idempotency-token dedupe hits
+# (a retried write whose first copy executed with the response lost —
+# the dedupe is what makes resending writes safe)
+rpc_timeouts = Counter("rpc_timeouts")
+rpc_retries = Counter("rpc_retries")
+rpc_dedup_hits = Counter("rpc_dedup_hits")
+# chaos (chaos/failpoint.py): total failpoint trips across all points
+# (per-point counts live in failpoint.<name> counters)
+failpoint_trips = Counter("failpoint_trips")
+# leaderless regions served by the most advanced live replica (learner
+# included) instead of failing the read — bounded-degradation valve
+learner_fallback_reads = Counter("learner_fallback_reads")
 
 
 def count_swallowed(site: str) -> None:

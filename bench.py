@@ -514,6 +514,112 @@ def run_trace_bench() -> dict:
     }
 
 
+def run_chaos_bench() -> dict:
+    """Chaos machinery overhead + a seeded latency-injection run.
+
+    Part 1 (the headline): the SAME cached point-query steady state as
+    run_point_bench, measured with the chaos machinery fully disabled and
+    then with chaos_enable=1 but NO failpoint armed — i.e. every wired
+    site evaluates its registry lookup and misses.  The acceptance
+    contract (docs/CHAOS.md): disabled overhead <= 1% (one module-bool
+    read per site; no distributed seam is even on this path), enabled-
+    but-unarmed stays within a few percent.
+
+    Part 2: one seeded rpc_chaos scenario (in-process store daemons,
+    store.handler latency + rpc.recv response drops + a leader crash)
+    reporting retry counts, dedupe hits, and write-latency p99."""
+    import pyarrow as pa
+
+    from baikaldb_tpu.chaos import failpoint
+    from baikaldb_tpu.chaos.scenarios import run_scenario
+    from baikaldb_tpu.exec.session import Session
+    from baikaldb_tpu.utils.flags import set_flag
+
+    n_rows = int(os.environ.get("BENCH_CHAOS_ROWS", 100_000))
+    n_q = int(os.environ.get("BENCH_CHAOS_QUERIES", 64))
+    rng = np.random.default_rng(17)
+    base = pa.table({
+        "id": np.arange(n_rows, dtype=np.int64),
+        "v": rng.normal(size=n_rows).astype(np.float64),
+    })
+
+    def phase(chaos_on: bool, its: int) -> float:
+        failpoint.clear_all()
+        set_flag("chaos_enable", chaos_on)
+        s = Session()
+        s.execute("CREATE TABLE ch (id BIGINT, v DOUBLE)")
+        s.load_arrow("ch", base)
+        s.query("SELECT v FROM ch WHERE id = 0")      # plan + first compile
+        t0 = time.perf_counter()
+        for i in range(its):
+            s.query(f"SELECT v FROM ch WHERE id = {1 + (i * 9173) % n_rows}")
+        return time.perf_counter() - t0
+
+    try:
+        off_dt = phase(False, n_q)
+        on_dt = phase(True, n_q)
+    finally:
+        failpoint.clear_all()
+        set_flag("chaos_enable", False)
+    off_per, on_per = off_dt / n_q, on_dt / n_q
+    chaos_run = run_scenario(
+        "rpc_chaos", int(os.environ.get("BENCH_CHAOS_SEED", 7)),
+        writes=int(os.environ.get("BENCH_CHAOS_WRITES", 12)))
+    platform = None
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:                                   # noqa: BLE001
+        pass
+    return {
+        "metric": f"point-query steady state with chaos machinery "
+                  f"compiled in but disabled "
+                  f"({n_rows / 1e3:.0f}k rows, {n_q} queries, {platform})",
+        "value": round(n_q / off_dt, 1),
+        "unit": "queries/sec",
+        # >1 means the enabled-but-unarmed machinery made it slower
+        "vs_baseline": round(on_per / off_per, 3),
+        "overhead_pct": round((on_per / off_per - 1.0) * 100, 2),
+        "platform": platform,
+        "rows": n_rows,
+        "queries": n_q,
+        "per_query_ms_chaos_off": round(off_per * 1e3, 2),
+        "per_query_ms_chaos_enabled_unarmed": round(on_per * 1e3, 2),
+        "chaos_latency_run": {
+            k: chaos_run.get(k)
+            for k in ("seed", "ok", "writes", "faults", "rpc_retries",
+                      "rpc_dedup_hits", "rpc_timeouts", "p50_ms", "p99_ms",
+                      "state_digest")},
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
+def _emit_chaos_line(skip_reason: str | None = None):
+    """Fifth JSON line: chaos-machinery overhead guard + seeded latency
+    injection.  Same robustness contract: always prints a line, never
+    raises."""
+    if os.environ.get("BENCH_SKIP_CHAOS") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "point-query steady state with chaos machinery "
+                      "compiled in but disabled (skipped)",
+            "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+            "platform": "none", "error": skip_reason}))
+        return
+    try:
+        result = run_chaos_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "point-query steady state with chaos "
+                            "machinery compiled in but disabled (failed)",
+                  "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+                  "platform": "none",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_trace_line(skip_reason: str | None = None):
     """Fourth JSON line: tracing-overhead regression guard.  Same
     robustness contract: always prints a line, never raises."""
@@ -604,6 +710,8 @@ def main():
                                  "point phase skipped")
                 _emit_trace_line(skip_reason="accelerator probe failed; "
                                  "tracing phase skipped")
+                _emit_chaos_line(skip_reason="accelerator probe failed; "
+                                 "chaos phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -640,11 +748,13 @@ def main():
             _emit_mixed_line()      # backend already ran here: measure
             _emit_point_line()
             _emit_trace_line()
+            _emit_chaos_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
     _emit_point_line()
     _emit_trace_line()
+    _emit_chaos_line()
     return 0
 
 

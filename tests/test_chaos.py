@@ -1,0 +1,349 @@
+"""Failpoint registry + chaos harness (docs/CHAOS.md).
+
+Covers: spec parsing and the deterministic (seed, name, hit-index) trigger
+schedule; every action (return/delay/drop/panic); the SQL control surface
+(SET failpoint.<name>, information_schema.failpoints); crash-recovery of
+the WAL binlog through an injected panic; 2PC under injected prepare
+failure; leader-unavailable reads falling back to learners/replicas; and
+the seeded scenario harness — identical fault schedules and identical
+final state across two runs, with the kill-leader/rpc scenario completing
+every client write exactly once via retry + dedupe.
+"""
+
+import time
+
+import pytest
+
+from baikaldb_tpu.chaos import failpoint
+from baikaldb_tpu.chaos.failpoint import (FailpointError, FailpointPanic,
+                                          clear_all, hit, set_failpoint)
+from baikaldb_tpu.raft.core import raft_available
+from baikaldb_tpu.utils import metrics
+from baikaldb_tpu.utils.flags import set_flag
+
+needs_raft = pytest.mark.skipif(not raft_available(),
+                                reason="native raft core unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    clear_all()
+    set_flag("chaos_enable", False)
+    yield
+    clear_all()
+    set_flag("chaos_enable", False)
+    set_flag("chaos_seed", 0)
+
+
+# ---- registry + specs ------------------------------------------------------
+
+def test_spec_parsing_and_validation():
+    set_failpoint("rpc.send", "30%delay(20)")
+    assert failpoint.get_spec("rpc.send") == "30%delay(20)"
+    set_failpoint("rpc.send", "off")            # clears
+    assert failpoint.get_spec("rpc.send") is None
+    with pytest.raises(ValueError, match="unknown failpoint"):
+        set_failpoint("rpc.snd", "drop")        # typos must not arm nothing
+    with pytest.raises(ValueError, match="bad spec"):
+        set_failpoint("rpc.send", "explode")
+    with pytest.raises(ValueError, match="no argument"):
+        set_failpoint("rpc.send", "drop(5)")
+    with pytest.raises(ValueError, match="millisecond"):
+        set_failpoint("rpc.send", "delay(soon)")
+
+
+def test_enable_semantics():
+    assert not failpoint.ENABLED
+    set_failpoint("rpc.send", "drop")           # arming implies enabled
+    assert failpoint.ENABLED
+    clear_all()
+    assert not failpoint.ENABLED
+    set_flag("chaos_enable", True)              # flag alone enables too
+    assert failpoint.ENABLED
+    assert hit("rpc.send") is False             # nothing armed: no-op
+
+
+def test_actions():
+    set_failpoint("rpc.send", "drop")
+    assert hit("rpc.send") is True
+    set_failpoint("rpc.send", "return(injected boom)")
+    with pytest.raises(FailpointError, match="injected boom"):
+        hit("rpc.send")
+    set_failpoint("rpc.send", "panic")
+    with pytest.raises(FailpointPanic):
+        hit("rpc.send")
+    assert issubclass(FailpointPanic, BaseException)
+    assert not issubclass(FailpointPanic, Exception)   # unswallowable
+    set_failpoint("rpc.send", "delay(30)")
+    t0 = time.perf_counter()
+    assert hit("rpc.send") is False
+    assert (time.perf_counter() - t0) >= 0.025
+    set_failpoint("rpc.send", "2*drop")         # count-limited
+    assert [hit("rpc.send") for _ in range(4)] == [True, True, False, False]
+
+
+def test_trip_schedule_is_deterministic():
+    """The trigger schedule is a pure function of (seed, name, hit index):
+    re-arming replays it; a different seed changes it; another armed point
+    does not perturb it."""
+    set_flag("chaos_seed", 123)
+
+    def schedule(n=64):
+        set_failpoint("rpc.send", "35%drop")
+        out = [hit("rpc.send") for _ in range(n)]
+        failpoint.clear("rpc.send")
+        return out
+
+    a = schedule()
+    b = schedule()
+    assert a == b and any(a) and not all(a)
+    set_failpoint("rpc.recv", "50%drop")        # unrelated armed point
+    assert schedule() == a
+    set_flag("chaos_seed", 124)
+    assert schedule() != a
+    set_flag("chaos_seed", 123)
+    assert schedule() == a
+
+
+def test_trips_counted_in_metrics():
+    before = metrics.failpoint_trips.value
+    set_failpoint("rpc.send", "drop")
+    hit("rpc.send")
+    hit("rpc.send")
+    assert metrics.failpoint_trips.value == before + 2
+    assert metrics.REGISTRY.counter("failpoint.rpc.send").value >= 2
+
+
+# ---- SQL control surface ---------------------------------------------------
+
+def test_set_failpoint_and_information_schema():
+    from baikaldb_tpu.exec.session import Session, SqlError
+
+    s = Session()
+    # SQL arming is gated on the master switch: any connected client can
+    # reach SET, and an armed panic is destructive
+    with pytest.raises(SqlError, match="chaos_enable"):
+        s.execute("SET failpoint.rpc.send = '25%delay(5)'")
+    s.execute("SET GLOBAL chaos_enable = 1")
+    s.execute("SET failpoint.rpc.send = '25%delay(5)'")
+    assert failpoint.get_spec("rpc.send") == "25%delay(5)"
+    rows = s.query("SELECT name, spec FROM "
+                   "information_schema.failpoints WHERE name = 'rpc.send'")
+    assert rows == [{"name": "rpc.send", "spec": "25%delay(5)"}]
+    # the full catalog is listed, armed or not
+    names = {r["name"] for r in
+             s.query("SELECT name FROM information_schema.failpoints")}
+    assert {"rpc.send", "rpc.recv", "raft.append", "raft.commit",
+            "raft.leader_step", "2pc.prepare", "2pc.decide",
+            "binlog.append", "binlog.dist_append", "coldfs.put",
+            "coldfs.get", "store.handler"} <= names
+    # digit-leading segments survive the lexer (".2" tokenizes as a NUM)
+    s.execute("SET failpoint.2pc.prepare = '1*drop'")
+    assert failpoint.get_spec("2pc.prepare") == "1*drop"
+    s.execute("SET failpoint.2pc.prepare = 'off'")
+    s.execute("SET failpoint.rpc.send = 'off'")
+    assert failpoint.get_spec("rpc.send") is None
+    with pytest.raises(SqlError, match="unknown failpoint"):
+        s.execute("SET failpoint.nope = 'drop'")
+    # a typo in the PREFIX is a parse error, never a silent session var
+    with pytest.raises(SqlError):
+        s.execute("SET failpoin.rpc.send = 'drop'")
+    # hit/trip counters surface (deltas: the registry counters are
+    # process-lifetime, shared across tests)
+    def counts():
+        r = s.query("SELECT hits, trips FROM information_schema.failpoints "
+                    "WHERE name = 'binlog.append'")[0]
+        return r["hits"], r["trips"]
+
+    h0, t0 = counts()
+    s.execute("SET failpoint.binlog.append = '1*drop'")
+    s.execute("CREATE DATABASE fpdb")
+    s.execute("USE fpdb")
+    s.execute("CREATE TABLE t (a BIGINT)")
+    s.execute("INSERT INTO t VALUES (1)")       # binlog append dropped
+    s.execute("INSERT INTO t VALUES (2)")       # limit spent: this one lands
+    h1, t1 = counts()
+    assert h1 - h0 >= 2 and t1 - t0 == 1
+    events = [e for e in s.db.binlog.read(0, 1000) if e.table == "t"]
+    assert len(events) == 1                     # first event was dropped
+
+
+# ---- binlog crash-recovery -------------------------------------------------
+
+def test_binlog_panic_crash_recovery(tmp_path):
+    """Injected panic at binlog.append, then 'daemon restart' (a fresh
+    Binlog over the same WAL): replay converges — every acked event
+    recovered exactly once, the unacked one owed nothing, and post-restart
+    timestamps stay monotonic."""
+    from baikaldb_tpu.storage.binlog import Binlog
+
+    path = str(tmp_path / "chaos_binlog.wal")
+    b = Binlog(path=path)
+    acked = [b.append("insert", "d", "t", rows=[{"k": i}])
+             for i in range(3)]
+    set_failpoint("binlog.append", "1*panic")
+    with pytest.raises(FailpointPanic):
+        b.append("insert", "d", "t", rows=[{"k": 99}])   # crash mid-append
+    clear_all()
+    b2 = Binlog(path=path)                      # the restart
+    got = b2.read(0, 1000)
+    assert [e.rows[0]["k"] for e in got] == [0, 1, 2]    # no lost, no dup
+    assert [e.commit_ts for e in got] == sorted(acked)
+    ts = b2.append("insert", "d", "t", rows=[{"k": 3}])
+    assert ts > max(acked)                      # TSO never reissues
+
+
+def test_binlog_panic_mid_transaction(tmp_path):
+    """Session-level: panic fires while COMMIT flushes the txn's binlog
+    events; restart replays a consistent prefix with no duplicates."""
+    from baikaldb_tpu.exec.session import Database, Session
+
+    d = str(tmp_path / "dbdir")
+    s = Session(Database(data_dir=d))
+    s.execute("CREATE DATABASE cr")
+    s.execute("USE cr")
+    s.execute("CREATE TABLE t (a BIGINT)")
+    s.execute("BEGIN")
+    s.execute("INSERT INTO t VALUES (1)")
+    s.execute("INSERT INTO t VALUES (2)")
+    set_failpoint("binlog.append", "1*panic")
+    with pytest.raises(FailpointPanic):
+        s.execute("COMMIT")
+    clear_all()
+    events_crashed = [e for e in s.db.binlog.read(0, 1000)
+                      if e.table == "t"]
+    s2 = Session(Database(data_dir=d))          # the restart
+    recovered = [e for e in s2.db.binlog.read(0, 1000) if e.table == "t"]
+    # replay converges: exactly the events that became durable before the
+    # panic, in the same order, no duplicates
+    assert [e.rows for e in recovered] == [e.rows for e in events_crashed]
+    assert len({e.commit_ts for e in recovered}) == len(recovered)
+
+
+# ---- raft / 2pc seams ------------------------------------------------------
+
+@needs_raft
+def test_2pc_prepare_failpoint_aborts_cleanly():
+    from baikaldb_tpu.raft import RaftGroup
+    from baikaldb_tpu.raft.twopc import TwoPhaseCoordinator, TwoPhaseError
+
+    gs = [RaftGroup(region_id=i + 1,
+                    peer_ids=[i * 10 + 1, i * 10 + 2, i * 10 + 3],
+                    seed=i + 3) for i in range(2)]
+
+    def ops(g, k, v):
+        rep = g.bus.nodes[g.leader()]
+        row = {"k": k, "v": v}
+        return [(0, rep.table.key_codec.encode_one(row),
+                 rep.table.row_codec.encode(row))]
+
+    set_failpoint("2pc.prepare", "1*drop")
+    with pytest.raises(TwoPhaseError, match="prepare failed"):
+        TwoPhaseCoordinator(gs).write({1: ops(gs[0], 1, "a"),
+                                       2: ops(gs[1], 2, "b")})
+    clear_all()
+    for g in gs:                                # nothing torn, nothing stuck
+        ldr = g.bus.nodes[g.leader()]
+        assert ldr.rows() == [] and not ldr.prepared
+    # with the failpoint cleared the same write commits
+    TwoPhaseCoordinator(gs).write({1: ops(gs[0], 1, "a"),
+                                   2: ops(gs[1], 2, "b")})
+    assert {r["k"] for r in gs[0].bus.nodes[gs[0].leader()].rows()} == {1}
+
+
+@needs_raft
+def test_raft_append_failpoint_fails_write():
+    from baikaldb_tpu.raft import RaftGroup
+
+    g = RaftGroup(region_id=1, peer_ids=[1, 2, 3], seed=5)
+    rep = g.bus.nodes[g.leader()]
+    row = {"k": 1, "v": "x"}
+    op = (0, rep.table.key_codec.encode_one(row),
+          rep.table.row_codec.encode(row))
+    set_failpoint("raft.append", "1*drop")
+    assert g.write([op]) is False               # the append never happened
+    assert g.write([op]) is True                # limit spent: lands now
+    assert g.bus.nodes[g.leader()].rows() == [{"k": 1, "v": "x"}]
+
+
+@needs_raft
+def test_leader_unavailable_reads_fall_back():
+    """Quorum gone (2 of 3 replicas dead): the tier serves the read from
+    the surviving replica instead of failing; the valve is counted and
+    flag-gated."""
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.fleet import StoreFleet
+
+    fleet = StoreFleet(MetaService(peer_count=3),
+                       ["f1:1", "f2:1", "f3:1"], seed=9)
+    s = Session(Database(fleet=fleet))
+    s.execute("CREATE DATABASE lf")
+    s.execute("USE lf")
+    s.execute("CREATE TABLE t (a BIGINT, PRIMARY KEY (a))")
+    s.execute("INSERT INTO t VALUES (1), (2), (3)")
+    tier = fleet.row_tiers["lf.t"]
+    g = tier.groups[0]
+    # kill the LEADER plus one follower: the survivor (a follower) cannot
+    # elect alone, so the leader-read path genuinely has nowhere to go —
+    # killing two followers would leave a still-serving stale leader
+    ldr = g.leader()
+    dead = [ldr] + [n for n in sorted(g.bus.nodes) if n != ldr][:1]
+    for nid in dead:
+        g.bus.kill(nid)
+    before = metrics.learner_fallback_reads.value
+    rows = {r["a"] for r in tier.scan_rows() if not r.get("__del")}
+    assert rows == {1, 2, 3}
+    assert metrics.learner_fallback_reads.value > before
+    set_flag("learner_read_fallback", False)
+    try:
+        with pytest.raises(RuntimeError):
+            tier.scan_rows()
+    finally:
+        set_flag("learner_read_fallback", True)
+        for nid in dead:
+            g.bus.revive(nid)
+
+
+# ---- scenario harness ------------------------------------------------------
+
+@needs_raft
+def test_kill_leader_scenario_deterministic():
+    """The acceptance contract: same seed -> identical fault schedule and
+    identical final table/binlog state; all invariants hold."""
+    from baikaldb_tpu.chaos.scenarios import run_scenario
+
+    a = run_scenario("kill_leader", 11, writes=14)
+    b = run_scenario("kill_leader", 11, writes=14)
+    assert a["ok"] and b["ok"], (a, b)
+    assert a["fault_schedule"] == b["fault_schedule"]
+    assert a["state_digest"] == b["state_digest"]
+    assert a["faults"] > 0                      # chaos actually happened
+    c = run_scenario("kill_leader", 12, writes=14)
+    assert c["ok"] and c["fault_schedule"] != a["fault_schedule"]
+
+
+@needs_raft
+def test_partition_scenario_converges():
+    from baikaldb_tpu.chaos.scenarios import run_scenario
+
+    a = run_scenario("partition", 7, writes=12)
+    assert a["ok"], a
+    assert a["faults"] > 0
+    assert run_scenario("partition", 7,
+                        writes=12)["state_digest"] == a["state_digest"]
+
+
+@needs_raft
+def test_rpc_chaos_scenario_exactly_once():
+    """Daemon plane: injected handler latency + lost responses + a leader
+    daemon crash; every client write lands exactly once via RpcClient
+    retry + idempotency-token dedupe."""
+    from baikaldb_tpu.chaos.scenarios import run_scenario
+
+    r = run_scenario("rpc_chaos", 21, writes=12, drop_pct=30,
+                     delay_pct=25, delay_ms=5)
+    assert r["ok"], r
+    assert r["faults"] >= 1                     # the leader daemon crashed
+    assert r["rpc_retries"] > 0                 # drops forced resends
+    assert r["p99_ms"] > 0

@@ -296,6 +296,75 @@ def test_spaninjit_regex_span_not_confused(tmp_path):
     assert out == []
 
 
+# ---- FAILPOINTHOT ----------------------------------------------------------
+
+def test_failpointhot_unguarded_site(tmp_path):
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.chaos import failpoint
+        def f(x):
+            failpoint.hit("rpc.send")
+            return x
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == [("FAILPOINTHOT", 3)]
+
+
+def test_failpointhot_guarded_sites_clean(tmp_path):
+    # both sanctioned spellings: the nested if and the inline and-chain
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.chaos import failpoint
+        def f(x):
+            if failpoint.ENABLED:
+                if failpoint.hit("rpc.send"):
+                    return None
+            return x
+        def g(x):
+            if failpoint.ENABLED and failpoint.hit("raft.leader_step"):
+                return None
+            return x
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == []
+
+
+def test_failpointhot_in_traced_scope(tmp_path):
+    # hot module: even a guarded site is wrong — host-side sleep/raise in
+    # jit-traced scope fires at trace time
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.chaos import failpoint
+        def f(x):
+            if failpoint.ENABLED:
+                if failpoint.hit("rpc.send"):
+                    return x
+            return x
+        """)
+    assert out == [("FAILPOINTHOT", 4)]
+
+
+def test_failpointhot_jit_decorated(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax
+        from baikaldb_tpu.chaos import failpoint
+        @jax.jit
+        def f(x):
+            if failpoint.ENABLED:
+                failpoint.hit("rpc.send")
+            return x
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == [("FAILPOINTHOT", 6)]
+
+
+def test_failpointhot_guard_outside_def_does_not_count(tmp_path):
+    # an `if ENABLED:` around the DEF is a definition-time check, not a
+    # per-call guard — calls inside still need their own
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.chaos import failpoint
+        if failpoint.ENABLED:
+            def f(x):
+                failpoint.hit("rpc.send")
+                return x
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == [("FAILPOINTHOT", 4)]
+
+
 # ---- suppression channels -------------------------------------------------
 
 def test_inline_suppression(tmp_path):

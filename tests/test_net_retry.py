@@ -1,23 +1,35 @@
-"""RpcClient retry semantics: a transport failure after the request was sent
-must only trigger a resend for idempotent methods — the server may have
-executed the first copy with the response lost, and a duplicated
-split_region_key mints a second child region with an identical start key,
-bricking the table layout (ADVICE r03 low #3)."""
+"""RpcClient retry semantics under the unified retry/backoff policy.
+
+A transport failure after the request was sent MAY resend any method —
+including mutating ones — because non-idempotent methods carry an
+idempotency token and a dedupe-aware server (RpcServer) executes the first
+copy only, replaying its recorded response for resends.  Against a server
+WITHOUT dedupe the token still rides every resend, so the wire contract is
+observable: all copies of one logical call share one token.  raft_msg is
+fire-and-forget (raft is its own retry protocol; transport re-delivery of
+stale acks destabilizes nextIndex), and exhausting the per-call deadline
+budget raises the typed RpcTimeout.
+"""
 
 import socket
 import threading
+import time
 
 import pytest
 
-from baikaldb_tpu.utils.net import RpcClient, recv_msg, send_msg
+from baikaldb_tpu.utils import metrics
+from baikaldb_tpu.utils.flags import FLAGS
+from baikaldb_tpu.utils.net import (RpcClient, RpcError, RpcServer,
+                                    RpcTimeout, recv_msg, send_msg)
 
 
 class OneShotDropServer:
     """Processes each request, then closes the connection WITHOUT replying —
-    the worst case: work done, response lost."""
+    the worst case: work done, response lost.  No dedupe (a raw socket
+    server), so every resend is visible in ``seen``."""
 
     def __init__(self):
-        self.seen: list[str] = []
+        self.seen: list[dict] = []
         self._srv = socket.create_server(("127.0.0.1", 0))
         self.port = self._srv.getsockname()[1]
         self._stop = False
@@ -38,7 +50,7 @@ class OneShotDropServer:
                 except TimeoutError:
                     continue
                 if req is not None:
-                    self.seen.append(req["method"])
+                    self.seen.append(req)
                 # close without replying
 
     def close(self):
@@ -51,7 +63,7 @@ class CountingServer:
     """Replies normally but records every request (duplicate detector)."""
 
     def __init__(self):
-        self.seen: list[str] = []
+        self.seen: list[dict] = []
         self._srv = socket.create_server(("127.0.0.1", 0))
         self.port = self._srv.getsockname()[1]
         self._stop = False
@@ -74,7 +86,7 @@ class CountingServer:
                         continue
                     if req is None:
                         break
-                    self.seen.append(req["method"])
+                    self.seen.append(req)
                     send_msg(conn, {"ok": True, "result": "pong"})
 
     def close(self):
@@ -83,27 +95,131 @@ class CountingServer:
         self._srv.close()
 
 
-def test_non_idempotent_not_resent_after_send():
+def test_non_idempotent_resent_with_one_token():
+    """A mutating method IS resent after a lost response — but every copy
+    carries the SAME idempotency token, so a dedupe-aware server executes
+    once.  Without dedupe (this raw server) the copies are visible:
+    1 original + rpc_retry_max resends."""
     srv = OneShotDropServer()
     try:
-        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=2.0)
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=5.0)
         with pytest.raises(OSError):
             c.call("split_region_key", region_id=1, split_key_hex="00")
-        assert srv.seen.count("split_region_key") == 1   # never resent
+        frames = [r for r in srv.seen if r["method"] == "split_region_key"]
+        assert len(frames) == 1 + int(FLAGS.rpc_retry_max)
+        tokens = {r.get("token") for r in frames}
+        assert len(tokens) == 1 and None not in tokens
     finally:
         srv.close()
 
 
-def test_idempotent_is_resent_after_send():
+def test_idempotent_resent_without_token():
     srv = OneShotDropServer()
     try:
-        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=2.0)
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=5.0)
         with pytest.raises(OSError):
             c.call("ping")
-        # resent once (two connections each saw the request)
-        assert srv.seen.count("ping") == 2
+        frames = [r for r in srv.seen if r["method"] == "ping"]
+        assert len(frames) == 1 + int(FLAGS.rpc_retry_max)
+        assert all(r.get("token") is None for r in frames)
     finally:
         srv.close()
+
+
+def test_raft_msg_is_fire_and_forget():
+    """raft messages never resend at the transport: raft retransmits by
+    protocol, and duplicated stale acks churn the leader's nextIndex."""
+    srv = OneShotDropServer()
+    try:
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=5.0)
+        with pytest.raises(OSError):
+            c.call("raft_msg", region_id=1, msg=b"x")
+        assert len([r for r in srv.seen if r["method"] == "raft_msg"]) == 1
+    finally:
+        srv.close()
+
+
+def test_dedupe_executes_exactly_once():
+    """The exactly-once contract end to end: a real RpcServer with a
+    non-idempotent counting handler; resends of one token execute once."""
+    srv = RpcServer("127.0.0.1", 0)
+    hits = []
+    srv.register("bump", lambda: hits.append(1) or len(hits))
+    srv.start()
+    try:
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=5.0)
+        token = "tok.exactly.once"
+        req = {"method": "bump", "args": {}, "token": token}
+        first = c._call_retrying("bump", req)
+        again = c._call_retrying("bump", dict(req))   # same token, resend
+        assert first["ok"] and again["ok"]
+        assert first["result"] == again["result"] == 1
+        assert hits == [1]
+        assert metrics.rpc_dedup_hits.value >= 1
+    finally:
+        srv.stop()
+
+
+def test_deadline_budget_raises_typed_timeout():
+    """A hung handler exhausts the per-call budget: the typed RpcTimeout
+    (an RpcError subclass) raises and metrics.rpc_timeouts counts it."""
+    srv = RpcServer("127.0.0.1", 0)
+    srv.register("hang", lambda: time.sleep(5.0))
+    srv.start()
+    try:
+        before = metrics.rpc_timeouts.value
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=0.3)
+        with pytest.raises(RpcTimeout):
+            c.call("hang")
+        assert issubclass(RpcTimeout, RpcError)
+        assert metrics.rpc_timeouts.value > before
+    finally:
+        srv.stop()
+
+
+def test_deadline_budget_propagates_to_handler():
+    """The deadline_ms header reaches the serving daemon: a handler
+    observing handler_deadline_s() sees (at most) the client's budget."""
+    from baikaldb_tpu.utils.net import handler_deadline_s
+
+    seen = []
+    srv = RpcServer("127.0.0.1", 0)
+    srv.register("peek", lambda: seen.append(handler_deadline_s()) or "ok")
+    srv.start()
+    try:
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=2.0)
+        assert c.call("peek") == "ok"
+        assert len(seen) == 1 and seen[0] is not None
+        assert 0.0 < seen[0] <= 2.0
+    finally:
+        srv.stop()
+
+
+def test_malformed_frame_counted_not_fatal():
+    """Garbage bytes on the wire: the server counts the bad frame
+    (swallowed.rpc.bad_frame), drops that connection, and keeps serving."""
+    srv = RpcServer("127.0.0.1", 0)
+    srv.register("ping", lambda: "pong")
+    srv.start()
+    try:
+        before = metrics.REGISTRY.counter("swallowed.rpc.bad_frame").value
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=2.0)
+        # valid length prefix, invalid JSON body
+        s.sendall(b"\x07\x00\x00\x00garbage")
+        s.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if metrics.REGISTRY.counter(
+                    "swallowed.rpc.bad_frame").value > before:
+                break
+            time.sleep(0.02)
+        assert metrics.REGISTRY.counter(
+            "swallowed.rpc.bad_frame").value > before
+        # the server survived: a normal call on a fresh connection works
+        c = RpcClient(f"127.0.0.1:{srv.port}", timeout=2.0)
+        assert c.call("ping") == "pong"
+    finally:
+        srv.stop()
 
 
 def test_normal_call_still_works():
@@ -112,6 +228,7 @@ def test_normal_call_still_works():
         c = RpcClient(f"127.0.0.1:{srv.port}", timeout=2.0)
         assert c.call("ping") == "pong"
         assert c.call("split_region_key", region_id=1) == "pong"
-        assert srv.seen == ["ping", "split_region_key"]
+        assert [r["method"] for r in srv.seen] == ["ping",
+                                                   "split_region_key"]
     finally:
         srv.close()
