@@ -106,6 +106,11 @@ CATALOG: dict[str, str] = {
                           "(drop: skip the CDC append, data still lands)",
     "coldfs.put": "cold-tier segment write (drop: the bytes never land)",
     "coldfs.get": "cold-tier segment read (drop: FileNotFoundError)",
+    "dispatch.combine": "batched dispatcher combiner tick (delay: stall "
+                        "the tick; drop/return: abandon it — every member "
+                        "falls back to its own inline execution, exactly-"
+                        "once preserved; panic: same fallback — the "
+                        "frontend combiner has no daemon to crash)",
 }
 
 _SPEC_RE = re.compile(

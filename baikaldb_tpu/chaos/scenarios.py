@@ -275,10 +275,133 @@ def rpc_chaos(seed: int = 3, writes: int = 16, delay_ms: float = 10.0,
             "max_ms": round(max(lat_ms), 3) if lat_ms else 0.0}
 
 
+def dispatch_overload(seed: int = 4, clients: int = 12, queries: int = 8,
+                      writes: int | None = None, delay_ms: float = 8.0,
+                      delay_pct: int = 60, queue_max: int = 4) -> dict:
+    """Overload the cross-query batched dispatcher (exec/dispatch.py) while
+    the combiner is stalled by a seeded ``dispatch.combine`` delay: many
+    client threads hammer one statement group through the qos gate with the
+    per-group queue bound cranked down.
+
+    Outcome contract (thread timing owns the interleaving, so this is the
+    rpc_chaos-style contract, not bit-identical replay): every query either
+    returns ITS OWN correct row exactly once or raises a typed
+    ``RejectedError`` (qos bucket / ``DispatchOverload`` queue bound) —
+    never a wrong row, never a hang, never an untyped failure; the observed
+    queue depth stays within the configured bound; combiner stalls degrade
+    to inline fallback, not loss."""
+    import threading
+
+    from ..exec.session import Database, Session
+    from ..utils import metrics
+    from ..utils.flags import FLAGS, set_flag
+    from ..utils.qos import QosManager, RejectedError
+
+    if writes is not None:              # chaos_run --writes compatibility
+        queries = max(1, int(writes) // clients)
+    prev_seed = int(FLAGS.chaos_seed)
+    prev_on = bool(FLAGS.batch_dispatch)
+    prev_qmax = int(FLAGS.batch_dispatch_queue_max)
+    prev_tick = float(FLAGS.batch_dispatch_tick_ms)
+    set_flag("chaos_seed", int(seed))
+    set_flag("batch_dispatch", True)    # the combiner IS the scenario
+    set_flag("batch_dispatch_queue_max", int(queue_max))
+    set_flag("batch_dispatch_tick_ms", 2.0)
+    t0 = metrics.failpoint_trips.value
+    f0 = metrics.dispatch_fallbacks.value
+    g0 = metrics.batched_groups.value
+    db = Database()
+    boot = Session(db)
+    boot.execute("CREATE TABLE dq (id BIGINT, v BIGINT)")
+    boot.execute("INSERT INTO dq VALUES " + ", ".join(
+        f"({i}, {i * 3})" for i in range(clients * queries)))
+    boot.query("SELECT v FROM dq WHERE id = 0")        # settle the plan
+    # generous user/sign rates, tight per-table bucket: the overload sheds
+    # AT the hot table, which is the dimension this scenario drives
+    db.qos = QosManager(table_rate=30.0, table_burst=float(
+        clients * queries // 2))
+    ok: list[tuple[int, int]] = []
+    rejected: list[str] = []
+    problems: list[str] = []
+    mu = threading.Lock()
+    depth_seen = [0]
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            depth_seen[0] = max(depth_seen[0], db.dispatcher.queue_depth())
+            time.sleep(0.0005)
+
+    def worker(tid: int):
+        s = Session(db)
+        for q in range(queries):
+            i = tid * queries + q
+            try:
+                r = s.query(f"SELECT v FROM dq WHERE id = {i}")
+            except RejectedError as e:
+                with mu:
+                    rejected.append(type(e).__name__)
+                continue
+            except Exception as e:      # noqa: BLE001 — the report IS the point
+                with mu:
+                    problems.append(
+                        f"untyped failure for id {i}: "
+                        f"{type(e).__name__}: {e}")
+                continue
+            if r != [{"v": i * 3}]:
+                with mu:
+                    problems.append(f"wrong result for id {i}: {r!r}")
+            else:
+                with mu:
+                    ok.append((tid, i))
+    try:
+        failpoint.set_failpoint("dispatch.combine",
+                                f"{delay_pct}%delay({delay_ms})")
+        smp = threading.Thread(target=sampler, daemon=True)
+        smp.start()
+        ts = [threading.Thread(target=worker, args=(t,))
+              for t in range(clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        stop.set()
+        smp.join(timeout=1)
+    finally:
+        failpoint.clear("dispatch.combine")
+        set_flag("chaos_seed", prev_seed)
+        set_flag("batch_dispatch", prev_on)
+        set_flag("batch_dispatch_queue_max", prev_qmax)
+        set_flag("batch_dispatch_tick_ms", prev_tick)
+    total = clients * queries
+    if len(ok) + len(rejected) != total:
+        problems.append(f"accounting hole: {len(ok)} ok + {len(rejected)} "
+                        f"rejected != {total} issued")
+    if metrics.batched_groups.value == g0:
+        problems.append("combiner never engaged — the scenario exercised "
+                        "nothing")
+    if depth_seen[0] > queue_max:
+        problems.append(f"queue depth {depth_seen[0]} exceeded the "
+                        f"{queue_max} bound")
+    return {"clients": clients, "queries": total,
+            "succeeded": len(ok), "rejected": len(rejected),
+            "faults": metrics.failpoint_trips.value - t0,
+            "fault_schedule": [],     # thread timing owns hit order; the
+            #                           per-hit trigger schedule is still a
+            #                           pure fn of (seed, hit index)
+            "combiner_fallbacks": metrics.dispatch_fallbacks.value - f0,
+            "batched_groups": metrics.batched_groups.value - g0,
+            "max_queue_depth": depth_seen[0],
+            "state_digest": _digest(
+                {"rows": [[i, i * 3] for i in range(total)]}),
+            "problems": problems}
+
+
 SCENARIOS = {
     "kill_leader": kill_leader,
     "partition": partition,
     "rpc_chaos": rpc_chaos,
+    "dispatch_overload": dispatch_overload,
 }
 
 

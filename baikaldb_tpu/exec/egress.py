@@ -419,6 +419,81 @@ def extract(stmt: SelectStmt, session):
     return inner, spec
 
 
+# -- batched-dispatch scatter-back (exec/dispatch.py) ----------------------
+#
+# The per-query egress densify (Session._egress_compact: cumsum +
+# searchsorted + gather, a chain of eager device ops) is the single largest
+# per-query host cost on the point-read path.  The batched dispatcher
+# amortizes it across the whole group by doing the SAME compact per lane
+# INSIDE the one jitted batched executable (gather_live, traced once per
+# shape) and shipping every lane's dense rows in ONE fused device->host
+# transfer; rebuild_clients then slices per-client host batches out of it
+# with plain numpy.  A per-client eager compact here would hand the whole
+# win straight back.
+
+def gather_live(batch, cap: int):
+    """Traced per-lane compact: the first ``cap`` live rows of ``batch`` in
+    row order, exactly the rows ``Session._egress_compact`` would surface.
+    Returns ``(datas, valids, n)`` — per-column gathered data/validity plus
+    the lane's true live count (a lane with ``n > cap`` overflowed the
+    static scatter budget; the dispatcher re-runs it inline)."""
+    import jax.numpy as jnp
+
+    capacity = len(batch)
+    k = min(max(1, int(cap)), capacity)
+    if capacity == 0:
+        idx = jnp.zeros((0,), jnp.int32)
+        n = jnp.int32(0)
+    elif batch.sel is None or batch.live_prefix:
+        # all-live (or live-prefix promise): the leading rows ARE the rows
+        idx = jnp.arange(k)
+        n = batch.live_count()
+    else:
+        cs = jnp.cumsum(batch.sel.astype(jnp.int32))
+        n = cs[-1]
+        idx = jnp.clip(
+            jnp.searchsorted(cs, jnp.arange(1, k + 1, dtype=jnp.int32)),
+            0, capacity - 1)
+    datas = tuple(jnp.take(c.data, idx, axis=0, mode="clip")
+                  for c in batch.columns)
+    valids = tuple(None if c.validity is None
+                   else jnp.take(c.validity, idx, mode="clip")
+                   for c in batch.columns)
+    return datas, valids, jnp.asarray(n, jnp.int32)
+
+
+def column_meta(batch) -> tuple:
+    """Static column metadata captured at trace time (names + per-column
+    ltype/dictionary), enough for rebuild_clients to reconstitute host
+    batches from the transferred leaves."""
+    return (batch.names,
+            tuple((c.ltype, c.dictionary) for c in batch.columns))
+
+
+def rebuild_clients(meta, hdatas, hvalids, ns, n_clients: int) -> list:
+    """Host side of the scatter: per-client ColumnBatches over numpy views
+    of the one fused transfer.  Bit-identical to serial execution — Arrow
+    conversion slices the same first ``n`` gathered rows either way.
+    Returns None for lanes whose live count overflowed the scatter budget
+    (``ns[i] > cap``); the dispatcher re-runs those inline."""
+    import numpy as np
+
+    from ..column.batch import Column, ColumnBatch
+
+    names, colmeta = meta
+    cap = int(hdatas[0].shape[1]) if hdatas else 0
+    outs = []
+    for i in range(n_clients):
+        n = int(ns[i])
+        if n > cap:
+            outs.append(None)
+            continue
+        cols = [Column(hd[i], None if hv is None else hv[i], lt, d)
+                for hd, hv, (lt, d) in zip(hdatas, hvalids, colmeta)]
+        outs.append(ColumnBatch(names, cols, np.arange(cap) < n, n))
+    return outs
+
+
 def finish(spec: EgressSpec, inner_result):
     """Evaluate the skeletons over the inner result and produce the final
     (names, row tuples)."""

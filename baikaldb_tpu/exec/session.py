@@ -59,6 +59,7 @@ define("param_queries", True,
        "auto-parameterize WHERE literals (plan/paramize.py): one plan-cache "
        "entry and one compiled executable serve every literal variant of a "
        "query shape; 0 restores SQL-text-keyed caching with baked literals")
+from .dispatch import BatchDispatcher
 from .executor import compile_plan
 
 # join overflow retry budget lives in FLAGS.join_retry_max: retries settle
@@ -316,6 +317,9 @@ class Database:
         self.query_log = deque(maxlen=1000)
         from ..storage.binlog import Binlog
         self.qos = None          # optional utils.qos.QosManager
+        # cross-query batched dispatch (exec/dispatch.py): engine-wide so
+        # concurrent SESSIONS coalesce onto one device batch per tick
+        self.dispatcher = BatchDispatcher()
         self.privileges = PrivilegeManager()
         from ..meta.ddl import DdlManager
         self.ddl = DdlManager(self)   # online-DDL work queue + worker
@@ -777,7 +781,9 @@ class Session:
             # transactions; batches are charged per statement
             billable = sum(1 for s in stmts if not isinstance(s, TxnStmt))
             if billable:
-                self.db.qos.admit(sql, cost=float(billable))
+                self.db.qos.admit(sql, cost=float(billable),
+                                  user=self.user,
+                                  tables=self._qos_tables(stmts))
         if len(stmts) == 1 and isinstance(stmts[0], SelectStmt):
             self._access_check(stmts[0])
             stmt, env = self._resolve_session_exprs(stmts[0])
@@ -794,6 +800,20 @@ class Session:
 
     def query(self, sql: str) -> list[dict]:
         return self.execute(sql).to_pylist()
+
+    def _qos_tables(self, stmts) -> tuple:
+        """Base tables a statement batch touches directly (FROM/joins/DML
+        target) — the per-table admission dimension.  Deliberately shallow:
+        qos gating is a rate limiter, not an access-control wall, so
+        subquery tables may ride free."""
+        out: list[str] = []
+        for s in stmts:
+            for t in [getattr(s, "table", None)] + \
+                    [j.table for j in getattr(s, "joins", ()) or ()]:
+                if t is not None and getattr(t, "subquery", None) is None \
+                        and getattr(t, "name", None):
+                    out.append(f"{t.database or self.current_db}.{t.name}")
+        return tuple(dict.fromkeys(out))
 
     def _sysvar(self, name: str):
         """@@name lookup: session SETs override server defaults; live flags
@@ -3517,7 +3537,7 @@ class Session:
             if norm is not None else None
         try:
             with trace.span("exec.batches"):
-                batches, shape_key, _full = self._collect_batches(plan)
+                batches, shape_key, full_scan = self._collect_batches(plan)
         finally:
             self._param_subst = None
         entry["versions"] = {tk: v for tk, v, _ in shape_key}
@@ -3526,7 +3546,8 @@ class Session:
             with trace.span("plan.bind"):
                 batches[PARAMS_KEY] = paramize.bind(norm.slots, batches)
         t0 = time.perf_counter()
-        result = self._run_plan(entry, batches, shape_key)
+        result = self._maybe_batched_run(entry, batches, shape_key, norm,
+                                         lookup_key, full_scan)
         with trace.span("egress.arrow"):
             table = result.to_arrow()
         dur_ms = (time.perf_counter() - t0) * 1e3
@@ -3653,6 +3674,15 @@ class Session:
         trace.event("guards", mode=gs["mode"],
                     transfer_trips=gs["transfer_trips"],
                     lock_trips=gs["lock_trips"])
+        # cross-query batched dispatch: whether this statement's shape is
+        # served by the combiner under concurrency, plus engine-wide tick
+        # telemetry (EXPLAIN ANALYZE itself always runs inline)
+        from . import dispatch as _dispatch
+        occ = metrics.group_occupancy.stats()
+        trace.event("dispatch", enabled=_dispatch.enabled(),
+                    groups_total=metrics.batched_groups.value,
+                    avg_occupancy=occ["avg_ms"],
+                    queue_p50_ms=metrics.queue_wait_ms.stats()["p50_ms"])
 
     @staticmethod
     def _render_analyze(spans: list[dict]) -> list[str]:
@@ -3691,6 +3721,12 @@ class Session:
             lines.append(f"-- guards: mode={a['mode']} "
                          f"transfer_trips={a['transfer_trips']} "
                          f"lock_trips={a['lock_trips']}")
+        for s in find("dispatch"):
+            a = s["attrs"]
+            lines.append(f"-- dispatch: enabled={int(a['enabled'])} "
+                         f"groups_total={a['groups_total']} "
+                         f"avg_occupancy={a['avg_occupancy']} "
+                         f"queue_p50_ms={a['queue_p50_ms']}")
         lines.append(f"-- trace: spans={len(spans)} "
                      "(SHOW PROFILE shows the same span records)")
         return lines
@@ -4108,6 +4144,43 @@ class Session:
                 "duration_ms": pa.array([r[7] for r in rows], pa.float64()),
                 "attrs": [r[8] for r in rows],
             }) if rows else _empty_info("trace_spans")
+        if name == "dispatcher":
+            # live state of the cross-query batched dispatcher: queue
+            # depth + in-flight, tick latency, the exact group-occupancy
+            # histogram, and per-bucket qos token levels
+            rows = []
+            dp = getattr(self.db, "dispatcher", None)
+            if dp is not None:
+                snap = dp.snapshot()
+                rows += [("queue", "depth", float(snap["queue_depth"]), ""),
+                         ("queue", "live_groups",
+                          float(snap["live_groups"]), ""),
+                         ("queue", "inflight", float(snap["inflight"]), ""),
+                         ("executables", "cached",
+                          float(snap["compiled"]), "")]
+                for size in sorted(snap["occupancy"]):
+                    rows.append(("occupancy", str(size),
+                                 float(snap["occupancy"][size]),
+                                 "groups combined at this size"))
+            tick = metrics.dispatch_tick_ms.stats()
+            wait = metrics.queue_wait_ms.stats()
+            rows += [("tick", k, float(tick[k]), "") for k in
+                     ("count", "avg_ms", "p50_ms", "p99_ms", "max_ms")]
+            rows += [("queue_wait", k, float(wait[k]), "") for k in
+                     ("count", "avg_ms", "p50_ms", "p99_ms")]
+            for c in ("batched_groups", "dispatch_inline",
+                      "dispatch_fallbacks", "qos_rejections"):
+                rows.append(("counter", c,
+                             float(metrics.REGISTRY.counter(c).value), ""))
+            if self.db.qos is not None:
+                for kind, key, tokens, detail in self.db.qos.state():
+                    rows.append((kind, key, float(tokens), detail))
+            return pa.table({
+                "kind": [r[0] for r in rows],
+                "name": [r[1] for r in rows],
+                "value": pa.array([r[2] for r in rows], pa.float64()),
+                "detail": [r[3] for r in rows],
+            }) if rows else _empty_info("dispatcher")
         if name == "failpoints":
             from ..chaos import failpoint as _fp
             rows = _fp.describe()
@@ -4148,6 +4221,48 @@ class Session:
                 "error": [w.error for w in ws],
             }) if ws else _empty_info("ddl_work")
         raise PlanError(f"unknown information_schema table {name!r}")
+
+    def _maybe_batched_run(self, entry: dict, batches: dict, shape_key,
+                           norm, lookup_key, full_scan) -> ColumnBatch:
+        """Route through the cross-query batched dispatcher when this query
+        is groupable; otherwise (and for every bypass/fallback) run the
+        session's own inline ``_run_plan``."""
+        from . import dispatch
+
+        def inline():
+            return self._run_plan(entry, batches, shape_key)
+
+        if norm is None or self.mesh is not None \
+                or self._sql_txn is not None or not dispatch.enabled():
+            return inline()
+        # groupability: every scan input must be the table's full device
+        # image at a real version — index/ANN-gathered batches are
+        # literal-dependent (two members' same-shaped inputs would hold
+        # DIFFERENT rows), information_schema (version -1) renders fresh
+        # per call, and host presort permutations are per-plan-object state
+        for tk, v, _cap in shape_key:
+            if v < 0 or tk not in full_scan:
+                return inline()
+        if any(k.startswith("__presort__") for k in batches):
+            return inline()
+        # members coalesce on (statement structure + pinned values, scan
+        # shapes at exact versions, plan signature): they differ only in
+        # their bound param feeds.  The compile key drops versions so DML
+        # inside one capacity bucket reuses the batched executable, but
+        # keeps the plan signature — a stats-driven replan must compile
+        # its own batched variant, never execute a structurally different
+        # stored plan.
+        group_key = (lookup_key, shape_key, entry["plan_sig"])
+        ck_base = (lookup_key, entry["plan_sig"],
+                   tuple((tk, cap) for tk, _v, cap in shape_key),
+                   int(FLAGS.radix_join_buckets),
+                   int(FLAGS.radix_join_min_build))
+        try:
+            return self.db.dispatcher.run(inline, group_key, ck_base,
+                                          entry, batches)
+        except dispatch.CombineFallback:    # belt: never escapes normally
+            metrics.dispatch_fallbacks.add(1)
+            return inline()
 
     def _run_plan(self, entry: dict, batches: dict, shape_key) -> ColumnBatch:
         plan = entry["plan"]

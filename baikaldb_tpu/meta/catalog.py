@@ -239,6 +239,13 @@ class Catalog:
                               Field("hits", LType.INT64),
                               Field("trips", LType.INT64),
                               Field("site", LType.STRING))),
+        # cross-query batched dispatch (exec/dispatch.py): live queue depth,
+        # tick latency, the group-occupancy histogram, and per-bucket qos
+        # token state, one (kind, name, value, detail) row each
+        "dispatcher": Schema((Field("kind", LType.STRING),
+                              Field("name", LType.STRING),
+                              Field("value", LType.FLOAT64),
+                              Field("detail", LType.STRING))),
     }
 
     def get_table(self, database: str, name: str) -> TableInfo:

@@ -309,21 +309,27 @@ def stmt_key(s: SelectStmt) -> tuple:
 # binding (per execution)
 
 def bind(slots: list, batches: dict) -> tuple:
-    """Raw literal values -> the typed device params pytree.  strcmp slots
-    search the compared column's dictionary in the CURRENT scan batch, so
-    dictionary rebuilds change two i32 values, never the executable."""
-    import jax.numpy as jnp
+    """Raw literal values -> the typed params pytree.  strcmp slots search
+    the compared column's dictionary in the CURRENT scan batch, so
+    dictionary rebuilds change two i32 values, never the executable.
+
+    The leaves are HOST (numpy) scalars on purpose: jit commits them to the
+    device itself at call time, while an eager ``jnp.asarray`` here would
+    pay one device-dispatch per slot per query — measurably the hot-path
+    bottleneck under concurrent sessions (the batched dispatcher stacks
+    feeds host-side and ships the whole group in one transfer)."""
+    import numpy as np
 
     out = []
     for s in slots:
         kind = s.binder[0]
         if kind == "scalar":
             lt = s.binder[1]
-            out.append(jnp.asarray(s.value, lt.np_dtype))
+            out.append(np.asarray(s.value, lt.np_dtype))
         elif kind == "strnum":
             from ..expr.compile import _mysql_str_to_num
-            out.append(jnp.asarray(_mysql_str_to_num(str(s.value)),
-                                   jnp.float64))
+            out.append(np.asarray(_mysql_str_to_num(str(s.value)),
+                                  np.float64))
         elif kind == "temporal":
             from ..expr.compile import ExprError, parse_temporal
             lt = s.binder[1]
@@ -331,7 +337,7 @@ def bind(slots: list, batches: dict) -> tuple:
                 v = parse_temporal(str(s.value), lt)
             except (ExprError, ValueError) as exc:
                 raise BindError(str(exc)) from exc
-            out.append(jnp.asarray(v, lt.np_dtype))
+            out.append(np.asarray(v, lt.np_dtype))
         elif kind == "strcmp":
             _, table_key, col = s.binder
             b = batches.get(table_key)
@@ -342,8 +348,8 @@ def bind(slots: list, batches: dict) -> tuple:
             if d is None:
                 raise BindError(f"{table_key}.{col} has no dictionary")
             sv = str(s.value)
-            out.append(jnp.asarray([d.lower_bound(sv), d.upper_bound(sv)],
-                                   jnp.int32))
+            out.append(np.asarray([d.lower_bound(sv), d.upper_bound(sv)],
+                                  np.int32))
         else:
             raise BindError(f"unknown binder {s.binder!r}")
     return tuple(out)

@@ -244,6 +244,22 @@ failpoint_trips = Counter("failpoint_trips")
 # leaderless regions served by the most advanced live replica (learner
 # included) instead of failing the read — bounded-degradation valve
 learner_fallback_reads = Counter("learner_fallback_reads")
+# cross-query batched dispatch (exec/dispatch.py): combiner ticks that ran
+# a batched executable, the group sizes they combined (percentiles over the
+# occupancy distribution), per-member queue wait, and wall time of the
+# batched device run itself
+batched_groups = Counter("batched_groups")
+group_occupancy = LatencyRecorder("group_occupancy")
+queue_wait_ms = LatencyRecorder("queue_wait_ms")
+dispatch_tick_ms = LatencyRecorder("dispatch_tick_ms")
+# queries that bypassed the queue (idle group / solo tick) and members that
+# degraded to inline execution after a combiner failure — the fallback
+# valve, should stay ~0 outside chaos runs
+dispatch_inline = Counter("dispatch_inline")
+dispatch_fallbacks = Counter("dispatch_fallbacks")
+# typed admission rejections: qos token buckets (per-sign/user/table) and
+# the dispatcher's bounded per-group queue
+qos_rejections = Counter("qos_rejections")
 
 
 def count_swallowed(site: str) -> None:

@@ -596,6 +596,157 @@ def run_chaos_bench() -> dict:
     }
 
 
+def run_concurrency_bench() -> dict:
+    """Concurrent point-query scaling (the batched-dispatch headline):
+    q/s and p99 vs client count, dispatcher on vs off.
+
+    Every client thread owns a Session on ONE shared Database and replays
+    the same parameterized point-query shape with distinct literals — the
+    workload PR 3 made compile-free and this PR makes dispatch-free: with
+    ``batch_dispatch`` on, concurrent queries hitting the same plan-cache
+    group coalesce into one vmapped device batch per combiner tick, so
+    throughput scales with client count instead of thread count.  Off, each
+    thread pays its own device dispatch + egress + GIL round-trip."""
+    import threading
+
+    import pyarrow as pa
+
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    n_rows = int(os.environ.get("BENCH_CONC_ROWS", 20_000))
+    counts = [int(x) for x in
+              os.environ.get("BENCH_CONC_CLIENTS", "1,8,64,256").split(",")]
+    per = int(os.environ.get("BENCH_CONC_QUERIES", 24))
+    rng = np.random.default_rng(23)
+    base = pa.table({
+        # NOT a primary key: PK point reads are host-tier lookups; this
+        # drives the compiled-plan path every non-key predicate takes
+        "id": np.arange(n_rows, dtype=np.int64),
+        "v": rng.normal(size=n_rows).astype(np.float64),
+    })
+
+    def phase(dispatch_on: bool, n_clients: int):
+        set_flag("batch_dispatch", dispatch_on)
+        db = Database()
+        boot = Session(db)
+        boot.execute("CREATE TABLE cq (id BIGINT, v DOUBLE)")
+        boot.load_arrow("cq", base)
+        boot.query("SELECT v FROM cq WHERE id = 0")
+        sessions = [Session(db) for _ in range(n_clients)]
+        # rebound per round below; the worker closure reads the latest
+        start = threading.Barrier(n_clients)
+        lats: list[list[float]] = [[] for _ in range(n_clients)]
+
+        def worker(tid: int, s: Session, record: bool):
+            start.wait()
+            for q in range(per):
+                i = 2 + ((tid * per + q) * 9173) % (n_rows - 2)
+                q0 = time.perf_counter()
+                s.query(f"SELECT v FROM cq WHERE id = {i}")
+                if record:
+                    lats[tid].append((time.perf_counter() - q0) * 1e3)
+
+        # concurrent warmup: two full untimed rounds — the off path compiles
+        # one executable per session, the on path compiles the dispatcher's
+        # pow2-padded batched executables for the group sizes this client
+        # count actually forms.  Steady state is the metric; first-compile
+        # cost has its own telemetry (metrics.compile_ms)
+        best = None
+        for measured in (False, False, True, True):
+            start = threading.Barrier(n_clients)
+            lats = [[] for _ in range(n_clients)]
+            ts = [threading.Thread(target=worker, args=(i, s, measured))
+                  for i, s in enumerate(sessions)]
+            t0 = time.perf_counter()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            if not measured:
+                continue
+            flat = sorted(x for ls in lats for x in ls)
+
+            def q(p):
+                return round(flat[min(len(flat) - 1,
+                                      int(p * (len(flat) - 1) + 0.5))], 3)
+            r = {"qps": round(n_clients * per / dt, 1),
+                 "p50_ms": q(0.50), "p99_ms": q(0.99)}
+            if best is None or r["qps"] > best["qps"]:
+                best = r            # best-of-2: a stray GC/compile round
+                #                     must not stand in for steady state
+        return best
+
+    prev = bool(FLAGS.batch_dispatch)
+    curve: dict[str, dict] = {}
+    try:
+        for n in counts:
+            off = phase(False, n)
+            on = phase(True, n)
+            curve[str(n)] = {
+                "clients": n,
+                "qps_on": on["qps"], "qps_off": off["qps"],
+                "speedup": round(on["qps"] / max(off["qps"], 1e-9), 3),
+                "p50_ms_on": on["p50_ms"], "p50_ms_off": off["p50_ms"],
+                "p99_ms_on": on["p99_ms"], "p99_ms_off": off["p99_ms"],
+            }
+    finally:
+        set_flag("batch_dispatch", prev)
+    head = curve.get("64") or curve[str(counts[-1])]
+    solo = curve.get("1")
+    platform = None
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:                                   # noqa: BLE001
+        pass
+    return {
+        "metric": f"concurrent point-query q/s at {head['clients']} clients"
+                  f", dispatcher on vs off ({n_rows / 1e3:.0f}k rows, "
+                  f"{platform})",
+        "value": head["qps_on"],
+        "unit": "queries/sec",
+        "vs_baseline": head["speedup"],
+        "platform": platform,
+        "rows": n_rows,
+        "queries_per_client": per,
+        "curve": curve,
+        # acceptance guard: the inline bypass must keep the idle-server
+        # single-client p50 within noise of the dispatcher-off path
+        "single_client_p50_regression_pct": None if solo is None else round(
+            (solo["p50_ms_on"] / max(solo["p50_ms_off"], 1e-9) - 1.0) * 100,
+            2),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
+def _emit_concurrency_line(skip_reason: str | None = None):
+    """Sixth JSON line: the concurrent-clients scaling curve (cross-query
+    batched dispatch).  Same robustness contract: always prints a line,
+    never raises."""
+    if os.environ.get("BENCH_SKIP_CONC") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "concurrent point-query q/s, dispatcher on vs off "
+                      "(skipped)",
+            "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+            "platform": "none", "error": skip_reason}))
+        return
+    try:
+        result = run_concurrency_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "concurrent point-query q/s, dispatcher on vs "
+                            "off (failed)",
+                  "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+                  "platform": "none",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_chaos_line(skip_reason: str | None = None):
     """Fifth JSON line: chaos-machinery overhead guard + seeded latency
     injection.  Same robustness contract: always prints a line, never
@@ -712,6 +863,8 @@ def main():
                                  "tracing phase skipped")
                 _emit_chaos_line(skip_reason="accelerator probe failed; "
                                  "chaos phase skipped")
+                _emit_concurrency_line(skip_reason="accelerator probe "
+                                       "failed; concurrency phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -749,12 +902,14 @@ def main():
             _emit_point_line()
             _emit_trace_line()
             _emit_chaos_line()
+            _emit_concurrency_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
     _emit_point_line()
     _emit_trace_line()
     _emit_chaos_line()
+    _emit_concurrency_line()
     return 0
 
 

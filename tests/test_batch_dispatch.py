@@ -1,0 +1,342 @@
+"""Cross-query batched dispatch (exec/dispatch.py): scatter-back
+correctness vs serial execution, group-size padding, pinned zero-retrace
+steady state, the bounded-queue + qos admission story, the
+``dispatch.combine`` failpoint, and the information_schema.dispatcher view.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from baikaldb_tpu.exec.dispatch import DispatchOverload
+from baikaldb_tpu.exec.session import Database, Session
+from baikaldb_tpu.utils import metrics
+from baikaldb_tpu.utils.flags import FLAGS, set_flag
+from baikaldb_tpu.utils.qos import QosManager, RejectedError
+
+
+@pytest.fixture
+def ticked():
+    """A wide combiner tick so a barrier of threads reliably lands in ONE
+    group (the first arrival runs inline; the rest coalesce)."""
+    prev = float(FLAGS.batch_dispatch_tick_ms)
+    prev_on = bool(FLAGS.batch_dispatch)
+    set_flag("batch_dispatch_tick_ms", 40.0)
+    set_flag("batch_dispatch", True)
+    yield
+    set_flag("batch_dispatch_tick_ms", prev)
+    set_flag("batch_dispatch", prev_on)
+
+
+def _mkdb():
+    db = Database()
+    s = Session(db)
+    s.execute("CREATE TABLE bd (id BIGINT, v DOUBLE, name VARCHAR(16), "
+              "maybe BIGINT)")
+    rows = []
+    for i in range(500):
+        rows.append(f"({i}, {i * 0.25}, 'n{i % 7}', "
+                    f"{'NULL' if i % 3 == 0 else i * 11})")
+    s.execute("INSERT INTO bd VALUES " + ", ".join(rows))
+    return db, s
+
+
+def _concurrent(db, sqls: list[str], threads: int, sessions=None):
+    """Run ``sqls`` spread over ``threads`` sessions behind one barrier;
+    returns {sql: Result.arrow} and re-raises the first worker error.
+    Pass ``sessions`` to reuse connections across calls (a fresh Session's
+    first inline query compiles its own per-session executable)."""
+    out: dict = {}
+    errs: list = []
+    start = threading.Barrier(threads)
+    chunks = [sqls[t::threads] for t in range(threads)]
+    if sessions is None:
+        sessions = [Session(db) for _ in range(threads)]
+
+    def worker(s, chunk):
+        start.wait()
+        for sql in chunk:
+            try:
+                out[sql] = s.execute(sql).arrow
+            except Exception as e:      # noqa: BLE001 — surfaced below
+                errs.append((sql, e))
+
+    ts = [threading.Thread(target=worker, args=(sessions[i], c))
+          for i, c in enumerate(chunks)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    if errs:
+        raise errs[0][1]
+    return out
+
+
+def test_scatter_back_bit_identical_to_serial(ticked):
+    """INT / FLOAT(strnum) / STRING / NULL-bearing outputs: concurrent
+    grouped execution returns byte-equal Arrow tables to serial runs."""
+    db, boot = _mkdb()
+    sqls = []
+    for i in range(24):
+        sqls.append(f"SELECT id, v, name, maybe FROM bd WHERE id = {i * 7}")
+        sqls.append(f"SELECT id, maybe FROM bd WHERE v = '{i * 0.25}'")
+        sqls.append(
+            f"SELECT id, name FROM bd WHERE name = 'n{i % 7}' AND id < 40")
+    serial = {sql: boot.execute(sql).arrow for sql in sqls}
+    g0 = metrics.batched_groups.value
+    got = _concurrent(db, sqls, threads=8)
+    assert metrics.batched_groups.value > g0, "nothing actually batched"
+    for sql in sqls:
+        assert got[sql].equals(serial[sql]), sql
+
+
+def test_mixed_capacity_buckets_group_separately(ticked):
+    """Two tables in different capacity buckets run concurrently: separate
+    groups, correct results for both."""
+    db = Database()
+    s = Session(db)
+    s.execute("CREATE TABLE small (id BIGINT, v BIGINT)")
+    s.execute("CREATE TABLE big (id BIGINT, v BIGINT)")
+    s.execute("INSERT INTO small VALUES " + ", ".join(
+        f"({i}, {i + 100})" for i in range(50)))
+    s.execute("INSERT INTO big VALUES " + ", ".join(
+        f"({i}, {i + 900})" for i in range(3000)))
+    sqls = [f"SELECT v FROM small WHERE id = {i}" for i in range(20)] + \
+           [f"SELECT v FROM big WHERE id = {i * 17}" for i in range(20)]
+    serial = {sql: s.execute(sql).arrow for sql in sqls}
+    got = _concurrent(db, sqls, threads=10)
+    for sql in sqls:
+        assert got[sql].equals(serial[sql]), sql
+
+
+def test_padding_edges_and_zero_retrace_steady_state(ticked):
+    """Group sizes across pow2 padding edges (2/3/4/5/8 members) reuse the
+    padded batched executables: after one warm pass per pad, further passes
+    at ANY of those sizes retrace zero times."""
+    db, boot = _mkdb()
+    pool = [Session(db) for _ in range(9)]
+
+    def ground(n_threads, salt):
+        sqls = [f"SELECT v FROM bd WHERE id = {salt + i}"
+                for i in range(n_threads)]
+        serial = {sql: boot.execute(sql).arrow for sql in sqls}
+        got = _concurrent(db, sqls, threads=n_threads,
+                          sessions=pool[:n_threads])
+        for sql in sqls:
+            assert got[sql].equals(serial[sql]), sql
+
+    # warm: the serial baselines compile the per-session path, then one
+    # concurrent pass per padded group size (pads 2, 4, 8)
+    for n, salt in ((3, 0), (5, 40), (9, 80), (4, 120), (6, 160)):
+        ground(n, salt)
+    r0 = metrics.xla_retraces.value
+    for n, salt in ((3, 200), (5, 240), (9, 280), (4, 320), (6, 360)):
+        ground(n, salt)
+    assert metrics.xla_retraces.value == r0, \
+        "steady-state grouped execution must not retrace"
+
+
+def test_single_query_bypasses_queue(ticked):
+    """An idle group runs inline: no group forms, no occupancy recorded."""
+    db, s = _mkdb()
+    g0 = metrics.batched_groups.value
+    i0 = metrics.dispatch_inline.value
+    for i in range(5):
+        s.query(f"SELECT v FROM bd WHERE id = {i}")
+    assert metrics.batched_groups.value == g0
+    assert metrics.dispatch_inline.value >= i0 + 5
+    assert db.dispatcher.queue_depth() == 0
+
+
+def test_dispatcher_off_restores_inline(ticked):
+    set_flag("batch_dispatch", False)
+    db, boot = _mkdb()
+    sqls = [f"SELECT v FROM bd WHERE id = {i}" for i in range(16)]
+    serial = {sql: boot.execute(sql).arrow for sql in sqls}
+    g0 = metrics.batched_groups.value
+    got = _concurrent(db, sqls, threads=8)
+    assert metrics.batched_groups.value == g0
+    for sql in sqls:
+        assert got[sql].equals(serial[sql])
+
+
+def test_combine_failpoints_fall_back_exactly_once(ticked):
+    """delay stalls the tick (results still exactly-once), drop and panic
+    abandon it (every member re-runs inline, results still exactly-once)."""
+    from baikaldb_tpu.chaos import failpoint
+
+    db, boot = _mkdb()
+    sqls = [f"SELECT v, maybe FROM bd WHERE id = {i}" for i in range(24)]
+    serial = {sql: boot.execute(sql).arrow for sql in sqls}
+    for spec, expect_fallback in (("delay(5)", False), ("drop", True),
+                                  ("panic", True)):
+        f0 = metrics.dispatch_fallbacks.value
+        try:
+            failpoint.set_failpoint("dispatch.combine", spec)
+            got = _concurrent(db, sqls, threads=8)
+        finally:
+            failpoint.clear("dispatch.combine")
+        for sql in sqls:
+            assert got[sql].equals(serial[sql]), (spec, sql)
+        if expect_fallback:
+            assert metrics.dispatch_fallbacks.value > f0, spec
+
+
+def test_queue_bound_rejects_typed(ticked):
+    """A full per-group queue rejects with DispatchOverload (a typed
+    RejectedError) while the combiner is stalled — bounded queueing, not
+    collapse."""
+    from baikaldb_tpu.chaos import failpoint
+
+    db, boot = _mkdb()
+    boot.query("SELECT v FROM bd WHERE id = 0")
+    prev = int(FLAGS.batch_dispatch_queue_max)
+    set_flag("batch_dispatch_queue_max", 1)
+    rejected, fine = [], []
+    start = threading.Barrier(10)
+
+    def worker(tid):
+        s = Session(db)
+        start.wait()
+        try:
+            s.query(f"SELECT v FROM bd WHERE id = {tid}")
+            fine.append(tid)
+        except DispatchOverload as e:
+            assert isinstance(e, RejectedError)
+            rejected.append(tid)
+
+    try:
+        failpoint.set_failpoint("dispatch.combine", "delay(60)")
+        ts = [threading.Thread(target=worker, args=(t,)) for t in range(10)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        failpoint.clear("dispatch.combine")
+        set_flag("batch_dispatch_queue_max", prev)
+    assert rejected, "queue bound never tripped"
+    assert fine, "every query rejected — bound too tight to mean queueing"
+    assert db.dispatcher.queue_depth() == 0
+
+
+def test_chaos_scenario_dispatch_overload():
+    from baikaldb_tpu.chaos.scenarios import run_scenario
+
+    out = run_scenario("dispatch_overload", seed=3, clients=8, queries=6)
+    assert out["ok"], out
+    assert out["succeeded"] + out["rejected"] == out["queries"]
+    assert out["max_queue_depth"] <= 4
+    # same seed, same expected-state digest (outcome contract)
+    again = run_scenario("dispatch_overload", seed=3, clients=8, queries=6)
+    assert again["state_digest"] == out["state_digest"]
+
+
+def test_qos_user_and_table_buckets():
+    class FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = FakeClock()
+    q = QosManager(sign_rate=1000, sign_burst=1000, global_rate=1000,
+                   global_burst=1000, user_rate=1, user_burst=2,
+                   table_rate=1, table_burst=2, clock=clock)
+    q.admit("SELECT 1 FROM a", user="alice", tables=("d.a",))
+    q.admit("SELECT 2 FROM b", user="alice", tables=("d.b",))
+    with pytest.raises(RejectedError, match="per-user"):
+        q.admit("SELECT 3 FROM c", user="alice", tables=("d.c",))
+    # bob is his own bucket, but table d.a is now empty too
+    q.admit("SELECT 4 FROM d", user="bob", tables=("d.d",))
+    q.admit("SELECT 5 FROM a", user="bob", tables=("d.a",))
+    with pytest.raises(RejectedError, match="per-table"):
+        q.admit("SELECT 6 FROM a", user="carol", tables=("d.a",))
+    kinds = {r[0] for r in q.state()}
+    assert {"qos_global", "qos_sign", "qos_user", "qos_table"} <= kinds
+    rej0 = q.rejected
+    clock.t += 5.0
+    q.admit("SELECT 7 FROM a", user="alice", tables=("d.a",))
+    assert q.rejected == rej0
+
+
+def test_information_schema_dispatcher(ticked):
+    db, boot = _mkdb()
+    db.qos = QosManager()
+    db.qos.admit("SELECT 1", user="root", tables=("default.bd",))
+    sqls = [f"SELECT v FROM bd WHERE id = {i}" for i in range(12)]
+    _concurrent(db, sqls, threads=6)
+    rows = boot.query("SELECT kind, name, value FROM "
+                      "information_schema.dispatcher")
+    kinds = {r["kind"] for r in rows}
+    assert {"queue", "tick", "queue_wait", "occupancy", "counter",
+            "executables"} <= kinds
+    assert {"qos_global", "qos_user", "qos_table"} <= kinds
+    by = {(r["kind"], r["name"]): r["value"] for r in rows}
+    assert by[("queue", "depth")] == 0.0
+    occ = {r["name"]: r["value"] for r in rows if r["kind"] == "occupancy"}
+    assert occ, "no group occupancy recorded"
+    assert sum(occ.values()) >= 1
+
+
+def test_explain_analyze_dispatch_line(ticked):
+    db, s = _mkdb()
+    txt = s.execute("EXPLAIN ANALYZE SELECT v FROM bd WHERE id = 5")
+    line = [ln for ln in txt.plan_text.splitlines()
+            if ln.startswith("-- dispatch:")]
+    assert line and "enabled=1" in line[0]
+    set_flag("batch_dispatch", False)
+    txt = s.execute("EXPLAIN ANALYZE SELECT v FROM bd WHERE id = 6")
+    line = [ln for ln in txt.plan_text.splitlines()
+            if ln.startswith("-- dispatch:")]
+    assert line and "enabled=0" in line[0]
+
+
+def test_trace_spans_for_batch_seams(ticked):
+    """batch.enqueue / batch.combine / batch.scatter visible in kept
+    traces under tracing, and pinned absent with tracing off."""
+    from baikaldb_tpu.obs.trace import TRACER
+
+    db, boot = _mkdb()
+    sqls = [f"SELECT v FROM bd WHERE id = {i}" for i in range(12)]
+    _concurrent(db, sqls, threads=6)       # warm compiles, tracing off
+    TRACER.clear()
+    prev = bool(FLAGS.tracing)
+    try:
+        set_flag("tracing", True)
+        _concurrent(db, sqls, threads=6)
+    finally:
+        set_flag("tracing", prev)
+    names = {sp["name"] for rec in TRACER.list() for sp in rec["spans"]}
+    assert "batch.enqueue" in names
+    assert "batch.combine" in names and "batch.scatter" in names
+    waits = [sp["attrs"]["queue_wait_ms"]
+             for rec in TRACER.list() for sp in rec["spans"]
+             if sp["name"] == "batch.enqueue"]
+    assert waits and all(w >= 0 for w in waits)
+    combines = [sp["attrs"] for rec in TRACER.list()
+                for sp in rec["spans"] if sp["name"] == "batch.combine"]
+    assert all("group" in a and "padded" in a for a in combines)
+    TRACER.clear()
+    _concurrent(db, sqls, threads=6)       # tracing off again
+    assert not TRACER.list()
+
+
+def test_strcmp_dictionary_params_group_correctly(ticked):
+    """String-compare params (dictionary (lo,hi) bounds) ride the batched
+    feed; distinct strings in one group return their own rows."""
+    db = Database()
+    s = Session(db)
+    s.execute("CREATE TABLE sd (k VARCHAR(8), n BIGINT)")
+    s.execute("INSERT INTO sd VALUES " + ", ".join(
+        f"('k{i}', {i * 5})" for i in range(64)))
+    sqls = [f"SELECT n FROM sd WHERE k = 'k{i}'" for i in range(32)]
+    serial = {sql: s.execute(sql).arrow for sql in sqls}
+    got = _concurrent(db, sqls, threads=8)
+    for sql in sqls:
+        assert got[sql].equals(serial[sql]), sql
