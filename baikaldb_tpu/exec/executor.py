@@ -34,9 +34,10 @@ from ..ops.hashagg import (AggSpec, MERGE_OP, finalize_partials,
 from ..ops.sort import SortKey, sort_batch, top_k
 from ..ops.compact import shrink
 from ..plan.nodes import (AggNode, DistinctNode, ExchangeNode, FilterNode,
-                          JoinNode, LimitNode, MembershipNode, PlanNode,
-                          ProjectNode, ScalarSourceNode, ScanNode, ShrinkNode,
-                          SortNode, UnionNode, ValuesNode, WindowNode)
+                          JoinNode, LimitNode, MembershipNode, MultiJoinNode,
+                          PlanNode, ProjectNode, ScalarSourceNode, ScanNode,
+                          ShrinkNode, SortNode, UnionNode, ValuesNode,
+                          WindowNode)
 from ..column.batch import concat_batches
 from ..parallel.mesh import AXIS, shard_map
 from ..types import LType
@@ -60,10 +61,14 @@ define("radix_join_min_build", 65536,
 class _CapBox:
     """A retryable capacity knob that rides the join-overflow protocol:
     the session retry loop grows ``.cap`` to the reported need and
-    re-traces (used for the radix join's per-bucket width)."""
+    re-traces (used for the radix join's per-bucket width and the fused
+    exchange's per-input shuffle capacities).  ``kind``/``site`` label the
+    knob for shuffle-retry accounting and the mpp.* trace spans."""
 
-    def __init__(self, cap=None):
+    def __init__(self, cap=None, kind: str = "width", site: str = ""):
         self.cap = cap
+        self.kind = kind
+        self.site = site
 
 
 def compile_plan(plan: PlanNode, trace: bool = False, mesh=None) -> Callable:
@@ -120,10 +125,19 @@ def compile_plan(plan: PlanNode, trace: bool = False, mesh=None) -> Callable:
     else:
         from jax.sharding import PartitionSpec as P
 
-        smapped = shard_map(run_local, mesh=mesh, in_specs=(P(AXIS),),
-                            out_specs=P(), check_vma=False)
-
         def run(batches: dict):
+            # per-leaf in_specs (the pjit per-leaf in_axis_resources shape):
+            # table batches shard over the row axis, the hoisted-literal
+            # params feed replicates P() — scalar params ride the
+            # partitioned batches pytree, so ONE mesh executable serves
+            # every literal variant instead of baking each literal into
+            # its own shard_map program.  Built per call from the batch
+            # keys; jit caches on the pytree structure, so steady state
+            # never reconstructs a trace.
+            specs = {k: (P() if k == PARAMS_KEY else P(AXIS))
+                     for k in batches}
+            smapped = shard_map(run_local, mesh=mesh, in_specs=(specs,),
+                                out_specs=P(), check_vma=False)
             return smapped(batches)
 
     run.join_order = join_order
@@ -257,6 +271,37 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         # label-qualified names are globally unique, no suffixing occurs
         return out
 
+    if isinstance(node, MultiJoinNode):
+        probe = _sub(node.children[0], batches, overflows, ctx)
+        builds = [_sub(c, batches, overflows, ctx)
+                  for c in node.children[1:]]
+        n = ctx[3]
+        if n:
+            # the fused exchange: every input hash-repartitions ONCE on
+            # the shared key (one shuffle round for the whole chain);
+            # intermediate join results never exist, so never re-shuffle
+            if node.exch_caps is None:
+                node.exch_caps = [
+                    _CapBox(kind="shuffle", site=f"multiway[{i}]")
+                    for i in range(len(node.children))]
+            inputs = [(probe, node.probe_keys)] + \
+                list(zip(builds, node.build_keys))
+            shuffled = []
+            for (b, keys), box in zip(inputs, node.exch_caps):
+                if box.cap is None:
+                    box.cap = max(1, 2 * len(b) // n)
+                out_b, needed = _repartition_exec(b, list(keys), n, box.cap)
+                overflows.append((box, needed))
+                shuffled.append(out_b)
+            probe, builds = shuffled[0], shuffled[1:]
+        if node.cap is None:
+            node.cap = max(1, len(probe), *(len(b) for b in builds))
+        out, ovf = join_ops.multiway_join(
+            probe, node.probe_keys, list(zip(builds, node.build_keys)),
+            list(node.hows), cap=node.cap)
+        overflows.append((node, ovf))
+        return out
+
     if isinstance(node, ExchangeNode):
         child = _sub(node.child(), batches, overflows, ctx)
         if node.kind == "gather":
@@ -300,6 +345,33 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
                     cols[i] = dreplace(c, data=c.data + jnp.asarray(mn, c.data.dtype))
                 out = ColumnBatch(out.names, cols, out.sel, out.num_rows)
             return out
+        if node.key_names and getattr(node, "agg_dist", "") == "local" \
+                and ctx is not None and ctx[3]:
+            # cardinality-adaptive "local" arm (sorted strategy): pre-reduce
+            # this shard's rows into partial-aggregate rows, shuffle only
+            # the PARTIALS on the key hash, merge co-located partials once
+            # (Partial Partial Aggregates; parallel/agg.py has the policy)
+            from ..parallel.agg import merge_partial_agg_specs
+
+            n = ctx[3]
+            parts, fin = partial_specs(node.specs)
+            mg_part = max(1, min(node.max_groups, len(child))
+                          if node.max_groups else len(child))
+            part = group_aggregate_sorted(child, node.key_names, parts,
+                                          mg_part)
+            part = ColumnBatch(part.names, part.columns, part.sel, None)
+            box = getattr(node, "agg_exch_cap", None)
+            if box is None:
+                box = node.agg_exch_cap = _CapBox(kind="shuffle", site="agg")
+            if box.cap is None:
+                box.cap = max(1, 2 * len(part) // n)
+            shuf, needed = _repartition_exec(part, node.key_names, n,
+                                             box.cap)
+            overflows.append((box, needed))
+            final = group_aggregate_sorted(shuf, node.key_names,
+                                           merge_partial_agg_specs(parts),
+                                           max(1, len(shuf)))
+            return finalize_partials(final, fin, node.key_names)
         mg = node.max_groups or max(1, len(child))
         return group_aggregate_sorted(child, node.key_names, node.specs, mg,
                                       order=_presort_order(node, batches,
@@ -435,6 +507,44 @@ def _sub(node, batches, overflows, ctx):
 
 
 # -- mesh collectives (dist mode; plan/distribute.py inserts the markers) ----
+
+def count_shuffle_rounds(plan: PlanNode) -> int:
+    """Hash-repartition exchange rounds a distributed plan executes — the
+    number the multiway fusion exists to reduce.  One round = one
+    synchronized repartition step: a binary shuffle join's two input
+    exchanges are ONE round, a fused MultiJoin's N+1 input exchanges are
+    ONE round, a lone repartition (group-by / distinct co-location) or a
+    "local" adaptive agg's internal partial shuffle is one each."""
+    rounds = 0
+    skip: set = set()
+    seen: set = set()
+
+    def walk(n: PlanNode) -> None:
+        nonlocal rounds
+        if id(n) in seen:           # DAG-shared subtrees execute per parent
+            return                  # trace, but count once for the metric
+        seen.add(id(n))
+        if isinstance(n, MultiJoinNode):
+            rounds += 1
+        elif isinstance(n, JoinNode):
+            reps = [c for c in n.children
+                    if isinstance(c, ExchangeNode) and c.kind == "repartition"]
+            if reps:
+                rounds += 1
+                skip.update(id(c) for c in reps)
+        elif isinstance(n, ExchangeNode) and n.kind == "repartition" \
+                and id(n) not in skip:
+            rounds += 1
+        elif isinstance(n, AggNode) and \
+                getattr(n, "agg_dist", "") == "local" \
+                and n.strategy != "dense":
+            rounds += 1
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    return rounds
+
 
 def _all_gather_batch(b: ColumnBatch) -> ColumnBatch:
     """Shard-partitioned rows -> replicated full batch (one all_gather)."""

@@ -27,8 +27,8 @@ from ..column.batch import ColumnBatch
 from ..expr.compile import eval_expr, eval_output, eval_predicate
 from ..meta.catalog import Catalog, IndexInfo, parse_type
 from ..ops.compact import compact
-from ..plan.nodes import (JoinNode, PlanNode, ScalarSourceNode,
-                          plan_signature)
+from ..plan.nodes import (AggNode, ExchangeNode, JoinNode, MultiJoinNode,
+                          PlanNode, ScalarSourceNode, plan_signature)
 from ..plan.planner import PlanError, Planner
 from ..sql.lexer import SqlError
 from ..sql.parser import parse_sql
@@ -60,7 +60,7 @@ define("param_queries", True,
        "entry and one compiled executable serve every literal variant of a "
        "query shape; 0 restores SQL-text-keyed caching with baked literals")
 from .dispatch import BatchDispatcher
-from .executor import compile_plan
+from .executor import _CapBox, compile_plan, count_shuffle_rounds
 
 # join overflow retry budget lives in FLAGS.join_retry_max: retries settle
 # at most one operator per re-trace, so a chain of N joins can need N rounds
@@ -1725,17 +1725,21 @@ class Session:
             shutil.rmtree(pq_dir)
 
     # -- helpers ------------------------------------------------------------
-    def _planner(self) -> Planner:
-        def stats_fn(table_key: str, col: str):
-            st = self.db.stores.get(table_key)
-            if st is None:
-                return None
-            try:
-                return st.column_stats(col)
-            except Exception:
-                return None
+    def _stats_fn(self, table_key: str, col: str):
+        """Collected column statistics, or None — the ONE stats-access
+        closure behind the planner's selectivity estimates AND the
+        distributor's adaptive-agg ndv lookups."""
+        st = self.db.stores.get(table_key)
+        if st is None:
+            return None
+        try:
+            return st.column_stats(col)
+        except Exception:   # noqa: BLE001 — stats are advisory
+            return None
 
-        return Planner(self.db.catalog, self.db.stores, self.current_db, stats_fn)
+    def _planner(self) -> Planner:
+        return Planner(self.db.catalog, self.db.stores, self.current_db,
+                       self._stats_fn)
 
     def _plan_select(self, stmt: SelectStmt) -> PlanNode:
         """Logical+physical planning, plus the distribution pass (the
@@ -1753,7 +1757,13 @@ class Session:
                 st = self.db.stores.get(table_key)
                 return st.num_rows if st is not None else 0
 
-            plan = distribute(plan, int(self.mesh.devices.size), rows_fn)
+            def ndv_fn(table_key: str, col: str):
+                # index/stats distinct-count estimate feeding the
+                # cardinality-adaptive aggregation choice
+                return (self._stats_fn(table_key, col) or {}).get("ndv")
+
+            plan = distribute(plan, int(self.mesh.devices.size), rows_fn,
+                              ndv_fn=ndv_fn)
         return plan
 
     def _annotate_ann(self, stmt: SelectStmt, plan: PlanNode) -> None:
@@ -3412,14 +3422,15 @@ class Session:
         # auto-parameterization (plan/paramize.py): hoist WHERE literals
         # into a runtime params vector and key the plan cache on the
         # canonical statement structure — WHERE id = 42 and WHERE id = 43
-        # share one entry AND one compiled executable.  Mesh programs stay
-        # text-keyed: shard_map's in_specs partition every batches leaf and
-        # scalar params cannot ride that pytree.
+        # share one entry AND one compiled executable.  Mesh programs
+        # participate too: the executor's per-leaf in_specs replicate the
+        # params feed (P()) while batches shard P(AXIS), so one shard_map
+        # executable serves every literal variant — without this, the big
+        # MPP programs (fused multiway exchange) would fork per WHERE value.
         norm = None
         lookup_key = cache_key
         stmt_run = stmt
-        if cache_key is not None and self.mesh is None \
-                and bool(FLAGS.param_queries):
+        if cache_key is not None and bool(FLAGS.param_queries):
             try:
                 with trace.span("plan.paramize"):
                     n = paramize.normalize(stmt, self._param_resolver(stmt))
@@ -3487,6 +3498,8 @@ class Session:
                     entry["plan"] = plan
                     entry["plan_sig"] = sig
                     entry["compiled"] = {}
+                    entry.pop("shuffle_rounds", None)   # re-count: the
+                    # fresh plan may shuffle differently
                     # the plan AND every executable were just rebuilt: in
                     # cost terms this is a miss, and the hit/miss split is
                     # how recompile churn shows on dashboards
@@ -3662,7 +3675,7 @@ class Session:
         # statement (plan/paramize.py; pinned = shape/trace-time feeders)
         try:
             nz = paramize.normalize(stmt, self._param_resolver(stmt)) \
-                if bool(FLAGS.param_queries) and self.mesh is None else None
+                if bool(FLAGS.param_queries) else None
         except Exception:   # noqa: BLE001 — display stays best-effort
             metrics.count_swallowed("session.explain_paramize")
             nz = None
@@ -3683,6 +3696,30 @@ class Session:
                     groups_total=metrics.batched_groups.value,
                     avg_occupancy=occ["avg_ms"],
                     queue_p50_ms=metrics.queue_wait_ms.stats()["p50_ms"])
+        # MPP exchange v2: shuffle rounds this plan pays, join chains fused
+        # into a multiway exchange, and the adaptive-agg strategy decision
+        # (local pre-reduce vs raw-row shuffle) per AggNode
+        mj = [0]
+        aggs: list[str] = []
+        seen_x: set = set()
+
+        def walk_x(n):
+            if id(n) in seen_x:
+                return
+            seen_x.add(id(n))
+            if isinstance(n, MultiJoinNode):
+                mj[0] += 1
+            if isinstance(n, AggNode) and getattr(n, "agg_dist", ""):
+                aggs.append(n.agg_dist)
+            for c in n.children:
+                walk_x(c)
+
+        walk_x(plan)
+        trace.event("exchange",
+                    rounds=(count_shuffle_rounds(plan)
+                            if self.mesh is not None else 0),
+                    multiway=mj[0], agg=",".join(aggs) or "-",
+                    retries_total=metrics.shuffle_overflow_retries.value)
 
     @staticmethod
     def _render_analyze(spans: list[dict]) -> list[str]:
@@ -3727,6 +3764,11 @@ class Session:
                          f"groups_total={a['groups_total']} "
                          f"avg_occupancy={a['avg_occupancy']} "
                          f"queue_p50_ms={a['queue_p50_ms']}")
+        for s in find("exchange"):
+            a = s["attrs"]
+            lines.append(f"-- exchange: rounds={a['rounds']} "
+                         f"multiway={a['multiway']} agg={a['agg']} "
+                         f"shuffle_retries_total={a['retries_total']}")
         lines.append(f"-- trace: spans={len(spans)} "
                      "(SHOW PROFILE shows the same span records)")
         return lines
@@ -4181,6 +4223,39 @@ class Session:
                 "value": pa.array([r[2] for r in rows], pa.float64()),
                 "detail": [r[3] for r in rows],
             }) if rows else _empty_info("dispatcher")
+        if name == "column_stats":
+            rows = []
+            for db in cat.databases():
+                if db == "information_schema":
+                    continue
+                for t in cat.tables(db):
+                    st = self.db.stores.get(f"{db}.{t}")
+                    if st is None:
+                        continue
+                    info = cat.get_table(db, t)
+                    for f in info.schema.fields:
+                        try:
+                            s = st.column_stats(f.name) or {}
+                        except Exception:   # noqa: BLE001 — stats advisory
+                            metrics.count_swallowed("session.column_stats")
+                            continue
+                        rows.append((db, t, f.name, int(s.get("ndv") or 0),
+                                     s.get("ndv_method") or "",
+                                     int(s.get("nulls") or 0),
+                                     int(s.get("n") or 0),
+                                     len(s.get("mcv") or ()),
+                                     max(0, len(s.get("hist") or ()) - 1)))
+            return pa.table({
+                "table_schema": [r[0] for r in rows],
+                "table_name": [r[1] for r in rows],
+                "column_name": [r[2] for r in rows],
+                "ndv": pa.array([r[3] for r in rows], pa.int64()),
+                "ndv_method": [r[4] for r in rows],
+                "nulls": pa.array([r[5] for r in rows], pa.int64()),
+                "row_count": pa.array([r[6] for r in rows], pa.int64()),
+                "mcv_count": pa.array([r[7] for r in rows], pa.int64()),
+                "hist_buckets": pa.array([r[8] for r in rows], pa.int64()),
+            }) if rows else _empty_info("column_stats")
         if name == "failpoints":
             from ..chaos import failpoint as _fp
             rows = _fp.describe()
@@ -4334,11 +4409,74 @@ class Session:
                     # slightly different data reuse the compiled executable)
                     node.cap = max(16, 1 << (needed - 1).bit_length())
                     grew = True
+                    if mesh is not None and (
+                            isinstance(node, ExchangeNode)
+                            or (isinstance(node, _CapBox)
+                                and node.kind == "shuffle")):
+                        # a skewed key blew past the per-destination
+                        # shuffle capacity — the exchange backpressure
+                        # analog, worth its own counter
+                        metrics.shuffle_overflow_retries.add(1)
             if not grew:
+                if mesh is not None:
+                    self._mpp_telemetry(plan, entry, raw.join_order,
+                                        host_flags)
                 with trace.span("egress.compact"):
                     return self._egress_compact(out)
             entry["compiled"].pop(shape_key, None)  # caps changed: re-trace
         raise RuntimeError("join output cap still overflowing after retries")
+
+    def _mpp_telemetry(self, plan, entry: dict, join_order,
+                       host_flags) -> None:
+        """Per-execution exchange observability for mesh plans: the
+        shuffle_rounds counter plus mpp.repartition / mpp.join / mpp.agg
+        spans with occupancy/overflow/strategy attrs.  Pure host work on
+        the already-fetched flag values — no extra device sync."""
+        rounds = entry.get("shuffle_rounds")
+        if rounds is None:
+            rounds = entry["shuffle_rounds"] = count_shuffle_rounds(plan)
+        metrics.shuffle_rounds.add(rounds)
+        if not trace.active():
+            # tracing off: the counter above is the whole cost — no plan
+            # walk, no per-node span churn on the hot path
+            return
+        for node, flag in zip(join_order, host_flags):
+            needed = int(flag)
+            if isinstance(node, ExchangeNode) and node.kind == "repartition":
+                with trace.span("mpp.repartition",
+                                keys=",".join(node.keys or ()),
+                                cap=int(node.cap or 0), occupancy=needed):
+                    pass
+            elif isinstance(node, _CapBox) and node.kind == "shuffle":
+                with trace.span("mpp.repartition", site=node.site,
+                                cap=int(node.cap or 0), occupancy=needed):
+                    pass
+            elif isinstance(node, MultiJoinNode):
+                with trace.span("mpp.join", strategy="multiway",
+                                builds=len(node.children) - 1, rows=needed,
+                                cap=int(node.cap or 0)):
+                    pass
+            elif isinstance(node, JoinNode) and any(
+                    isinstance(c, ExchangeNode) and c.kind == "repartition"
+                    for c in node.children):
+                with trace.span("mpp.join", strategy="chained", rows=needed,
+                                cap=int(node.cap or 0)):
+                    pass
+
+        seen: set = set()
+
+        def walk(n):
+            if id(n) in seen:
+                return
+            seen.add(id(n))
+            if isinstance(n, AggNode) and getattr(n, "agg_dist", ""):
+                with trace.span("mpp.agg", strategy=n.agg_dist,
+                                agg_kind=n.strategy):
+                    pass
+            for c in n.children:
+                walk(c)
+
+        walk(plan)
 
     def _egress_compact(self, batch: ColumnBatch) -> ColumnBatch:
         """Densify the finished result for egress, O(live) not O(capacity).

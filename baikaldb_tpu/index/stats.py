@@ -42,27 +42,98 @@ define("histogram_sample", 200_000,
 DEFAULT_EQ_SEL = 0.1
 DEFAULT_RANGE_SEL = 0.3
 
+# HLL register-index bits: 2^12 registers ≈ 1.6% standard error — plenty
+# for the adaptive-agg local-vs-raw threshold (a 2x decision boundary)
+_HLL_P = 12
+_HLL_MULT = np.uint64(0x9E3779B97F4A7C15)
+
+
+def hll_ndv(values: np.ndarray, p: int = _HLL_P) -> Optional[int]:
+    """HyperLogLog distinct-count estimate over a FULL numeric value array
+    (vectorized numpy, O(n) — cheap enough to run on every stats
+    collection, unlike an exact unique of millions of rows).  None when
+    the dtype can't be hashed vectorized (object/strings — the caller
+    falls back to the sampled Chao floor)."""
+    try:
+        v = np.ascontiguousarray(values)
+        if v.dtype.kind == "f":
+            if v.dtype.itemsize not in (4, 8):
+                return None     # float16 etc. would alias adjacent values
+            #                     through the 32-bit view — fall back
+            # canonicalize -0.0/0.0 before bit-punning so equal floats
+            # hash equal
+            v = v + 0.0
+            v = v.view(np.uint64 if v.dtype.itemsize == 8
+                       else np.uint32).astype(np.uint64)
+        elif v.dtype.kind in "iub":
+            v = v.astype(np.int64).view(np.uint64)
+        else:
+            return None
+    except (TypeError, ValueError):
+        return None
+    with np.errstate(over="ignore"):
+        h = v * _HLL_MULT
+        h ^= h >> np.uint64(29)
+        h *= np.uint64(0xBF58476D1CE4E5B9)
+        h ^= h >> np.uint64(32)
+    m = 1 << p
+    idx = (h >> np.uint64(64 - p)).astype(np.int64)
+    nz = 64 - p
+    rem = h & np.uint64((1 << nz) - 1)
+    # rho = leading-zero count of the nz-bit word + 1; bit length == frexp
+    # exponent (values < 2^52 are exactly representable, nz = 52 here), so
+    # rho = nz - bitlen + 1
+    _, exp = np.frexp(rem.astype(np.float64))
+    rho = np.where(rem == 0, nz + 1, nz - exp + 1).astype(np.int64)
+    reg = np.zeros(m, np.int64)
+    np.maximum.at(reg, idx, rho)
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    est = alpha * m * m / np.sum(np.exp2(-reg.astype(np.float64)))
+    zeros = int((reg == 0).sum())
+    if est <= 2.5 * m and zeros:
+        est = m * np.log(m / zeros)         # small-range correction
+    return max(1, int(round(est)))
+
 
 def collect(values: np.ndarray, n_total: int, n_nulls: int,
             numeric: bool) -> dict:
-    """Build the stats payload from a (non-null) value sample."""
+    """Build the stats payload from a (non-null) value sample.
+
+    The distinct-count estimate (``ndv``/``ndv_method``) feeds join fanout
+    sizing and the adaptive-agg local-vs-raw decision: exact when the
+    sample holds every value, HLL over the full array when sampling
+    truncates a numeric column, sampled Chao floor otherwise."""
     out: dict = {"n": int(n_total), "nulls": int(n_nulls)}
     if not len(values):
         out["ndv"] = 0
+        out["ndv_method"] = "exact"
         return out
     sample = values
     cap = int(FLAGS.histogram_sample)
-    if len(sample) > cap:
+    truncated = len(sample) > cap
+    if truncated:
         idx = np.random.RandomState(0).choice(len(sample), cap,
                                               replace=False)
         sample = sample[idx]
     uniq, counts = np.unique(sample, return_counts=True)
-    # scale sample ndv up to the population conservatively: values seen
-    # once in the sample hint at unseen ones (a Chao-style floor)
     scale = max(len(values), 1) / len(sample)
-    singletons = int((counts == 1).sum())
-    out["ndv"] = int(min(len(uniq) + singletons * (scale - 1.0),
-                         n_total - n_nulls)) or 1
+    if not truncated:
+        # the sample IS the population: the unique count is exact
+        out["ndv"] = int(min(len(uniq), n_total - n_nulls)) or 1
+        out["ndv_method"] = "exact"
+    else:
+        h = hll_ndv(values)
+        if h is not None:
+            out["ndv"] = int(min(h, n_total - n_nulls)) or 1
+            out["ndv_method"] = "hll"
+        else:
+            # scale sample ndv up to the population conservatively: values
+            # seen once in the sample hint at unseen ones (a Chao-style
+            # floor)
+            singletons = int((counts == 1).sum())
+            out["ndv"] = int(min(len(uniq) + singletons * (scale - 1.0),
+                                 n_total - n_nulls)) or 1
+            out["ndv_method"] = "chao"
     k = int(FLAGS.histogram_mcv)
     if len(uniq) <= k:
         mcv_idx = np.argsort(-counts)

@@ -246,6 +246,18 @@ class Catalog:
                               Field("name", LType.STRING),
                               Field("value", LType.FLOAT64),
                               Field("detail", LType.STRING))),
+        # per-column collected statistics (index/stats): the distinct-count
+        # estimate feeding the adaptive-agg decision, plus histogram/MCV
+        # collection state — the reference's statistics.proto surface
+        "column_stats": Schema((Field("table_schema", LType.STRING),
+                                Field("table_name", LType.STRING),
+                                Field("column_name", LType.STRING),
+                                Field("ndv", LType.INT64),
+                                Field("ndv_method", LType.STRING),
+                                Field("nulls", LType.INT64),
+                                Field("row_count", LType.INT64),
+                                Field("mcv_count", LType.INT64),
+                                Field("hist_buckets", LType.INT64))),
     }
 
     def get_table(self, database: str, name: str) -> TableInfo:

@@ -192,6 +192,12 @@ def span(name: str, /, **attrs):
     return _Span(ctx, name, attrs)
 
 
+def active() -> bool:
+    """True when a trace is live (one contextvar read) — lets callers skip
+    building span batches whose every member would be the no-op."""
+    return _CUR.get() is not None
+
+
 def event(name: str, /, **attrs) -> None:
     """Zero-duration span: attach a point-in-time record (telemetry the
     renderers re-read) to the active trace."""

@@ -370,6 +370,121 @@ def radix_join(probe: ColumnBatch, probe_keys: list[str],
     return out, total, needed_width
 
 
+def _align_multiway_strings(probe: ColumnBatch, probe_keys: list[str],
+                            builds: list):
+    """Align string key columns of the probe and EVERY build side onto one
+    shared code space.  Two passes: the first grows the probe's dictionary
+    to the union of all sides; the second re-aligns each build against that
+    union (a second merge with a subset is value-stable, so every side ends
+    up comparing codes in the same space — a single probe column compared
+    against N independently-dictionaried builds must not stop at pairwise
+    merges, or build_1's codes would be stale after build_2 widened the
+    probe's dictionary)."""
+    for i, (bb, bk) in enumerate(builds):
+        probe, bb = _align_string_keys(probe, probe_keys, bb, bk)
+        builds[i] = (bb, bk)
+    for i, (bb, bk) in enumerate(builds):
+        probe, bb = _align_string_keys(probe, probe_keys, bb, bk)
+        builds[i] = (bb, bk)
+    return probe, builds
+
+
+def multiway_join(probe: ColumnBatch, probe_keys: list[str],
+                  builds: list, hows: list[str],
+                  cap: int | None = None, suffix: str = "_r",
+                  wide_keys_ok: bool = False):
+    """Fused multiway equi-join: ONE probe stream joined against N build
+    sides on the SAME probe key columns in a single pass (the Efficient
+    Multiway Hash Join shape; PAPERS.md).
+
+    ``builds``: list of (build_batch, build_key_names); ``hows[i]``:
+    inner | left per level.  Semantically identical to the left-deep chain
+    ``((probe ⋈ build_1) ⋈ build_2) ⋈ ...`` — each build side sorts by
+    (deadness, key) once, the probe binary-searches every side, and the
+    output expansion enumerates the cross product of per-side match ranges
+    via one mixed-radix decode (last build fastest-varying, matching the
+    chained expansion order).  The probe's key columns are packed/searched
+    once per side but the probe rows themselves are materialized ONCE —
+    no intermediate join result exists.
+
+    Returns (out_batch, needed_rows): ``needed_rows`` is the exact fused
+    output cardinality for the overflow retry protocol (int64 — a chain of
+    expansions can overflow int32 counts)."""
+    builds = list(builds)
+    probe, builds = _align_multiway_strings(probe, probe_keys, builds)
+    pk, pvalid = _key_array(probe, probe_keys, wide_keys_ok)
+    psel_dead, pdead = _probe_dead(probe, pvalid)
+
+    per_side = []       # (oc, counts, lo, order, nbuild) per build
+    for (bb, bkeys), how in zip(builds, hows):
+        bk, bvalid = _key_array(bb, bkeys, wide_keys_ok)
+        bdead = _build_dead(bb, bvalid)
+        order = jnp.lexsort((bk, bdead))
+        n_live = jnp.sum(~bdead).astype(jnp.int32)
+        bk_sorted = jnp.where(jnp.arange(len(bb)) < n_live,
+                              bk[order], _sentinel_max(bk.dtype))
+        lo = jnp.searchsorted(bk_sorted, pk, side="left")
+        hi = jnp.searchsorted(bk_sorted, pk, side="right")
+        counts = jnp.where(pdead, 0, hi - lo)
+        first_dead = n_live.astype(lo.dtype)
+        counts = jnp.where(lo >= first_dead, 0,
+                           jnp.minimum(counts, first_dead - lo))
+        if how == "left":
+            # NULL-key probe rows still survive (NULL build side); only
+            # sel-dead probe rows are dropped — the binary-join contract
+            oc = jnp.maximum(counts, jnp.where(psel_dead, 0, 1))
+        elif how == "inner":
+            oc = counts
+        else:
+            raise ValueError(f"multiway_join: unsupported how {how!r}")
+        per_side.append((oc, counts, lo, order, len(bb)))
+
+    out_counts = jnp.ones(len(probe), jnp.int64)
+    for oc, _c, _lo, _o, _n in per_side:
+        out_counts = out_counts * oc.astype(jnp.int64)
+
+    if cap is None:
+        cap = len(probe)
+    offsets = jnp.cumsum(out_counts)
+    total = (offsets[-1] if len(probe) else jnp.int64(0)).astype(jnp.int64)
+    starts = offsets - out_counts
+    j = jnp.arange(cap, dtype=jnp.int64)
+    pi = jnp.searchsorted(offsets, j, side="right")
+    pi_c = jnp.clip(pi, 0, len(probe) - 1)
+    k = j - starts[pi_c]
+    live_out = j < total
+
+    # mixed-radix decode of the per-probe-row match ordinal: last build
+    # varies fastest (== the chained left-deep expansion order)
+    ordinals = [None] * len(per_side)
+    rem = k
+    for i in reversed(range(len(per_side))):
+        oc_i = per_side[i][0][pi_c].astype(jnp.int64)
+        d = jnp.maximum(oc_i, 1)
+        ordinals[i] = rem % d
+        rem = rem // d
+
+    out_p = probe.gather(pi_c, valid=None)
+    names = list(out_p.names)
+    cols = list(out_p.columns)
+    for (oc, counts, lo, order, nbuild), how, ki, (bb, _bk) in zip(
+            per_side, hows, ordinals, builds):
+        matched = ki < counts[pi_c].astype(jnp.int64)
+        bpos = lo[pi_c].astype(jnp.int64) + ki
+        bidx = order[jnp.clip(bpos, 0, max(nbuild - 1, 0))]
+        out_b = bb.gather(jnp.clip(bidx, 0, max(nbuild - 1, 0)), valid=None)
+        bvalid_out = matched & live_out
+        for n, c in zip(out_b.names, out_b.columns):
+            if how == "left":
+                v = c.validity & bvalid_out if c.validity is not None \
+                    else bvalid_out
+                c = replace(c, validity=v)
+            names.append(n if n not in names else n + suffix)
+            cols.append(c)
+    out = ColumnBatch(tuple(names), cols, live_out, None)
+    return out, total
+
+
 def _dense_slots(batch: ColumnBatch, keys: list[str],
                  los: list[int], spans: list[int]):
     """Row -> slot in the row-major product space of the key domains,

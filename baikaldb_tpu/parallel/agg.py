@@ -6,9 +6,20 @@ partial states over brpc.  Here each mesh shard computes the SAME fixed-size
 partial table (dense group domain), and the merge is a single XLA collective
 over ICI: psum for sum/count partials, pmin/pmax for min/max — the
 BASELINE.json north-star config #2 ("per-region partial agg + psum").
+
+Cardinality-adaptive partial aggregation (the Partial Partial Aggregates
+policy, PAPERS.md): pre-reducing locally only pays when the group-key
+cardinality is small relative to each shard's row count — a near-unique
+group key makes the local pre-pass pure overhead (every "partial" holds one
+row).  ``choose_strategy`` picks per query from the index/stats ndv
+estimate: "local" = pre-reduce before the psum/all-to-all, "raw" = shuffle
+raw rows and aggregate once.  plan/distribute.py records the decision on
+the AggNode (EXPLAIN ANALYZE ``-- exchange:`` surfaces it).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -16,8 +27,39 @@ from jax.sharding import PartitionSpec as P
 
 from ..column.batch import Column, ColumnBatch
 from ..ops.hashagg import (AggSpec, MERGE_OP, finalize_partials,
-                           group_aggregate_dense, partial_specs)
+                           group_aggregate_dense, group_aggregate_sorted,
+                           partial_specs)
+from ..utils.flags import FLAGS, define
 from .mesh import AXIS, shard_map
+
+define("adaptive_agg", True,
+       "choose per query between local pre-aggregation and raw-row shuffle "
+       "for distributed GROUP BY, from the stats distinct-count estimate "
+       "(off: the pre-round-7 static policy — dense pre-reduces, sorted "
+       "shuffles raw)")
+define("agg_local_ratio", 0.5,
+       "pre-reduce locally when estimated groups <= ratio * rows-per-shard "
+       "(above it the partial pass moves more data than it saves)")
+
+
+def choose_strategy(est_groups: Optional[int], rows_per_shard: int) -> str:
+    """-> "local" | "raw".  Pre-reduction shrinks each shard's exchange
+    payload from ~rows_per_shard rows to ~min(groups, rows_per_shard)
+    partials; it pays exactly when groups is well under rows_per_shard.
+    Unknown cardinality (no stats) keeps the conservative raw shuffle —
+    a wrong "local" costs a wasted O(n log n) pre-pass on every shard."""
+    if not FLAGS.adaptive_agg or est_groups is None:
+        return "raw"
+    ratio = float(FLAGS.agg_local_ratio)
+    return "local" if est_groups <= max(1, int(rows_per_shard * ratio)) \
+        else "raw"
+
+
+def merge_partial_agg_specs(parts: list[AggSpec]) -> list[AggSpec]:
+    """Specs that re-aggregate shuffled PARTIAL rows into final partials:
+    each partial column merges under its MERGE_OP (sum-of-sums,
+    min-of-mins, ...) keeping its name so the finalize plan still binds."""
+    return [AggSpec(MERGE_OP[p.op], p.out_name, p.out_name) for p in parts]
 
 
 def _merge_collective(op: str, x, axis_name: str):
@@ -79,6 +121,75 @@ def _shape_probe(batch, key_names, domains, parts):
 
     out = jax.eval_shape(probe, batch)
     return out
+
+
+def dist_group_aggregate_partial_shuffled(batch: ColumnBatch,
+                                          key_names: list[str],
+                                          specs: list[AggSpec], mesh,
+                                          max_groups_per_shard: int,
+                                          shuffle_cap: int | None = None):
+    """Low-cardinality GROUP BY over the sorted strategy: each shard
+    pre-reduces its rows into partial-aggregate rows (AVG -> SUM+COUNT,
+    ...), shuffles only the PARTIALS on the key hash, and merges co-located
+    partials once — the "local" arm of the adaptive policy.  Exchange
+    payload is O(groups) per shard instead of O(rows).
+
+    Returns (out, (shuffle_overflow, group_overflow)) matching the raw-arm
+    kernel's contract (dist_group_aggregate_shuffled)."""
+    from ..parallel.shuffle import repartition_collective
+
+    parts, fin = partial_specs(specs)
+    merge_specs = merge_partial_agg_specs(parts)
+    n = mesh.devices.size
+    per_shard = max(1, len(batch) // n)
+    mg_part = min(max_groups_per_shard, per_shard)
+    cap = shuffle_cap if shuffle_cap is not None \
+        else max(1, 2 * mg_part // n)
+    in_specs = jax.tree.map(lambda _: P(AXIS), batch)
+
+    def local(b: ColumnBatch):
+        part, p_ovf = group_aggregate_sorted(b, key_names, parts, mg_part,
+                                             with_overflow=True)
+        part = ColumnBatch(part.names, part.columns, part.sel, None)
+        shuf, needed = repartition_collective(part, key_names, n, cap)
+        final, f_ovf = group_aggregate_sorted(shuf, key_names, merge_specs,
+                                              len(shuf), with_overflow=True)
+        out = finalize_partials(final, fin, key_names)
+        out = ColumnBatch(out.names, out.columns, out.sel, None)
+        g_ovf = jax.lax.psum((p_ovf | f_ovf).astype(jnp.int32), AXIS) > 0
+        s_ovf = jax.lax.pmax(needed, AXIS) > cap
+        return out, s_ovf, g_ovf
+
+    def probe_fn(b):
+        part = group_aggregate_sorted(b, key_names, parts, mg_part)
+        part = ColumnBatch(part.names, part.columns, part.sel, None)
+        shuf = ColumnBatch(
+            part.names,
+            [Column(jnp.zeros((n * cap,), c.data.dtype),
+                    None if c.validity is None else jnp.zeros((n * cap,),
+                                                              bool),
+                    c.ltype, c.dictionary) for c in part.columns],
+            jnp.zeros((n * cap,), bool), None)
+        final = group_aggregate_sorted(shuf, key_names, merge_specs,
+                                       len(shuf))
+        out = finalize_partials(final, fin, key_names)
+        return ColumnBatch(out.names, out.columns, out.sel, None)
+
+    probe = jax.eval_shape(probe_fn, _shard_view(batch, n))
+    out_specs = (jax.tree.map(lambda _: P(AXIS), probe), P(), P())
+    fn = shard_map(local, mesh=mesh, in_specs=(in_specs,),
+                   out_specs=out_specs, check_vma=False)
+    out, s_ovf, g_ovf = fn(batch)
+    return out, (s_ovf, g_ovf)
+
+
+def _shard_view(batch: ColumnBatch, n: int) -> ColumnBatch:
+    """Shape-only per-shard view (for eval_shape)."""
+    def slc(x):
+        return jax.ShapeDtypeStruct((x.shape[0] // n,) + x.shape[1:],
+                                    x.dtype)
+
+    return jax.tree.map(slc, batch)
 
 
 def dist_scalar_aggregate(batch: ColumnBatch, specs: list[AggSpec],

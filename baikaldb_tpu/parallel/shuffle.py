@@ -189,6 +189,53 @@ def dist_join(probe: ColumnBatch, probe_keys: list[str],
     return out, (ovf_p, ovf_b, ovf_j)
 
 
+def dist_multiway_join(probe: ColumnBatch, probe_keys: list[str],
+                       builds: list, hows: list[str], mesh,
+                       cap: int | None = None,
+                       shuffle_cap: int | None = None):
+    """Distributed fused multiway equi-join on ONE shared key (the MPP
+    exchange v2 shape): every input — the probe and each build in
+    ``builds`` = [(batch, key_names), ...] — radix-partitions and
+    ``all_to_all``s ONCE on its key hash, then a single fused multi-build
+    probe pass (ops/join.multiway_join) runs per shard.  One exchange
+    round total, versus one per binary join in the chained plan; the
+    intermediate join results never exist, so they are never re-shuffled.
+
+    Returns (out, (probe_shuffle_needed, [build_shuffle_needed...],
+    join_overflow)) — every flag rides the standard retry protocol."""
+    n = mesh.devices.size
+    pshard, ovf_p = dist_hash_repartition(probe, probe_keys, mesh,
+                                          shuffle_cap)
+    bshards, ovf_b = [], []
+    for bb, bkeys in builds:
+        bs, ob = dist_hash_repartition(bb, bkeys, mesh, shuffle_cap)
+        bshards.append(bs)
+        ovf_b.append(ob)
+
+    local_cap = cap or len(pshard) // n
+    build_keys = [bkeys for _, bkeys in builds]
+    in_specs = tuple(jax.tree.map(lambda _: P(AXIS), b)
+                     for b in [pshard] + bshards)
+
+    def local(pb: ColumnBatch, *bbs):
+        out, needed = join_ops.multiway_join(
+            pb, probe_keys, list(zip(bbs, build_keys)), hows, cap=local_cap)
+        any_ovf = jax.lax.pmax(needed, AXIS) > local_cap
+        return out, any_ovf
+
+    locals_ = [_local_view(b, n) for b in [pshard] + bshards]
+    out_probe = jax.eval_shape(
+        lambda pb, *bbs: join_ops.multiway_join(
+            pb, probe_keys, list(zip(bbs, build_keys)), hows,
+            cap=local_cap)[0],
+        *locals_)
+    out_specs = (jax.tree.map(lambda _: P(AXIS), out_probe), P())
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=False)
+    out, ovf_j = fn(pshard, *bshards)
+    return out, (ovf_p, ovf_b, ovf_j)
+
+
 def dist_group_aggregate_shuffled(batch: ColumnBatch, key_names: list[str],
                                   specs: list[AggSpec], mesh,
                                   max_groups_per_shard: int,

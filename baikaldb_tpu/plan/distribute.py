@@ -31,10 +31,18 @@ from __future__ import annotations
 import math
 from typing import Callable, Optional
 
+from ..utils import metrics
+from ..utils.flags import FLAGS, define
 from .nodes import (AggNode, DistinctNode, ExchangeNode, FilterNode, JoinNode,
-                    LimitNode, MembershipNode, PlanNode, ProjectNode,
-                    ScalarSourceNode, ScanNode, ShrinkNode, SortNode,
-                    UnionNode, ValuesNode, WindowNode)
+                    LimitNode, MembershipNode, MultiJoinNode, PlanNode,
+                    ProjectNode, ScalarSourceNode, ScanNode, ShrinkNode,
+                    SortNode, UnionNode, ValuesNode, WindowNode)
+
+define("multiway_join", True,
+       "fuse left-deep chains of shuffle joins sharing one equi-key into a "
+       "single multiway exchange: every input repartitions ONCE and one "
+       "fused multi-build probe pass replaces the binary build/probe/"
+       "shuffle rounds (off: chained binary joins)")
 
 SHARD = "shard"
 REP = "rep"
@@ -65,15 +73,23 @@ def _clear_exchanged_sorted_builds(plan: PlanNode) -> None:
 
 def distribute(plan: PlanNode, n_shards: int,
                rows_fn: Optional[Callable[[str], int]] = None,
-               broadcast_rows: Optional[int] = None) -> PlanNode:
+               broadcast_rows: Optional[int] = None,
+               ndv_fn: Optional[Callable[[str, str], Optional[int]]] = None,
+               ) -> PlanNode:
     """Annotate ``plan`` in place and insert Exchange nodes; returns the (new)
     root.  ``rows_fn(table_key) -> row count`` feeds the broadcast-vs-shuffle
-    join decision; absent stats are treated as small (broadcast)."""
+    join decision; absent stats are treated as small (broadcast).
+    ``ndv_fn(table_key, col) -> distinct count`` (index/stats) feeds the
+    cardinality-adaptive aggregation choice; absent stats keep the
+    conservative raw-row shuffle."""
     if broadcast_rows is None:
         broadcast_rows = BROADCAST_ROWS     # module attr: patchable in tests
-    d = _Distributor(n_shards, rows_fn or (lambda tk: 0), broadcast_rows)
+    d = _Distributor(n_shards, rows_fn or (lambda tk: 0), broadcast_rows,
+                     ndv_fn)
     dist, _ = d.visit(plan)
     _clear_exchanged_sorted_builds(plan)
+    if FLAGS.multiway_join and n_shards > 1:
+        plan = _fuse_multiway(plan)
     if dist == SHARD:
         root = ExchangeNode(children=[plan], schema=plan.schema, kind="gather")
         root.dist = REP
@@ -81,11 +97,123 @@ def distribute(plan: PlanNode, n_shards: int,
     return plan
 
 
+# -- multiway shuffle-join fusion (the MPP exchange v2 rewrite) ------------
+
+def _fusable_shuffle_join(node: PlanNode) -> bool:
+    """A binary join both of whose inputs the distributor chose to
+    hash-repartition, in a shape the fused multiway kernel reproduces
+    exactly (plain sort-strategy inner/left equi-join; the planner already
+    moved residuals into a FilterNode above and semi/anti/dense take other
+    kernels)."""
+    return (isinstance(node, JoinNode) and node.how in ("inner", "left")
+            and node.strategy == "sort" and node.neq is None
+            # planner-verified wide-key 32-bit packing is a per-join proof
+            # the fused kernel does not carry: keep those chains binary
+            and not getattr(node, "pack32_verified", False)
+            and len(node.children) == 2
+            and all(isinstance(c, ExchangeNode) and c.kind == "repartition"
+                    for c in node.children))
+
+
+def _fuse_multiway(node: PlanNode, _seen: Optional[dict] = None) -> PlanNode:
+    """Fold left-deep chains of shuffle joins that all repartition their
+    probe side on the SAME key columns into one MultiJoinNode: the fused
+    exchange repartitions every input once (probe + N builds) instead of
+    re-shuffling each intermediate join result, and the probe stream is
+    expanded against all build sides in one pass (Efficient Multiway Hash
+    Join).  Bottom-up, so a 4-table chain folds build-by-build.  Plans are
+    DAGs (subquery rewrites share the outer stream): the memo makes a
+    shared chain fuse exactly once, both parents seeing one replacement."""
+    if _seen is None:
+        _seen = {}
+    hit = _seen.get(id(node))
+    if hit is not None:
+        return hit
+    _seen[id(node)] = node       # provisional: breaks cycles, updated below
+    for i, c in enumerate(node.children):
+        node.children[i] = _fuse_multiway(c, _seen)
+    if not _fusable_shuffle_join(node):
+        return node
+    lx, rx = node.children
+    inner = lx.children[0]
+    # ShrinkNodes above the inner join exist only to cut the INTERMEDIATE
+    # result's capacity before its re-shuffle; the fused plan never
+    # materializes that intermediate, so they unwrap (identity on live
+    # rows — Shrink is a pure capacity compaction)
+    while isinstance(inner, ShrinkNode):
+        inner = inner.child()
+    out = node
+    if isinstance(inner, MultiJoinNode) and \
+            inner.probe_keys == node.left_keys:
+        # extend an already-fused chain with one more build side — on a
+        # COPY, never in place: a DAG-shared MultiJoinNode mutated here
+        # would leak this parent's build side into every other consumer
+        mj = MultiJoinNode(
+            children=list(inner.children) + [rx.children[0]],
+            schema=node.schema,
+            probe_keys=list(inner.probe_keys),
+            build_keys=[list(bk) for bk in inner.build_keys]
+            + [list(node.right_keys)],
+            hows=list(inner.hows) + [node.how])
+        mj.dist = SHARD
+        metrics.multiway_joins_fused.add(1)
+        out = mj
+    elif _fusable_shuffle_join(inner) and \
+            inner.left_keys == node.left_keys:
+        # the outer join's probe keys are the columns the inner join's
+        # probe side already repartitions on: one partition pass serves
+        # both levels
+        il, ir = inner.children
+        mj = MultiJoinNode(
+            children=[il.children[0], ir.children[0], rx.children[0]],
+            schema=node.schema,
+            probe_keys=list(inner.left_keys),
+            build_keys=[list(inner.right_keys), list(node.right_keys)],
+            hows=[inner.how, node.how])
+        mj.dist = SHARD
+        metrics.multiway_joins_fused.add(1)
+        out = mj
+    _seen[id(node)] = out
+    return out
+
+
+def _column_origins(node: PlanNode) -> dict:
+    """Map each output column name of ``node`` to its base-table source
+    ``(table_key, physical_col)`` where derivable — the resolution the
+    adaptive-agg ndv estimate needs.  Conservative: renamed/computed
+    columns simply drop out of the map."""
+    from ..expr.ast import ColRef
+
+    if isinstance(node, ScanNode):
+        return {f"{node.label}.{c}": (node.table_key, c)
+                for c in node.columns}
+    if isinstance(node, ProjectNode):
+        child = _column_origins(node.child())
+        out = {}
+        for name, e in zip(node.names, node.exprs):
+            if isinstance(e, ColRef) and e.name in child:
+                out[name] = child[e.name]
+        return out
+    if isinstance(node, (JoinNode, MultiJoinNode, UnionNode)):
+        out: dict = {}
+        for c in node.children:
+            for k, v in _column_origins(c).items():
+                out.setdefault(k, v)
+        return out
+    if isinstance(node, (MembershipNode, ScalarSourceNode)):
+        return _column_origins(node.children[0])
+    if node.children:
+        return _column_origins(node.children[0])
+    return {}
+
+
 class _Distributor:
-    def __init__(self, n_shards: int, rows_fn, broadcast_rows: int):
+    def __init__(self, n_shards: int, rows_fn, broadcast_rows: int,
+                 ndv_fn=None):
         self.n = n_shards
         self.rows_fn = rows_fn
         self.broadcast_rows = broadcast_rows
+        self.ndv_fn = ndv_fn
         # plans are DAGs (subquery rewrites share the outer stream between a
         # Membership probe and its joined subplan): visit shared subtrees
         # once, or the second walk would find its own inserted Exchanges
@@ -106,6 +234,31 @@ class _Distributor:
                           keys=None if keys is None else list(keys))
         ex.dist = SHARD
         parent.children[i] = ex
+
+    def _est_groups(self, node: AggNode, child_est: int) -> Optional[int]:
+        """Group-key cardinality estimate from index/stats distinct counts
+        (product over key columns, capped by the child's row estimate).
+        None = no basis (unresolvable key or missing stats) — the caller
+        keeps the conservative raw shuffle."""
+        if self.ndv_fn is None:
+            return None
+        origins = _column_origins(node.child())
+        total = 1
+        for k in node.key_names:
+            src = origins.get(k)
+            if src is None:
+                return None
+            try:
+                ndv = self.ndv_fn(*src)
+            except Exception:       # noqa: BLE001 — stats are advisory
+                metrics.count_swallowed("distribute.ndv")
+                return None
+            if not ndv:
+                return None
+            total *= int(ndv)
+            if total >= child_est:
+                return child_est
+        return min(total, child_est)
 
     # -- the pass --------------------------------------------------------
     def visit(self, node: PlanNode) -> tuple[str, int]:
@@ -177,11 +330,42 @@ class _Distributor:
                       if node.strategy == "dense" else (node.max_groups or e))
             if d == REP:
                 return REP, est
+            from ..parallel.agg import choose_strategy
+
+            rows_per_shard = max(1, e // max(1, self.n))
             if node.strategy == "dense" and not has_distinct:
-                node.merge = "collective"   # psum/pmin/pmax partial merge
-                return REP, est
+                # the psum pre-merge exchanges the whole domain table per
+                # shard: the table size IS the group count the local arm
+                # pays for
+                table = math.prod(x + 1 for x in node.domains)
+                if not FLAGS.adaptive_agg or \
+                        choose_strategy(table, rows_per_shard) == "local":
+                    node.merge = "collective"   # psum/pmin/pmax partial merge
+                    node.agg_dist = "local"
+                    metrics.agg_strategy_local.add(1)
+                    return REP, est
+                # domain table wider than the rows it would summarize:
+                # demote to the sorted raw-row shuffle (groups co-located,
+                # aggregated once)
+                node.strategy = "sorted"
+                node.max_groups = 0      # executor: local capacity bound
+                node.agg_dist = "raw"
+                metrics.agg_strategy_raw.add(1)
+                self._repartition(node, 0, node.key_names)
+                return SHARD, est
+            if not has_distinct and \
+                    choose_strategy(self._est_groups(node, e),
+                                    rows_per_shard) == "local":
+                # low-cardinality sorted GROUP BY: pre-reduce per shard and
+                # shuffle only the partial rows (executor-internal exchange
+                # — no ExchangeNode inserted here)
+                node.agg_dist = "local"
+                metrics.agg_strategy_local.add(1)
+                return SHARD, est
             # sorted strategy or DISTINCT aggregates: co-locate each group on
             # one shard, then aggregate locally (the MPP hash-agg plan)
+            node.agg_dist = "raw"
+            metrics.agg_strategy_raw.add(1)
             self._repartition(node, 0, node.key_names)
             return SHARD, est
 

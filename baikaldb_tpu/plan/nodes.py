@@ -136,6 +136,13 @@ class AggNode(PlanNode):
     # "collective": per-shard partials merged in-network (psum/pmin/pmax) —
     # the partial-AggNode + MERGE_AGG_NODE pair as one collective
     merge: str = ""
+    # cardinality-adaptive MPP aggregation (plan/distribute.py, from
+    # index/stats ndv estimates — the Partial Partial Aggregates policy):
+    #   "local": pre-reduce per shard before the exchange (dense partial
+    #            tables psum-merged, or sorted partials shuffled + merged)
+    #   "raw":   shuffle raw rows and aggregate once per shard
+    # "" = single-device / decision not applicable
+    agg_dist: str = ""
     # sorted strategy over base-table keys of one position-preserving scan
     # chain: the executor feeds store.agg_sort_permutation(cols) so the
     # kernel skips its multi-key device sort.  (table_key, (col, ...))
@@ -144,7 +151,8 @@ class AggNode(PlanNode):
     def _label(self):
         s = f"dense{self.domains}" if self.strategy == "dense" else f"sorted<= {self.max_groups}"
         m = " merge=collective" if self.merge else ""
-        return f"Agg(keys={self.key_names} {s} aggs={[sp.out_name for sp in self.specs]}{m})"
+        a = f" agg_dist={self.agg_dist}" if self.agg_dist else ""
+        return f"Agg(keys={self.key_names} {s} aggs={[sp.out_name for sp in self.specs]}{m}{a})"
 
 
 @dataclass
@@ -250,6 +258,35 @@ class ExchangeNode(PlanNode):
 
 
 @dataclass
+class MultiJoinNode(PlanNode):
+    """Fused multiway hash join over ONE shared equi-key (the Efficient
+    Multiway Hash Join shape): children = [probe, build_1, ..., build_N],
+    every level joining the probe stream on the SAME probe key columns.
+
+    plan/distribute.py folds a left-deep chain of shuffle joins that all
+    repartition on one key into this node; the executor then radix-
+    partitions / ``all_to_all``s each input ONCE on that key hash (one
+    exchange round instead of one per binary join) and runs a single
+    fused multi-build probe pass (ops/join.multiway_join) per shard.
+    Intermediate join results never materialize and never re-shuffle.
+
+    ``cap`` is the fused output capacity (rides the overflow retry-flag
+    protocol like binary join caps); ``exch_caps`` hold the per-input
+    shuffle capacities (runtime-settled _CapBox objects, same protocol)."""
+    probe_keys: list[str] = field(default_factory=list)
+    build_keys: list[list[str]] = field(default_factory=list)  # per build
+    hows: list[str] = field(default_factory=list)              # inner|left
+    cap: Optional[int] = None
+    exch_caps: Optional[list] = None       # per-child _CapBox, trace-settled
+
+    def _label(self):
+        sides = ", ".join(f"{h}:{bk}" for h, bk in zip(self.hows,
+                                                       self.build_keys))
+        return (f"MultiJoin(on {self.probe_keys} x{len(self.hows)} "
+                f"[{sides}])")
+
+
+@dataclass
 class WindowNode(PlanNode):
     """Window functions over one (partition, order) spec (reference:
     src/exec/window_node.cpp)."""
@@ -280,7 +317,7 @@ class ValuesNode(PlanNode):
 # protocol (keeping an old plan keeps its settled caps — a feature);
 # presort_input is rebound per execution; access_desc is EXPLAIN text.
 _SIG_SKIP = frozenset({"children", "cap", "radix_width", "presort_input",
-                       "access_desc"})
+                       "access_desc", "exch_caps", "agg_exch_cap"})
 
 
 def _sig_value(v):

@@ -1,8 +1,13 @@
 """TPC-H wall-clock harness: all 22 queries end-to-end through Session.
 
 Usage:  python -m baikaldb_tpu.tools.bench_tpch [--scale 0.05] [--mesh N]
+                                                [--json]
 Prints per-query first-run (compile incl.) and warm times plus a JSON
-summary line (BASELINE config #5's measurement shape)."""
+summary line (BASELINE config #5's measurement shape).  With ``--json``
+every query emits ONE machine-readable line instead of the human row:
+wall-clock (first + best warm), shuffle rounds per execution, and compiles
+paid — the counters the MPP exchange v2 work moves.
+"""
 
 from __future__ import annotations
 
@@ -17,12 +22,16 @@ def main():
     ap.add_argument("--mesh", type=int, default=0,
                     help="run distributed over an N-device mesh")
     ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON line per query "
+                         "(wall-clock, shuffle rounds, compiles)")
     args = ap.parse_args()
 
     import jax
 
     from ..exec.session import Session
     from ..models import tpch
+    from ..utils import metrics
 
     mesh = None
     if args.mesh:
@@ -35,29 +44,59 @@ def main():
     load_s = time.perf_counter() - t0
     platform = jax.devices()[0].platform
     n_li = s.db.stores["default.lineitem"].num_rows
-    print(f"# scale={args.scale} lineitem={n_li} platform={platform} "
-          f"mesh={args.mesh or 1} load={load_s:.1f}s")
+    header = (f"# scale={args.scale} lineitem={n_li} platform={platform} "
+              f"mesh={args.mesh or 1} load={load_s:.1f}s")
+    if args.json:
+        print(json.dumps({"header": {"scale": args.scale, "lineitem": n_li,
+                                     "platform": platform,
+                                     "mesh": args.mesh or 1,
+                                     "load_s": round(load_s, 1)}}))
+    else:
+        print(header)
 
     results = {}
     total_warm = 0.0
     for name in sorted(tpch.QUERIES, key=lambda q: int(q[1:])):
         sql = tpch.QUERIES[name]
+        c0 = metrics.xla_retraces.value
         t0 = time.perf_counter()
         s.query(sql)
         first = time.perf_counter() - t0
+        first_compiles = metrics.xla_retraces.value - c0
         warm = []
+        warm_rounds = 0
+        warm_compiles = 0
         for _ in range(args.repeat):
+            r0 = metrics.shuffle_rounds.value
+            c0 = metrics.xla_retraces.value
             t0 = time.perf_counter()
             s.query(sql)
             warm.append(time.perf_counter() - t0)
+            warm_rounds = metrics.shuffle_rounds.value - r0
+            warm_compiles += metrics.xla_retraces.value - c0
         w = min(warm)
         total_warm += w
         results[name] = round(w * 1e3, 2)
-        print(f"{name:>4}: first {first * 1e3:8.1f} ms   warm {w * 1e3:8.1f} ms")
+        if args.json:
+            print(json.dumps({
+                "query": name,
+                "first_ms": round(first * 1e3, 2),
+                "warm_ms": round(w * 1e3, 2),
+                "shuffle_rounds": warm_rounds,
+                "first_compiles": first_compiles,
+                "warm_compiles": warm_compiles,
+            }))
+        else:
+            print(f"{name:>4}: first {first * 1e3:8.1f} ms   "
+                  f"warm {w * 1e3:8.1f} ms")
     print(json.dumps({"metric": f"tpch-22 warm total (SF{args.scale}, "
                                 f"{platform}, mesh={args.mesh or 1})",
                       "value": round(total_warm * 1e3, 1), "unit": "ms",
-                      "per_query_ms": results}))
+                      "per_query_ms": results,
+                      "multiway_joins_fused":
+                          metrics.multiway_joins_fused.value,
+                      "shuffle_overflow_retries":
+                          metrics.shuffle_overflow_retries.value}))
 
 
 if __name__ == "__main__":

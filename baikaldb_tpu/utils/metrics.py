@@ -260,6 +260,19 @@ dispatch_fallbacks = Counter("dispatch_fallbacks")
 # typed admission rejections: qos token buckets (per-sign/user/table) and
 # the dispatcher's bounded per-group queue
 qos_rejections = Counter("qos_rejections")
+# MPP exchange v2 (plan/distribute.py + exec/executor.py): hash-repartition
+# exchange rounds executed (a fused multiway join counts ONE round however
+# many inputs it repartitions — the headline the fusion reduces), retries
+# forced by a per-destination shuffle capacity overflow (skew), and join
+# chains folded into a MultiJoinNode at plan time
+shuffle_rounds = Counter("shuffle_rounds")
+shuffle_overflow_retries = Counter("shuffle_overflow_retries")
+multiway_joins_fused = Counter("multiway_joins_fused")
+# cardinality-adaptive partial aggregation decisions (plan time, from the
+# index/stats ndv estimate): local = pre-reduce before the exchange,
+# raw = shuffle raw rows and aggregate once
+agg_strategy_local = Counter("agg_strategy_local")
+agg_strategy_raw = Counter("agg_strategy_raw")
 
 
 def count_swallowed(site: str) -> None:

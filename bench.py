@@ -723,6 +723,153 @@ def run_concurrency_bench() -> dict:
     }
 
 
+def run_multiway_bench() -> dict:
+    """3-table shared-key join at scale, chained-binary vs fused multiway
+    exchange (MPP exchange v2): same SQL, same mesh, the only difference is
+    FLAGS.multiway_join — off pays one build/probe + shuffle round per
+    binary join (the intermediate result re-shuffles), on repartitions
+    every input ONCE and probes all build sides in a single fused pass.
+    Reports warm wall-clock both ways, shuffle rounds per execution
+    (counted, not inferred), and compile counts.
+
+    Runs on a mesh (the caller arranges >= 2 devices); the fact table has
+    BENCH_MULTIWAY_ROWS rows (default 4M), each dim BENCH_MULTIWAY_ROWS/4
+    unique keys, so the join output stays linear in the fact size."""
+    import pyarrow as pa
+
+    import baikaldb_tpu.plan.distribute  # noqa: F401 — defines the flag
+    from baikaldb_tpu.exec.session import Session
+    from baikaldb_tpu.parallel.mesh import make_mesh
+    from baikaldb_tpu.utils import metrics
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    import jax
+
+    n_rows = int(os.environ.get("BENCH_MULTIWAY_ROWS", 4_000_000))
+    repeats = int(os.environ.get("BENCH_MULTIWAY_REPEATS", 2))
+    n_dim = max(16, n_rows // 4)
+    platform = jax.devices()[0].platform
+    mesh = make_mesh()
+    n_dev = int(mesh.devices.size)
+
+    rng = np.random.default_rng(11)
+    s = Session(mesh=mesh)
+    s.execute("CREATE TABLE fact (id BIGINT, k BIGINT, val DOUBLE)")
+    s.load_arrow("fact", pa.table({
+        "id": np.arange(n_rows, dtype=np.int64),
+        "k": rng.integers(0, n_dim, n_rows).astype(np.int64),
+        "val": rng.normal(size=n_rows).astype(np.float64)}))
+    s.execute("CREATE TABLE d1 (k BIGINT, w DOUBLE)")
+    s.load_arrow("d1", pa.table({
+        "k": np.arange(n_dim, dtype=np.int64),
+        "w": rng.normal(size=n_dim).astype(np.float64)}))
+    s.execute("CREATE TABLE d2 (k BIGINT, u DOUBLE)")
+    s.load_arrow("d2", pa.table({
+        "k": np.arange(n_dim, dtype=np.int64),
+        "u": rng.normal(size=n_dim).astype(np.float64)}))
+
+    sql = ("SELECT SUM(f.val * d1.w + d2.u) s3 FROM fact f "
+           "JOIN d1 ON f.k = d1.k JOIN d2 ON f.k = d2.k")
+
+    import baikaldb_tpu.plan.distribute as dist_mod
+
+    prev = bool(FLAGS.multiway_join)
+    prev_bcast = dist_mod.BROADCAST_ROWS
+    # the exchange is what this line measures: force the repartition path
+    # at every BENCH_MULTIWAY_ROWS scale (at the 4M default the dims exceed
+    # the broadcast threshold anyway)
+    dist_mod.BROADCAST_ROWS = 0
+    out: dict = {}
+    try:
+        for label, on in (("chained", False), ("multiway", True)):
+            set_flag("multiway_join", on)
+            c0 = metrics.xla_retraces.value
+            t0 = time.perf_counter()
+            first_res = s.query(sql)
+            first = time.perf_counter() - t0
+            compiles = metrics.xla_retraces.value - c0
+            warm, rounds = [], 0
+            for _ in range(repeats):
+                r0 = metrics.shuffle_rounds.value
+                t0 = time.perf_counter()
+                res = s.query(sql)
+                warm.append(time.perf_counter() - t0)
+                rounds = metrics.shuffle_rounds.value - r0
+            out[label] = {
+                "warm_ms": round(min(warm) * 1e3, 1),
+                "first_ms": round(first * 1e3, 1),
+                "shuffle_rounds": rounds,
+                "compiles": compiles,
+                "result": round(float(first_res[0]["s3"]), 3),
+            }
+            # a different SQL text per flag value is NOT what we measure:
+            # drop the cached plans so each arm plans + compiles its own
+            s._plan_cache.clear()
+    finally:
+        set_flag("multiway_join", prev)
+        dist_mod.BROADCAST_ROWS = prev_bcast
+    assert out["chained"]["result"] == out["multiway"]["result"], \
+        "multiway result diverged from chained"
+    speedup = out["chained"]["warm_ms"] / max(out["multiway"]["warm_ms"],
+                                              1e-9)
+    return {
+        "metric": f"3-table shared-key join, multiway vs chained exchange "
+                  f"({n_rows / 1e6:.1f}M rows, {platform}, mesh={n_dev})",
+        "value": out["multiway"]["warm_ms"],
+        "unit": "ms",
+        "vs_baseline": round(speedup, 3),
+        "platform": platform,
+        "rows": n_rows,
+        "mesh": n_dev,
+        "chained": out["chained"],
+        "multiway": out["multiway"],
+        "shuffle_rounds_saved":
+            out["chained"]["shuffle_rounds"] - out["multiway"]["shuffle_rounds"],
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
+def _emit_multiway_line(skip_reason: str | None = None):
+    """Seventh JSON line: chained-binary vs fused multiway exchange on a
+    3-table shared-key join (MPP exchange v2).  Runs in a SUBPROCESS
+    pinned to an 8-virtual-device CPU mesh — the multi-device platform
+    config must be fixed before jax initializes, and the parent process
+    may already hold a single-device backend.  Same robustness contract:
+    always prints a line, never raises."""
+    if os.environ.get("BENCH_SKIP_MULTIWAY") == "1":
+        return
+    fail = {"metric": "3-table shared-key join, multiway vs chained "
+                      "exchange (failed)",
+            "value": 0, "unit": "ms", "vs_baseline": 0.0,
+            "platform": "none"}
+    if skip_reason is not None:
+        fail["metric"] = fail["metric"].replace("(failed)", "(skipped)")
+        fail["error"] = skip_reason
+        print(json.dumps(fail))
+        return
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import json, bench; "
+             "print(json.dumps(bench.run_multiway_bench()))"],
+            capture_output=True, text=True, cwd=_REPO, env=env,
+            timeout=float(os.environ.get("BENCH_MULTIWAY_TIMEOUT", 1800)))
+        lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+        print(lines[-1] if lines and r.returncode == 0 else json.dumps({
+            **fail, "error": (r.stderr or "no output").strip()[-400:]}))
+    except Exception as e:                              # noqa: BLE001
+        fail["error"] = f"{type(e).__name__}: {e}"
+        print(json.dumps(fail))
+
+
 def _emit_concurrency_line(skip_reason: str | None = None):
     """Sixth JSON line: the concurrent-clients scaling curve (cross-query
     batched dispatch).  Same robustness contract: always prints a line,
@@ -865,6 +1012,7 @@ def main():
                                  "chaos phase skipped")
                 _emit_concurrency_line(skip_reason="accelerator probe "
                                        "failed; concurrency phase skipped")
+                _emit_multiway_line()   # cpu-subprocess: safe when wedged
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -903,6 +1051,7 @@ def main():
             _emit_trace_line()
             _emit_chaos_line()
             _emit_concurrency_line()
+            _emit_multiway_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
@@ -910,6 +1059,7 @@ def main():
     _emit_trace_line()
     _emit_chaos_line()
     _emit_concurrency_line()
+    _emit_multiway_line()
     return 0
 
 
