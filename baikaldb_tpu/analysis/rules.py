@@ -161,6 +161,56 @@ class _SpanInJit(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# metric mutation entry points (utils/metrics.py) that must stay host-side:
+# inside a jit trace an ``add``/``observe`` fires at TRACE time — the count
+# bakes into nothing and moves once per compile, not once per execution —
+# or captures tracers if fed a device value.  The one sanctioned exception
+# is a counter that deliberately counts TRACES (exec/executor.py run_local's
+# xla_retraces), which lives in the suppression registry.
+_METRIC_METHODS = frozenset({"add", "observe"})
+
+
+def _is_metric_call(mi: ModuleIndex, node: ast.Call) -> bool:
+    path = mi.resolve(node.func)
+    if path is not None and "." in path:
+        head, _, last = path.rpartition(".")
+        h = head.lower()
+        if last == "count_swallowed" and "metrics" in h:
+            return True
+        if last in _METRIC_METHODS and "metrics" in h:
+            return True
+    # REGISTRY.counter("x").add(1): an add/observe on a registry-getter
+    # call result — the getter resolves even though the receiver is a
+    # transient value
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _METRIC_METHODS \
+            and isinstance(func.value, ast.Call):
+        inner = mi.resolve(func.value.func)
+        if inner is not None and "metrics" in inner.lower():
+            return True
+    return False
+
+
+class _MetricInJit(ast.NodeVisitor):
+    """METRICINJIT: registry increments/observes inside traced scope (hot
+    modules / jit-decorated functions) — the SPANINJIT discipline applied
+    to metrics: counts fire per TRACE, not per execution (bake), or leak
+    tracers into host state.  Count at the dispatch layer instead."""
+
+    def __init__(self, mi: ModuleIndex, report):
+        self.mi = mi
+        self.report = report
+
+    def visit_Call(self, node):
+        if _is_metric_call(self.mi, node):
+            self.report("METRICINJIT", node,
+                        "metric increment/observe inside jit-traced scope: "
+                        "it fires at trace time (counting compiles, not "
+                        "executions) or captures tracers — count at the "
+                        "dispatch layer around the jitted call")
+        self.generic_visit(node)
+
+
 def _is_failpoint_hit(path: str | None) -> bool:
     if path is None or "." not in path:
         return False
@@ -305,6 +355,7 @@ def lint_tree(tree: ast.AST, hot_module: bool, report) -> None:
                     # nested defs inherit traced-ness (compile_plan's
                     # run_local pattern), so the whole subtree is checked
                     _SpanInJit(mi, report).visit(node)
+                    _MetricInJit(mi, report).visit(node)
             elif isinstance(node, ast.ClassDef):
                 walk_defs(node.body, True)
 

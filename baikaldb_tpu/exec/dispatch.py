@@ -367,9 +367,20 @@ class BatchDispatcher:
                 traces_before = raw.trace_count[0]
                 (gdatas, gvalids, ns_dev), flags = fn(table_batches, stacked)
                 if raw.trace_count[0] > traces_before:
-                    metrics.compile_ms.observe(
-                        (time.perf_counter() - t0) * 1e3)
+                    cms = (time.perf_counter() - t0) * 1e3
+                    metrics.compile_ms.observe(cms)
                     sp.set(compiled=True)
+                    # device accounting: a batched executable is its own
+                    # compile (vmapped over the padded group) — record
+                    # under kind="batched" with the group size in the
+                    # shape so fleet dashboards see the fork-out
+                    from ..utils import compilecache
+                    if compilecache.EXECUTABLES.enabled():
+                        compilecache.EXECUTABLES.record_compile(
+                            "batched",
+                            str(entry.get("text") or "<unnamed>"),
+                            entry.get("plan_sig"), f"group={gpad}", cms,
+                            fn, (table_batches, stacked))
                 grew = False
                 # ONE fused transfer for every lane of every overflow flag
                 host_flags = jax.device_get(flags)

@@ -50,6 +50,14 @@ class ExecError(RuntimeError):
 from ..utils import metrics  # noqa: E402
 from ..utils.flags import FLAGS, define  # noqa: E402
 
+import threading  # noqa: E402
+
+# set (thread-locally) by utils/compilecache._analyze while it AOT
+# re-lowers a cached executable for cost accounting: jax traces on the
+# calling thread, and that bookkeeping trace must not count as plan-cache
+# churn in trace_count / metrics.xla_retraces
+ACCOUNTING_TRACE = threading.local()
+
 define("radix_join_buckets", 0,
        "hash-partition sort-join builds into this many buckets (power of "
        "two; 0 = off): batched per-bucket sorts replace the one global "
@@ -97,8 +105,9 @@ def compile_plan(plan: PlanNode, trace: bool = False, mesh=None) -> Callable:
     trace_count = [0]
 
     def run_local(batches: dict):
-        trace_count[0] += 1
-        metrics.xla_retraces.add(1)
+        if not getattr(ACCOUNTING_TRACE, "active", False):
+            trace_count[0] += 1
+            metrics.xla_retraces.add(1)
         overflows: list = []
         counts: list = []
         trace_order.clear()

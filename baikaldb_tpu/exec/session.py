@@ -49,7 +49,7 @@ from ..types import Field, LType, Schema
 from ..analysis.runtime import guard_stats, hot_path_guard
 from ..obs import trace
 from ..obs.trace import TRACER
-from ..utils import metrics
+from ..utils import compilecache, metrics
 from ..utils.flags import FLAGS, define
 
 define("cold_fs_dir", "",
@@ -312,6 +312,21 @@ class Database:
             raise ValueError("data_dir cannot combine with fleet/cluster "
                              "mode: durability lives in the replicated tier")
         self.stores: dict[str, TableStore] = {}
+        # fleet telemetry plane (obs/telemetry.py): registered daemon
+        # addresses polled into information_schema.cluster_metrics /
+        # SHOW STATUS cluster.* rows; cheap until daemons register (no
+        # thread, no RPC) — device HBM gauges install into REGISTRY here
+        from ..obs.telemetry import Telemetry
+        self.telemetry = Telemetry()
+        if cluster is not None:
+            # three-binary deployment: meta + its registered stores join
+            # the scrape set automatically (instances refresh per poll)
+            self.telemetry.attach_meta(
+                f"{cluster.meta.host}:{cluster.meta.port}")
+            # real TCP daemons: scrape in the background (telemetry_poll_s)
+            # so cluster_metrics / SHOW STATUS read a warm cache instead of
+            # paying a serial fleet RPC round inline per query
+            self.telemetry.start()
         # query statistics ring (reference: slow-SQL collection + print_agg_sql,
         # network_server.h:82-107) — feeds information_schema.query_log
         self.query_log = deque(maxlen=1000)
@@ -359,6 +374,13 @@ class Database:
             self._recover()
         else:
             self.binlog = Binlog()
+
+    def close(self) -> None:
+        """Stop this Database's background machinery — today the fleet
+        telemetry poller (auto-started in cluster mode), whose scrape RPCs
+        would otherwise outlive a discarded Database, paying timeouts
+        against dead daemon addresses forever.  Idempotent."""
+        self.telemetry.stop()
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]
@@ -1474,6 +1496,11 @@ class Session:
                 for name, st in metrics.REGISTRY.expose().items():
                     for k, v in st.items():
                         vals[f"{name}.{k}"] = str(v)
+                # fleet extension: merged cluster counters/histograms plus
+                # per-daemon liveness as cluster.* rows (only when daemons
+                # are registered — a standalone frontend adds nothing)
+                if self.db.telemetry.has_daemons():
+                    vals.update(self.db.telemetry.status_rows())
             items = sorted(vals.items())
             if s.pattern is not None:
                 items = [(k, v) for k, v in items if like(k, s.pattern)]
@@ -3670,6 +3697,18 @@ class Session:
         trace.event("xla", retraces_total=metrics.xla_retraces.value,
                     compiles=cstats["count"],
                     compile_avg_ms=cstats["avg_ms"])
+        # device-resource accounting for THIS plan's executable (same rows
+        # as information_schema.executables): what the program costs the
+        # accelerator, not just how long the host waited
+        if compilecache.EXECUTABLES.enabled():
+            dev = compilecache.EXECUTABLES.find(
+                plan_sig=entry.get("plan_sig"))
+            if dev is not None:
+                trace.event("device", compile_ms=dev["last_compile_ms"],
+                            flops=dev["flops"],
+                            bytes_accessed=dev["bytes_accessed"],
+                            peak_hbm_bytes=dev["peak_hbm_bytes"],
+                            source=dev["mem_source"])
         # literal auto-parameterization: how many literals the normalizer
         # hoists into runtime params vs pins into the cache key for this
         # statement (plan/paramize.py; pinned = shape/trace-time feeders)
@@ -3748,6 +3787,13 @@ class Session:
             lines.append(f"-- xla: retraces_total={a['retraces_total']} "
                          f"compiles={a['compiles']} "
                          f"compile_avg_ms={a['compile_avg_ms']}")
+        for s in find("device"):
+            a = s["attrs"]
+            lines.append(f"-- device: compile_ms={a['compile_ms']} "
+                         f"flops={a['flops']:.0f} "
+                         f"bytes={a['bytes_accessed']:.0f} "
+                         f"peak_hbm={a['peak_hbm_bytes']:.0f} "
+                         f"mem_source={a['source']}")
         for s in find("params"):
             a = s["attrs"]
             lines.append(f"-- params: hoisted={a['hoisted']} "
@@ -4275,6 +4321,48 @@ class Session:
                 "field": [r[1] for r in rows],
                 "value": pa.array([r[2] for r in rows], pa.float64()),
             }) if rows else _empty_info("metrics")
+        if name == "cluster_metrics":
+            # the fleet telemetry plane: this frontend's registry plus
+            # every registered daemon's last rpc_metrics snapshot, merged
+            # under daemon='fleet' (counters sum, histograms bucket-wise);
+            # a daemon whose scrape failed keeps its last rows, stale=1
+            rows = self.db.telemetry.cluster_rows()
+            return pa.table({
+                "daemon": [r[0] for r in rows],
+                "metric": [r[1] for r in rows],
+                "labels": [r[2] for r in rows],
+                "field": [r[3] for r in rows],
+                "value": pa.array([r[4] for r in rows], pa.float64()),
+                "stale": pa.array([int(r[5]) for r in rows], pa.int64()),
+                "age_ms": pa.array([round(float(r[6]), 3) for r in rows],
+                                   pa.float64()),
+            }) if rows else _empty_info("cluster_metrics")
+        if name == "executables":
+            # device-resource accounting: what each cached executable costs
+            # the accelerator (cost/memory analysis fills lazily here)
+            ex = compilecache.EXECUTABLES.rows()
+            return pa.table({
+                "statement": [r["statement"] for r in ex],
+                "kind": [r["kind"] for r in ex],
+                "plan_sig": [r["plan_sig"] for r in ex],
+                "shape": [r["shape"] for r in ex],
+                "compiles": pa.array([r["compiles"] for r in ex],
+                                     pa.int64()),
+                "compile_ms_total": pa.array(
+                    [r["compile_ms_total"] for r in ex], pa.float64()),
+                "last_compile_ms": pa.array(
+                    [r["last_compile_ms"] for r in ex], pa.float64()),
+                "flops": pa.array([r["flops"] for r in ex], pa.float64()),
+                "bytes_accessed": pa.array(
+                    [r["bytes_accessed"] for r in ex], pa.float64()),
+                "peak_hbm_bytes": pa.array(
+                    [r["peak_hbm_bytes"] for r in ex], pa.float64()),
+                "argument_bytes": pa.array(
+                    [r["argument_bytes"] for r in ex], pa.float64()),
+                "output_bytes": pa.array(
+                    [r["output_bytes"] for r in ex], pa.float64()),
+                "mem_source": [r["mem_source"] for r in ex],
+            }) if ex else _empty_info("executables")
         if name == "flags":
             rows = FLAGS.describe()
             return pa.table({
@@ -4388,9 +4476,21 @@ class Session:
                     # bucket crossing / overflow retry): record it so
                     # first-run vs steady-state shows up in SHOW metrics
                     # and the trace vs execute split shows in the span
-                    metrics.compile_ms.observe(
-                        (time.perf_counter() - t0) * 1e3)
+                    cms = (time.perf_counter() - t0) * 1e3
+                    metrics.compile_ms.observe(cms)
                     sp.set(compiled=True)
+                    # device-resource accounting (compile seam): the cost/
+                    # memory analysis itself is LAZY — only the identity,
+                    # wall-ms, and the arg shape skeleton record here
+                    if compilecache.EXECUTABLES.enabled():
+                        sig = entry.get("plan_sig")
+                        if sig is None:
+                            sig = entry["plan_sig"] = plan_signature(plan)
+                        compilecache.EXECUTABLES.record_compile(
+                            "plan", entry.get("text") or "<unnamed>", sig,
+                            ";".join(f"{tk}={cap}"
+                                     for tk, cap in shape_key[0]),
+                            cms, fn, (batches,))
             grew = False
             # ONE explicit transfer for every overflow flag: int(flag) per
             # join would block on a device round-trip once per node

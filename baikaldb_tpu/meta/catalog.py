@@ -246,6 +246,35 @@ class Catalog:
                               Field("name", LType.STRING),
                               Field("value", LType.FLOAT64),
                               Field("detail", LType.STRING))),
+        # fleet telemetry plane (obs/telemetry.py): per-daemon metric rows
+        # merged by the frontend poller — counters sum, histograms sum
+        # bucket-wise under daemon='fleet'; gauges/latency stay per-daemon.
+        # stale=1 marks a daemon whose last scrape failed (rows are its
+        # last-known snapshot, age_ms how old)
+        "cluster_metrics": Schema((Field("daemon", LType.STRING),
+                                   Field("metric", LType.STRING),
+                                   Field("labels", LType.STRING),
+                                   Field("field", LType.STRING),
+                                   Field("value", LType.FLOAT64),
+                                   Field("stale", LType.INT64),
+                                   Field("age_ms", LType.FLOAT64))),
+        # device-resource accounting (utils/compilecache.EXECUTABLES): one
+        # row per compiled executable — compile wall-ms at the seam plus
+        # lazy XLA cost/memory analysis (FLOPs, bytes accessed, peak HBM;
+        # mem_source xla|estimate|evicted|error)
+        "executables": Schema((Field("statement", LType.STRING),
+                               Field("kind", LType.STRING),
+                               Field("plan_sig", LType.STRING),
+                               Field("shape", LType.STRING),
+                               Field("compiles", LType.INT64),
+                               Field("compile_ms_total", LType.FLOAT64),
+                               Field("last_compile_ms", LType.FLOAT64),
+                               Field("flops", LType.FLOAT64),
+                               Field("bytes_accessed", LType.FLOAT64),
+                               Field("peak_hbm_bytes", LType.FLOAT64),
+                               Field("argument_bytes", LType.FLOAT64),
+                               Field("output_bytes", LType.FLOAT64),
+                               Field("mem_source", LType.STRING))),
         # per-column collected statistics (index/stats): the distinct-count
         # estimate feeding the adaptive-agg decision, plus histogram/MCV
         # collection state — the reference's statistics.proto surface

@@ -16,11 +16,13 @@ import threading
 import time
 
 from ..meta.service import HeartbeatRequest, MetaService
+from ..utils.metrics import Registry
 from ..utils.net import RpcServer
 
 
 class MetaServer:
     def __init__(self, address: str, peer_count: int = 3):
+        self.address = address
         host, port = address.rsplit(":", 1)
         self.rpc = RpcServer(host, int(port))
         self.service = MetaService(peer_count=peer_count)
@@ -28,8 +30,25 @@ class MetaServer:
         self._mu = threading.Lock()
         for name in ("register_store", "create_regions", "table_regions",
                      "drop_regions", "heartbeat", "tso", "instances", "ping",
-                     "split_region_key", "merge_regions_key", "alloc_ids"):
+                     "split_region_key", "merge_regions_key", "alloc_ids",
+                     "metrics", "prometheus"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
+        # daemon-scoped registry (see StoreServer): handler latency via the
+        # RpcServer hook, topology gauges sampled live at scrape time
+        self.metrics = Registry()
+        self.rpc.attach_metrics(self.metrics)
+        self._started = time.time()
+        self.metrics.gauge("uptime_s", fn=lambda: time.time() - self._started)
+        self.metrics.gauge("meta_instances",
+                           fn=lambda: len(self.service.instances))
+        self.metrics.gauge("meta_regions",
+                           fn=lambda: len(self.service.regions))
+        self.metrics.gauge(
+            "meta_instances_faulty",
+            fn=lambda: sum(1 for i in self.service.instances.values()
+                           if i.status != "NORMAL"))
+        self._c_heartbeats = self.metrics.counter("meta_heartbeats")
+        self._c_orders = self.metrics.counter("meta_balance_orders")
 
     def start(self) -> None:
         self.rpc.start()
@@ -40,6 +59,18 @@ class MetaServer:
     # -- RPC surface ------------------------------------------------------
     def rpc_ping(self):
         return {}
+
+    def rpc_metrics(self):
+        """Telemetry snapshot of the meta daemon (obs/telemetry scrape
+        unit)."""
+        return {"daemon": self.address, "role": "meta", "ts": time.time(),
+                "metrics": self.metrics.snapshot()}
+
+    def rpc_prometheus(self):
+        from ..obs.telemetry import render_prometheus
+        return {"text": render_prometheus(
+            self.metrics.snapshot(),
+            const_labels={"daemon": self.address, "role": "meta"})}
 
     def rpc_register_store(self, address: str, store_id: int):
         with self._mu:
@@ -84,6 +115,9 @@ class MetaServer:
             {int(rid): (int(v), int(n)) for rid, (v, n) in regions.items()},
             [int(x) for x in leader_ids])
         resp = self.service.heartbeat(req)
+        self._c_heartbeats.add(1)
+        if resp.orders:
+            self._c_orders.add(len(resp.orders))
         return {"orders": len(resp.orders)}
 
     def rpc_tso(self, count: int = 1):
@@ -109,9 +143,16 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--address", required=True)
     ap.add_argument("--peer-count", type=int, default=3)
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve Prometheus exposition over HTTP on this "
+                         "port (0 = RPC-plane rpc_prometheus only)")
     args = ap.parse_args()
     srv = MetaServer(args.address, peer_count=args.peer_count)
     srv.start()
+    if args.metrics_port:
+        from ..obs.telemetry import start_http_exporter
+        start_http_exporter(lambda: srv.rpc_prometheus()["text"],
+                            args.metrics_port)
     print(f"meta serving on {srv.rpc.host}:{srv.rpc.port}", flush=True)
     try:
         while True:

@@ -144,6 +144,7 @@ class MySQLServer:
                 self._listener.close()
             except OSError:
                 pass
+        self.db.close()   # background telemetry poller dies with the server
 
     def _accept_loop(self):
         while not self._stop.is_set():

@@ -26,6 +26,7 @@ from ..chaos import failpoint
 from ..raft.cluster import ReplicatedRegion
 from ..raft.core import LEADER
 from ..types import Field, LType, Schema
+from ..utils.metrics import Registry
 from ..utils.net import RpcClient, RpcServer, handler_deadline_s
 
 
@@ -55,11 +56,55 @@ class StoreServer:
         self._stop = threading.Event()
         for name in ("create_region", "drop_region", "raft_msg", "propose",
                      "scan_raw", "region_status", "region_size", "ping",
-                     "txn_status", "cold_manifest", "exec_fragment"):
+                     "txn_status", "cold_manifest", "exec_fragment",
+                     "metrics", "prometheus"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
         # the failpoint `panic` action crashes THIS daemon, not just the
         # serving thread (the chaos harness's kill-9 analog)
         self.rpc.on_panic = self.crash
+        # daemon-SCOPED metrics registry (the telemetry plane's unit of
+        # aggregation): several in-process StoreServers must never share
+        # rows, so this is NOT utils.metrics.REGISTRY.  The frontend polls
+        # it through rpc_metrics; raft/region gauges refresh per scrape.
+        self.metrics = Registry()
+        self.rpc.attach_metrics(self.metrics)
+        self._started = time.time()
+        self.metrics.gauge("uptime_s", fn=lambda: time.time() - self._started)
+        self.metrics.gauge("regions_hosted", fn=lambda: len(self.regions))
+        self._c_proposals = self.metrics.counter("raft_proposals")
+        self._c_redirects = self.metrics.counter("raft_not_leader")
+        region_labels = ("region",)
+        self._region_gauges = {
+            # 1 when this replica leads the region (sum over the fleet per
+            # region should be exactly 1 — a cheap split-brain dashboard)
+            "raft_leader": self.metrics.gauge_family("raft_leader",
+                                                     region_labels),
+            "raft_term": self.metrics.gauge_family("raft_term",
+                                                   region_labels),
+            "raft_commit_index": self.metrics.gauge_family(
+                "raft_commit_index", region_labels),
+            "raft_applied_index": self.metrics.gauge_family(
+                "raft_applied_index", region_labels),
+            # commit-vs-applied lag: committed entries the apply loop has
+            # not executed yet (a stuck tick loop shows here first)
+            "raft_apply_lag": self.metrics.gauge_family(
+                "raft_apply_lag", region_labels),
+            # proposal queue depth: appended-but-uncommitted suffix on the
+            # leader (quorum backpressure)
+            "raft_proposal_queue": self.metrics.gauge_family(
+                "raft_proposal_queue", region_labels),
+            # rows = keys whose newest version is live (the visible row
+            # count); keys_total additionally counts tombstoned keys — the
+            # gap between the two is GC/compaction debt
+            "region_rows": self.metrics.gauge_family("region_rows",
+                                                     region_labels),
+            "region_keys_total": self.metrics.gauge_family(
+                "region_keys_total", region_labels),
+            "region_cold_segments": self.metrics.gauge_family(
+                "region_cold_segments", region_labels),
+            "region_prepared_txns": self.metrics.gauge_family(
+                "region_prepared_txns", region_labels),
+        }
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> None:
@@ -87,6 +132,66 @@ class StoreServer:
     # -- RPC surface ------------------------------------------------------
     def rpc_ping(self):
         return {"store_id": self.store_id}
+
+    # -- telemetry plane --------------------------------------------------
+    def _refresh_region_gauges(self) -> None:
+        """Re-sample per-region raft/size gauges from live core state;
+        called under ``self._mu`` (every read below touches the raft core
+        or the replicated table)."""
+        seen: set[str] = set()
+        g = self._region_gauges
+        for rid, region in self.regions.items():
+            lab = str(rid)
+            seen.add(lab)
+            core = region.core
+            commit = core.commit_index
+            g["raft_leader"].labels(region=lab).set(
+                1.0 if core.role == LEADER else 0.0)
+            g["raft_term"].labels(region=lab).set(core.term)
+            g["raft_commit_index"].labels(region=lab).set(commit)
+            g["raft_applied_index"].labels(region=lab).set(
+                region.applied_index)
+            g["raft_apply_lag"].labels(region=lab).set(
+                max(0, commit - region.applied_index))
+            g["raft_proposal_queue"].labels(region=lab).set(
+                max(0, core.last_index - commit))
+            # num_live_keys/num_keys are O(1) in the C lib; a materializing
+            # scan_raw() here would copy every key/value byte per scrape
+            # while holding self._mu
+            g["region_rows"].labels(region=lab).set(
+                region.table.num_live_keys())
+            g["region_keys_total"].labels(region=lab).set(
+                region.table.num_keys())
+            g["region_cold_segments"].labels(region=lab).set(
+                len(region.cold_manifest))
+            g["region_prepared_txns"].labels(region=lab).set(
+                len(region.prepared))
+        for fam in g.values():
+            for key, _child in fam.rows():
+                if key[0] not in seen:      # dropped/migrated region: the
+                    fam.remove(region=key[0])   # row must not linger
+
+    def rpc_metrics(self):
+        """One telemetry snapshot of THIS daemon — the scrape unit the
+        frontend's obs/telemetry poller merges into
+        information_schema.cluster_metrics.  Gauges refresh under the core
+        lock; serialization happens outside it."""
+        with self._mu:
+            self._refresh_region_gauges()
+        return {"daemon": self.address, "role": "store",
+                "store_id": self.store_id, "ts": time.time(),
+                "metrics": self.metrics.snapshot()}
+
+    def rpc_prometheus(self):
+        """Prometheus text exposition of this daemon's registry, served
+        in-band on the RPC plane (tools/metrics_export.py bridges it to a
+        real HTTP scrape endpoint)."""
+        from ..obs.telemetry import render_prometheus
+        with self._mu:
+            self._refresh_region_gauges()
+        return {"text": render_prometheus(
+            self.metrics.snapshot(),
+            const_labels={"daemon": self.address, "role": "store"})}
 
     def rpc_create_region(self, region_id: int, peers: list, fields: list,
                           key_columns: list):
@@ -146,8 +251,10 @@ class StoreServer:
         budget = handler_deadline_s()
         if budget is not None:
             wait_s = min(float(wait_s), budget)
+        self._c_proposals.add(1)
         with self._mu:
             if region.core.role != LEADER:
+                self._c_redirects.add(1)
                 return {"status": "not_leader",
                         "leader": int(region.core.leader)}
             # stale-routed writes (a frontend whose cached ranges predate a
@@ -384,10 +491,17 @@ def main() -> None:
     ap.add_argument("--address", required=True)
     ap.add_argument("--meta", default="")
     ap.add_argument("--tick", type=float, default=0.05)
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="serve Prometheus exposition over HTTP on this "
+                         "port (0 = RPC-plane rpc_prometheus only)")
     args = ap.parse_args()
     srv = StoreServer(args.store_id, args.address, args.meta,
                       tick_interval=args.tick)
     srv.start()
+    if args.metrics_port:
+        from ..obs.telemetry import start_http_exporter
+        start_http_exporter(lambda: srv.rpc_prometheus()["text"],
+                            args.metrics_port)
     print(f"store {args.store_id} serving on {srv.rpc.host}:{srv.rpc.port}",
           flush=True)
     try:

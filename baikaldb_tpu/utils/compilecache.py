@@ -1,4 +1,5 @@
-"""Shared persistent XLA compilation-cache location.
+"""Shared persistent XLA compilation-cache location + the per-executable
+device-resource accounting registry.
 
 The driver's multichip dryrun and the test suite compile the same
 cpu/8-device programs; both enable this one cache so the suite warms what the
@@ -7,9 +8,35 @@ the driver budget — its cost is almost entirely cold XLA compiles).
 
 One definition only: the cache directory and thresholds must stay identical
 between the warmers and the consumer or the sharing silently stops working.
+
+Device-resource accounting (the telemetry plane's "what does an executable
+COST" half): every compile seam (exec/session.py ``_run_plan``,
+exec/dispatch.py ``_combine``) records its executable here — statement,
+plan signature, data shape, compile wall-ms — and the expensive XLA
+``cost_analysis()`` / ``memory_analysis()`` numbers (FLOPs, bytes accessed,
+argument/output/temp HBM) are filled LAZILY, only when
+``information_schema.executables`` or EXPLAIN ANALYZE's ``-- device:`` line
+asks, then memoized.  Lazy because the AOT re-lower that produces them is
+not free; it must never tax the hot path that merely executes.
+
+The re-lower traces the plan function once more, which would corrupt the
+retrace telemetry the bucketing tests pin (``metrics.xla_retraces``, the
+per-plan ``trace_count``) — so the analysis pass flags itself thread-locally
+(``executor.ACCOUNTING_TRACE``) and ``run_local`` skips both counters for
+that trace.  Executables are referenced through weakrefs:
+an entry whose executable the plan cache evicted reports its recorded
+compile stats but no fresh analysis (``analyzed='evicted'``).
 """
 
+from __future__ import annotations
+
 import os
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Optional
+
+from .flags import FLAGS, define
 
 REPO_DIR = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -22,3 +49,219 @@ def enable() -> None:
     jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+define("device_accounting", True,
+       "per-executable device-resource accounting: compile seams record "
+       "(statement, plan signature, shape, compile ms) and "
+       "information_schema.executables / EXPLAIN ANALYZE's '-- device:' "
+       "line add lazy XLA cost/memory analysis (FLOPs, bytes accessed, "
+       "peak HBM).  0 disables recording entirely")
+define("device_accounting_max", 256,
+       "executable-accounting LRU entries (distinct (kind, statement, "
+       "plan signature, shape) tuples)")
+
+
+class _ExecRecord:
+    __slots__ = ("kind", "statement", "plan_sig", "shape", "compiles",
+                 "compile_ms_total", "last_compile_ms", "fn_ref",
+                 "arg_structs", "analysis", "analyzed")
+
+    def __init__(self, kind: str, statement: str, plan_sig, shape: str):
+        self.kind = kind
+        self.statement = statement
+        self.plan_sig = plan_sig
+        self.shape = shape
+        self.compiles = 0
+        self.compile_ms_total = 0.0
+        self.last_compile_ms = 0.0
+        self.fn_ref = None
+        self.arg_structs = None
+        self.analysis: Optional[dict] = None
+        self.analyzed = ""          # "" | "xla" | "estimate" | "evicted"
+                                    # | "error"
+
+
+def _tree_bytes(structs) -> float:
+    import jax
+    total = 0
+    # structs holds ShapeDtypeStructs (host metadata), never live device
+    # arrays — iterating them is plain host work
+    leaves = jax.tree.leaves(structs)
+    for leaf in leaves:  # tpulint: disable=RETRACE
+
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * getattr(dtype, "itemsize", 1)
+    return float(total)
+
+
+class ExecutableAccounting:
+    """Bounded LRU of executable cost records, snapshot-able as rows for
+    ``information_schema.executables``."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # serializes lazy analysis OUTSIDE _mu: a lower+compile is slow and
+        # must not block record() on the compile hot path, but two view
+        # readers analyzing one record concurrently would double-pay the
+        # AOT trace; held per record, not across a whole view read
+        self._an_mu = threading.Lock()
+        self._entries: "OrderedDict[tuple, _ExecRecord]" = OrderedDict()
+
+    def enabled(self) -> bool:
+        return bool(FLAGS.device_accounting)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._entries.clear()
+
+    def record_compile(self, kind: str, statement: str, plan_sig,
+                       shape: str, compile_ms: float, fn,
+                       args: tuple) -> None:
+        """One compile at a seam.  ``fn`` is the jitted callable (weakref'd
+        — the plan cache owns its lifetime), ``args`` the positional
+        example args whose shape/dtype skeleton the lazy analysis lowers
+        against."""
+        if not self.enabled():
+            return
+        import jax
+        key = (kind, statement, plan_sig, shape)
+        structs = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape") and hasattr(x, "dtype") else x, args)
+        with self._mu:
+            rec = self._entries.get(key)
+            if rec is None:
+                rec = self._entries[key] = _ExecRecord(
+                    kind, statement, plan_sig, shape)
+                cap = max(1, int(FLAGS.device_accounting_max))
+                while len(self._entries) > cap:
+                    self._entries.popitem(last=False)
+            else:
+                self._entries.move_to_end(key)
+            rec.compiles += 1
+            rec.compile_ms_total += float(compile_ms)
+            rec.last_compile_ms = float(compile_ms)
+            try:
+                rec.fn_ref = weakref.ref(fn)
+            except TypeError:       # non-weakref-able callable: pin it —
+                rec.fn_ref = (lambda f=fn: f)   # bounded by the LRU cap
+            rec.arg_structs = structs
+            rec.analysis = None     # recompiled: stale numbers must refresh
+            rec.analyzed = ""
+
+    def _analyze(self, rec: _ExecRecord) -> None:
+        """Fill FLOPs / bytes / HBM via one AOT re-lower + compile (served
+        from XLA's in-memory/persistent compile cache when possible).  The
+        re-trace this costs is flagged via ``executor.ACCOUNTING_TRACE`` so
+        it never enters the retrace telemetry — accounting must not look
+        like plan-cache churn."""
+        import jax
+
+        from . import metrics
+        from ..exec import executor
+        fn = rec.fn_ref() if rec.fn_ref is not None else None
+        if fn is None or rec.arg_structs is None:
+            rec.analysis = {}
+            rec.analyzed = "evicted"
+            return
+        # jax traces on THIS thread: flag the re-lower as accounting so
+        # run_local skips trace_count / metrics.xla_retraces entirely —
+        # suppression at the source beats decrementing afterwards (no race
+        # with a concurrent legitimate compile, and the exported counter
+        # stays monotonic for Prometheus rate())
+        executor.ACCOUNTING_TRACE.active = True
+        try:
+            compiled = fn.lower(*rec.arg_structs).compile()
+            out = {}
+            try:
+                ca = compiled.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                out["flops"] = float(ca.get("flops", float("nan")))
+                out["bytes_accessed"] = float(
+                    ca.get("bytes accessed", float("nan")))
+            except Exception:
+                metrics.count_swallowed("device.cost_analysis")
+            arg_est = _tree_bytes(rec.arg_structs)
+            out.setdefault("flops", float("nan"))
+            out.setdefault("bytes_accessed", float("nan"))
+            try:
+                ma = compiled.memory_analysis()
+            except Exception:
+                ma = None
+            if ma is not None and getattr(ma, "argument_size_in_bytes",
+                                          None) is not None:
+                arg_b = float(ma.argument_size_in_bytes)
+                out_b = float(ma.output_size_in_bytes)
+                tmp_b = float(ma.temp_size_in_bytes)
+                out.update(argument_bytes=arg_b, output_bytes=out_b,
+                           temp_bytes=tmp_b,
+                           # the standard XLA live-set peak: args + outputs
+                           # + transient workspace
+                           peak_hbm_bytes=arg_b + out_b + tmp_b,
+                           code_bytes=float(
+                               ma.generated_code_size_in_bytes))
+                rec.analyzed = "xla"
+            else:
+                # backend without memory stats: shape-derived lower bound
+                out_est = _tree_bytes(jax.eval_shape(fn, *rec.arg_structs))
+                out.update(argument_bytes=arg_est, output_bytes=out_est,
+                           temp_bytes=float("nan"),
+                           peak_hbm_bytes=arg_est + out_est,
+                           code_bytes=float("nan"))
+                rec.analyzed = "estimate"
+            rec.analysis = out
+        except Exception:   # noqa: BLE001 — accounting is advisory; the
+            #   view must answer even when a lowering path can't re-run
+            metrics.count_swallowed("device.analyze")
+            rec.analysis = {}
+            rec.analyzed = "error"
+        finally:
+            executor.ACCOUNTING_TRACE.active = False
+
+    def _row(self, rec: _ExecRecord, analyze: bool) -> dict:
+        if analyze and rec.analysis is None:
+            with self._an_mu:
+                if rec.analysis is None:       # lost the race: memoized
+                    self._analyze(rec)
+        a = rec.analysis or {}
+        nan = float("nan")
+        return {
+            "statement": rec.statement, "kind": rec.kind,
+            "plan_sig": str(rec.plan_sig), "shape": rec.shape,
+            "compiles": rec.compiles,
+            "compile_ms_total": round(rec.compile_ms_total, 3),
+            "last_compile_ms": round(rec.last_compile_ms, 3),
+            "flops": a.get("flops", nan),
+            "bytes_accessed": a.get("bytes_accessed", nan),
+            "peak_hbm_bytes": a.get("peak_hbm_bytes", nan),
+            "argument_bytes": a.get("argument_bytes", nan),
+            "output_bytes": a.get("output_bytes", nan),
+            "mem_source": rec.analyzed,
+        }
+
+    def find(self, plan_sig=None) -> Optional[dict]:
+        """Newest row matching ``plan_sig``, analyzed on demand (EXPLAIN
+        ANALYZE's ``-- device:`` feed) — only the match is analyzed, not
+        every pending record."""
+        with self._mu:
+            recs = [r for r in self._entries.values()
+                    if plan_sig is None or str(r.plan_sig) == str(plan_sig)]
+        if not recs:
+            return None
+        return self._row(recs[-1], analyze=True)
+
+    def rows(self, analyze: bool = True) -> list[dict]:
+        with self._mu:
+            recs = list(self._entries.values())
+        return [self._row(rec, analyze) for rec in recs]
+
+
+EXECUTABLES = ExecutableAccounting()

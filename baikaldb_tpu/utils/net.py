@@ -178,9 +178,25 @@ class RpcServer:
         # daemon installs its kill-9 analog (StoreServer.crash); default
         # is stop() — the server goes dark
         self.on_panic: Optional[Callable[[], None]] = None
+        # telemetry-plane instrumentation (attach_metrics): per-method
+        # handler latency histogram + in-flight gauge, recorded into the
+        # OWNING daemon's registry; None = uninstrumented, zero cost
+        self._m_handler = None
+        self._m_inflight = None
 
     def register(self, name: str, fn: Callable) -> None:
         self._handlers[name] = fn
+
+    def attach_metrics(self, registry) -> None:
+        """Record per-method handler telemetry into ``registry`` (a
+        daemon-scoped metrics.Registry): ``rpc_handler_ms`` histogram —
+        the mergeable instrument, so the frontend's fleet aggregator can
+        sum latency distributions across daemons — and ``rpc_inflight``
+        gauge (requests currently executing, the brpc concurrency bvar)."""
+        self._m_handler = registry.histogram_family(
+            "rpc_handler_ms", ("method",))
+        self._m_inflight = registry.gauge_family(
+            "rpc_inflight", ("method",))
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -329,24 +345,38 @@ class RpcServer:
                                        "exhausted before dispatch")
                     return {"ok": True,
                             "result": fn(**req.get("args", {}))}
+                # only KNOWN methods mint metric children: the label value
+                # is client-supplied, and an unknown-method probe must not
+                # grow the registry one Gauge+Histogram row per spelling
+                instrumented = self._m_inflight is not None \
+                    and fn is not None
+                if instrumented:
+                    self._m_inflight.labels(method=method).add(1)
+                t_h = time.perf_counter()
                 try:
-                    if isinstance(wire, dict):
-                        # caller's sampling decision propagates: record
-                        # handler spans under ITS trace and ship them back
-                        # for the frontend tree (obs/trace.py)
-                        with trace.adopt(wire, f"serve.{method}",
-                                         node=self.trace_node) as buf:
+                    try:
+                        if isinstance(wire, dict):
+                            # caller's sampling decision propagates: record
+                            # handler spans under ITS trace and ship them
+                            # back for the frontend tree (obs/trace.py)
+                            with trace.adopt(wire, f"serve.{method}",
+                                             node=self.trace_node) as buf:
+                                resp = run()
+                        else:
                             resp = run()
-                    else:
-                        resp = run()
-                except failpoint.FailpointPanic:
-                    # a panic failpoint fired INSIDE the handler (e.g.
-                    # binlog.append): the daemon crashes, no reply
-                    self._panic()
-                    return
-                except Exception as e:  # noqa: BLE001 — fault isolation per call
-                    resp = {"ok": False,
-                            "error": f"{type(e).__name__}: {e}"}
+                    except failpoint.FailpointPanic:
+                        # a panic failpoint fired INSIDE the handler (e.g.
+                        # binlog.append): the daemon crashes, no reply
+                        self._panic()
+                        return
+                    except Exception as e:  # noqa: BLE001 — fault isolation per call
+                        resp = {"ok": False,
+                                "error": f"{type(e).__name__}: {e}"}
+                finally:
+                    if instrumented:
+                        self._m_inflight.labels(method=method).add(-1)
+                        self._m_handler.labels(method=method).observe(
+                            (time.perf_counter() - t_h) * 1e3)
                 if buf:
                     resp["trace_spans"] = list(buf)
                 if entry is not None:
@@ -418,7 +448,7 @@ class RpcClient:
         "ping", "scan_raw", "txn_status", "region_size", "region_status",
         "instances", "table_regions", "heartbeat", "tso", "raft_msg",
         "drop_region", "drop_regions", "register_store", "cold_manifest",
-        "exec_fragment",
+        "exec_fragment", "metrics", "prometheus",
     })
 
     # Fire-and-forget at the transport: raft IS its own retry protocol

@@ -514,6 +514,110 @@ def run_trace_bench() -> dict:
     }
 
 
+def run_telemetry_bench() -> dict:
+    """Telemetry-plane overhead guard (eighth JSON line): the point-query
+    steady state with the fleet telemetry poller scraping two REAL
+    in-process store daemons in the background, vs no poller.
+
+    The poller runs off the query path (its own thread, RPC + merge work
+    only), so the acceptance contract (docs/OBSERVABILITY.md) pins the
+    steady-state overhead at <= 1%.  Also reports one full fleet scrape
+    round-trip — poll both daemons, merge bucket-wise, render Prometheus
+    text — the latency a dashboard refresh actually pays."""
+    import pyarrow as pa
+
+    from baikaldb_tpu.exec.session import Session
+    from baikaldb_tpu.obs.telemetry import Telemetry
+    from baikaldb_tpu.server.store_server import StoreServer, schema_to_wire
+    from baikaldb_tpu.types import Field, LType, Schema
+    from baikaldb_tpu.utils.net import RpcClient
+
+    n_rows = int(os.environ.get("BENCH_TELEMETRY_ROWS", 100_000))
+    n_q = int(os.environ.get("BENCH_TELEMETRY_QUERIES", 64))
+    poll_s = float(os.environ.get("BENCH_TELEMETRY_POLL_S", 0.05))
+    rng = np.random.default_rng(23)
+    base = pa.table({
+        "id": np.arange(n_rows, dtype=np.int64),
+        "v": rng.normal(size=n_rows).astype(np.float64),
+    })
+    sch = Schema((Field("id", LType.INT64, False),
+                  Field("v", LType.FLOAT64, True)))
+    stores = []
+    for sid in (1, 2):
+        st = StoreServer(sid, "127.0.0.1:0", tick_interval=0.02)
+        st.address = f"127.0.0.1:{st.rpc.port}"
+        st.start()
+        stores.append(st)
+    try:
+        for i, st in enumerate(stores, 1):
+            c = RpcClient(st.address)
+            c.call("create_region", region_id=i,
+                   peers=[[st.store_id, st.address]],
+                   fields=schema_to_wire(sch), key_columns=["id"])
+            c.close()
+
+        def phase(poller_on: bool) -> float:
+            s = Session()
+            s.execute("CREATE TABLE tm (id BIGINT, v DOUBLE)")
+            s.load_arrow("tm", base)
+            tel = s.db.telemetry
+            if poller_on:
+                for st in stores:
+                    tel.register(st.address)
+                tel.start(interval_s=poll_s)
+            s.query("SELECT v FROM tm WHERE id = 0")    # first compile
+            t0 = time.perf_counter()
+            try:
+                for i in range(n_q):
+                    s.query(f"SELECT v FROM tm "
+                            f"WHERE id = {1 + (i * 9173) % n_rows}")
+                return time.perf_counter() - t0
+            finally:
+                if poller_on:
+                    tel.stop()
+
+        off_dt = phase(False)
+        on_dt = phase(True)
+        # one cold fleet scrape round-trip: poll + merge + render
+        tel = Telemetry(device_gauges=False)
+        for st in stores:
+            tel.register(st.address)
+        t0 = time.perf_counter()
+        text = tel.prometheus()
+        scrape_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        for st in stores:
+            st.stop()
+    off_per, on_per = off_dt / n_q, on_dt / n_q
+    platform = None
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:                                   # noqa: BLE001
+        pass
+    return {
+        "metric": f"point-query steady state with telemetry poller on vs "
+                  f"off ({n_rows / 1e3:.0f}k rows, {n_q} queries, 2 store "
+                  f"daemons, {platform})",
+        "value": round(n_q / on_dt, 1),
+        "unit": "queries/sec",
+        # >1 means the poller made queries slower; contract: <= 1.01
+        "vs_baseline": round(on_per / off_per, 3),
+        "overhead_pct": round((on_per / off_per - 1.0) * 100, 2),
+        "platform": platform,
+        "rows": n_rows,
+        "queries": n_q,
+        "poll_interval_s": poll_s,
+        "per_query_ms_poller_on": round(on_per * 1e3, 2),
+        "per_query_ms_poller_off": round(off_per * 1e3, 2),
+        "scrape_roundtrip_ms": round(scrape_ms, 2),
+        "scrape_bytes": len(text),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
 def run_chaos_bench() -> dict:
     """Chaos machinery overhead + a seeded latency-injection run.
 
@@ -894,6 +998,30 @@ def _emit_concurrency_line(skip_reason: str | None = None):
     print(json.dumps(result))
 
 
+def _emit_telemetry_line(skip_reason: str | None = None):
+    """Eighth JSON line: fleet-telemetry poller overhead guard + one
+    scrape round-trip.  Same robustness contract: always prints a line,
+    never raises."""
+    if os.environ.get("BENCH_SKIP_TELEMETRY") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "point-query steady state with telemetry poller on "
+                      "vs off (skipped)",
+            "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+            "platform": "none", "error": skip_reason}))
+        return
+    try:
+        result = run_telemetry_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "point-query steady state with telemetry "
+                            "poller on vs off (failed)",
+                  "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+                  "platform": "none",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_chaos_line(skip_reason: str | None = None):
     """Fifth JSON line: chaos-machinery overhead guard + seeded latency
     injection.  Same robustness contract: always prints a line, never
@@ -1013,6 +1141,8 @@ def main():
                 _emit_concurrency_line(skip_reason="accelerator probe "
                                        "failed; concurrency phase skipped")
                 _emit_multiway_line()   # cpu-subprocess: safe when wedged
+                _emit_telemetry_line(skip_reason="accelerator probe "
+                                     "failed; telemetry phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -1052,6 +1182,7 @@ def main():
             _emit_chaos_line()
             _emit_concurrency_line()
             _emit_multiway_line()
+            _emit_telemetry_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
@@ -1060,6 +1191,7 @@ def main():
     _emit_chaos_line()
     _emit_concurrency_line()
     _emit_multiway_line()
+    _emit_telemetry_line()
     return 0
 
 

@@ -365,6 +365,60 @@ def test_failpointhot_guard_outside_def_does_not_count(tmp_path):
     assert out == [("FAILPOINTHOT", 4)]
 
 
+# ---- METRICINJIT ----------------------------------------------------------
+
+def test_metricinjit_in_hot_module(tmp_path):
+    # hot module: every function counts as traced scope — a counter add
+    # there fires per TRACE, not per execution
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.utils import metrics
+        def f(x):
+            metrics.queries_total.add(1)
+            return x
+        """)
+    assert out == [("METRICINJIT", 3)]
+
+
+def test_metricinjit_jit_decorated_host_module(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax
+        from baikaldb_tpu.utils import metrics
+        @jax.jit
+        def f(x):
+            metrics.query_latency.observe(1.0)
+            metrics.count_swallowed("op.site")
+            return x
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == [("METRICINJIT", 5), ("METRICINJIT", 6)]
+
+
+def test_metricinjit_registry_getter_chain(tmp_path):
+    # REGISTRY.counter("x").add(1): the receiver is a transient call
+    # result, but the getter resolves to the metrics module
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.utils import metrics
+        def f(x):
+            metrics.REGISTRY.counter("dyn").add(1)
+            return x
+        """)
+    assert out == [("METRICINJIT", 3)]
+
+
+def test_metricinjit_dispatch_layer_clean(tmp_path):
+    # the sanctioned pattern: count AROUND the jitted call, host-side —
+    # and unrelated .add (a set) in hot scope is not a metric call
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.utils import metrics
+        def dispatch(fn, batches):
+            seen = set()
+            seen.add("x")
+            out = fn(batches)
+            metrics.queries_total.add(1)
+            return out
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == []
+
+
 # ---- suppression channels -------------------------------------------------
 
 def test_inline_suppression(tmp_path):

@@ -1,0 +1,291 @@
+"""Metrics-plane instruments (utils/metrics.py) + fleet merging and
+Prometheus exposition (obs/telemetry.py): labeled families, the mergeable
+fixed-bucket Histogram, window-rate semantics, the gauge dump guard,
+registry thread-safety, merge determinism, and the exact exposition
+format."""
+
+import threading
+import time
+
+import pytest
+
+from baikaldb_tpu.obs.telemetry import (merge_snapshots, render_prometheus,
+                                        render_fleet_prometheus)
+from baikaldb_tpu.utils import metrics
+from baikaldb_tpu.utils.metrics import (Counter, Gauge, Histogram, Registry,
+                                        histogram_quantile)
+
+
+# ---- Histogram -------------------------------------------------------------
+
+def test_histogram_bucket_semantics():
+    r = Registry()
+    h = r.histogram("lat", buckets=(1.0, 10.0, 100.0))
+    for v in (0.5, 1.0, 5.0, 10.0, 99.0, 1000.0):
+        h.observe(v)
+    snap = h.snapshot_fields()
+    # le semantics: a value exactly on a bound lands in THAT bucket
+    assert snap["le"] == [1.0, 10.0, 100.0]
+    assert snap["buckets"] == [2, 2, 1, 1]      # [<=1, <=10, <=100, +Inf]
+    assert snap["count"] == 6.0
+    assert snap["sum"] == pytest.approx(1115.5)
+    st = h.stats()
+    assert st["count"] == 6.0 and 0 < st["p50"] <= 10.0 <= st["p99"]
+
+
+def test_histogram_quantile_interpolation():
+    # all mass in one bucket: quantiles interpolate inside (lo, hi)
+    le = [10.0, 20.0]
+    assert histogram_quantile(0.5, le, [0, 4, 0]) == pytest.approx(15.0)
+    assert histogram_quantile(0.5, le, [0, 0, 0]) == 0.0
+    # +Inf bucket clamps at the last finite bound
+    assert histogram_quantile(0.99, le, [0, 0, 5]) == 20.0
+
+
+# ---- labeled families ------------------------------------------------------
+
+def test_families_label_discipline_and_rows():
+    r = Registry()
+    f = r.counter_family("rpc_requests", ("method", "peer"))
+    f.labels(method="ping", peer="a").add(2)
+    f.labels(peer="a", method="ping").add(1)        # kw order irrelevant
+    f.labels(method="propose", peer="b").add(5)
+    with pytest.raises(ValueError):
+        f.labels(method="ping")                     # missing label
+    with pytest.raises(ValueError):
+        f.labels(method="ping", peer="a", extra="x")
+    rows = {tuple(row["labels"]): row["value"]
+            for row in r.snapshot()["rpc_requests"]["rows"]}
+    assert rows == {("ping", "a"): 3, ("propose", "b"): 5}
+    f.remove(method="ping", peer="a")
+    assert len(r.snapshot()["rpc_requests"]["rows"]) == 1
+    # expose() flattens family rows for SHOW STATUS / dump()
+    assert r.expose()["rpc_requests"]["{method=propose,peer=b}.value"] == 5
+
+
+def test_gauge_family_settable_and_add():
+    r = Registry()
+    g = r.gauge_family("inflight", ("method",))
+    g.labels(method="scan").add(1)          # unset cell starts from 0
+    g.labels(method="scan").add(1)
+    g.labels(method="scan").add(-1)
+    assert r.snapshot()["inflight"]["rows"][0]["value"] == 1.0
+    g.labels(method="scan").set(7)
+    assert r.snapshot()["inflight"]["rows"][0]["value"] == 7.0
+
+
+# ---- Counter.per_second window semantics ----------------------------------
+
+def test_per_second_window_semantics():
+    """Regression for the O(window) forward scan fix: the right-scan must
+    preserve the baseline contract — the NEWEST sample older than the
+    window start; the oldest retained sample when all are inside."""
+    r = Registry()
+    c = Counter("reqs", registry=r)
+    now = time.monotonic()
+    # hand-built window: 30, 20, 5, 2 seconds ago at cumulative 10/20/30/40
+    c._value = 40
+    c._window.clear()
+    c._window.extend([(now - 30, 10), (now - 20, 20),
+                      (now - 5, 30), (now - 2, 40)])
+    # 10 s window: baseline = sample at now-20 (newest older than cutoff)
+    rate = c.per_second(window_s=10.0)
+    assert rate == pytest.approx((40 - 20) / 20.0, rel=0.1)
+    # 60 s window: nothing older than cutoff -> oldest retained sample
+    rate = c.per_second(window_s=60.0)
+    assert rate == pytest.approx((40 - 10) / 30.0, rel=0.1)
+    # degenerate windows
+    c._window.clear()
+    assert c.per_second() == 0.0
+    c._window.append((now, 40))
+    assert c.per_second() == 0.0
+
+
+def test_per_second_live():
+    r = Registry()
+    c = Counter("live", registry=r)
+    for _ in range(50):
+        c.add(2)
+    assert c.value == 100 and c.per_second() > 0
+
+
+# ---- gauge dump guard ------------------------------------------------------
+
+def test_raising_gauge_does_not_break_expose():
+    r = Registry()
+    Gauge("boom", fn=lambda: 1 / 0, registry=r)
+    r.counter("ok").add(3)
+    before = metrics.REGISTRY.counter("swallowed.metrics.gauge").value
+    exposed = r.expose()
+    v = exposed["boom"]["value"]
+    assert v != v                           # NaN, not a raised exception
+    assert exposed["ok"]["value"] == 3
+    assert "boom.value" in r.dump()         # dump() survives too
+    assert metrics.REGISTRY.counter("swallowed.metrics.gauge").value > before
+
+
+def test_raising_gauge_does_not_break_show_status():
+    from baikaldb_tpu.exec.session import Database, Session
+    metrics.REGISTRY.gauge("test_boom_gauge", fn=lambda: 1 / 0)
+    s = Session(Database())
+    rows = s.query("SHOW STATUS LIKE 'test_boom_gauge%'")
+    assert rows == [{"Variable_name": "test_boom_gauge.value",
+                     "Value": "nan"}]
+    rows = s.query("SELECT * FROM information_schema.metrics "
+                   "WHERE name = 'test_boom_gauge'")
+    assert len(rows) == 1 and rows[0]["value"] != rows[0]["value"]
+
+
+# ---- registry thread-safety ------------------------------------------------
+
+def test_registry_thread_safety_under_concurrent_snapshot():
+    """Concurrent add/observe (incl. first-touch family label creation)
+    from N threads while a poller snapshots: no exception anywhere, and
+    the final snapshot accounts for every operation exactly."""
+    r = Registry()
+    N, PER = 8, 500
+    errs = []
+    stop = threading.Event()
+
+    def worker(i):
+        try:
+            c = r.counter("w_total")
+            f = r.histogram_family("w_lat", ("worker",))
+            g = r.gauge_family("w_gauge", ("worker",))
+            for k in range(PER):
+                c.add(1)
+                f.labels(worker=str(i)).observe(float(k % 7))
+                g.labels(worker=str(i)).set(k)
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errs.append(e)
+
+    def poller():
+        try:
+            while not stop.is_set():
+                r.snapshot()
+                r.expose()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(N)]
+    pt = threading.Thread(target=poller)
+    pt.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    pt.join()
+    assert errs == []
+    snap = r.snapshot()
+    assert snap["w_total"]["rows"][0]["value"] == N * PER
+    hist_rows = snap["w_lat"]["rows"]
+    assert len(hist_rows) == N
+    assert sum(row["count"] for row in hist_rows) == N * PER
+    for row in hist_rows:
+        assert sum(row["buckets"]) == row["count"]
+
+
+# ---- merge determinism -----------------------------------------------------
+
+def _snap_with(obs, adds):
+    r = Registry()
+    h = r.histogram("lat")
+    for v in obs:
+        h.observe(v)
+    c = r.counter("writes")
+    c.add(adds)
+    f = r.counter_family("per_table", ("table",))
+    f.labels(table="t1").add(adds * 2)
+    return r.snapshot()
+
+def test_merge_bucketwise_order_independent_and_exact():
+    a = _snap_with([0.2, 3.0, 700.0], 5)
+    b = _snap_with([0.2, 0.2], 7)
+    c = _snap_with([90000.0], 11)
+    import itertools
+    merges = [merge_snapshots(dict(perm))
+              for perm in itertools.permutations(
+                  [("x", a), ("y", b), ("z", c)])]
+    assert all(m == merges[0] for m in merges[1:])
+    m = merges[0]
+    assert m["writes"]["rows"][0]["value"] == 23
+    row = m["lat"]["rows"][0]
+    assert row["count"] == 6.0
+    assert sum(row["buckets"]) == 6
+    assert row["sum"] == pytest.approx(0.2 * 3 + 3.0 + 700.0 + 90000.0)
+    assert m["per_table"]["rows"][0]["labels"] == ["t1"]
+    assert m["per_table"]["rows"][0]["value"] == 46
+    # gauges / latency rings must NOT merge
+    assert "w_gauge" not in m
+
+
+def test_merge_skips_mismatched_buckets():
+    r1, r2 = Registry(), Registry()
+    r1.histogram("h", buckets=(1.0, 2.0)).observe(1.5)
+    r2.histogram("h", buckets=(5.0, 6.0)).observe(5.5)
+    m = merge_snapshots({"a": r1.snapshot(), "b": r2.snapshot()})
+    # first-seen bounds win; the mismatched snapshot is dropped, counted
+    assert m["h"]["rows"][0]["count"] == 1.0
+
+
+# ---- Prometheus exposition -------------------------------------------------
+
+def test_prometheus_exact_format():
+    r = Registry()
+    r.counter("queries_total").add(42)
+    r.gauge("queue_depth", fn=lambda: 3)
+    h = r.histogram("op_ms", buckets=(1.0, 10.0))
+    for v in (0.5, 5.0, 5.0, 100.0):
+        h.observe(v)
+    f = r.counter_family("rows_read", ("table",))
+    f.labels(table="t1").add(7)
+    text = render_prometheus(r.snapshot(), prefix="baikal_")
+    assert text == (
+        "# TYPE baikal_op_ms histogram\n"
+        'baikal_op_ms_bucket{le="1"} 1\n'
+        'baikal_op_ms_bucket{le="10"} 3\n'
+        'baikal_op_ms_bucket{le="+Inf"} 4\n'
+        "baikal_op_ms_sum 110.5\n"
+        "baikal_op_ms_count 4\n"
+        "# TYPE baikal_queries_total counter\n"
+        "baikal_queries_total 42\n"
+        "# TYPE baikal_queue_depth gauge\n"
+        "baikal_queue_depth 3\n"
+        "# TYPE baikal_rows_read counter\n"
+        'baikal_rows_read{table="t1"} 7\n'
+    )
+
+
+def test_prometheus_fleet_grouping_and_sanitization():
+    r1, r2 = Registry(), Registry()
+    r1.counter("swallowed.rpc.bad_frame").add(1)
+    r2.counter("swallowed.rpc.bad_frame").add(2)
+    text = render_fleet_prometheus({"s1": r1.snapshot(),
+                                    "s2": r2.snapshot()})
+    lines = text.splitlines()
+    # one TYPE line, both daemons' samples grouped under it, dots sanitized
+    assert lines[0] == "# TYPE baikal_swallowed_rpc_bad_frame counter"
+    assert 'baikal_swallowed_rpc_bad_frame{daemon="s1"} 1' in lines
+    assert 'baikal_swallowed_rpc_bad_frame{daemon="s2"} 2' in lines
+    assert sum(1 for ln in lines if ln.startswith("# TYPE")) == 1
+
+
+def test_prometheus_output_parses():
+    """Every non-comment line must be `name{labels} value` with a float
+    value — the minimal scrape-ability contract."""
+    import re
+    r = Registry()
+    r.histogram("h").observe(2.0)
+    r.latency("l").observe(3.0)
+    r.gauge("g", fn=lambda: float("nan"))
+    r.counter_family("c", ("a", "b")).labels(a="x", b='q"uo\\te').add(1)
+    sample = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+        r'(\{[a-zA-Z0-9_]+="(?:[^"\\]|\\.)*"'
+        r'(,[a-zA-Z0-9_]+="(?:[^"\\]|\\.)*")*\})? '
+        r'(NaN|[-+0-9.e]+)$')
+    for line in render_prometheus(r.snapshot()).splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert sample.match(line), f"unparseable sample line: {line!r}"

@@ -231,6 +231,10 @@ def _fresh_session(meta_addr):
     from baikaldb_tpu.exec.session import Database, Session
 
     s = Session(Database(cluster=meta_addr))
+    # this module meters pushdown wire bytes via WIRE_STATS: the
+    # cluster-mode background telemetry poller's periodic scrapes
+    # (~20 KB/round) would land inside the measurement windows
+    s.db.telemetry.stop()
     s.execute("CREATE TABLE big (id BIGINT NOT NULL, v DOUBLE, "
               "pad VARCHAR(128), PRIMARY KEY (id))")
     return s

@@ -39,6 +39,11 @@ _RULE_HELP = {
     "BAREEXC": "swallow-all exception handlers",
     "SPANINJIT": "tracer spans (obs/trace.py) inside jit-traced scope — "
                  "host-side spans bake or leak under a trace",
+    "FAILPOINTHOT": "failpoint sites in jit-traced scope, or not behind "
+                    "the module-level `if failpoint.ENABLED:` guard",
+    "METRICINJIT": "metric add/observe (utils/metrics.py) inside "
+                   "jit-traced scope — counts fire per trace, not per "
+                   "execution, or capture tracers",
 }
 
 
