@@ -252,12 +252,7 @@ def _walk(e: Expr):
         yield from _walk(a)
 
 
-def _conjuncts(e: Optional[Expr]) -> list[Expr]:
-    if e is None:
-        return []
-    if isinstance(e, Call) and e.op == "and":
-        return _conjuncts(e.args[0]) + _conjuncts(e.args[1])
-    return [e]
+from ..plan.eqclasses import conjuncts as _conjuncts  # noqa: E402
 
 
 def _and_all(parts: list[Expr]) -> Optional[Expr]:

@@ -285,18 +285,27 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         builds = [_sub(c, batches, overflows, ctx)
                   for c in node.children[1:]]
         n = ctx[3]
+        reuse = node.reuse or [False] * len(node.children)
+        exch = node.exch_keys or ([list(node.probe_keys)]
+                                  + [list(bk) for bk in node.build_keys])
         if n:
             # the fused exchange: every input hash-repartitions ONCE on
-            # the shared key (one shuffle round for the whole chain);
-            # intermediate join results never exist, so never re-shuffle
+            # the segment's key class (one shuffle round for the whole
+            # segment); intermediate join results never exist, so never
+            # re-shuffle.  Inputs the scheduler proved already partitioned
+            # on the class — and replicated rider builds (exch None) —
+            # flow through without a collective.
             if node.exch_caps is None:
                 node.exch_caps = [
+                    None if (reuse[i] or exch[i] is None) else
                     _CapBox(kind="shuffle", site=f"multiway[{i}]")
                     for i in range(len(node.children))]
-            inputs = [(probe, node.probe_keys)] + \
-                list(zip(builds, node.build_keys))
+            inputs = list(zip([probe] + builds, exch))
             shuffled = []
             for (b, keys), box in zip(inputs, node.exch_caps):
+                if box is None:         # reused partition / replicated rider
+                    shuffled.append(b)
+                    continue
                 if box.cap is None:
                     box.cap = max(1, 2 * len(b) // n)
                 out_b, needed = _repartition_exec(b, list(keys), n, box.cap)
@@ -307,7 +316,8 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
             node.cap = max(1, len(probe), *(len(b) for b in builds))
         out, ovf = join_ops.multiway_join(
             probe, node.probe_keys, list(zip(builds, node.build_keys)),
-            list(node.hows), cap=node.cap)
+            list(node.hows), cap=node.cap, level_keys=node.level_keys,
+            packs=node.packs)
         overflows.append((node, ovf))
         return out
 
@@ -315,6 +325,11 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
         child = _sub(node.child(), batches, overflows, ctx)
         if node.kind == "gather":
             return _all_gather_batch(child)
+        if node.reused:
+            # keyed exchange scheduler: the child is already hash-
+            # partitioned on this key class — rows flow through, no
+            # collective, no overflow flag
+            return child
         n = ctx[3]
         keys = node.keys if node.keys is not None else list(child.names)
         if node.cap is None:
@@ -517,42 +532,85 @@ def _sub(node, batches, overflows, ctx):
 
 # -- mesh collectives (dist mode; plan/distribute.py inserts the markers) ----
 
-def count_shuffle_rounds(plan: PlanNode) -> int:
-    """Hash-repartition exchange rounds a distributed plan executes — the
-    number the multiway fusion exists to reduce.  One round = one
+def exchange_summary(plan: PlanNode) -> dict:
+    """Exchange accounting for a distributed plan — the numbers the keyed
+    exchange scheduler exists to move.  One round = one EXECUTED
     synchronized repartition step: a binary shuffle join's two input
     exchanges are ONE round, a fused MultiJoin's N+1 input exchanges are
     ONE round, a lone repartition (group-by / distinct co-location) or a
-    "local" adaptive agg's internal partial shuffle is one each."""
+    "local" adaptive agg's internal partial shuffle is one each.  Reused
+    partitions (scheduler-proved, collective skipped at runtime) count in
+    ``reused``, never in ``rounds`` or ``collectives`` — the EXPLAIN
+    ANALYZE line and the bench JSON must report what the device actually
+    paid, not what the plan tree syntactically contains.  ``collectives``
+    counts individual executed repartition all_to_alls (a fused segment's
+    probe + each shuffled build; replicated rider builds cost none);
+    ``keys`` lists the chosen partition key (short names) per counted
+    round, outermost-last."""
     rounds = 0
+    reused = 0
+    collectives = 0
+    keys: list = []
     skip: set = set()
     seen: set = set()
 
+    def short(cols) -> str:
+        return "+".join(c.split(".")[-1] for c in (cols or ()))
+
     def walk(n: PlanNode) -> None:
-        nonlocal rounds
+        nonlocal rounds, reused, collectives
         if id(n) in seen:           # DAG-shared subtrees execute per parent
             return                  # trace, but count once for the metric
         seen.add(id(n))
         if isinstance(n, MultiJoinNode):
-            rounds += 1
+            r = n.reuse or [False] * len(n.children)
+            exch = n.exch_keys or ([n.probe_keys] + list(n.build_keys))
+            execd = sum(1 for i in range(len(n.children))
+                        if exch[i] is not None and not r[i])
+            reused += sum(1 for i in range(len(n.children))
+                          if exch[i] is not None and r[i])
+            collectives += execd
+            if execd:
+                rounds += 1
+                keys.append(short(n.probe_keys))
         elif isinstance(n, JoinNode):
             reps = [c for c in n.children
                     if isinstance(c, ExchangeNode) and c.kind == "repartition"]
             if reps:
-                rounds += 1
+                reused += sum(c.reused for c in reps)
+                execd = sum(1 for c in reps if not c.reused)
+                collectives += execd
+                if execd:
+                    rounds += 1
+                    keys.append(short(n.left_keys))
                 skip.update(id(c) for c in reps)
         elif isinstance(n, ExchangeNode) and n.kind == "repartition" \
                 and id(n) not in skip:
-            rounds += 1
+            if n.reused:
+                reused += 1
+            else:
+                rounds += 1
+                collectives += 1
+                keys.append(short(n.keys) or "*")
         elif isinstance(n, AggNode) and \
                 getattr(n, "agg_dist", "") == "local" \
                 and n.strategy != "dense":
             rounds += 1
+            collectives += 1
+            keys.append(short(n.key_names))
         for c in n.children:
             walk(c)
 
     walk(plan)
-    return rounds
+    # outermost-last reads as execution order (keys collected top-down)
+    keys.reverse()
+    return {"rounds": rounds, "reused": reused, "collectives": collectives,
+            "keys": keys}
+
+
+def count_shuffle_rounds(plan: PlanNode) -> int:
+    """Executed hash-repartition rounds (see :func:`exchange_summary`)."""
+    return exchange_summary(plan)["rounds"]
 
 
 def _all_gather_batch(b: ColumnBatch) -> ColumnBatch:

@@ -60,7 +60,8 @@ define("param_queries", True,
        "entry and one compiled executable serve every literal variant of a "
        "query shape; 0 restores SQL-text-keyed caching with baked literals")
 from .dispatch import BatchDispatcher
-from .executor import _CapBox, compile_plan, count_shuffle_rounds
+from .executor import (_CapBox, compile_plan, count_shuffle_rounds,
+                       exchange_summary)
 
 # join overflow retry budget lives in FLAGS.join_retry_max: retries settle
 # at most one operator per re-trace, so a chain of N joins can need N rounds
@@ -1790,7 +1791,7 @@ class Session:
                 return (self._stats_fn(table_key, col) or {}).get("ndv")
 
             plan = distribute(plan, int(self.mesh.devices.size), rows_fn,
-                              ndv_fn=ndv_fn)
+                              ndv_fn=ndv_fn, stats_fn=self._stats_fn)
         return plan
 
     def _annotate_ann(self, stmt: SelectStmt, plan: PlanNode) -> None:
@@ -3525,7 +3526,7 @@ class Session:
                     entry["plan"] = plan
                     entry["plan_sig"] = sig
                     entry["compiled"] = {}
-                    entry.pop("shuffle_rounds", None)   # re-count: the
+                    entry.pop("exchange_summary", None)  # re-count: the
                     # fresh plan may shuffle differently
                     # the plan AND every executable were just rebuilt: in
                     # cost terms this is a miss, and the hit/miss split is
@@ -3754,11 +3755,16 @@ class Session:
                 walk_x(c)
 
         walk_x(plan)
+        xsum = (exchange_summary(plan) if self.mesh is not None
+                else {"rounds": 0, "reused": 0, "collectives": 0,
+                      "keys": []})
         trace.event("exchange",
-                    rounds=(count_shuffle_rounds(plan)
-                            if self.mesh is not None else 0),
+                    rounds=xsum["rounds"], reused=xsum["reused"],
+                    collectives=xsum["collectives"],
+                    keys="[" + ",".join(xsum["keys"]) + "]",
                     multiway=mj[0], agg=",".join(aggs) or "-",
-                    retries_total=metrics.shuffle_overflow_retries.value)
+                    retries_total=metrics.shuffle_overflow_retries.value,
+                    saved_total=metrics.shuffle_rounds_saved.value)
 
     @staticmethod
     def _render_analyze(spans: list[dict]) -> list[str]:
@@ -3813,6 +3819,9 @@ class Session:
         for s in find("exchange"):
             a = s["attrs"]
             lines.append(f"-- exchange: rounds={a['rounds']} "
+                         f"reused={a.get('reused', 0)} "
+                         f"collectives={a.get('collectives', 0)} "
+                         f"keys={a.get('keys', '[]')} "
                          f"multiway={a['multiway']} agg={a['agg']} "
                          f"shuffle_retries_total={a['retries_total']}")
         lines.append(f"-- trace: spans={len(spans)} "
@@ -4532,10 +4541,14 @@ class Session:
         shuffle_rounds counter plus mpp.repartition / mpp.join / mpp.agg
         spans with occupancy/overflow/strategy attrs.  Pure host work on
         the already-fetched flag values — no extra device sync."""
-        rounds = entry.get("shuffle_rounds")
-        if rounds is None:
-            rounds = entry["shuffle_rounds"] = count_shuffle_rounds(plan)
-        metrics.shuffle_rounds.add(rounds)
+        summary = entry.get("exchange_summary")
+        if summary is None:
+            summary = entry["exchange_summary"] = exchange_summary(plan)
+        metrics.shuffle_rounds.add(summary["rounds"])
+        if summary["reused"]:
+            # keyed exchange scheduler: collectives this execution did NOT
+            # pay because an input was already partitioned on the key class
+            metrics.shuffle_rounds_saved.add(summary["reused"])
         if not trace.active():
             # tracing off: the counter above is the whole cost — no plan
             # walk, no per-node span churn on the hot path

@@ -151,6 +151,28 @@ def collect(values: np.ndarray, n_total: int, n_nulls: int,
     return out
 
 
+def partition_key_ndv(payload: Optional[dict]) -> int:
+    """Distinct-count estimate of a candidate partition key column for the
+    keyed exchange scheduler's tie-break (plan/distribute._Scheduler):
+    among equality-class signatures serving the same number of join
+    levels, the higher-spread key balances shards better.  Falls through
+    the same ladder as the planner's join-fanout ``distinct()``: collected
+    ndv, then value span, then dictionary size; 0 = no basis (the
+    tie-break treats unknown as worst)."""
+    if not payload:
+        return 0
+    if payload.get("ndv"):
+        return int(payload["ndv"])
+    if payload.get("min") is not None and payload.get("max") is not None:
+        try:
+            return max(1, int(payload["max"]) - int(payload["min"]) + 1)
+        except (TypeError, ValueError):
+            return 0
+    if payload.get("dict_size"):
+        return int(payload["dict_size"])
+    return 0
+
+
 def _hist_frac_below(hist: list, v: float, inclusive: bool) -> float:
     """Fraction of non-null values < v (<= v when inclusive), by
     equi-depth bucket counting + linear interpolation."""

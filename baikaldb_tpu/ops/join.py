@@ -370,10 +370,13 @@ def radix_join(probe: ColumnBatch, probe_keys: list[str],
     return out, total, needed_width
 
 
-def _align_multiway_strings(probe: ColumnBatch, probe_keys: list[str],
+def _align_multiway_strings(probe: ColumnBatch, level_keys: list[list[str]],
                             builds: list):
     """Align string key columns of the probe and EVERY build side onto one
-    shared code space.  Two passes: the first grows the probe's dictionary
+    shared code space.  ``level_keys[i]`` holds side i's probe key columns
+    (identical lists in the one-shared-key shape; per-level columns under
+    the keyed exchange scheduler — sides on different probe columns simply
+    never interact).  Two passes: the first grows the probe's dictionary
     to the union of all sides; the second re-aligns each build against that
     union (a second merge with a subset is value-stable, so every side ends
     up comparing codes in the same space — a single probe column compared
@@ -381,10 +384,10 @@ def _align_multiway_strings(probe: ColumnBatch, probe_keys: list[str],
     merges, or build_1's codes would be stale after build_2 widened the
     probe's dictionary)."""
     for i, (bb, bk) in enumerate(builds):
-        probe, bb = _align_string_keys(probe, probe_keys, bb, bk)
+        probe, bb = _align_string_keys(probe, level_keys[i], bb, bk)
         builds[i] = (bb, bk)
     for i, (bb, bk) in enumerate(builds):
-        probe, bb = _align_string_keys(probe, probe_keys, bb, bk)
+        probe, bb = _align_string_keys(probe, level_keys[i], bb, bk)
         builds[i] = (bb, bk)
     return probe, builds
 
@@ -392,10 +395,17 @@ def _align_multiway_strings(probe: ColumnBatch, probe_keys: list[str],
 def multiway_join(probe: ColumnBatch, probe_keys: list[str],
                   builds: list, hows: list[str],
                   cap: int | None = None, suffix: str = "_r",
-                  wide_keys_ok: bool = False):
+                  wide_keys_ok: bool = False,
+                  level_keys: list[list[str]] | None = None,
+                  packs: list[bool] | None = None):
     """Fused multiway equi-join: ONE probe stream joined against N build
-    sides on the SAME probe key columns in a single pass (the Efficient
-    Multiway Hash Join shape; PAPERS.md).
+    sides in a single pass (the Efficient Multiway Hash Join shape;
+    PAPERS.md).  Every level's key columns live ON THE PROBE STREAM:
+    by default all levels share ``probe_keys`` (the PR 7 one-shared-key
+    shape); ``level_keys[i]`` gives level i its own probe columns (the
+    keyed exchange scheduler's mixed-key segments — co-location across
+    levels is the SCHEDULER's proof, via equality classes, not this
+    kernel's concern).
 
     ``builds``: list of (build_batch, build_key_names); ``hows[i]``:
     inner | left per level.  Semantically identical to the left-deep chain
@@ -411,13 +421,24 @@ def multiway_join(probe: ColumnBatch, probe_keys: list[str],
     output cardinality for the overflow retry protocol (int64 — a chain of
     expansions can overflow int32 counts)."""
     builds = list(builds)
-    probe, builds = _align_multiway_strings(probe, probe_keys, builds)
-    pk, pvalid = _key_array(probe, probe_keys, wide_keys_ok)
-    psel_dead, pdead = _probe_dead(probe, pvalid)
+    if level_keys is None:
+        level_keys = [list(probe_keys)] * len(builds)
+    if packs is None:
+        packs = [wide_keys_ok] * len(builds)
+    probe, builds = _align_multiway_strings(probe, level_keys, builds)
+    psel_dead = ~probe.sel if probe.sel is not None \
+        else jnp.zeros(len(probe), bool)
 
     per_side = []       # (oc, counts, lo, order, nbuild) per build
-    for (bb, bkeys), how in zip(builds, hows):
-        bk, bvalid = _key_array(bb, bkeys, wide_keys_ok)
+    pk_cache: dict = {}  # shared-key levels pack the probe columns ONCE
+    for (bb, bkeys), how, pkeys, wide in zip(builds, hows, level_keys,
+                                             packs):
+        ck = (tuple(pkeys), bool(wide))
+        if ck not in pk_cache:
+            pk_cache[ck] = _key_array(probe, pkeys, wide)
+        pk, pvalid = pk_cache[ck]
+        pdead = psel_dead if pvalid is None else (psel_dead | ~pvalid)
+        bk, bvalid = _key_array(bb, bkeys, wide)
         bdead = _build_dead(bb, bvalid)
         order = jnp.lexsort((bk, bdead))
         n_live = jnp.sum(~bdead).astype(jnp.int32)
@@ -445,21 +466,37 @@ def multiway_join(probe: ColumnBatch, probe_keys: list[str],
 
     if cap is None:
         cap = len(probe)
+    if cap > 0x7FFF0000:
+        # the overflow-retry loop feeds the int64 needed_rows back as the
+        # next cap; the int32 expansion below cannot index past 2^31 (and
+        # a 2-billion-row static batch would not fit regardless) — fail
+        # with a clear message instead of wrapped indices
+        raise ValueError(f"multiway_join cap {cap} exceeds the int32 "
+                         "expansion range")
     offsets = jnp.cumsum(out_counts)
     total = (offsets[-1] if len(probe) else jnp.int64(0)).astype(jnp.int64)
     starts = offsets - out_counts
-    j = jnp.arange(cap, dtype=jnp.int64)
-    pi = jnp.searchsorted(offsets, j, side="right")
+    # the EXPANSION arithmetic runs in int32: every live ordinal is
+    # bounded by cap (rem = j - start < cap < 2^31), and per-side counts
+    # are bounded by the build length.  Only the cumulative offsets /
+    # ``total`` (the overflow flag — a chain of expansions can genuinely
+    # exceed int32) stay int64; an output slot corrupted by the int32
+    # clamp can only occur on a run whose flag already reports overflow,
+    # and the session discards that output and retries.
+    off32 = jnp.minimum(offsets, jnp.int64(0x7FFFFFF0)).astype(jnp.int32)
+    st32 = jnp.minimum(starts, jnp.int64(0x7FFFFFF0)).astype(jnp.int32)
+    j = jnp.arange(cap, dtype=jnp.int32)
+    pi = jnp.searchsorted(off32, j, side="right")
     pi_c = jnp.clip(pi, 0, len(probe) - 1)
-    k = j - starts[pi_c]
-    live_out = j < total
+    k = j - st32[pi_c]
+    live_out = j.astype(jnp.int64) < total
 
     # mixed-radix decode of the per-probe-row match ordinal: last build
     # varies fastest (== the chained left-deep expansion order)
     ordinals = [None] * len(per_side)
     rem = k
     for i in reversed(range(len(per_side))):
-        oc_i = per_side[i][0][pi_c].astype(jnp.int64)
+        oc_i = per_side[i][0][pi_c].astype(jnp.int32)
         d = jnp.maximum(oc_i, 1)
         ordinals[i] = rem % d
         rem = rem // d
@@ -469,8 +506,8 @@ def multiway_join(probe: ColumnBatch, probe_keys: list[str],
     cols = list(out_p.columns)
     for (oc, counts, lo, order, nbuild), how, ki, (bb, _bk) in zip(
             per_side, hows, ordinals, builds):
-        matched = ki < counts[pi_c].astype(jnp.int64)
-        bpos = lo[pi_c].astype(jnp.int64) + ki
+        matched = ki < counts[pi_c].astype(jnp.int32)
+        bpos = lo[pi_c].astype(jnp.int32) + ki
         bidx = order[jnp.clip(bpos, 0, max(nbuild - 1, 0))]
         out_b = bb.gather(jnp.clip(bidx, 0, max(nbuild - 1, 0)), valid=None)
         bvalid_out = matched & live_out

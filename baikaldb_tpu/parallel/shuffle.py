@@ -192,7 +192,9 @@ def dist_join(probe: ColumnBatch, probe_keys: list[str],
 def dist_multiway_join(probe: ColumnBatch, probe_keys: list[str],
                        builds: list, hows: list[str], mesh,
                        cap: int | None = None,
-                       shuffle_cap: int | None = None):
+                       shuffle_cap: int | None = None,
+                       level_keys: list | None = None,
+                       packs: list | None = None):
     """Distributed fused multiway equi-join on ONE shared key (the MPP
     exchange v2 shape): every input — the probe and each build in
     ``builds`` = [(batch, key_names), ...] — radix-partitions and
@@ -202,7 +204,10 @@ def dist_multiway_join(probe: ColumnBatch, probe_keys: list[str],
     intermediate join results never exist, so they are never re-shuffled.
 
     Returns (out, (probe_shuffle_needed, [build_shuffle_needed...],
-    join_overflow)) — every flag rides the standard retry protocol."""
+    join_overflow)) — every flag rides the standard retry protocol.
+    ``level_keys`` (per-level probe key columns, keyed-exchange-scheduler
+    segments) passes through to the kernel; the probe still partitions on
+    ``probe_keys``, the segment's class representative."""
     n = mesh.devices.size
     pshard, ovf_p = dist_hash_repartition(probe, probe_keys, mesh,
                                           shuffle_cap)
@@ -219,7 +224,8 @@ def dist_multiway_join(probe: ColumnBatch, probe_keys: list[str],
 
     def local(pb: ColumnBatch, *bbs):
         out, needed = join_ops.multiway_join(
-            pb, probe_keys, list(zip(bbs, build_keys)), hows, cap=local_cap)
+            pb, probe_keys, list(zip(bbs, build_keys)), hows, cap=local_cap,
+            level_keys=level_keys, packs=packs)
         any_ovf = jax.lax.pmax(needed, AXIS) > local_cap
         return out, any_ovf
 
@@ -227,7 +233,7 @@ def dist_multiway_join(probe: ColumnBatch, probe_keys: list[str],
     out_probe = jax.eval_shape(
         lambda pb, *bbs: join_ops.multiway_join(
             pb, probe_keys, list(zip(bbs, build_keys)), hows,
-            cap=local_cap)[0],
+            cap=local_cap, level_keys=level_keys, packs=packs)[0],
         *locals_)
     out_specs = (jax.tree.map(lambda _: P(AXIS), out_probe), P())
     fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=out_specs,

@@ -1,0 +1,182 @@
+"""Column equality classes over a plan region — the keyed-exchange analog
+of the reference's predicate-transitivity pass (src/physical_plan/
+predicate_pushdown rewrites `a.k = b.k AND b.k = 5` into scan filters on
+both sides; mpp_analyzer sizes exchanges from the JOIN GRAPH, not one edge).
+
+Two consumers share this module:
+
+- plan/planner.py (predicate pushdown): a constant conjunct on one member
+  of a class propagates to every other member's scan, so zonemap/index
+  pruning fires on BOTH sides of a join.
+- plan/distribute.py (keyed exchange scheduler): a chain of shuffle joins
+  whose per-level keys fall into one equality class can repartition every
+  input ONCE on a class representative, and an input already partitioned
+  on a class flows into the next exchange without re-shuffling.
+
+Soundness of treating class members as interchangeable partition/join keys:
+every equality that feeds a class is ENFORCED somewhere on the path to the
+root (an inner-join key, or a Filter/pushed-scan predicate), so any row on
+which two members differ is guaranteed dead in the final result — a miss
+or spurious match on such a row is invisible.  Equalities from LEFT-join
+ON clauses (which hold only for matched rows) and from semi/anti joins are
+therefore NEVER unioned.
+
+Scoping: column names are label-qualified and unique within one name
+scope, but UNION arms, derived tables, and subquery subplans may repeat a
+label — an equality collected in one arm must not leak into another.  All
+walkers here stop at those scope boundaries; callers build one ClassMap
+per region (regions are small, the walk is O(nodes)).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..expr.ast import Call, ColRef, Expr
+from .nodes import (ExchangeNode, JoinNode, MultiJoinNode, PlanNode,
+                    ProjectNode, ScanNode, UnionNode)
+
+
+class ClassMap:
+    """Union-find over qualified column names with canonical class tuples."""
+
+    def __init__(self):
+        self._parent: dict[str, str] = {}
+
+    def _find(self, x: str) -> str:
+        self._parent.setdefault(x, x)
+        root = x
+        while self._parent[root] != root:
+            root = self._parent[root]
+        while self._parent[x] != root:            # path compression
+            self._parent[x], x = root, self._parent[x]
+        return root
+
+    def union(self, a: str, b: str) -> None:
+        ra, rb = self._find(a), self._find(b)
+        if ra != rb:
+            # deterministic root: lexicographic min, so canonical class
+            # tuples never depend on union order
+            if rb < ra:
+                ra, rb = rb, ra
+            self._parent[rb] = ra
+
+    def cls(self, col: str) -> tuple:
+        """Canonical class of ``col``: sorted tuple of members (singleton
+        ``(col,)`` when the column never joined a class)."""
+        if col not in self._parent:
+            return (col,)
+        root = self._find(col)
+        return tuple(sorted(m for m in self._parent
+                            if self._find(m) == root))
+
+    def same(self, a: str, b: str) -> bool:
+        if a == b:
+            return True
+        if a not in self._parent or b not in self._parent:
+            return False
+        return self._find(a) == self._find(b)
+
+    def members(self, col: str) -> tuple:
+        return self.cls(col)
+
+
+def conjuncts(e: Optional[Expr]) -> list[Expr]:
+    if e is None:
+        return []
+    if isinstance(e, Call) and e.op == "and":
+        return conjuncts(e.args[0]) + conjuncts(e.args[1])
+    return [e]
+
+
+def col_eq_pair(e: Expr) -> Optional[tuple[str, str]]:
+    """``col = col`` conjunct -> the qualified name pair, else None."""
+    if isinstance(e, Call) and e.op == "eq" and len(e.args) == 2 and \
+            all(isinstance(a, ColRef) for a in e.args):
+        return e.args[0].name, e.args[1].name
+    return None
+
+
+def region_children(node: PlanNode) -> list[PlanNode]:
+    """Children inside the SAME name scope.  Union arms, derived-table
+    bodies, and subquery subplans (semi/anti right sides, Membership /
+    ScalarSource sources) start fresh regions: their labels may collide
+    with this region's and their predicates hold only internally."""
+    from .nodes import MembershipNode, ScalarSourceNode
+
+    if isinstance(node, UnionNode):
+        return []
+    if isinstance(node, ProjectNode) and getattr(node, "derived", False):
+        return []
+    if isinstance(node, (MembershipNode, ScalarSourceNode)):
+        return node.children[:1]
+    if isinstance(node, JoinNode) and getattr(node, "subquery_right", False):
+        return node.children[:1]
+    if isinstance(node, JoinNode) and node.how in ("semi", "anti"):
+        return node.children[:1]
+    return list(node.children)
+
+
+def region_classes(root: PlanNode) -> ClassMap:
+    """Equality classes of ``root``'s region, from every enforced equality
+    in the subtree: inner-join equi-keys, fused MultiJoin levels, Filter
+    and pushed-scan ``col = col`` conjuncts, and projection identities
+    (``SELECT a.k AS x`` makes x ~ a.k — same value by construction)."""
+    cm = ClassMap()
+    seen: set[int] = set()
+
+    def walk(n: PlanNode) -> None:
+        if id(n) in seen:       # DAG-shared subtrees contribute once
+            return
+        seen.add(id(n))
+        if isinstance(n, JoinNode) and n.how == "inner" \
+                and not getattr(n, "subquery_right", False):
+            # subquery-rewrite joins name right keys in the SUBQUERY's
+            # scope — unioning them would leak a foreign region's labels
+            for lk, rk in zip(n.left_keys, n.right_keys):
+                cm.union(lk, rk)
+        elif isinstance(n, MultiJoinNode):
+            keys = n.level_keys or [n.probe_keys] * len(n.build_keys)
+            for how, pks, bks in zip(n.hows, keys, n.build_keys):
+                if how == "inner":
+                    for pk, bk in zip(pks, bks):
+                        cm.union(pk, bk)
+        elif isinstance(n, ProjectNode) and not getattr(n, "derived", False):
+            # derived-table Projects map outer names onto INNER-scope
+            # columns whose labels may collide with this region's — the
+            # identity union is sound only within one name scope
+            for name, e in zip(n.names, n.exprs):
+                if isinstance(e, ColRef):
+                    cm.union(name, e.name)
+        elif isinstance(n, ScanNode) and n.pushed_filter is not None:
+            for c in conjuncts(n.pushed_filter):
+                pair = col_eq_pair(c)
+                if pair is not None:
+                    cm.union(*pair)
+        pred = getattr(n, "pred", None)
+        if pred is not None:
+            for c in conjuncts(pred):
+                pair = col_eq_pair(c)
+                if pair is not None:
+                    cm.union(*pair)
+        for c in region_children(n):
+            walk(c)
+
+    walk(root)
+    return cm
+
+
+def statement_classes(plan: PlanNode, where: Optional[Expr]) -> ClassMap:
+    """Planner-side classes for constant propagation: the (pre-pushdown)
+    WHERE's ``col = col`` conjuncts plus the plan's inner-join keys, with
+    the same scope discipline as :func:`region_classes`."""
+    cm = region_classes(plan)
+    for c in conjuncts(where):
+        pair = col_eq_pair(c)
+        if pair is not None:
+            cm.union(*pair)
+    return cm
+
+# NOTE: the partition-routing signature lives in plan/distribute.py
+# (_partition_sig) because class identity alone is NOT sufficient for
+# routing equality — the hash-family of the column type matters too.

@@ -250,11 +250,17 @@ class ExchangeNode(PlanNode):
     kind: str = "gather"
     keys: list[str] = field(default_factory=list)
     cap: Optional[int] = None
+    # keyed exchange scheduler (plan/distribute._mark_partition_reuse): the
+    # child is ALREADY hash-partitioned on this key class — the executor
+    # passes rows through without a collective, and the round does not
+    # count as executed in count_shuffle_rounds / the bench JSON
+    reused: bool = False
 
     def _label(self):
         if self.kind == "gather":
             return "Exchange(gather -> replicated)"
-        return f"Exchange(repartition on {self.keys} cap={self.cap})"
+        r = " reused" if self.reused else ""
+        return f"Exchange(repartition on {self.keys} cap={self.cap}{r})"
 
 
 @dataclass
@@ -270,20 +276,47 @@ class MultiJoinNode(PlanNode):
     fused multi-build probe pass (ops/join.multiway_join) per shard.
     Intermediate join results never materialize and never re-shuffle.
 
+    The keyed exchange scheduler (beyond-one-shared-key fusion) generalizes
+    this: ``level_keys`` carries PER-LEVEL probe key columns (all living on
+    the probe stream, possibly rewritten onto equality-class siblings of
+    the original join keys) while ``probe_keys`` stays the PARTITION key —
+    the class representative every input repartitions on.  When
+    ``level_keys`` is None every level joins on ``probe_keys`` (the PR 7
+    one-shared-key shape).  ``reuse[i]`` marks child ``i`` (0 = probe) as
+    already partitioned on the segment's key class: its repartition
+    collective is skipped entirely.
+
     ``cap`` is the fused output capacity (rides the overflow retry-flag
     protocol like binary join caps); ``exch_caps`` hold the per-input
     shuffle capacities (runtime-settled _CapBox objects, same protocol)."""
     probe_keys: list[str] = field(default_factory=list)
     build_keys: list[list[str]] = field(default_factory=list)  # per build
     hows: list[str] = field(default_factory=list)              # inner|left
+    level_keys: Optional[list[list[str]]] = None   # per-level probe keys
+    reuse: Optional[list[bool]] = None             # per child, 0 = probe
+    # per-child partition columns for the fused exchange (0 = probe):
+    # None = no repartition (replicated rider build, or a rider-only
+    # segment's pass-through probe); a shuffle build's list may be a
+    # SUBSET of its join keys when the segment partitions on a shared
+    # class (co-location on the subset co-locates the full key)
+    exch_keys: Optional[list] = None
+    # per-level planner-verified 32-bit key packing (JoinNode's
+    # pack32_verified, carried through fusion — levels with it never
+    # rewrite onto class siblings, whose bounds the proof did not cover)
+    packs: Optional[list[bool]] = None
     cap: Optional[int] = None
     exch_caps: Optional[list] = None       # per-child _CapBox, trace-settled
 
     def _label(self):
-        sides = ", ".join(f"{h}:{bk}" for h, bk in zip(self.hows,
-                                                       self.build_keys))
+        keys = self.level_keys or [self.probe_keys] * len(self.hows)
+        sides = ", ".join(f"{h}:{pk}={bk}" if pk != self.probe_keys
+                          else f"{h}:{bk}"
+                          for h, pk, bk in zip(self.hows, keys,
+                                               self.build_keys))
+        reused = sum(self.reuse) if self.reuse else 0
+        r = f" reused={reused}" if reused else ""
         return (f"MultiJoin(on {self.probe_keys} x{len(self.hows)} "
-                f"[{sides}])")
+                f"[{sides}]{r})")
 
 
 @dataclass
@@ -317,7 +350,11 @@ class ValuesNode(PlanNode):
 # protocol (keeping an old plan keeps its settled caps — a feature);
 # presort_input is rebound per execution; access_desc is EXPLAIN text.
 _SIG_SKIP = frozenset({"children", "cap", "radix_width", "presort_input",
-                       "access_desc", "exch_caps", "agg_exch_cap"})
+                       "access_desc", "exch_caps", "agg_exch_cap",
+                       # derived partition metadata (canonical class tuples
+                       # recomputed per plan); the reuse DECISIONS stay in
+                       # the signature via reused/reuse fields
+                       "partitioned_on"})
 
 
 def _sig_value(v):

@@ -30,7 +30,14 @@ from ..ops.hashagg import AggSpec, agg_result_type
 from ..sql.lexer import SqlError
 from ..sql.stmt import JoinClause, SelectStmt, TableRef
 from ..types import Field, LType, Schema
+from ..utils import metrics
 from ..utils.flags import FLAGS, define
+
+define("eqclass_pushdown", True,
+       "equality-class constant propagation in predicate pushdown: "
+       "a.k = b.k AND b.k = 5 pushes a.k = 5 into a's scan too, so "
+       "zonemap/index pruning fires on both join sides (off: constants "
+       "reach only their own table)")
 
 define("dense_join_span_max", 1 << 24,
        "dense PK-FK join: max key-domain span for the position-table "
@@ -963,7 +970,8 @@ class Planner:
         scan_label_walk(plan)
         remaining = None
         pushed: dict[str, Expr] = {}
-        for c in _conjuncts(where):
+        cjs = _conjuncts(where)
+        for c in cjs:
             labels = {r.name.split(".", 1)[0] for r in walk(c)
                       if isinstance(r, ColRef)}
             # derived tables have no ScanNode: their conjuncts must stay above
@@ -973,11 +981,63 @@ class Planner:
                     pushed[lbl] = c if lbl not in pushed else Call("and", (pushed[lbl], c))
                     continue
             remaining = c if remaining is None else Call("and", (remaining, c))
+        for lbl, c in self._propagate_eq_constants(plan, where, cjs,
+                                                   scan_labels, unsafe):
+            pushed[lbl] = c if lbl not in pushed else \
+                Call("and", (pushed[lbl], c))
         if pushed:
             _push_into_scans(plan, pushed)
         if remaining is not None:
             plan = FilterNode(children=[plan], pred=remaining, schema=plan.schema)
         return plan
+
+    def _propagate_eq_constants(self, plan: PlanNode, where: Expr, cjs,
+                                scan_labels: set, unsafe: set):
+        """Equality-class constant propagation: ``a.k = b.k AND b.k = 5``
+        also pushes ``a.k = 5`` into a's scan, so zonemap/index pruning
+        fires on BOTH sides of the join (the reference's predicate
+        transitivity).  Classes come from inner-join equi-keys plus WHERE
+        ``col = col`` conjuncts (plan/eqclasses.py — LEFT/semi/anti
+        equalities hold only for matched rows and never feed a class); the
+        derived conjunct is redundant above the scan, so it is pushed ONLY
+        (never added to the residual filter).  -> [(label, conjunct)]."""
+        if not bool(FLAGS.eqclass_pushdown) or not scan_labels:
+            return []
+        from ..expr.ast import Param
+        from .eqclasses import statement_classes
+
+        cm = statement_classes(plan, where)
+        existing = set()
+        for c in cjs:
+            try:
+                existing.add(c.key())
+            except Exception:   # noqa: BLE001 — dedupe is best-effort
+                metrics.count_swallowed("planner.eqconst_key")
+        out = []
+        for c in cjs:
+            if not (isinstance(c, Call) and c.op == "eq" and len(c.args) == 2):
+                continue
+            a, b = c.args
+            if isinstance(b, ColRef) and isinstance(a, (Lit, Param)):
+                a, b = b, a
+            if not (isinstance(a, ColRef) and isinstance(b, (Lit, Param))):
+                continue
+            for member in cm.cls(a.name):
+                if member == a.name:
+                    continue
+                lbl = member.split(".", 1)[0]
+                if lbl not in scan_labels or lbl in unsafe:
+                    continue
+                derived = Call("eq", (ColRef(member), b))
+                try:
+                    if derived.key() in existing:
+                        continue
+                    existing.add(derived.key())
+                except Exception:   # noqa: BLE001
+                    metrics.count_swallowed("planner.eqconst_key")
+                out.append((lbl, derived))
+                metrics.eqclass_consts_pushed.add(1)
+        return out
 
     # ------------------------------------------------------------------
     def _spine_dense_joins(self, plan: PlanNode):
@@ -2418,10 +2478,9 @@ def _colrefs(e: Expr) -> set[str]:
     return out
 
 
-def _conjuncts(e: Expr) -> list[Expr]:
-    if isinstance(e, Call) and e.op == "and":
-        return _conjuncts(e.args[0]) + _conjuncts(e.args[1])
-    return [e]
+# the one AND-splitting primitive lives in plan/eqclasses.py; this alias
+# keeps the planner's historical name for its many call sites
+from .eqclasses import conjuncts as _conjuncts  # noqa: E402
 
 
 def _equi_pair(e: Expr, lcols: set, rcols: set) -> Optional[tuple[str, str]]:

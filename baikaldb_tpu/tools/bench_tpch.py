@@ -25,13 +25,42 @@ def main():
     ap.add_argument("--json", action="store_true",
                     help="one machine-readable JSON line per query "
                          "(wall-clock, shuffle rounds, compiles)")
+    ap.add_argument("--force-shuffle", action="store_true",
+                    help="repartition every sharded join input (the "
+                         "pure-MPP regime: the per-edge baseline pays one "
+                         "shuffle round per binary join — the config the "
+                         "keyed exchange scheduler moves)")
+    ap.add_argument("--mpp", action="store_true",
+                    help="natural MPP regime: big joins shuffle "
+                         "(broadcast size threshold 0, dense fast path "
+                         "off), small dims broadcast by the mesh-ratio "
+                         "rule and fuse as rider levels")
+    ap.add_argument("--no-multiway", action="store_true",
+                    help="disable the keyed exchange scheduler (the "
+                         "per-edge chained-binary baseline, for diffing "
+                         "with tools/bench_regress.py)")
+    ap.add_argument("--queries", default="",
+                    help="comma-separated subset (e.g. q5,q7,q8,q9); "
+                         "empty = all 22")
     args = ap.parse_args()
 
     import jax
 
     from ..exec.session import Session
     from ..models import tpch
+    from ..plan import distribute as _dist  # noqa: F401 — registers flags
+    from ..plan import planner as _planner  # noqa: F401 — registers flags
     from ..utils import metrics
+    from ..utils.flags import set_flag
+
+    if args.force_shuffle:
+        set_flag("mpp_force_shuffle", True)
+        set_flag("dense_join_span_max", 0)
+    if args.mpp:
+        set_flag("mpp_broadcast_rows", 0)
+        set_flag("dense_join_span_max", 0)
+    if args.no_multiway:
+        set_flag("multiway_join", False)
 
     mesh = None
     if args.mesh:
@@ -50,13 +79,20 @@ def main():
         print(json.dumps({"header": {"scale": args.scale, "lineitem": n_li,
                                      "platform": platform,
                                      "mesh": args.mesh or 1,
+                                     "force_shuffle":
+                                         bool(args.force_shuffle),
+                                     "mpp": bool(args.mpp),
+                                     "multiway": not args.no_multiway,
                                      "load_s": round(load_s, 1)}}))
     else:
         print(header)
 
+    only = {q.strip() for q in args.queries.split(",") if q.strip()}
     results = {}
     total_warm = 0.0
     for name in sorted(tpch.QUERIES, key=lambda q: int(q[1:])):
+        if only and name not in only:
+            continue
         sql = tpch.QUERIES[name]
         c0 = metrics.xla_retraces.value
         t0 = time.perf_counter()
@@ -65,14 +101,17 @@ def main():
         first_compiles = metrics.xla_retraces.value - c0
         warm = []
         warm_rounds = 0
+        warm_saved = 0
         warm_compiles = 0
         for _ in range(args.repeat):
             r0 = metrics.shuffle_rounds.value
+            s0 = metrics.shuffle_rounds_saved.value
             c0 = metrics.xla_retraces.value
             t0 = time.perf_counter()
             s.query(sql)
             warm.append(time.perf_counter() - t0)
             warm_rounds = metrics.shuffle_rounds.value - r0
+            warm_saved = metrics.shuffle_rounds_saved.value - s0
             warm_compiles += metrics.xla_retraces.value - c0
         w = min(warm)
         total_warm += w
@@ -83,6 +122,7 @@ def main():
                 "first_ms": round(first * 1e3, 2),
                 "warm_ms": round(w * 1e3, 2),
                 "shuffle_rounds": warm_rounds,
+                "rounds_saved": warm_saved,
                 "first_compiles": first_compiles,
                 "warm_compiles": warm_compiles,
             }))
@@ -95,6 +135,8 @@ def main():
                       "per_query_ms": results,
                       "multiway_joins_fused":
                           metrics.multiway_joins_fused.value,
+                      "shuffle_rounds_saved":
+                          metrics.shuffle_rounds_saved.value,
                       "shuffle_overflow_retries":
                           metrics.shuffle_overflow_retries.value}))
 

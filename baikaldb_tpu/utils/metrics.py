@@ -611,6 +611,13 @@ qos_rejections = Counter("qos_rejections")
 shuffle_rounds = Counter("shuffle_rounds")
 shuffle_overflow_retries = Counter("shuffle_overflow_retries")
 multiway_joins_fused = Counter("multiway_joins_fused")
+# keyed exchange scheduler: repartition collectives SKIPPED because the
+# input was already hash-partitioned on the key class (transitive
+# partition reuse) — each one is an avoided all_to_all + its trace
+shuffle_rounds_saved = Counter("shuffle_rounds_saved")
+# equality-class constant propagation (plan/planner.py): derived
+# col = const conjuncts pushed to sibling scans at plan time
+eqclass_consts_pushed = Counter("eqclass_consts_pushed")
 # cardinality-adaptive partial aggregation decisions (plan time, from the
 # index/stats ndv estimate): local = pre-reduce before the exchange,
 # raw = shuffle raw rows and aggregate once

@@ -310,6 +310,242 @@ def test_mesh_param_cache_zero_retraces(pair):
             assert got[0]["s"] == pytest.approx(ref[0]["s"], rel=1e-9)
 
 
+# -- keyed exchange scheduler: beyond one shared key ------------------------
+
+@pytest.fixture(scope="module")
+def mixed(mesh):
+    """Fact with TWO join key columns plus a string key — the TPC-H
+    q5/q7/q8/q9 shape where chain levels repartition on different keys."""
+    single = Session()
+    rng = np.random.default_rng(11)
+    names = ["alpha", "beta", "gamma", "delta", None]
+    single.execute("CREATE TABLE mf (id BIGINT, k1 BIGINT, k2 BIGINT, "
+                   "nm VARCHAR, val DOUBLE)")
+    rows = []
+    for i in range(420):
+        nm = names[int(rng.integers(0, 5))]
+        rows.append(f"({i}, {int(rng.integers(0, 40))}, "
+                    f"{int(rng.integers(0, 30))}, "
+                    + ("NULL" if nm is None else f"'{nm}'")
+                    + f", {round(float(rng.normal()), 3)})")
+    single.execute("INSERT INTO mf VALUES " + ", ".join(rows))
+    single.execute("CREATE TABLE ma (k BIGINT, a DOUBLE)")
+    single.execute("INSERT INTO ma VALUES " + ", ".join(
+        f"({int(rng.integers(0, 40))}, {i * 0.5})" for i in range(170)))
+    single.execute("CREATE TABLE mb (k BIGINT, b DOUBLE)")
+    single.execute("INSERT INTO mb VALUES " + ", ".join(
+        f"({int(rng.integers(0, 30))}, {i * 1.5})" for i in range(170)))
+    single.execute("CREATE TABLE mc (k BIGINT, c DOUBLE)")
+    single.execute("INSERT INTO mc VALUES " + ", ".join(
+        f"({int(rng.integers(0, 40))}, {i * 2.5})" for i in range(170)))
+    single.execute("CREATE TABLE md (nm VARCHAR, d DOUBLE)")
+    mdrows = []
+    for i in range(170):
+        nm = names[int(rng.integers(0, 5))]
+        mdrows.append("(" + ("NULL" if nm is None else f"'{nm}'")
+                      + f", {i * 3.5})")
+    single.execute("INSERT INTO md VALUES " + ", ".join(mdrows))
+    dist = Session(db=single.db, mesh=mesh)
+    return single, dist
+
+
+def _check_vs_chained(single, dist, mesh, sql):
+    """dist == single, AND the fused result == a fresh chained-binary
+    session's result of the SAME query (only FLAGS.multiway_join differs —
+    a fresh Session so the flipped flag cannot serve a cached fused plan)."""
+    a = _canon(single.query(sql))
+    fused = _canon(dist.query(sql))
+    assert len(a) == len(fused), (sql, len(a), len(fused))
+    for ra, rb in zip(a, fused):
+        for k in ra:
+            va, vb = ra[k], rb[k]
+            if isinstance(va, float) and vb is not None:
+                assert vb == pytest.approx(va, rel=1e-9, abs=1e-9), (sql, k)
+            else:
+                assert va == vb, (sql, k, ra, rb)
+    set_flag("multiway_join", False)
+    try:
+        chained_sess = Session(db=single.db, mesh=mesh)
+        plan_off = chained_sess.execute("EXPLAIN " + sql).plan_text
+        assert "MultiJoin" not in plan_off
+        chained = _canon(chained_sess.query(sql))
+    finally:
+        set_flag("multiway_join", True)
+    assert fused == chained
+    return fused
+
+
+MIXED_3WAY = ("SELECT f.id, a.a, b.b, c.c FROM mf f "
+              "JOIN ma a ON f.k1 = a.k JOIN mb b ON f.k2 = b.k "
+              "JOIN mc c ON f.k1 = c.k WHERE f.val > -9")
+
+
+def test_keyed_mixed_chain_two_segments(mixed, mesh, monkeypatch):
+    """k1, k2, k1 levels: the scheduler groups the two k1 levels into ONE
+    segment (the key class serving the most levels) and the k2 level into
+    a second — 2 shuffle rounds instead of 3, bit-identical to chained."""
+    _force_shuffle(monkeypatch)
+    single, dist = mixed
+    plan = dist.execute("EXPLAIN " + MIXED_3WAY).plan_text
+    assert plan.count("MultiJoin") == 2
+    assert "x2" in plan                  # the k1 segment holds two builds
+    assert "Exchange(repartition" not in plan
+    ex = dist.execute("EXPLAIN ANALYZE " + MIXED_3WAY).plan_text
+    line = [l for l in ex.splitlines() if l.startswith("-- exchange:")]
+    assert line and "rounds=2" in line[0] and "multiway=2" in line[0]
+    assert "keys=[k1,k2]" in line[0] or "keys=[k2,k1]" in line[0]
+    _check_vs_chained(single, dist, mesh, MIXED_3WAY)
+
+
+def test_keyed_transitive_single_segment(mixed, mesh, monkeypatch):
+    """f.k1 = a.k AND a.k = b.k: the equality class rewrites b's level
+    onto f.k1, so BOTH levels fuse into one segment — one shuffle round,
+    the ROADMAP's transitive-equality case."""
+    _force_shuffle(monkeypatch)
+    single, dist = mixed
+    sql = ("SELECT f.id, a.a, b.a b2 FROM mf f "
+           "JOIN ma a ON f.k1 = a.k JOIN ma b ON a.k = b.k "
+           "WHERE f.val > 0.0")
+    plan = dist.execute("EXPLAIN " + sql).plan_text
+    assert plan.count("MultiJoin") == 1 and "x2" in plan
+    ex = dist.execute("EXPLAIN ANALYZE " + sql).plan_text
+    line = [l for l in ex.splitlines() if l.startswith("-- exchange:")]
+    assert line and "rounds=1" in line[0]
+    _check_vs_chained(single, dist, mesh, sql)
+
+
+def test_keyed_left_levels_mixed(mixed, mesh, monkeypatch):
+    """LEFT levels on differing keys: each becomes its own segment (LEFT
+    keys never rewrite across classes), NULL-extension preserved."""
+    _force_shuffle(monkeypatch)
+    single, dist = mixed
+    sql = ("SELECT f.id, a.a, b.b FROM mf f "
+           "LEFT JOIN ma a ON f.k1 = a.k LEFT JOIN mb b ON f.k2 = b.k "
+           "WHERE f.id < 150")
+    plan = dist.execute("EXPLAIN " + sql).plan_text
+    assert plan.count("MultiJoin") == 2
+    _check_vs_chained(single, dist, mesh, sql)
+
+
+def test_keyed_string_and_null_mixed(mixed, mesh, monkeypatch):
+    """A STRING-keyed level (NULLs both sides) mixed with an INT-keyed
+    level: per-level dictionary alignment + NULL-never-matches through
+    two fused segments."""
+    _force_shuffle(monkeypatch)
+    single, dist = mixed
+    sql = ("SELECT f.id, d.d, a.a FROM mf f "
+           "JOIN md d ON f.nm = d.nm JOIN ma a ON f.k1 = a.k "
+           "WHERE f.val < 1.0")
+    plan = dist.execute("EXPLAIN " + sql).plan_text
+    assert plan.count("MultiJoin") == 2
+    _check_vs_chained(single, dist, mesh, sql)
+
+
+def test_keyed_four_table_mixed(mixed, mesh, monkeypatch):
+    """k1, k2, k1, k2 levels -> exactly two segments of two builds each:
+    4 per-edge rounds become 2."""
+    _force_shuffle(monkeypatch)
+    single, dist = mixed
+    sql = ("SELECT f.id, a.a, b.b, c.c, e.b e2 FROM mf f "
+           "JOIN ma a ON f.k1 = a.k JOIN mb b ON f.k2 = b.k "
+           "JOIN mc c ON f.k1 = c.k JOIN mb e ON f.k2 = e.k "
+           "WHERE f.val > 1.0")
+    plan = dist.execute("EXPLAIN " + sql).plan_text
+    assert plan.count("MultiJoin") == 2
+    assert plan.count("x2") == 2
+    ex = dist.execute("EXPLAIN ANALYZE " + sql).plan_text
+    line = [l for l in ex.splitlines() if l.startswith("-- exchange:")]
+    assert line and "rounds=2" in line[0]
+    _check_vs_chained(single, dist, mesh, sql)
+
+
+def test_keyed_skew_overflow_retry(mesh, monkeypatch):
+    """A hot key on ONE segment of a mixed-key chain rides the shuffle
+    overflow retry protocol; the other segment is untouched and the
+    result stays exact."""
+    _force_shuffle(monkeypatch)
+    single = Session()
+    rng = np.random.default_rng(13)
+    ks = [(7 if i < 380 else int(rng.integers(0, 40)),
+           int(rng.integers(0, 25))) for i in range(440)]
+    single.execute("CREATE TABLE sk (id BIGINT, k1 BIGINT, k2 BIGINT)")
+    single.execute("INSERT INTO sk VALUES " + ", ".join(
+        f"({i}, {a}, {b})" for i, (a, b) in enumerate(ks)))
+    single.execute("CREATE TABLE sa (k BIGINT, w DOUBLE)")
+    single.execute("INSERT INTO sa VALUES " + ", ".join(
+        f"({7 if i < 90 else int(rng.integers(0, 40))}, {i * 0.5})"
+        for i in range(128)))
+    single.execute("CREATE TABLE sb (k BIGINT, u DOUBLE)")
+    single.execute("INSERT INTO sb VALUES " + ", ".join(
+        f"({int(rng.integers(0, 25))}, {i * 1.5})" for i in range(128)))
+    dist = Session(db=single.db, mesh=mesh)
+    sql = ("SELECT f.id, a.w, b.u FROM sk f JOIN sa a ON f.k1 = a.k "
+           "JOIN sb b ON f.k2 = b.k WHERE f.id >= 0")
+    assert dist.execute("EXPLAIN " + sql).plan_text.count("MultiJoin") == 2
+    r0 = metrics.shuffle_overflow_retries.value
+    assert _canon(single.query(sql)) == _canon(dist.query(sql))
+    assert metrics.shuffle_overflow_retries.value > r0
+
+
+def test_partition_reuse_agg_after_join(mixed, monkeypatch):
+    """GROUP BY on the chain's partition class: the agg's repartition
+    exchange is marked reused (rows already co-located), the collective is
+    skipped, metrics.shuffle_rounds_saved counts it, and the executed
+    round count excludes it."""
+    _force_shuffle(monkeypatch)
+    single, dist = mixed
+    sql = ("SELECT f.k1, COUNT(*) n, SUM(a.a) s FROM mf f "
+           "JOIN ma a ON f.k1 = a.k JOIN mc c ON f.k1 = c.k "
+           "GROUP BY f.k1")
+    plan = dist.execute("EXPLAIN " + sql).plan_text
+    assert "reused" in plan
+    ex = dist.execute("EXPLAIN ANALYZE " + sql).plan_text
+    line = [l for l in ex.splitlines() if l.startswith("-- exchange:")]
+    assert line and "rounds=1" in line[0] and "reused=1" in line[0]
+    s0 = metrics.shuffle_rounds_saved.value
+    a = _canon(single.query(sql))
+    b = _canon(dist.query(sql))
+    assert metrics.shuffle_rounds_saved.value > s0
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        for k in ra:
+            if isinstance(ra[k], float):
+                assert rb[k] == pytest.approx(ra[k], rel=1e-9, abs=1e-9)
+            else:
+                assert ra[k] == rb[k]
+
+
+def test_keyed_mixed_param_cache_zero_retraces(mixed, monkeypatch):
+    """50 literal variants of a fused MULTI-KEY program (two segments,
+    differing classes) serve from ONE executable — the mesh param cache
+    holds through the keyed exchange scheduler's lowering."""
+    _force_shuffle(monkeypatch)
+    single, dist = mixed
+    sql = ("SELECT SUM(a.a) s FROM mf f JOIN ma a ON f.k1 = a.k "
+           "JOIN mb b ON f.k2 = b.k WHERE f.val > {lit}")
+    assert dist.execute(
+        "EXPLAIN " + sql.format(lit="0.0")).plan_text.count("MultiJoin") == 2
+    # warm BOTH sessions (xla_retraces is global — the single-device
+    # reference must not count against the mesh program) with the LOOSEST
+    # filter so shuffle/join caps settle at their high-water mark;
+    # tighter literals then reuse the same executables
+    for sess in (dist, single):
+        sess.query(sql.format(lit="-9.99"))
+        sess.query(sql.format(lit="-9.98"))
+    r0 = metrics.xla_retraces.value
+    h0 = metrics.plan_cache_param_hits.value
+    for i in range(50):
+        got = dist.query(sql.format(lit=str(i / 100)))
+        want = single.query(sql.format(lit=str(i / 100)))
+        if want[0]["s"] is None:
+            assert got[0]["s"] is None
+        else:
+            assert got[0]["s"] == pytest.approx(want[0]["s"], rel=1e-9)
+    assert metrics.xla_retraces.value == r0
+    # 50 param hits on the mesh session + 50 on the reference session
+    assert metrics.plan_cache_param_hits.value - h0 == 100
+
+
 def test_mpp_trace_spans(pair, monkeypatch):
     _force_shuffle(monkeypatch)
     single, dist = pair

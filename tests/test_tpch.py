@@ -151,6 +151,41 @@ def test_q14(env):
     assert abs(got - want) < 1e-6
 
 
+def test_tpch_shuffle_rounds_pinned(env, monkeypatch):
+    """Executed shuffle rounds for the multi-join shapes (q5/q7/q8/q9),
+    pinned per query in the forced-shuffle MPP regime so a keyed-exchange-
+    scheduler regression fails loudly.  Counted from the per-execution
+    metric, so a reused partition that still showed up in the plan tree
+    would inflate these numbers — the counter must report EXECUTED
+    repartitions only (q9 reuses one: its pin is 3 rounds / 5 collectives,
+    not the per-edge 3 / 6).  Plan-level pins incl. the per-edge baseline
+    live in tests/test_keyed_exchange.py::test_tpch_rounds_manifest."""
+    s, dfs = env
+    if s.mesh is None:
+        pytest.skip("shuffle rounds exist on the mesh only")
+    import baikaldb_tpu.plan.distribute as dist_mod
+    from baikaldb_tpu.utils import metrics
+    from baikaldb_tpu.utils.flags import set_flag
+
+    monkeypatch.setattr(dist_mod, "BROADCAST_ROWS", 0)
+    set_flag("dense_join_span_max", 0)
+    try:
+        from baikaldb_tpu.exec.session import Session
+        fresh = Session(db=s.db, mesh=s.mesh)
+        pinned = {"q5": 2, "q7": 4, "q8": 2, "q9": 3}
+        saved = {"q9": 1}
+        for q, want in pinned.items():
+            fresh.query(tpch.QUERIES[q])        # settle caps/compiles
+            r0 = metrics.shuffle_rounds.value
+            s0 = metrics.shuffle_rounds_saved.value
+            fresh.query(tpch.QUERIES[q])
+            assert metrics.shuffle_rounds.value - r0 == want, q
+            assert metrics.shuffle_rounds_saved.value - s0 == \
+                saved.get(q, 0), q
+    finally:
+        set_flag("dense_join_span_max", 1 << 24)
+
+
 def test_q4(env):
     s, dfs = env
     rows = s.query(tpch.QUERIES["q4"])

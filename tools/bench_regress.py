@@ -1,0 +1,114 @@
+"""Diff two ``bench_tpch --json`` outputs and fail on plan-level regressions.
+
+Wall-clock is noisy on shared CI hosts, but SHUFFLE ROUNDS and COMPILE
+COUNTS are deterministic functions of the plan — a keyed-exchange-scheduler
+regression shows up there loudly and reproducibly.  This tool compares a
+baseline capture against a candidate capture and exits nonzero when, for
+any query, the candidate
+
+  - executes MORE shuffle rounds (``shuffle_rounds``),
+  - pays MORE warm compiles (``warm_compiles`` — steady state must stay
+    compile-free), or
+  - loses partition reuse (``rounds_saved`` strictly decreased).
+
+Usage:
+    python -m baikaldb_tpu.tools.bench_tpch --json [--mesh 8] > base.json
+    ... change the planner ...
+    python -m baikaldb_tpu.tools.bench_tpch --json [--mesh 8] > cand.json
+    python -m tools.bench_regress base.json cand.json
+
+``--wall-clock-pct N`` additionally flags queries whose warm wall-clock
+regressed by more than N percent (off by default: timing noise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_capture(path: str) -> dict:
+    """Parse a bench_tpch --json capture: {"header": {...}, "queries":
+    {name: row}}.  Unknown/summary lines are ignored."""
+    out: dict = {"header": None, "queries": {}}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue                     # log noise interleaved: skip
+            if not isinstance(row, dict):
+                continue
+            if "header" in row:
+                out["header"] = row["header"]
+            elif "query" in row:
+                out["queries"][row["query"]] = row
+    return out
+
+
+def compare(base: dict, cand: dict, wall_clock_pct: float = 0.0) -> list:
+    """-> list of human-readable regression strings (empty = clean)."""
+    problems = []
+    bh, ch = base.get("header"), cand.get("header")
+    if bh and ch:
+        for k in ("scale", "mesh", "force_shuffle", "multiway"):
+            if bh.get(k) != ch.get(k):
+                problems.append(
+                    f"config mismatch: header.{k} {bh.get(k)!r} vs "
+                    f"{ch.get(k)!r} — captures are not comparable")
+    for q, b in sorted(base["queries"].items()):
+        c = cand["queries"].get(q)
+        if c is None:
+            problems.append(f"{q}: missing from candidate capture")
+            continue
+        if c.get("shuffle_rounds", 0) > b.get("shuffle_rounds", 0):
+            problems.append(
+                f"{q}: shuffle_rounds {b.get('shuffle_rounds')} -> "
+                f"{c.get('shuffle_rounds')}")
+        if c.get("warm_compiles", 0) > b.get("warm_compiles", 0):
+            problems.append(
+                f"{q}: warm_compiles {b.get('warm_compiles')} -> "
+                f"{c.get('warm_compiles')}")
+        if c.get("rounds_saved", 0) < b.get("rounds_saved", 0):
+            problems.append(
+                f"{q}: rounds_saved {b.get('rounds_saved')} -> "
+                f"{c.get('rounds_saved')} (partition reuse lost)")
+        if wall_clock_pct > 0 and b.get("warm_ms") and c.get("warm_ms"):
+            lim = b["warm_ms"] * (1.0 + wall_clock_pct / 100.0)
+            if c["warm_ms"] > lim:
+                problems.append(
+                    f"{q}: warm_ms {b['warm_ms']} -> {c['warm_ms']} "
+                    f"(> +{wall_clock_pct}%)")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("baseline", help="bench_tpch --json capture (before)")
+    ap.add_argument("candidate", help="bench_tpch --json capture (after)")
+    ap.add_argument("--wall-clock-pct", type=float, default=0.0,
+                    help="also flag warm wall-clock regressions beyond "
+                         "this percentage (0 = rounds/compiles only)")
+    args = ap.parse_args(argv)
+    base = load_capture(args.baseline)
+    cand = load_capture(args.candidate)
+    if not base["queries"]:
+        print(f"bench_regress: no query rows in {args.baseline}",
+              file=sys.stderr)
+        return 2
+    problems = compare(base, cand, args.wall_clock_pct)
+    if problems:
+        for p in problems:
+            print(f"REGRESSION {p}")
+        print(f"bench_regress: {len(problems)} regression(s)")
+        return 1
+    print(f"bench_regress: clean ({len(base['queries'])} queries compared)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
