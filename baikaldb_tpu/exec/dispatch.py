@@ -153,8 +153,13 @@ class BatchDispatcher:
         # ck_base -> the plan object every batched compile of this statement
         # group traces from (the first leader's; join-cap growth mutates it)
         self._plans: OrderedDict = OrderedDict()
-        # (ck_base, padded_group) -> (jitted fn, raw) — LRU-bounded
+        # (ck_base, padded_group) -> (jitted fn, raw, meta, publishable)
+        # — LRU-bounded; ``publishable`` is the unjitted batched callable
+        # the AOT publisher exports, None for AOT-loaded pairs
         self._compiled: OrderedDict = OrderedDict()
+        # batched-executable keys whose AOT artifact's baked caps
+        # overflowed on live data: never re-load them this process
+        self._aot_bad: set = set()
         # exact group-size histogram for information_schema.dispatcher
         self.occupancy: dict[int, int] = {}
 
@@ -329,6 +334,52 @@ class BatchDispatcher:
             self.occupancy[G] = self.occupancy.get(G, 0) + 1
         metrics.batched_groups.add(1)
         metrics.group_occupancy.observe(float(G))
+        from ..utils import compilecache
+        from .executor import AotRawShim, flag_meta_of
+
+        scap = max(1, int(FLAGS.batch_dispatch_scatter_rows))
+        # AOT artifact identity for this batched program: the statement
+        # group + plan signature (ck_base), the padded group size and
+        # scatter budget, the input skeleton (incl. dictionary content)
+        # and the topology.  Derived lazily — a warm tick that hits the
+        # in-memory pair never pays the fingerprint walk.
+        aot_key = None
+
+        def get_aot_key():
+            nonlocal aot_key
+            if aot_key is None and compilecache.AOT.enabled():
+                aot_key = compilecache.aot_key(
+                    "batched", entry.get("plan_sig"),
+                    (str(ck_base), gpad, scap),
+                    compilecache.input_fingerprint((table_batches,
+                                                    stacked)))
+            return aot_key
+
+        # AOT pairs pin the EXACT store versions they loaded under: jit
+        # retraces when a dictionary's content changes (pytree aux), a
+        # deserialized program cannot — in-bucket DML must re-derive the
+        # artifact key instead of reusing a stale-dictionary executable
+        vk = tuple(sorted(entry.get("versions", {}).items()))
+
+        def _fresh_batched():
+            # a publish-only clone of the combine program: the background
+            # export re-traces it, so it must own its OWN run_local
+            # closure and meta list — tracing the live pair's would mutate
+            # state a concurrent tick is reading
+            raw2 = compile_plan(plan)
+            meta2: list = []
+
+            def batched2(tb, sp_, _raw=raw2, _meta=meta2, _cap=scap):
+                def one(p):
+                    b = dict(tb)
+                    b[PARAMS_KEY] = p
+                    out, flags = _raw(b)
+                    _meta.clear()
+                    _meta.append(egress_mod.column_meta(out))
+                    return egress_mod.gather_live(out, _cap), flags
+                return jax.vmap(one)(sp_)
+            return batched2
+
         t0 = time.perf_counter()
         with trace.span("batch.combine", group=G, padded=gpad) as sp:
             if failpoint.ENABLED:
@@ -339,12 +390,28 @@ class BatchDispatcher:
                 ck = (ck_base, gpad)
                 with self._mu:
                     pair = self._compiled.get(ck)
-                    if pair is not None:
+                    if pair is not None and pair[3] is not None \
+                            and pair[3] != vk:
+                        del self._compiled[ck]      # stale AOT pair
+                        pair = None
+                    elif pair is not None:
                         self._compiled.move_to_end(ck)
+                if pair is None and compilecache.AOT.enabled() \
+                        and get_aot_key() is not None \
+                        and aot_key not in self._aot_bad:
+                    art = compilecache.AOT.load(aot_key)
+                    if art is not None and isinstance(
+                            (art.extra or {}).get("egress_meta"), tuple):
+                        # the vmapped program + its egress column meta
+                        # round-trip from the artifact: zero traces
+                        pair = (lambda tb, sp_, _art=art: _art.run((tb, sp_)),
+                                AotRawShim(art.flag_meta),
+                                [art.extra["egress_meta"]], vk)
+                        with self._mu:
+                            self._compiled[ck] = pair
                 if pair is None:
                     raw = compile_plan(plan)
                     meta: list = []          # filled at trace time
-                    scap = max(1, int(FLAGS.batch_dispatch_scatter_rows))
 
                     def batched(tb, sp_, _raw=raw, _meta=meta, _cap=scap):
                         def one(p):
@@ -357,16 +424,17 @@ class BatchDispatcher:
                         return jax.vmap(one)(sp_)
 
                     pair = (jax.jit(batched), raw,  # tpulint: disable=RETRACE
-                            meta)
+                            meta, None)
                     with self._mu:
                         self._compiled[ck] = pair
                         while len(self._compiled) > max(1, int(
                                 FLAGS.batch_dispatch_cache)):
                             self._compiled.popitem(last=False)
-                fn, raw, meta = pair
+                fn, raw, meta, _aot_vk = pair
                 traces_before = raw.trace_count[0]
                 (gdatas, gvalids, ns_dev), flags = fn(table_batches, stacked)
-                if raw.trace_count[0] > traces_before:
+                compiled_now = raw.trace_count[0] > traces_before
+                if compiled_now:
                     cms = (time.perf_counter() - t0) * 1e3
                     metrics.compile_ms.observe(cms)
                     sp.set(compiled=True)
@@ -374,7 +442,6 @@ class BatchDispatcher:
                     # compile (vmapped over the padded group) — record
                     # under kind="batched" with the group size in the
                     # shape so fleet dashboards see the fork-out
-                    from ..utils import compilecache
                     if compilecache.EXECUTABLES.enabled():
                         compilecache.EXECUTABLES.record_compile(
                             "batched",
@@ -386,7 +453,8 @@ class BatchDispatcher:
                 host_flags = jax.device_get(flags)
                 for node, flag in zip(raw.join_order, host_flags):
                     fl = np.asarray(flag)
-                    if isinstance(node, ScalarSourceNode):
+                    if isinstance(node, ScalarSourceNode) \
+                            or getattr(node, "aot_scalar", False):
                         for i in np.nonzero(fl[:G] > 1)[0]:
                             ws[int(i)].err = PlanError(
                                 "Subquery returns more than 1 row")
@@ -396,7 +464,23 @@ class BatchDispatcher:
                         node.cap = max(16, 1 << (needed - 1).bit_length())
                         grew = True
                 if not grew:
+                    if compiled_now and not isinstance(raw, AotRawShim) \
+                            and get_aot_key() is not None:
+                        compilecache.AOT.publish_async(
+                            aot_key, "batched",
+                            str(entry.get("text") or "<unnamed>"),
+                            entry.get("plan_sig"),
+                            lambda a, _b=_fresh_batched(): _b(a[0], a[1]),
+                            (table_batches, stacked),
+                            ((gdatas, gvalids, ns_dev), flags),
+                            flag_meta_of(raw.join_order),
+                            extra={"egress_meta": meta[0]})
                     break
+                if isinstance(raw, AotRawShim):
+                    # live data outgrew the artifact's baked caps: drop it
+                    # for this process and compile fresh
+                    self._aot_bad.add(aot_key)
+                    metrics.aot_cache_fallbacks.add(1)
                 with self._mu:
                     self._compiled.pop(ck, None)   # caps changed: re-trace
             else:

@@ -66,6 +66,49 @@ define("radix_join_min_build", 65536,
        "radix-partition joins only engage for builds at least this large")
 
 
+class AotFlagShim:
+    """Stands in for one plan node in the flag order of an AOT-loaded
+    executable: the artifact records each overflow flag's settled capacity
+    (and whether it is a scalar-subquery count) at publish time, and the
+    session's retry loop checks live flags against these.  A shim whose
+    cap is exceeded cannot grow (the capacity is baked into the exported
+    program) — the session falls back to compile-from-scratch instead."""
+
+    __slots__ = ("cap", "aot_scalar", "kind")
+
+    def __init__(self, cap, scalar: bool, kind: str):
+        self.cap = cap
+        self.aot_scalar = bool(scalar)
+        self.kind = kind
+
+
+def flag_meta_of(join_order) -> list:
+    """The publish-time snapshot of a settled executable's flag order:
+    [(cap, is_scalar, node-kind), ...] — everything an AOT run needs to
+    interpret the returned overflow flags without the plan objects."""
+    out = []
+    for node in join_order:
+        cap = getattr(node, "cap", None)
+        out.append({"cap": None if cap is None else int(cap),
+                    "scalar": isinstance(node, ScalarSourceNode),
+                    "kind": type(node).__name__})
+    return out
+
+
+class AotRawShim:
+    """Quacks like :func:`compile_plan`'s raw closure for the session /
+    dispatcher retry loops: ``trace_count`` never moves (an AOT run never
+    compiles — warm_compiles stays 0 by construction) and ``join_order``
+    carries :class:`AotFlagShim` entries in the artifact's flag order."""
+
+    def __init__(self, flag_meta: list):
+        self.join_order = [AotFlagShim(m.get("cap"), m.get("scalar", False),
+                                       m.get("kind", "?"))
+                           for m in (flag_meta or [])]
+        self.trace_order: list = []
+        self.trace_count = [0]
+
+
 class _CapBox:
     """A retryable capacity knob that rides the join-overflow protocol:
     the session retry loop grows ``.cap`` to the reported need and

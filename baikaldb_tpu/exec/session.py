@@ -60,6 +60,7 @@ define("param_queries", True,
        "entry and one compiled executable serve every literal variant of a "
        "query shape; 0 restores SQL-text-keyed caching with baked literals")
 from .dispatch import BatchDispatcher
+from . import executor
 from .executor import (_CapBox, compile_plan, count_shuffle_rounds,
                        exchange_summary)
 
@@ -324,6 +325,11 @@ class Database:
             # the scrape set automatically (instances refresh per poll)
             self.telemetry.attach_meta(
                 f"{cluster.meta.host}:{cluster.meta.port}")
+            # ... and the AOT executable tier replicates through the same
+            # deployment: this node publishes its compilations to the
+            # store daemons and warm-starts from its peers'
+            compilecache.AOT.attach_peer(
+                f"{cluster.meta.host}:{cluster.meta.port}")
             # real TCP daemons: scrape in the background (telemetry_poll_s)
             # so cluster_metrics / SHOW STATUS read a warm cache instead of
             # paying a serial fleet RPC round inline per query
@@ -385,6 +391,14 @@ class Database:
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]
+
+    @staticmethod
+    def attach_aot_peer(meta_address: str) -> None:
+        """Join the fleet AOT executable tier without full cluster mode:
+        publish compiled artifacts to / warm-start from the store daemons
+        behind this meta service (the cache tier is process-wide, so one
+        attach serves every Database in the process)."""
+        compilecache.AOT.attach_peer(meta_address)
 
     _BINLOG_RETRY_MAX = 1024    # queued batches PER TABLE; beyond, oldest drop
 
@@ -1775,6 +1789,44 @@ class Session:
         with trace.span("plan.build"):
             return self._plan_select_inner(stmt)
 
+    def _where_selectivity(self, stmt: SelectStmt):
+        """Combined selectivity estimate of the WHERE conjuncts that have
+        a stats basis (index/stats histograms + MCVs over THIS
+        execution's literal values); None when no conjunct resolves.
+        Feeds the adaptive-agg local-vs-raw decision and the mesh plan
+        cache's selectivity class — a parameterized statement replans per
+        CLASS, not per value, so the executable multiplier stays small."""
+        if stmt.where is None:
+            return None
+        from ..expr.ast import Call as ECall, ColRef as EColRef, Lit as ELit
+        from ..index.stats import conjunct_selectivity
+        from ..plan.eqclasses import conjuncts
+
+        _FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}
+        resolve = self._param_resolver(stmt)
+        total, basis = 1.0, False
+        for cj in conjuncts(stmt.where):
+            if not (isinstance(cj, ECall)
+                    and cj.op in ("eq", "ne", "lt", "le", "gt", "ge")
+                    and len(cj.args) == 2):
+                continue
+            a, b = cj.args
+            op = cj.op
+            if isinstance(b, EColRef) and isinstance(a, ELit):
+                a, b = b, a
+                op = _FLIP.get(op, op)
+            if not (isinstance(a, EColRef) and isinstance(b, ELit)):
+                continue
+            src = resolve(a.table, a.name)
+            if src is None:
+                continue
+            st = self._stats_fn(src[0], a.name.split(".")[-1])
+            s = conjunct_selectivity(st, op, b.value)
+            if s is not None:
+                total *= s
+                basis = True
+        return total if basis else None
+
     def _plan_select_inner(self, stmt: SelectStmt) -> PlanNode:
         plan = self._planner().plan_select(stmt)
         self._annotate_ann(stmt, plan)
@@ -1790,8 +1842,19 @@ class Session:
                 # cardinality-adaptive aggregation choice
                 return (self._stats_fn(table_key, col) or {}).get("ndv")
 
+            from ..parallel import agg as _agg  # noqa: F401 — defines the
+            #                                     adaptive_agg_* flags
+
+            # the parameterized path stashes the ORIGINAL statement's
+            # bound-value selectivity before planning (stmt here carries
+            # Param markers, not values); EXPLAIN and unparameterized
+            # plans compute it from their own baked literals
+            wsel = getattr(self, "_where_sel_hint", None)
+            if wsel is None and bool(FLAGS.adaptive_agg_selectivity):
+                wsel = self._where_selectivity(stmt)
             plan = distribute(plan, int(self.mesh.devices.size), rows_fn,
-                              ndv_fn=ndv_fn, stats_fn=self._stats_fn)
+                              ndv_fn=ndv_fn, stats_fn=self._stats_fn,
+                              where_selectivity=wsel)
         return plan
 
     def _annotate_ann(self, stmt: SelectStmt, plan: PlanNode) -> None:
@@ -3471,6 +3534,28 @@ class Session:
                 lookup_key = ("//params", self.current_db, n.key)
                 stmt_run = n.stmt
                 metrics.params_hoisted.add(len(n.slots))
+                if self.mesh is not None and stmt.group_by:
+                    # selectivity-aware parameterized plans (scoped to the
+                    # adaptive-agg decision): the bound values' combined
+                    # WHERE selectivity joins the cache key as a coarse
+                    # CLASS, so a highly selective literal replans (and can
+                    # flip local->raw) while same-regime literals share one
+                    # plan + executable.  Class 0 / no-basis keep the
+                    # unsuffixed key, and only GROUP BY statements key at
+                    # all (the class exists to flip the keyed-agg
+                    # local/raw decision; forking scalar-agg executables
+                    # per class would repay nothing) — the common case
+                    # pays nothing.
+                    from ..index.stats import selectivity_class
+                    from ..parallel import agg as _agg  # noqa: F401 —
+                    #   defines the adaptive_agg_* flags
+
+                    wsel = self._where_selectivity(stmt) \
+                        if bool(FLAGS.adaptive_agg_selectivity) else None
+                    self._where_sel_hint = wsel
+                    cls = selectivity_class(wsel)
+                    if cls > 0:
+                        lookup_key = lookup_key + (f"selcls{cls}",)
         if norm is None:
             return self._select_cached(stmt, cache_key, cache_key, None)
         from ..expr.compile import ExprError
@@ -3497,6 +3582,8 @@ class Session:
             # for the parameterized path itself
             metrics.plan_cache_param_fallbacks.add(1)
             return res
+        finally:
+            self._where_sel_hint = None
 
     def _select_cached(self, stmt: SelectStmt, text_key, lookup_key,
                        norm, count: bool = True) -> Result:
@@ -3698,6 +3785,16 @@ class Session:
         trace.event("xla", retraces_total=metrics.xla_retraces.value,
                     compiles=cstats["count"],
                     compile_avg_ms=cstats["avg_ms"])
+        # AOT persistent executable cache: whether this node can serve the
+        # plan without compiling after a restart, and the engine-wide
+        # hit/miss/fallback state of the tier
+        dstats = metrics.aot_cache_deser_ms.stats()
+        trace.event("aot", enabled=int(compilecache.AOT.enabled()),
+                    hits_total=metrics.aot_cache_hits.value,
+                    misses_total=metrics.aot_cache_misses.value,
+                    fallbacks_total=metrics.aot_cache_fallbacks.value,
+                    publishes_total=metrics.aot_cache_publishes.value,
+                    deser_avg_ms=dstats["avg_ms"])
         # device-resource accounting for THIS plan's executable (same rows
         # as information_schema.executables): what the program costs the
         # accelerator, not just how long the host waited
@@ -3793,6 +3890,14 @@ class Session:
             lines.append(f"-- xla: retraces_total={a['retraces_total']} "
                          f"compiles={a['compiles']} "
                          f"compile_avg_ms={a['compile_avg_ms']}")
+        for s in find("aot"):
+            a = s["attrs"]
+            lines.append(f"-- aot: enabled={a['enabled']} "
+                         f"hits_total={a['hits_total']} "
+                         f"misses_total={a['misses_total']} "
+                         f"fallbacks_total={a['fallbacks_total']} "
+                         f"publishes_total={a['publishes_total']} "
+                         f"deser_avg_ms={a['deser_avg_ms']}")
         for s in find("device"):
             a = s["attrs"]
             lines.append(f"-- device: compile_ms={a['compile_ms']} "
@@ -4372,6 +4477,26 @@ class Session:
                     [r["output_bytes"] for r in ex], pa.float64()),
                 "mem_source": [r["mem_source"] for r in ex],
             }) if ex else _empty_info("executables")
+        if name == "aot_cache":
+            # the persistent executable tier: what survives a restart
+            # (disk artifacts) and what this process did with it
+            # (hits / sources / deserialization cost)
+            rows = compilecache.AOT.rows()
+            return pa.table({
+                "key": [r["key"] for r in rows],
+                "kind": [r["kind"] for r in rows],
+                "statement": [r["statement"] for r in rows],
+                "plan_sig": [r["plan_sig"] for r in rows],
+                "size_bytes": pa.array([r["size_bytes"] for r in rows],
+                                       pa.int64()),
+                "jax_version": [r["jax_version"] for r in rows],
+                "created_at": [r["created_at"] for r in rows],
+                "source": [r["source"] for r in rows],
+                "hits": pa.array([r["hits"] for r in rows], pa.int64()),
+                "deser_ms": pa.array([r["deser_ms"] for r in rows],
+                                     pa.float64()),
+                "status": [r["status"] for r in rows],
+            }) if rows else _empty_info("aot_cache")
         if name == "flags":
             rows = FLAGS.describe()
             return pa.table({
@@ -4448,11 +4573,57 @@ class Session:
         # execution flags join the key: flipping SET GLOBAL
         # radix_join_buckets must re-trace, not silently reuse an executable
         # compiled under the other strategy
+        versions_key = tuple((tk, v) for tk, v, _cap in shape_key)
         shape_key = (tuple((tk, cap) for tk, _v, cap in shape_key),
                      int(FLAGS.radix_join_buckets),
                      int(FLAGS.radix_join_min_build))
+
+        # AOT persistent tier (utils/compilecache.AOT): the artifact key
+        # adds the input pytree skeleton (incl. dictionary content) + jax
+        # version + topology to the shape key, so a hit is exactly "the
+        # program this compile would produce".  Derived LAZILY — only on a
+        # shape-cache miss or at publish time — so the steady-state hot
+        # path never pays the fingerprint walk.
+        aot_key = None
+
+        def get_aot_key():
+            nonlocal aot_key
+            if aot_key is None and compilecache.AOT.enabled():
+                sig = entry.get("plan_sig")
+                if sig is None:
+                    sig = entry["plan_sig"] = plan_signature(plan)
+                aot_key = compilecache.aot_key(
+                    "plan", sig, shape_key,
+                    compilecache.input_fingerprint(batches), mesh)
+            return aot_key
+
+        compiled_here = False
         for _ in range(int(FLAGS.join_retry_max) + 1):
             pair = entry["compiled"].get(shape_key)
+            if pair is not None and len(pair) == 3 \
+                    and pair[2] != versions_key:
+                # an AOT pair is pinned to the EXACT store versions it
+                # loaded under: unlike jit (which keys on pytree aux and
+                # silently retraces when a dictionary's content changes),
+                # a deserialized program cannot notice that its baked
+                # string dictionaries went stale.  Any DML — even inside
+                # the capacity bucket — re-derives the artifact key; an
+                # unchanged input skeleton re-hits the same artifact, a
+                # changed dictionary is a clean miss
+                entry["compiled"].pop(shape_key, None)
+                pair = None
+            if pair is None and compilecache.AOT.enabled() \
+                    and shape_key not in entry.get("aot_bad", ()) \
+                    and get_aot_key() is not None:
+                art = compilecache.AOT.load(aot_key, mesh=mesh)
+                if art is not None:
+                    # no trace, no compile: the deserialized program runs
+                    # with its settled caps baked in; the shim feeds the
+                    # overflow loop below from the artifact's flag meta
+                    pair = (art.run,
+                            executor.AotRawShim(art.flag_meta),
+                            versions_key)
+                    entry["compiled"][shape_key] = pair
             if pair is None:
                 raw = compile_plan(plan, mesh=mesh)
                 # not a per-iteration wrapper: built only on a shape-cache
@@ -4469,7 +4640,7 @@ class Session:
                 while len(comp) >= max(1, int(FLAGS.plan_cache_shapes)):
                     comp.pop(next(iter(comp)))
                 comp[shape_key] = pair
-            fn, raw = pair
+            fn, raw = pair[0], pair[1]
             traces_before = raw.trace_count[0]
             t0 = time.perf_counter()
             # debug_guards: no implicit device->host transfer may hide in
@@ -4488,6 +4659,7 @@ class Session:
                     cms = (time.perf_counter() - t0) * 1e3
                     metrics.compile_ms.observe(cms)
                     sp.set(compiled=True)
+                    compiled_here = True
                     # device-resource accounting (compile seam): the cost/
                     # memory analysis itself is LAZY — only the identity,
                     # wall-ms, and the arg shape skeleton record here
@@ -4507,7 +4679,8 @@ class Session:
             host_flags = jax.device_get(flags)
             for node, flag in zip(raw.join_order, host_flags):
                 needed = int(flag)
-                if isinstance(node, ScalarSourceNode):
+                if isinstance(node, ScalarSourceNode) \
+                        or getattr(node, "aot_scalar", False):
                     if needed > 1:
                         raise PlanError("Subquery returns more than 1 row")
                     continue
@@ -4526,7 +4699,32 @@ class Session:
                         # shuffle capacity — the exchange backpressure
                         # analog, worth its own counter
                         metrics.shuffle_overflow_retries.add(1)
+            if grew and isinstance(raw, executor.AotRawShim):
+                # live data outgrew the artifact's baked capacities: an
+                # exported program cannot re-trace, so this shape compiles
+                # from scratch (and never re-loads the undersized artifact
+                # in this entry's lifetime)
+                entry.setdefault("aot_bad", set()).add(shape_key)
+                metrics.aot_cache_fallbacks.add(1)
+                entry["compiled"].pop(shape_key, None)
+                continue
             if not grew:
+                if compiled_here and not isinstance(raw, executor.AotRawShim) \
+                        and get_aot_key() is not None:
+                    # settled executable: hand it to the background
+                    # publisher (export + verify + disk + peer); the query
+                    # path never waits on it.  The publisher re-traces on
+                    # its own thread, so it gets a FRESH compile_plan
+                    # closure — tracing the live `raw` would mutate the
+                    # join_order/trace_order lists a concurrent execution
+                    # of this entry is reading
+                    compilecache.AOT.publish_async(
+                        aot_key, "plan",
+                        str(entry.get("text") or "<unnamed>"),
+                        entry.get("plan_sig"),
+                        compile_plan(plan, mesh=mesh), batches,
+                        (out, flags),
+                        executor.flag_meta_of(raw.join_order), mesh=mesh)
                 if mesh is not None:
                     self._mpp_telemetry(plan, entry, raw.join_order,
                                         host_flags)

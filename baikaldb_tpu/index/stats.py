@@ -262,6 +262,21 @@ def _coerce_value(st: dict, value):
     return value
 
 
+def selectivity_class(sel: Optional[float]) -> int:
+    """Coarse log8 bucket of a combined WHERE selectivity, the unit the
+    mesh plan cache keys on (exec/session): class 0 = unselective (>= 1/8
+    of rows survive), each higher class is another 8x cut, -1 = no stats
+    basis.  Coarse on purpose — each distinct class is another planned
+    variant of the statement, so the bucketing must collapse the continuum
+    of bound values into a handful of plan-relevant regimes."""
+    if sel is None:
+        return -1
+    import math
+
+    s = min(max(float(sel), 1e-12), 1.0)
+    return min(8, int(-math.log(s, 8) + 1e-9))
+
+
 def conjunct_selectivity(st: Optional[dict], op: str,
                          value) -> Optional[float]:
     """Selectivity of ``col OP literal`` under ``st``; None = no basis

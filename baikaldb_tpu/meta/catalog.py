@@ -275,6 +275,20 @@ class Catalog:
                                Field("argument_bytes", LType.FLOAT64),
                                Field("output_bytes", LType.FLOAT64),
                                Field("mem_source", LType.STRING))),
+        # AOT persistent executable cache (utils/compilecache.AOT): one row
+        # per artifact known to this node — disk-tier residents plus what
+        # this process loaded/published (source compiled|disk|peer|stale)
+        "aot_cache": Schema((Field("key", LType.STRING),
+                             Field("kind", LType.STRING),
+                             Field("statement", LType.STRING),
+                             Field("plan_sig", LType.STRING),
+                             Field("size_bytes", LType.INT64),
+                             Field("jax_version", LType.STRING),
+                             Field("created_at", LType.STRING),
+                             Field("source", LType.STRING),
+                             Field("hits", LType.INT64),
+                             Field("deser_ms", LType.FLOAT64),
+                             Field("status", LType.STRING))),
         # per-column collected statistics (index/stats): the distinct-count
         # estimate feeding the adaptive-agg decision, plus histogram/MCV
         # collection state — the reference's statistics.proto surface

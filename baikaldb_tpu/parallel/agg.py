@@ -40,16 +40,32 @@ define("adaptive_agg", True,
 define("agg_local_ratio", 0.5,
        "pre-reduce locally when estimated groups <= ratio * rows-per-shard "
        "(above it the partial pass moves more data than it saves)")
+define("adaptive_agg_selectivity", True,
+       "feed the bound-value WHERE selectivity (index/stats histograms "
+       "over THIS execution's literals) into the local-vs-raw decision: a "
+       "highly selective predicate shrinks effective rows-per-shard and "
+       "can flip local -> raw per execution.  0 restores the "
+       "selectivity-blind threshold")
 
 
-def choose_strategy(est_groups: Optional[int], rows_per_shard: int) -> str:
+def choose_strategy(est_groups: Optional[int], rows_per_shard: int,
+                    selectivity: Optional[float] = None) -> str:
     """-> "local" | "raw".  Pre-reduction shrinks each shard's exchange
     payload from ~rows_per_shard rows to ~min(groups, rows_per_shard)
     partials; it pays exactly when groups is well under rows_per_shard.
     Unknown cardinality (no stats) keeps the conservative raw shuffle —
-    a wrong "local" costs a wasted O(n log n) pre-pass on every shard."""
+    a wrong "local" costs a wasted O(n log n) pre-pass on every shard.
+
+    ``selectivity`` is the bound-value WHERE selectivity estimate for the
+    rows feeding this aggregate (index/stats over the literals of THIS
+    execution; None = no basis): the pre-pass only summarizes rows the
+    filter keeps, so effective rows-per-shard scales by it — a WHERE that
+    keeps 0.1% of rows makes even a 3-value group key not worth a local
+    pre-reduce pass over the full shard."""
     if not FLAGS.adaptive_agg or est_groups is None:
         return "raw"
+    if selectivity is not None and FLAGS.adaptive_agg_selectivity:
+        rows_per_shard = max(1, int(rows_per_shard * float(selectivity)))
     ratio = float(FLAGS.agg_local_ratio)
     return "local" if est_groups <= max(1, int(rows_per_shard * ratio)) \
         else "raw"

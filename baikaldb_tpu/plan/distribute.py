@@ -90,6 +90,7 @@ def distribute(plan: PlanNode, n_shards: int,
                broadcast_rows: Optional[int] = None,
                ndv_fn: Optional[Callable[[str, str], Optional[int]]] = None,
                stats_fn: Optional[Callable[[str, str], Optional[dict]]] = None,
+               where_selectivity: Optional[float] = None,
                ) -> PlanNode:
     """Annotate ``plan`` in place and insert Exchange nodes; returns the (new)
     root.  ``rows_fn(table_key) -> row count`` feeds the broadcast-vs-shuffle
@@ -97,13 +98,18 @@ def distribute(plan: PlanNode, n_shards: int,
     ``ndv_fn(table_key, col) -> distinct count`` (index/stats) feeds the
     cardinality-adaptive aggregation choice; absent stats keep the
     conservative raw-row shuffle.  ``stats_fn(table_key, col) -> stats
-    payload`` feeds the keyed exchange scheduler's partition-key tie-break."""
+    payload`` feeds the keyed exchange scheduler's partition-key tie-break.
+    ``where_selectivity`` is the session's bound-value estimate of the
+    fraction of rows the WHERE keeps (index/stats over THIS execution's
+    literals; None = no basis) — it scales the adaptive-agg rows-per-shard
+    so a highly selective predicate flips local -> raw per execution (the
+    mesh plan cache keys on its selectivity class)."""
     if broadcast_rows is None:
         broadcast_rows = BROADCAST_ROWS     # module attr: patchable in tests
         if int(FLAGS.mpp_broadcast_rows) >= 0:
             broadcast_rows = int(FLAGS.mpp_broadcast_rows)
     d = _Distributor(n_shards, rows_fn or (lambda tk: 0), broadcast_rows,
-                     ndv_fn)
+                     ndv_fn, where_selectivity)
     dist, _ = d.visit(plan)
     _clear_exchanged_sorted_builds(plan)
     if FLAGS.multiway_join and n_shards > 1:
@@ -633,11 +639,12 @@ def _column_origins(node: PlanNode) -> dict:
 
 class _Distributor:
     def __init__(self, n_shards: int, rows_fn, broadcast_rows: int,
-                 ndv_fn=None):
+                 ndv_fn=None, where_selectivity=None):
         self.n = n_shards
         self.rows_fn = rows_fn
         self.broadcast_rows = broadcast_rows
         self.ndv_fn = ndv_fn
+        self.where_sel = where_selectivity
         # plans are DAGs (subquery rewrites share the outer stream between a
         # Membership probe and its joined subplan): visit shared subtrees
         # once, or the second walk would find its own inserted Exchanges
@@ -766,7 +773,8 @@ class _Distributor:
                 # pays for
                 table = math.prod(x + 1 for x in node.domains)
                 if not FLAGS.adaptive_agg or \
-                        choose_strategy(table, rows_per_shard) == "local":
+                        choose_strategy(table, rows_per_shard,
+                                        self.where_sel) == "local":
                     node.merge = "collective"   # psum/pmin/pmax partial merge
                     node.agg_dist = "local"
                     metrics.agg_strategy_local.add(1)
@@ -782,7 +790,8 @@ class _Distributor:
                 return SHARD, est
             if not has_distinct and \
                     choose_strategy(self._est_groups(node, e),
-                                    rows_per_shard) == "local":
+                                    rows_per_shard,
+                                    self.where_sel) == "local":
                 # low-cardinality sorted GROUP BY: pre-reduce per shard and
                 # shuffle only the partial rows (executor-internal exchange
                 # — no ExchangeNode inserted here)

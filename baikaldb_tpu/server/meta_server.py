@@ -28,10 +28,20 @@ class MetaServer:
         self.service = MetaService(peer_count=peer_count)
         self._store_ids: dict[str, int] = {}        # address -> store_id
         self._mu = threading.Lock()
+        # AOT executable artifact manifest: key -> {address, info, ts} —
+        # the consensus-truth half of the fleet cache tier (bytes live on
+        # the store daemons, this map says which daemon holds which key).
+        # Bounded FIFO-by-publish: without a cap, every (statement, shape,
+        # jax version, topology) ever published lives here forever across
+        # fleet upgrades; an evicted key just recompiles+republishes once
+        from collections import OrderedDict
+        self._aot_manifest: "OrderedDict[str, dict]" = OrderedDict()
+        self._aot_manifest_max = 4096
         for name in ("register_store", "create_regions", "table_regions",
                      "drop_regions", "heartbeat", "tso", "instances", "ping",
                      "split_region_key", "merge_regions_key", "alloc_ids",
-                     "metrics", "prometheus"):
+                     "metrics", "prometheus", "aot_publish", "aot_lookup",
+                     "aot_manifest"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
         # daemon-scoped registry (see StoreServer): handler latency via the
         # RpcServer hook, topology gauges sampled live at scrape time
@@ -49,6 +59,8 @@ class MetaServer:
                            if i.status != "NORMAL"))
         self._c_heartbeats = self.metrics.counter("meta_heartbeats")
         self._c_orders = self.metrics.counter("meta_balance_orders")
+        self.metrics.gauge("meta_aot_artifacts",
+                           fn=lambda: len(self._aot_manifest))
 
     def start(self) -> None:
         self.rpc.start()
@@ -126,6 +138,30 @@ class MetaServer:
     def rpc_alloc_ids(self, table_id: int, n: int, floor: int = 0):
         return {"start": self.service.alloc_ids(int(table_id), int(n),
                                                 int(floor))}
+
+    # -- AOT artifact manifest --------------------------------------------
+    def rpc_aot_publish(self, key: str, address: str, info: dict = None):
+        """Register an artifact a store daemon now holds.  Last publisher
+        wins — republishing the same key after a recompile (new jax
+        version, moved topology) must repoint readers at the fresh
+        bytes."""
+        with self._mu:
+            self._aot_manifest.pop(str(key), None)
+            self._aot_manifest[str(key)] = {
+                "address": str(address), "info": dict(info or {}),
+                "ts": time.time()}
+            while len(self._aot_manifest) > self._aot_manifest_max:
+                self._aot_manifest.popitem(last=False)
+        return {"published": True}
+
+    def rpc_aot_lookup(self, key: str):
+        with self._mu:
+            ent = self._aot_manifest.get(str(key))
+            return dict(ent) if ent is not None else {}
+
+    def rpc_aot_manifest(self):
+        with self._mu:
+            return {k: dict(v) for k, v in self._aot_manifest.items()}
 
     def rpc_split_region_key(self, region_id: int, split_key_hex: str):
         """Key-range split finalize in the routing table: the child

@@ -41,7 +41,8 @@ def schema_from_wire(fields: list) -> Schema:
 
 class StoreServer:
     def __init__(self, store_id: int, address: str, meta_address: str = "",
-                 tick_interval: float = 0.05, seed: Optional[int] = None):
+                 tick_interval: float = 0.05, seed: Optional[int] = None,
+                 aot_dir: Optional[str] = None):
         self.store_id = store_id
         self.address = address
         host, port = address.rsplit(":", 1)
@@ -54,10 +55,23 @@ class StoreServer:
         self._peer_addr: dict[int, str] = {}           # store_id -> address
         self._peer_clients: dict[int, RpcClient] = {}
         self._stop = threading.Event()
+        # AOT executable artifact blobs this store holds for the fleet
+        # (utils/compilecache publish pushes them here; rejoining frontends
+        # fetch).  ``aot_dir`` makes the tier crash-durable through the
+        # cold-FS abstraction (a restarted daemon re-serves the same
+        # artifacts — the chaos-rejoin scenario); without it the blobs are
+        # in-memory only.  Namespaced: art/<key> vs xla/<file>.
+        self._aot_mu = threading.Lock()
+        self._aot_blobs: dict[str, bytes] = {}
+        self._aot_fs = None
+        if aot_dir:
+            from ..storage.coldfs import ExternalFS
+            self._aot_fs = ExternalFS(aot_dir)
         for name in ("create_region", "drop_region", "raft_msg", "propose",
                      "scan_raw", "region_status", "region_size", "ping",
                      "txn_status", "cold_manifest", "exec_fragment",
-                     "metrics", "prometheus"):
+                     "metrics", "prometheus", "aot_put", "aot_fetch",
+                     "aot_put_xla", "aot_fetch_xla", "aot_list"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
         # the failpoint `panic` action crashes THIS daemon, not just the
         # serving thread (the chaos harness's kill-9 analog)
@@ -71,6 +85,8 @@ class StoreServer:
         self._started = time.time()
         self.metrics.gauge("uptime_s", fn=lambda: time.time() - self._started)
         self.metrics.gauge("regions_hosted", fn=lambda: len(self.regions))
+        self.metrics.gauge("aot_artifacts_hosted",
+                           fn=lambda: len(self.rpc_aot_list()["artifacts"]))
         self._c_proposals = self.metrics.counter("raft_proposals")
         self._c_redirects = self.metrics.counter("raft_not_leader")
         region_labels = ("region",)
@@ -132,6 +148,57 @@ class StoreServer:
     # -- RPC surface ------------------------------------------------------
     def rpc_ping(self):
         return {"store_id": self.store_id}
+
+    # -- AOT artifact blob store ------------------------------------------
+    # Dumb named-bytes storage, the cold-tier discipline (storage/coldfs):
+    # the meta manifest is the truth about which keys exist; this store
+    # only holds and returns bytes.  Integrity is the READER's job — every
+    # artifact is digest-checked at unpack, so a store serving corrupted
+    # bytes degrades to a compile, never a wrong result.
+    def _aot_name(self, ns: str, key: str) -> str:
+        return f"{ns}_{key}"
+
+    def _aot_put(self, ns: str, key: str, data: bytes) -> None:
+        with self._aot_mu:
+            if self._aot_fs is not None:
+                self._aot_fs.put(self._aot_name(ns, key), data)
+            else:
+                self._aot_blobs[self._aot_name(ns, key)] = bytes(data)
+
+    def _aot_get(self, ns: str, key: str) -> Optional[bytes]:
+        name = self._aot_name(ns, key)
+        with self._aot_mu:
+            if self._aot_fs is not None:
+                try:
+                    return self._aot_fs.get(name)
+                except (OSError, FileNotFoundError):
+                    return None
+            return self._aot_blobs.get(name)
+
+    def rpc_aot_put(self, key: str, data: bytes):
+        self._aot_put("art", str(key), data)
+        return {"stored": True}
+
+    def rpc_aot_fetch(self, key: str):
+        return {"data": self._aot_get("art", str(key))}
+
+    def rpc_aot_put_xla(self, name: str, data: bytes):
+        self._aot_put("xla", str(name), data)
+        return {"stored": True}
+
+    def rpc_aot_fetch_xla(self, name: str):
+        return {"data": self._aot_get("xla", str(name))}
+
+    def rpc_aot_list(self):
+        with self._aot_mu:
+            if self._aot_fs is not None:
+                names = self._aot_fs.list()
+            else:
+                names = sorted(self._aot_blobs)
+        return {"artifacts": [n[len("art_"):] for n in names
+                              if n.startswith("art_")],
+                "xla": [n[len("xla_"):] for n in names
+                        if n.startswith("xla_")]}
 
     # -- telemetry plane --------------------------------------------------
     def _refresh_region_gauges(self) -> None:
@@ -494,9 +561,14 @@ def main() -> None:
     ap.add_argument("--metrics-port", type=int, default=0,
                     help="serve Prometheus exposition over HTTP on this "
                          "port (0 = RPC-plane rpc_prometheus only)")
+    ap.add_argument("--aot-dir", default="",
+                    help="directory for hosted AOT executable artifacts "
+                         "(empty = in-memory only; set it to survive "
+                         "daemon restarts)")
     args = ap.parse_args()
     srv = StoreServer(args.store_id, args.address, args.meta,
-                      tick_interval=args.tick)
+                      tick_interval=args.tick,
+                      aot_dir=args.aot_dir or None)
     srv.start()
     if args.metrics_port:
         from ..obs.telemetry import start_http_exporter

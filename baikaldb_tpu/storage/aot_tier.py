@@ -1,0 +1,347 @@
+"""AOT executable artifact tiers: container format, local disk tier, and
+the peer replication channel.
+
+The reference amortizes compilation by persisting plans (the prepared-stmt
+plan cache survives in the instance); a tensor-runtime engine pays a far
+steeper setup cost — every (plan signature, capacity bucket) executable is
+an XLA compile — so artifacts must survive the PROCESS and travel the
+FLEET.  This module owns the dumb, auditable half of that story:
+
+- :func:`pack_artifact` / :func:`unpack_artifact` — one self-verifying
+  container: magic + JSON header + the ``jax.export`` StableHLO payload +
+  a pickled host-side aux record (output pytree template, flag metadata,
+  egress column meta).  The header carries the sha256 of the payload
+  bytes; a truncated or bit-flipped file fails :class:`ArtifactError` at
+  unpack and is EVICTED by the caller, never trusted.
+- :class:`ArtifactDisk` — the local on-disk tier (atomic tmp+rename puts,
+  mtime-LRU eviction under ``aot_cache_disk_max``, gc/verify walks for
+  tools/aotcache.py).
+- :class:`AotReplicator` — the fleet tier: publish pushes the artifact
+  bytes (plus the XLA persistent-cache files its verify compile produced)
+  to a store daemon and registers the key in the meta service's manifest;
+  fetch resolves key -> holder address at meta and pulls the bytes under
+  the utils/net retry policy.  Everything here is best-effort: any
+  failure degrades to compile-from-scratch on the caller's side.
+
+The authoritative map of which keys exist where is the meta manifest (the
+cold-tier discipline of storage/coldfs.py: bytes on a dumb store, truth in
+the service) — a store daemon that lost its disk simply stops serving
+fetches and the manifest entry goes stale, which readers treat as a miss.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+from ..utils import metrics
+
+MAGIC = b"AOTX1\n"
+_SUFFIX = ".aotx"
+
+
+class ArtifactError(ValueError):
+    """Container-level corruption: bad magic, truncated payload, digest
+    mismatch, unparseable header.  Callers evict and fall back to compile;
+    this must never propagate into a query."""
+
+
+def pack_artifact(meta: dict, blob: bytes, aux: bytes) -> bytes:
+    """One self-verifying container.  ``meta`` is JSON-safe header fields;
+    ``blob`` the serialized ``jax.export`` module; ``aux`` the pickled
+    host-side record (never touched until the blob's digest checks out)."""
+    meta = dict(meta)
+    meta["blob_len"] = len(blob)
+    meta["aux_len"] = len(aux)
+    meta["sha256"] = hashlib.sha256(blob + aux).hexdigest()
+    head = json.dumps(meta, sort_keys=True).encode()
+    return MAGIC + len(head).to_bytes(8, "big") + head + blob + aux
+
+
+def unpack_meta(data: bytes) -> dict:
+    """Header only — no payload verification (cheap listing/gc walks)."""
+    if not data.startswith(MAGIC):
+        raise ArtifactError("bad magic")
+    if len(data) < len(MAGIC) + 8:
+        raise ArtifactError("truncated header length")
+    n = int.from_bytes(data[len(MAGIC):len(MAGIC) + 8], "big")
+    start = len(MAGIC) + 8
+    if n <= 0 or len(data) < start + n:
+        raise ArtifactError("truncated header")
+    try:
+        meta = json.loads(data[start:start + n])
+    except ValueError as e:
+        raise ArtifactError(f"unparseable header: {e}") from None
+    if not isinstance(meta, dict):
+        raise ArtifactError("header is not an object")
+    return meta
+
+
+def unpack_artifact(data: bytes) -> tuple[dict, bytes, bytes]:
+    """-> (meta, blob, aux); raises :class:`ArtifactError` on ANY
+    corruption (the digest covers both payload sections)."""
+    meta = unpack_meta(data)
+    head = json.dumps(meta, sort_keys=True).encode()
+    # header length from the wire, not re-derived: key order round-trips
+    n = int.from_bytes(data[len(MAGIC):len(MAGIC) + 8], "big")
+    start = len(MAGIC) + 8 + n
+    try:
+        blob_len = int(meta["blob_len"])
+        aux_len = int(meta["aux_len"])
+        want = meta["sha256"]
+    except (KeyError, TypeError, ValueError):
+        raise ArtifactError("header missing payload fields") from None
+    if len(data) != start + blob_len + aux_len:
+        raise ArtifactError("payload length mismatch")
+    blob = data[start:start + blob_len]
+    aux = data[start + blob_len:]
+    if hashlib.sha256(blob + aux).hexdigest() != want:
+        raise ArtifactError("payload digest mismatch")
+    del head
+    return meta, blob, aux
+
+
+class ArtifactDisk:
+    """Local on-disk artifact tier: one ``<key>.aotx`` file per executable,
+    atomic puts, mtime-LRU bound.  Keys are sha256 hexdigests, so the
+    filename needs no escaping."""
+
+    def __init__(self, root: str, max_entries: int = 256):
+        self.root = root
+        self.max_entries = max(1, int(max_entries))
+        os.makedirs(root, exist_ok=True)
+
+    def path(self, key: str) -> str:
+        return os.path.join(self.root, key + _SUFFIX)
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self.path(key), "rb") as f:
+                data = f.read()
+        except OSError:
+            return None
+        try:
+            # LRU touch: hits must outlive eviction pressure from colder
+            # artifacts published later
+            os.utime(self.path(key))
+        except OSError:
+            pass
+        self._bump_hits(key)
+        return data
+
+    def _hits_path(self, key: str) -> str:
+        return os.path.join(self.root, key + ".hits")
+
+    def _bump_hits(self, key: str) -> None:
+        """Advisory cross-process hit counter (tools/aotcache --list);
+        last-writer-wins racy by design — it informs eviction decisions,
+        it is not accounting."""
+        p = self._hits_path(key)
+        try:
+            try:
+                with open(p) as f:
+                    n = int(f.read().strip() or 0)
+            except (OSError, ValueError):
+                n = 0
+            with open(p, "w") as f:
+                f.write(str(n + 1))
+        except OSError:
+            pass
+
+    def hits(self, key: str) -> int:
+        try:
+            with open(self._hits_path(key)) as f:
+                return int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            return 0
+
+    def put(self, key: str, data: bytes) -> None:
+        tmp = self.path(key) + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path(key))
+        self._evict_over_cap()
+
+    def delete(self, key: str) -> bool:
+        try:
+            os.remove(self._hits_path(key))
+        except OSError:
+            pass
+        try:
+            os.remove(self.path(key))
+            return True
+        except FileNotFoundError:
+            return False
+        except OSError:
+            return False
+
+    def keys(self) -> list[str]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return sorted(n[:-len(_SUFFIX)] for n in names
+                      if n.endswith(_SUFFIX))
+
+    def entries(self) -> list[dict]:
+        """(key, size, mtime, header-meta-or-error) rows for --list and
+        information_schema.aot_cache; header parse only, no digest walk."""
+        rows = []
+        for key in self.keys():
+            p = self.path(key)
+            try:
+                stat = os.stat(p)
+                with open(p, "rb") as f:
+                    head = f.read(1 << 16)
+                meta = unpack_meta(head)
+                err = ""
+            except (OSError, ArtifactError) as e:
+                meta, err = {}, f"{type(e).__name__}: {e}"
+                try:
+                    stat = os.stat(p)
+                except OSError:
+                    continue
+            rows.append({"key": key, "size": stat.st_size,
+                         "mtime": stat.st_mtime, "meta": meta,
+                         "error": err})
+        return rows
+
+    def _evict_over_cap(self) -> None:
+        try:
+            names = [n for n in os.listdir(self.root)
+                     if n.endswith(_SUFFIX)]
+        except OSError:
+            return
+        if len(names) <= self.max_entries:
+            return
+        def mtime(n):
+            try:
+                return os.stat(os.path.join(self.root, n)).st_mtime
+            except OSError:
+                return 0.0
+        for n in sorted(names, key=mtime)[:len(names) - self.max_entries]:
+            try:
+                os.remove(os.path.join(self.root, n))
+                metrics.aot_cache_evictions.add(1)
+            except OSError:
+                continue
+            try:
+                # the hits sidecar goes with its artifact, or the dir
+                # accumulates orphans and a re-published key resumes a
+                # stale count
+                os.remove(os.path.join(
+                    self.root, n[:-len(_SUFFIX)] + ".hits"))
+            except OSError:
+                pass
+
+    def gc(self, keep_fn) -> list[str]:
+        """Evict artifacts ``keep_fn(meta) -> False`` rejects (stale jax
+        version, foreign topology).  Unparseable headers always evict.
+        Returns evicted keys."""
+        gone = []
+        for row in self.entries():
+            stale = bool(row["error"])
+            if not stale:
+                try:
+                    stale = not keep_fn(row["meta"])
+                except Exception:   # noqa: BLE001 — gc must finish the walk
+                    metrics.count_swallowed("aot.gc_keep")
+                    stale = False
+            if stale and self.delete(row["key"]):
+                metrics.aot_cache_evictions.add(1)
+                gone.append(row["key"])
+        return gone
+
+
+class AotReplicator:
+    """Fleet tier over the meta manifest + store daemon blob RPCs.
+
+    Publish: push the artifact (and the XLA persistent-cache files its
+    verify compile minted) to one store daemon, then register
+    ``key -> holder address`` at meta.  Fetch: resolve at meta, pull from
+    the holder.  Both sides run under the utils/net retry policy (deadline
+    budgets, jittered resends); every failure returns None/False — the
+    caller's fallback is always compile-from-scratch."""
+
+    def __init__(self, meta_address: str):
+        from ..utils.net import RpcClient
+
+        self._meta_address = meta_address
+        self.meta = RpcClient(meta_address, timeout=8.0)
+        self._stores: dict = {}
+
+    def _store(self, address: str):
+        from ..utils.net import RpcClient
+
+        c = self._stores.get(address)
+        if c is None:
+            c = self._stores[address] = RpcClient(address, timeout=8.0)
+        return c
+
+    def _pick_holder(self) -> Optional[str]:
+        try:
+            inst = self.meta.call("instances")
+        except Exception:   # noqa: BLE001 — replication is best-effort
+            metrics.count_swallowed("aot.pick_holder")
+            return None
+        live = sorted(a for a, row in (inst or {}).items()
+                      if row.get("status", "NORMAL") == "NORMAL")
+        return live[0] if live else None
+
+    def publish(self, key: str, data: bytes, info: dict,
+                xla_files: Optional[list] = None) -> bool:
+        """Push ``data`` (and sidecar xla cache files: [(name, bytes)])
+        to a store daemon and register the manifest entry."""
+        holder = self._pick_holder()
+        if holder is None:
+            return False
+        try:
+            st = self._store(holder)
+            st.call("aot_put", key=key, data=data)
+            for name, fdata in (xla_files or []):
+                st.call("aot_put_xla", name=name, data=fdata)
+            self.meta.call(
+                "aot_publish", key=key, address=holder,
+                info=dict(info,
+                          xla_files=[n for n, _ in (xla_files or [])]))
+            return True
+        except Exception:   # noqa: BLE001 — publish failure only costs a
+            #                 future recompile somewhere
+            metrics.count_swallowed("aot.publish_rpc")
+            return False
+
+    def fetch(self, key: str) -> Optional[tuple[bytes, list]]:
+        """-> (artifact bytes, [(xla name, bytes), ...]) or None."""
+        try:
+            ent = self.meta.call("aot_lookup", key=key)
+        except Exception:   # noqa: BLE001
+            metrics.count_swallowed("aot.lookup_rpc")
+            return None
+        if not ent or not ent.get("address"):
+            return None
+        try:
+            st = self._store(ent["address"])
+            resp = st.call("aot_fetch", key=key)
+            if not resp or resp.get("data") is None:
+                return None
+            xla = []
+            for name in (ent.get("info") or {}).get("xla_files", []):
+                xr = st.call("aot_fetch_xla", name=name)
+                if xr and xr.get("data") is not None:
+                    xla.append((name, xr["data"]))
+            return resp["data"], xla
+        except Exception:   # noqa: BLE001 — a dead holder is a cache miss
+            metrics.count_swallowed("aot.fetch_rpc")
+            return None
+
+    def manifest(self) -> dict:
+        try:
+            return self.meta.call("aot_manifest") or {}
+        except Exception:   # noqa: BLE001
+            metrics.count_swallowed("aot.manifest_rpc")
+            return {}

@@ -30,8 +30,12 @@ compile stats but no fresh analysis (``analyzed='evicted'``).
 
 from __future__ import annotations
 
+import hashlib
 import os
+import pickle
+import queue
 import threading
+import time
 import weakref
 from collections import OrderedDict
 from typing import Optional
@@ -41,6 +45,10 @@ from .flags import FLAGS, define
 REPO_DIR = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 CACHE_DIR = os.path.join(REPO_DIR, ".jax_cache")
+
+# bump when the artifact container / aux pickle layout changes: old
+# artifacts become clean misses instead of deserialization landmines
+AOT_FORMAT = 1
 
 
 def enable() -> None:
@@ -265,3 +273,623 @@ class ExecutableAccounting:
 
 
 EXECUTABLES = ExecutableAccounting()
+
+
+# -- AOT persistent executable cache ----------------------------------------
+#
+# The other half of zero-compile cold start: the in-memory plan cache dies
+# with the process, so a restarted node used to re-pay every (plan
+# signature, capacity bucket) trace+lower+compile from scratch.  Here every
+# settled executable is serialized via JAX AOT export (StableHLO + the
+# in/out calling convention) into a self-verifying artifact
+# (storage/aot_tier.py), spilled to a local disk tier, and replicated
+# through the store daemons + meta manifest so a fresh node warm-starts
+# from its peers' compilations.
+#
+# Two costs die separately:
+# - the Python trace + jax lowering (and every join-cap overflow retrace,
+#   since settled caps are baked into the exported program) die at
+#   ``export.deserialize`` — no plan function ever runs;
+# - the backend StableHLO->executable compile dies at the XLA persistent
+#   compilation cache, which the publish worker PRIMES by compiling its own
+#   artifact once (the deserialized module's cache key differs from the
+#   original jit compile's, so without the priming pass the first load
+#   would still pay a backend compile).
+#
+# Trust boundary: artifacts are advisory.  Corrupt bytes, foreign jax
+# versions, and alien device topologies are detected before anything
+# executes (digest + version/fingerprint checks); a loaded executable whose
+# baked capacities overflow on live data falls back to compile-from-scratch
+# (metrics.aot_cache_fallbacks).  The off-switch restores the exact
+# pre-cache behavior: every path below is gated on FLAGS.aot_cache.
+
+define("aot_cache", True,
+       "persist settled executables via JAX AOT export to a local disk "
+       "tier (and the peer tier when a meta service is attached) so a "
+       "restarted node warm-starts with zero compiles.  0 restores "
+       "compile-from-scratch cold starts")
+define("aot_cache_dir", "",
+       "AOT artifact directory (empty = <repo>/.aot_cache); the XLA "
+       "persistent compilation cache lives in its xla/ subdir unless the "
+       "process already configured one")
+define("aot_cache_peer_fetch", True,
+       "on a local disk miss, resolve the artifact through the meta "
+       "manifest and fetch it from the holding store daemon")
+define("aot_cache_disk_max", 256,
+       "local disk tier bound (artifacts); least-recently-touched evict")
+define("aot_cache_xla_dir", "",
+       "XLA persistent compilation cache directory backing the AOT tier "
+       "(empty = <repo>/.jax_cache).  MUST be the same absolute path on "
+       "every node: XLA's compile-cache keys incorporate the directory "
+       "path, so peer-replicated cache entries only hit when the fleet "
+       "agrees on one path (like any shared-cache mount point)")
+
+
+def backend_fingerprint(mesh=None) -> str:
+    """Platform/topology identity an artifact is only valid under: a CPU
+    export must never feed a TPU process, an 8-device shard_map program
+    never a 1-device mesh."""
+    import jax
+
+    devs = jax.devices()
+    fp = (f"{devs[0].platform}:{getattr(devs[0], 'device_kind', '?')}"
+          f":{len(devs)}")
+    if mesh is not None:
+        fp += ":mesh=" + "x".join(str(int(s)) for s in mesh.devices.shape)
+    return fp
+
+
+def _dict_digest(d) -> str:
+    if d is None:
+        return "-"
+    try:
+        return d._fingerprint().hex()
+    except Exception:   # noqa: BLE001 — an unhashable dictionary only
+        #                 costs cache reuse, never correctness
+        from . import metrics
+        metrics.count_swallowed("aot.dict_digest")
+        return f"?{id(d)}"
+
+
+def _fp_walk(h, obj) -> None:
+    """Structural fingerprint of a program input pytree: leaf shapes and
+    dtypes plus the STATIC aux data jit keys executables on (column ltypes,
+    dictionary contents, names, live-prefix promises).  Two batches with
+    equal fingerprints flatten to the same leaf order and trace to the
+    same program."""
+    from ..column.batch import Column, ColumnBatch
+
+    if isinstance(obj, ColumnBatch):
+        h.update(b"B")
+        h.update(repr(obj.names).encode())
+        h.update(b"1" if obj.live_prefix else b"0")
+        _fp_walk(h, obj.sel)
+        _fp_walk(h, obj.num_rows)
+        for c in obj.columns:
+            _fp_walk(h, c)
+        return
+    if isinstance(obj, Column):
+        h.update(b"C")
+        h.update(str(obj.ltype.value).encode())
+        h.update(_dict_digest(obj.dictionary).encode())
+        _fp_walk(h, obj.data)
+        _fp_walk(h, obj.validity)
+        return
+    if isinstance(obj, dict):
+        h.update(b"D")
+        for k in sorted(obj):
+            h.update(str(k).encode())
+            _fp_walk(h, obj[k])
+        return
+    if isinstance(obj, (tuple, list)):
+        h.update(b"T" if isinstance(obj, tuple) else b"L")
+        h.update(str(len(obj)).encode())
+        for x in obj:
+            _fp_walk(h, x)
+        return
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        h.update(f"A{tuple(obj.shape)}{obj.dtype}".encode())
+        return
+    h.update(f"V{obj!r}".encode())
+
+
+def input_fingerprint(args) -> str:
+    h = hashlib.sha256()
+    _fp_walk(h, args)
+    return h.hexdigest()
+
+
+def aot_key(kind: str, plan_sig, shape_sig, input_fp: str,
+            mesh=None) -> str:
+    """Artifact identity: program structure (plan signature), data shape
+    (capacity buckets + trace-time flags in ``shape_sig``), the input
+    pytree skeleton, jax/jaxlib versions and the backend topology.  Any
+    component moving is a clean miss — never a wrong-program hit."""
+    import jax
+    import jaxlib
+
+    h = hashlib.sha256()
+    for part in (f"fmt={AOT_FORMAT}", f"kind={kind}",
+                 f"sig={plan_sig}", f"shape={shape_sig!r}",
+                 f"in={input_fp}", f"jax={jax.__version__}",
+                 f"jaxlib={jaxlib.__version__}",
+                 f"dev={backend_fingerprint(mesh)}"):
+        h.update(part.encode())
+        h.update(b"\x00")
+    return h.hexdigest()
+
+
+class LoadedArtifact:
+    """A deserialized AOT executable plus the host-side metadata a run
+    needs: the output pytree template, the flag-order capacity metadata
+    (exec/executor.AotRawShim consumes it), and any kind-specific extra
+    (the batched dispatcher's egress column meta)."""
+
+    __slots__ = ("key", "meta", "source", "flag_meta", "extra",
+                 "_call", "_out_struct")
+
+    def __init__(self, key, meta, source, call, template, extra):
+        import jax
+
+        self.key = key
+        self.meta = meta
+        self.source = source                    # "disk" | "peer"
+        self.flag_meta = meta.get("flag_meta") or []
+        self.extra = extra
+        self._call = call
+        self._out_struct = jax.tree_util.tree_structure(template)
+
+    def run(self, args):
+        """Execute on an input pytree structurally identical to the one
+        the artifact was exported against (the key guarantees it)."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(args)
+        out_leaves = self._call(*leaves)
+        return jax.tree_util.tree_unflatten(self._out_struct,
+                                            list(out_leaves))
+
+
+class _PublishTask:
+    __slots__ = ("key", "kind", "statement", "plan_sig", "raw_call",
+                 "treedef", "structs", "shardings", "template", "flag_meta",
+                 "extra", "mesh")
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+
+class AotExecutableCache:
+    """Process-wide orchestrator of the artifact tiers (one instance,
+    ``AOT``): load = disk -> peer -> miss; publish = background export +
+    verify + disk put + peer push.  Every operation is gated on
+    FLAGS.aot_cache and degrades to a miss on any failure."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._disk = None
+        self._disk_root = None
+        self._replicator = None
+        self._records: "OrderedDict[str, dict]" = OrderedDict()
+        self._q: "queue.Queue[_PublishTask]" = queue.Queue()
+        self._worker = None
+        self._xla_configured = False
+        # XLA persistent-cache files already pushed to the peer tier: each
+        # publish ships every not-yet-pushed local entry (the query
+        # executables AND the eager op kernels around them — egress
+        # compact, dictionary remaps), so a peer-warmed node compiles
+        # nothing at all, not just no plan programs
+        self._xla_pushed: set = set()
+        # keys with a publish already queued/in flight: concurrent first
+        # touches of one executable (two sessions racing the same compile)
+        # export exactly once — the second enqueue is a no-op
+        self._pending: set = set()
+
+    # -- config -----------------------------------------------------------
+    def enabled(self) -> bool:
+        return bool(FLAGS.aot_cache)
+
+    def root(self) -> str:
+        d = str(FLAGS.aot_cache_dir).strip()
+        return d or os.path.join(REPO_DIR, ".aot_cache")
+
+    def disk(self):
+        from ..storage.aot_tier import ArtifactDisk
+
+        root = self.root()
+        with self._mu:
+            if self._disk is None or self._disk_root != root:
+                self._disk = ArtifactDisk(
+                    root, max_entries=int(FLAGS.aot_cache_disk_max))
+                self._disk_root = root
+            self._disk.max_entries = max(1, int(FLAGS.aot_cache_disk_max))
+            return self._disk
+
+    def attach_peer(self, meta_address: str) -> None:
+        """Join the fleet tier: publish to / fetch from the store daemons
+        behind this meta service's manifest."""
+        from ..storage.aot_tier import AotReplicator
+
+        with self._mu:
+            self._replicator = AotReplicator(meta_address)
+
+    def detach_peer(self) -> None:
+        with self._mu:
+            self._replicator = None
+
+    def xla_cache_dir(self) -> Optional[str]:
+        import jax
+
+        try:
+            return jax.config.jax_compilation_cache_dir or None
+        except AttributeError:
+            return None
+
+    def configure_xla_cache(self) -> None:
+        """Enable the XLA persistent compilation cache at the FLEET-
+        CONSTANT path (aot_cache_xla_dir, default <repo>/.jax_cache) —
+        unless the process already chose one (the tier-1 suite and the
+        driver share CACHE_DIR via :func:`enable`; composing with it is
+        fine, the artifacts' verify compiles just land there).
+
+        The path is deliberately NOT under aot_cache_dir: XLA's cache
+        keys incorporate the directory path itself, so priming entries
+        published by one node only hit on another node when both use the
+        SAME absolute path — a per-node path would silently break the
+        zero-compile warm start."""
+        import jax
+
+        if self._xla_configured or self.xla_cache_dir() is not None:
+            self._xla_configured = True
+            return
+        xdir = str(FLAGS.aot_cache_xla_dir).strip() or CACHE_DIR
+        jax.config.update("jax_compilation_cache_dir", xdir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        try:
+            # jax memoizes "is a cache configured?" at the FIRST compile of
+            # the process; a dir set after that (this path: engine compiles
+            # happen during table load, before the first AOT touch) would
+            # silently never be consulted.  Reset the memo so the very next
+            # compile re-reads the config.
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _jcc)
+            _jcc.reset_cache()
+        except Exception:   # noqa: BLE001 — jax-version drift: the tier
+            #                 still works, only the priming optimization
+            #                 degrades
+            from . import metrics
+            metrics.count_swallowed("aot.xla_reset")
+        self._xla_configured = True
+
+    # -- load -------------------------------------------------------------
+    def _version_ok(self, meta: dict, mesh) -> bool:
+        import jax
+        import jaxlib
+
+        return (meta.get("jax") == jax.__version__
+                and meta.get("jaxlib") == jaxlib.__version__
+                and meta.get("fingerprint") == backend_fingerprint(mesh)
+                and meta.get("format") == AOT_FORMAT)
+
+    def load(self, key: str, mesh=None) -> Optional[LoadedArtifact]:
+        """disk -> peer -> None.  Counts exactly one of hits/misses; a
+        corrupt artifact additionally counts an eviction + fallback."""
+        from . import metrics
+
+        if not self.enabled():
+            return None
+        self.configure_xla_cache()
+        disk = self.disk()
+        data = disk.get(key)
+        source = "disk"
+        if data is None and bool(FLAGS.aot_cache_peer_fetch):
+            with self._mu:
+                rep = self._replicator
+            if rep is not None:
+                fetched = rep.fetch(key)
+                if fetched is not None:
+                    data, xla_files = fetched
+                    source = "peer"
+                    metrics.aot_cache_peer_fetches.add(1)
+                    disk.put(key, data)
+                    self._plant_xla_files(xla_files)
+        if data is None:
+            metrics.aot_cache_misses.add(1)
+            return None
+        t0 = time.perf_counter()
+        try:
+            from ..storage.aot_tier import unpack_artifact
+
+            meta, blob, aux = unpack_artifact(data)
+            if not self._version_ok(meta, mesh):
+                # clean miss: a stale-version/foreign-topology artifact is
+                # not corruption, but keeping it on disk would re-run this
+                # check on every cold start forever
+                disk.delete(key)
+                metrics.aot_cache_evictions.add(1)
+                metrics.aot_cache_misses.add(1)
+                self._record(key, meta, "stale", 0.0)
+                return None
+            import jax
+            from jax import export as jax_export
+
+            exported = jax_export.deserialize(bytearray(blob))
+            call = jax.jit(exported.call)
+            auxd = pickle.loads(aux)
+            art = LoadedArtifact(key, meta, source, call,
+                                 auxd["template"], auxd.get("extra"))
+        except Exception:   # noqa: BLE001 — poisoned artifact: evict,
+            #   count, and let the caller compile; a cache must never turn
+            #   a query into a crash
+            metrics.count_swallowed("aot.load")
+            disk.delete(key)
+            metrics.aot_cache_evictions.add(1)
+            metrics.aot_cache_fallbacks.add(1)
+            self._record(key, {}, "corrupt", 0.0)
+            return None
+        deser_ms = (time.perf_counter() - t0) * 1e3
+        metrics.aot_cache_hits.add(1)
+        metrics.aot_cache_deser_ms.observe(deser_ms)
+        self._record(key, meta, source, deser_ms)
+        return art
+
+    def _plant_xla_files(self, xla_files) -> None:
+        """Write peer-fetched XLA persistent-cache entries into the local
+        cache dir so the artifact's backend compile is a cache hit."""
+        xdir = self.xla_cache_dir()
+        if not xdir or not xla_files:
+            return
+        try:
+            os.makedirs(xdir, exist_ok=True)
+            for name, data in xla_files:
+                safe = os.path.basename(str(name))
+                p = os.path.join(xdir, safe)
+                if os.path.exists(p):
+                    continue
+                tmp = p + f".tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, p)
+        except OSError:
+            from . import metrics
+            metrics.count_swallowed("aot.plant_xla")
+
+    def _record(self, key: str, meta: dict, source: str,
+                deser_ms: float) -> None:
+        with self._mu:
+            rec = self._records.get(key)
+            if rec is None:
+                rec = self._records[key] = {
+                    "key": key, "hits": 0, "deser_ms": 0.0}
+                while len(self._records) > 512:
+                    self._records.popitem(last=False)
+            rec.update(kind=meta.get("kind", rec.get("kind", "?")),
+                       statement=meta.get("statement",
+                                          rec.get("statement", "")),
+                       plan_sig=str(meta.get("plan_sig",
+                                             rec.get("plan_sig", ""))),
+                       source=source, deser_ms=round(deser_ms, 3))
+            if source in ("disk", "peer"):
+                rec["hits"] += 1
+
+    # -- publish ----------------------------------------------------------
+    def publish_async(self, key: str, kind: str, statement: str, plan_sig,
+                      raw_call, args, out, flag_meta, extra=None,
+                      mesh=None) -> None:
+        """Enqueue one settled executable for background export.  ``args``
+        is the live input pytree (only its struct skeleton is kept),
+        ``out`` the full output pytree of a successful run (only its
+        structure template is kept), ``raw_call(args_pytree)`` the
+        pure traceable program."""
+        import jax
+
+        if not self.enabled():
+            return
+        self.configure_xla_cache()
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        try:
+            def _struct(x):
+                # metadata only: .shape/.dtype are host attributes on both
+                # jax arrays and numpy feeds — never materialize the value
+                shape = getattr(x, "shape", None)
+                dtype = getattr(x, "dtype", None)
+                if shape is None or dtype is None:
+                    import numpy as np
+
+                    arr = np.asarray(x)     # plain host scalar leaf
+                    shape, dtype = arr.shape, arr.dtype
+                return jax.ShapeDtypeStruct(shape, dtype)
+
+            # live input shardings feed the verify/priming compile: a
+            # multi-device exported program can only lower in a context
+            # that knows its device assignment.  Single-device leaves stay
+            # UNANNOTATED — an explicit SingleDeviceSharding changes the
+            # XLA compile-cache key away from what the load-time call
+            # produces, and a mismatched priming is a wasted compile
+            def _multi(x):
+                sh = getattr(x, "sharding", None)
+                try:
+                    return sh if sh is not None and \
+                        len(sh.device_set) > 1 else None
+                except Exception:   # noqa: BLE001
+                    return None
+
+            # leaves is a host list; per-leaf work reads metadata only
+            structs = [_struct(x) for x in leaves]  # tpulint: disable=RETRACE
+            shardings = [_multi(x) for x in leaves]  # tpulint: disable=RETRACE
+        except Exception:   # noqa: BLE001 — an unexportable feed (object
+            #                 leaf) simply opts this executable out
+            from . import metrics
+            metrics.count_swallowed("aot.structs")
+            return
+        template = jax.tree_util.tree_map(lambda _x: 0, out)
+        task = _PublishTask(key=key, kind=kind, statement=statement,
+                            plan_sig=plan_sig, raw_call=raw_call,
+                            treedef=treedef, structs=structs,
+                            shardings=shardings, template=template,
+                            flag_meta=flag_meta, extra=extra, mesh=mesh)
+        with self._mu:
+            if key in self._pending:
+                return          # a concurrent first touch already queued it
+            self._pending.add(key)
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(target=self._work,
+                                                daemon=True,
+                                                name="aot-publish")
+                self._worker.start()
+                # a daemon thread killed mid-XLA-compile aborts the
+                # interpreter teardown; give in-flight publishes a bounded
+                # window to finish before exit
+                import atexit
+                atexit.register(self.drain, 10.0)
+        self._q.put(task)
+
+    def drain(self, timeout_s: float = 60.0) -> bool:
+        """Block until every queued publish finished (tests/CLI)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._q.all_tasks_done:
+                if self._q.unfinished_tasks == 0:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def _work(self) -> None:
+        while True:
+            task = self._q.get()
+            try:
+                self._publish_one(task)
+            except Exception:   # noqa: BLE001 — publishing is strictly
+                #   best-effort: a failed export costs one future recompile
+                from . import metrics
+                metrics.count_swallowed("aot.publish")
+            finally:
+                with self._mu:
+                    self._pending.discard(task.key)
+                self._q.task_done()
+
+    def _xla_listing(self) -> set:
+        xdir = self.xla_cache_dir()
+        if not xdir:
+            return set()
+        try:
+            return set(os.listdir(xdir))
+        except OSError:
+            return set()
+
+    def _publish_one(self, task: _PublishTask) -> None:
+        import jax
+        import jaxlib
+        from jax import export as jax_export
+
+        from ..storage.aot_tier import pack_artifact
+        from . import metrics
+        from ..exec import executor
+
+        # the export (and the verify compile below) re-trace the plan
+        # function on THIS thread: flag it so run_local's side-effect
+        # counters (trace_count / metrics.xla_retraces) stay untouched —
+        # a background publish must not read as plan-cache churn
+        executor.ACCOUNTING_TRACE.active = True
+        try:
+            if task.statement == "<unnamed>" \
+                    and os.path.exists(self.disk().path(task.key)):
+                # an EXPLAIN ANALYZE re-run of an already-published
+                # executable: same key, same program — re-exporting would
+                # only overwrite the artifact's real statement label
+                return
+            raw_call, treedef = task.raw_call, task.treedef
+
+            def _flat(*leaves):
+                out = raw_call(jax.tree_util.tree_unflatten(treedef,
+                                                            list(leaves)))
+                return tuple(jax.tree_util.tree_leaves(out))
+
+            exported = jax_export.export(jax.jit(_flat))(*task.structs)
+            blob = bytes(exported.serialize())
+            # verify: deserializing our own bytes is the integrity check —
+            # a corrupt export dies here, not on a serving node
+            back = jax_export.deserialize(bytearray(blob))
+            try:
+                # prime the XLA persistent cache: the deserialized
+                # module's compile-cache key differs from the original jit
+                # compile's, so without this pass every future load would
+                # still pay one backend compile.  Lowering needs the live
+                # device assignment for multi-device programs — the
+                # shardings captured from the real input leaves carry it.
+                primed = [jax.ShapeDtypeStruct(st.shape, st.dtype,
+                                               sharding=sh)
+                          for st, sh in zip(task.structs, task.shardings)]
+                jax.jit(back.call).lower(*primed).compile()
+            except Exception:   # noqa: BLE001 — priming is an
+                #   optimization: without it the first load compiles once
+                from . import metrics as _m
+                _m.count_swallowed("aot.prime")
+            meta = {"format": AOT_FORMAT, "key": task.key,
+                    "kind": task.kind, "statement": task.statement,
+                    "plan_sig": str(task.plan_sig),
+                    "jax": jax.__version__, "jaxlib": jaxlib.__version__,
+                    "fingerprint": backend_fingerprint(task.mesh),
+                    "created_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                time.gmtime()),
+                    "flag_meta": task.flag_meta}
+            aux = pickle.dumps({"template": task.template,
+                                "extra": task.extra})
+            data = pack_artifact(meta, blob, aux)
+            self.disk().put(task.key, data)
+            metrics.aot_cache_publishes.add(1)
+            self._record(task.key, meta, "published", 0.0)
+            with self._mu:
+                rep = self._replicator
+            if rep is not None:
+                xdir = self.xla_cache_dir()
+                xla_files = []
+                to_push = self._xla_listing() - self._xla_pushed
+                for name in sorted(to_push):
+                    try:
+                        with open(os.path.join(xdir, name), "rb") as f:
+                            xla_files.append((name, f.read()))
+                    except OSError:
+                        continue
+                if rep.publish(task.key, data,
+                               {"kind": task.kind,
+                                "plan_sig": str(task.plan_sig),
+                                "jax": jax.__version__}, xla_files):
+                    self._xla_pushed |= {n for n, _ in xla_files}
+        finally:
+            executor.ACCOUNTING_TRACE.active = False
+
+    # -- introspection (information_schema.aot_cache, tools/aotcache) -----
+    def rows(self) -> list[dict]:
+        disk_rows = {r["key"]: r for r in self.disk().entries()} \
+            if self.enabled() else {}
+        with self._mu:
+            recs = dict(self._records)
+        out = []
+        for key in sorted(set(disk_rows) | set(recs)):
+            d = disk_rows.get(key, {})
+            m = d.get("meta", {})
+            r = recs.get(key, {})
+            out.append({
+                "key": key,
+                "kind": r.get("kind") or m.get("kind", "?"),
+                "statement": r.get("statement") or m.get("statement", ""),
+                "plan_sig": r.get("plan_sig") or str(m.get("plan_sig", "")),
+                "size_bytes": int(d.get("size", 0)),
+                "jax_version": m.get("jax", ""),
+                "created_at": m.get("created_at", ""),
+                "source": r.get("source", "disk" if d else "memory"),
+                "hits": int(r.get("hits", 0)),
+                "deser_ms": float(r.get("deser_ms", 0.0)),
+                "status": "corrupt" if d.get("error") else "ok",
+            })
+        return out
+
+    def reset_records(self) -> None:
+        with self._mu:
+            self._records.clear()
+
+
+AOT = AotExecutableCache()

@@ -623,6 +623,22 @@ eqclass_consts_pushed = Counter("eqclass_consts_pushed")
 # raw = shuffle raw rows and aggregate once
 agg_strategy_local = Counter("agg_strategy_local")
 agg_strategy_raw = Counter("agg_strategy_raw")
+# AOT persistent executable cache (utils/compilecache.py): artifacts served
+# from the disk/peer tiers instead of a fresh trace+compile (hits), compile
+# seams that found no artifact (misses), artifacts fetched from a peer
+# through the meta manifest, artifacts published (exported + verified +
+# written), stale/corrupt artifacts evicted, and loads that had to degrade
+# back to a fresh compile AFTER a hit (corruption, baked-cap overflow) —
+# the correctness valve, should stay ~0 outside chaos runs
+aot_cache_hits = Counter("aot_cache_hits")
+aot_cache_misses = Counter("aot_cache_misses")
+aot_cache_peer_fetches = Counter("aot_cache_peer_fetches")
+aot_cache_publishes = Counter("aot_cache_publishes")
+aot_cache_evictions = Counter("aot_cache_evictions")
+aot_cache_fallbacks = Counter("aot_cache_fallbacks")
+# wall time of deserialize + first executable build for an AOT hit — the
+# cold-start cost that REPLACES compile_ms on warm-started nodes
+aot_cache_deser_ms = LatencyRecorder("aot_cache_deser_ms")
 
 
 def count_swallowed(site: str) -> None:

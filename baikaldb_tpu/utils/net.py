@@ -209,6 +209,16 @@ class RpcServer:
         lets in-flight replies drain)."""
         self._stop.set()
         try:
+            # shutdown BEFORE close: the accept thread blocks inside
+            # accept() holding the socket's fd reference, so a bare
+            # close() defers the actual fd teardown until one more
+            # connection arrives — the port stays LISTENING and a
+            # restarted daemon on the same address gets EADDRINUSE
+            # forever.  shutdown() pops the blocked accept immediately.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
@@ -449,6 +459,11 @@ class RpcClient:
         "instances", "table_regions", "heartbeat", "tso", "raft_msg",
         "drop_region", "drop_regions", "register_store", "cold_manifest",
         "exec_fragment", "metrics", "prometheus",
+        # AOT artifact tier: reads, plus puts/publishes that are
+        # idempotent by construction (same key -> same bytes; the meta
+        # manifest is last-writer-wins on identical content)
+        "aot_fetch", "aot_fetch_xla", "aot_list", "aot_lookup",
+        "aot_manifest", "aot_put", "aot_put_xla", "aot_publish",
     })
 
     # Fire-and-forget at the transport: raft IS its own retry protocol

@@ -14,9 +14,43 @@ It must run before any backend init in the process; ``jax.config.update``
 after a backend has initialized succeeds silently with no effect.
 """
 
+import json
 import os
 import subprocess
 import sys
+import time
+
+# process-level probe verdict memo: one bench/watcher process must never
+# pay the subprocess probe (or its retry window) twice
+_PROBE_MEMO: dict = {}
+
+
+def load_probe_verdict(cache_path: str,
+                       max_age_s: float) -> dict | None:
+    """The cross-process probe verdict ({"platform": str|None, "ts": ...})
+    if one was saved within ``max_age_s``, else None.  A cached FAILURE is
+    the valuable case: it lets the next bench process skip the multi-
+    minute retry window a wedged tunnel costs (BENCH_r05: 4 x 75 s failed
+    attempts before the CPU fallback)."""
+    try:
+        with open(cache_path) as f:
+            v = json.load(f)
+        if time.time() - float(v["ts"]) <= max_age_s:
+            return v
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    return None
+
+
+def save_probe_verdict(cache_path: str, platform: str | None) -> None:
+    try:
+        os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+        tmp = f"{cache_path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"platform": platform, "ts": time.time()}, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        pass                                    # verdict cache is advisory
 
 
 def probe_backend_once(timeout_s: float) -> str | None:
@@ -25,6 +59,9 @@ def probe_backend_once(timeout_s: float) -> str | None:
     subprocess is essential: a wedged tunnel hangs the initializing process,
     and that process must not be the caller.  Shared by bench.py and
     tools/tpu_watch.py so tunnel-health logic cannot diverge."""
+    memo = _PROBE_MEMO.get("verdict")
+    if memo is not None:
+        return memo["platform"]
     code = "import jax; print(jax.devices()[0].platform)"
     try:
         r = subprocess.run([sys.executable, "-c", code],
@@ -34,7 +71,12 @@ def probe_backend_once(timeout_s: float) -> str | None:
     if r.returncode != 0:
         return None
     out = r.stdout.strip().splitlines()
-    return out[-1] if out else None
+    platform = out[-1] if out else None
+    if platform is not None:
+        # memoize success only: a failure may be transient within this
+        # process's lifetime (the caller owns the retry policy)
+        _PROBE_MEMO["verdict"] = {"platform": platform}
+    return platform
 
 
 def honor_cpu_env() -> bool:

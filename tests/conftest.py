@@ -30,3 +30,12 @@ if not honor_cpu_env():          # not assert: must survive python -O
 from baikaldb_tpu.utils import compilecache  # noqa: E402
 
 compilecache.enable()
+
+# The AOT artifact tier is OFF for the suite: many tests pin exact
+# trace/compile counts (xla_retraces, compiles-per-query), and an artifact
+# persisted by a previous run would serve those compiles from disk — same
+# results, different counters, flaky pins.  tests/test_aot_cache.py turns
+# it on explicitly against tmp directories.
+from baikaldb_tpu.utils.flags import set_flag  # noqa: E402
+
+set_flag("aot_cache", False)

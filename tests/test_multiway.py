@@ -592,3 +592,68 @@ def test_hll_ndv_estimate():
     assert abs(st["ndv"] - truth) / truth < 0.05
     # floats hash by value (0.0 == -0.0)
     assert hll_ndv(np.array([0.0, -0.0, 1.5, 1.5])) <= 3
+
+
+def test_adaptive_agg_selectivity_flips_local_to_raw(pair):
+    """Selectivity-aware thresholds: ONE statement shape, two bound
+    values.  An unselective WHERE keeps the low-cardinality local
+    pre-reduce; a highly selective bound value shrinks effective
+    rows-per-shard and flips the SAME statement to the raw shuffle per
+    execution (the plan cache keys on the selectivity class).  Both arms
+    must agree with single-device execution."""
+    single, dist = pair
+    tpl = ("SELECT hk, COUNT(*) c, SUM(val) sv FROM fact "
+           "WHERE id > {v} GROUP BY hk")
+    # unselective: every row survives -> local arm (hk has 3 values)
+    plan_lo = dist.execute("EXPLAIN " + tpl.format(v=-1)).plan_text
+    assert "agg_dist=local" in plan_lo
+    check(pair, tpl.format(v=-1))
+    # selective: ~1/500 of rows survive -> raw arm, same statement shape
+    loc0 = metrics.agg_strategy_local.value
+    raw0 = metrics.agg_strategy_raw.value
+    plan_hi = dist.execute("EXPLAIN " + tpl.format(v=498)).plan_text
+    assert "agg_dist=raw" in plan_hi
+    assert metrics.agg_strategy_raw.value > raw0
+    check(pair, tpl.format(v=498))
+    # the parameterized path planned one variant per selectivity CLASS:
+    # nearby values in the same regime share the raw-arm plan entry
+    hits0 = metrics.plan_cache_param_hits.value
+    check(pair, tpl.format(v=497))
+    assert metrics.plan_cache_param_hits.value > hits0
+    # off-switch restores the selectivity-blind local decision
+    set_flag("adaptive_agg_selectivity", False)
+    try:
+        plan_off = dist.execute("EXPLAIN " + tpl.format(v=498)).plan_text
+        assert "agg_dist=local" in plan_off
+    finally:
+        set_flag("adaptive_agg_selectivity", True)
+    assert metrics.agg_strategy_local.value > loc0
+
+
+def test_choose_strategy_selectivity_unit():
+    from baikaldb_tpu.parallel.agg import choose_strategy
+
+    # 8 groups vs 100 rows/shard: local without selectivity...
+    assert choose_strategy(8, 100) == "local"
+    # ...raw when a selective WHERE leaves ~1 row per shard
+    assert choose_strategy(8, 100, selectivity=0.01) == "raw"
+    # unselective predicates change nothing
+    assert choose_strategy(8, 100, selectivity=1.0) == "local"
+    # no stats basis keeps the selectivity-blind decision
+    assert choose_strategy(8, 100, selectivity=None) == "local"
+    set_flag("adaptive_agg_selectivity", False)
+    try:
+        assert choose_strategy(8, 100, selectivity=0.01) == "local"
+    finally:
+        set_flag("adaptive_agg_selectivity", True)
+
+
+def test_selectivity_class_buckets():
+    from baikaldb_tpu.index.stats import selectivity_class
+
+    assert selectivity_class(None) == -1
+    assert selectivity_class(1.0) == 0
+    assert selectivity_class(0.5) == 0          # still >= 1/8
+    assert selectivity_class(1.0 / 8) == 1
+    assert selectivity_class(0.01) == 2
+    assert selectivity_class(1e-30) == 8        # clamped

@@ -1,0 +1,161 @@
+"""AOT executable cache admin CLI.
+
+The persistent artifact tier (baikaldb_tpu/utils/compilecache.AOT +
+storage/aot_tier) is operator-facing state: it survives restarts, it is
+replicated around the fleet, and a corrupted or stale artifact costs a
+(counted, safe) fallback compile on every node that touches it.  This tool
+is the offline half of that contract:
+
+    python -m tools.aotcache --list            # inventory: key, kind,
+                                               #   size, jax version, hits
+    python -m tools.aotcache --gc              # evict artifacts from other
+                                               #   jax versions/topologies
+    python -m tools.aotcache --verify          # deserialize-check every
+                                               #   artifact; exit 1 on any
+                                               #   corruption
+    ... --dir PATH                             # non-default artifact dir
+
+``--verify`` performs the full trust pipeline a serving node would —
+container digest check, header validation, ``jax.export`` deserialization —
+WITHOUT executing anything, so it is safe to run against a live tier.
+``--gc`` uses header metadata only (cheap walk).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _disk(args):
+    from baikaldb_tpu.storage.aot_tier import ArtifactDisk
+    from baikaldb_tpu.utils.compilecache import AOT
+
+    root = args.dir or AOT.root()
+    if not os.path.isdir(root):
+        print(f"aotcache: no artifact directory at {root}")
+        return None
+    return ArtifactDisk(root, max_entries=1 << 30)   # admin view: no evict
+
+
+def cmd_list(args) -> int:
+    disk = _disk(args)
+    if disk is None:
+        return 0
+    rows = disk.entries()
+    if not rows:
+        print("aotcache: empty")
+        return 0
+    print(f"{'key':16} {'kind':8} {'size':>9} {'jax':10} {'hits':>5} "
+          f"{'created':20} statement")
+    total = 0
+    for r in sorted(rows, key=lambda r: r["key"]):
+        m = r["meta"]
+        total += r["size"]
+        status = " CORRUPT" if r["error"] else ""
+        print(f"{r['key'][:16]:16} {m.get('kind', '?'):8} "
+              f"{r['size']:>9} {m.get('jax', '?'):10} "
+              f"{disk.hits(r['key']):>5} "
+              f"{m.get('created_at', '?'):20} "
+              f"{(m.get('statement') or '')[:60]}{status}")
+    print(f"-- {len(rows)} artifact(s), {total / 1024:.1f} KiB "
+          f"in {disk.root}")
+    return 0
+
+
+def cmd_gc(args) -> int:
+    disk = _disk(args)
+    if disk is None:
+        return 0
+    import jax
+    import jaxlib
+
+    from baikaldb_tpu.utils.compilecache import (AOT_FORMAT,
+                                                 backend_fingerprint)
+
+    fp_prefix = backend_fingerprint().split(":mesh=")[0]
+
+    def keep(meta: dict) -> bool:
+        if meta.get("format") != AOT_FORMAT:
+            return False
+        if meta.get("jax") != jax.__version__ \
+                or meta.get("jaxlib") != jaxlib.__version__:
+            return False
+        # mesh-shape variants of THIS backend survive; foreign platforms
+        # and device counts go
+        return str(meta.get("fingerprint", "")).startswith(fp_prefix)
+
+    gone = disk.gc(keep)
+    for k in gone:
+        print(f"evicted {k}")
+    print(f"aotcache: gc evicted {len(gone)} stale artifact(s) "
+          f"(current jax {jax.__version__}, {fp_prefix})")
+    return 0
+
+
+def cmd_verify(args) -> int:
+    disk = _disk(args)
+    if disk is None:
+        return 0
+    import pickle
+
+    from jax import export as jax_export
+
+    from baikaldb_tpu.storage.aot_tier import (ArtifactError,
+                                               unpack_artifact)
+
+    bad = 0
+    for key in disk.keys():
+        try:
+            # read the file directly: disk.get() would utime + bump hit
+            # counters, corrupting the live tier's LRU ordering — a verify
+            # walk must leave no trace
+            with open(disk.path(key), "rb") as f:
+                data = f.read()
+        except OSError:
+            data = None
+        try:
+            if data is None:
+                raise ArtifactError("unreadable")
+            meta, blob, aux = unpack_artifact(data)
+            jax_export.deserialize(bytearray(blob))
+            pickle.loads(aux)
+            print(f"ok      {key[:16]} ({len(data)} bytes, "
+                  f"{meta.get('kind', '?')})")
+        except Exception as e:  # noqa: BLE001 — report every corruption,
+            #                     whatever layer it surfaces from
+            bad += 1
+            print(f"CORRUPT {key[:16]}: {type(e).__name__}: {e}")
+    n = len(disk.keys())
+    print(f"aotcache: verified {n} artifact(s), {bad} corrupt")
+    return 1 if bad else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    g = ap.add_mutually_exclusive_group(required=True)
+    g.add_argument("--list", action="store_true",
+                   help="inventory the artifact tier")
+    g.add_argument("--gc", action="store_true",
+                   help="evict artifacts from other jax versions / "
+                        "device topologies")
+    g.add_argument("--verify", action="store_true",
+                   help="deserialize-check every artifact; exit nonzero "
+                        "on corruption")
+    ap.add_argument("--dir", default="",
+                    help="artifact directory (default: the engine's "
+                         "aot_cache_dir)")
+    args = ap.parse_args(argv)
+    if args.list:
+        return cmd_list(args)
+    if args.gc:
+        return cmd_gc(args)
+    return cmd_verify(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,4 @@
-"""Diff two ``bench_tpch --json`` outputs and fail on plan-level regressions.
+"""Diff two bench captures, fail on plan-level / cold-start regressions.
 
 Wall-clock is noisy on shared CI hosts, but SHUFFLE ROUNDS and COMPILE
 COUNTS are deterministic functions of the plan — a keyed-exchange-scheduler
@@ -19,6 +19,13 @@ Usage:
 
 ``--wall-clock-pct N`` additionally flags queries whose warm wall-clock
 regressed by more than N percent (off by default: timing noise).
+
+Captures from ``bench.py`` are also understood: the cold-start line (AOT
+persistent executable cache) is diffed on its deterministic counters — a
+warm-started node that starts paying compiles again
+(``warm_*.warm_compiles`` > baseline) or loses AOT hits fails CI, and
+``--coldstart-pct N`` bounds the ``restart_to_steady_ms`` wall-clock
+regression (default 50; 0 disables).
 """
 
 from __future__ import annotations
@@ -29,9 +36,10 @@ import sys
 
 
 def load_capture(path: str) -> dict:
-    """Parse a bench_tpch --json capture: {"header": {...}, "queries":
-    {name: row}}.  Unknown/summary lines are ignored."""
-    out: dict = {"header": None, "queries": {}}
+    """Parse a bench_tpch --json capture ({"header": ..., "queries": ...})
+    or a bench.py JSON-lines capture (the cold-start row is extracted).
+    Unknown/summary lines are ignored."""
+    out: dict = {"header": None, "queries": {}, "coldstart": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -47,7 +55,47 @@ def load_capture(path: str) -> dict:
                 out["header"] = row["header"]
             elif "query" in row:
                 out["queries"][row["query"]] = row
+            elif str(row.get("metric", "")).startswith(
+                    "restart-to-steady") and "cold" in row:
+                out["coldstart"] = row
     return out
+
+
+def compare_coldstart(base: dict, cand: dict, pct: float) -> list:
+    """Cold-start regressions between two bench.py captures: compile
+    counters are deterministic (hard fail), restart wall clock is bounded
+    by ``pct`` percent."""
+    b, c = base.get("coldstart"), cand.get("coldstart")
+    if b is None or c is None:
+        return []
+    problems = []
+    for phase in ("warm_disk", "warm_peer", "chaos_rejoin"):
+        bp, cp = b.get(phase), c.get(phase)
+        if not isinstance(bp, dict) or not isinstance(cp, dict):
+            continue
+        if cp.get("warm_compiles", 0) > bp.get("warm_compiles", 0):
+            problems.append(
+                f"coldstart.{phase}: warm_compiles "
+                f"{bp.get('warm_compiles')} -> {cp.get('warm_compiles')} "
+                f"(warm start is compiling again)")
+        if cp.get("aot_hits", 0) < bp.get("aot_hits", 0):
+            problems.append(
+                f"coldstart.{phase}: aot_hits {bp.get('aot_hits')} -> "
+                f"{cp.get('aot_hits')} (artifacts no longer served)")
+    if c.get("cold_compiles", 0) > b.get("cold_compiles", 0):
+        problems.append(
+            f"coldstart: cold_compiles {b.get('cold_compiles')} -> "
+            f"{c.get('cold_compiles')} (workload compiles more from "
+            f"scratch)")
+    if pct > 0 and b.get("restart_to_steady_ms") \
+            and c.get("restart_to_steady_ms"):
+        lim = b["restart_to_steady_ms"] * (1.0 + pct / 100.0)
+        if c["restart_to_steady_ms"] > lim:
+            problems.append(
+                f"coldstart: restart_to_steady_ms "
+                f"{b['restart_to_steady_ms']} -> "
+                f"{c['restart_to_steady_ms']} (> +{pct}%)")
+    return problems
 
 
 def compare(base: dict, cand: dict, wall_clock_pct: float = 0.0) -> list:
@@ -93,20 +141,30 @@ def main(argv=None) -> int:
     ap.add_argument("--wall-clock-pct", type=float, default=0.0,
                     help="also flag warm wall-clock regressions beyond "
                          "this percentage (0 = rounds/compiles only)")
+    ap.add_argument("--coldstart-pct", type=float, default=50.0,
+                    help="flag restart_to_steady_ms regressions beyond "
+                         "this percentage (0 = counters only)")
     args = ap.parse_args(argv)
     base = load_capture(args.baseline)
     cand = load_capture(args.candidate)
-    if not base["queries"]:
-        print(f"bench_regress: no query rows in {args.baseline}",
-              file=sys.stderr)
+    if not base["queries"] and base["coldstart"] is None:
+        print(f"bench_regress: no query or cold-start rows in "
+              f"{args.baseline}", file=sys.stderr)
         return 2
     problems = compare(base, cand, args.wall_clock_pct)
+    problems += compare_coldstart(base, cand, args.coldstart_pct)
+    compared = []
+    if base["queries"]:
+        compared.append(f"{len(base['queries'])} queries")
+    if base["coldstart"] is not None and cand["coldstart"] is not None:
+        compared.append("cold-start line")
     if problems:
         for p in problems:
             print(f"REGRESSION {p}")
         print(f"bench_regress: {len(problems)} regression(s)")
         return 1
-    print(f"bench_regress: clean ({len(base['queries'])} queries compared)")
+    print(f"bench_regress: clean ({', '.join(compared) or 'nothing'} "
+          f"compared)")
     return 0
 
 
