@@ -28,7 +28,7 @@ _INLINE_RE = re.compile(
     r"([A-Z]+(?:\s*,\s*[A-Z]+)*)")
 
 RULES = ("HOSTSYNC", "RETRACE", "TRACERLEAK", "LOCKORDER", "BAREEXC",
-         "SPANINJIT", "FAILPOINTHOT", "METRICINJIT")
+         "SPANINJIT", "FAILPOINTHOT", "METRICINJIT", "PROGRESSINJIT")
 
 
 @dataclass(frozen=True)

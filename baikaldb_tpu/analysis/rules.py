@@ -211,6 +211,56 @@ class _MetricInJit(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# progress-record entry points (obs/progress.py) that must stay host-side:
+# a beat inside a jit trace fires at TRACE time (reporting compile-time
+# progress, not run-time) and its CancelToken check can never interrupt a
+# running device program — beats belong at the host seams around fn(batches)
+_PROGRESS_METHODS = frozenset({"beat", "checkpoint"})
+
+
+def _is_progress_call(mi: ModuleIndex, node: ast.Call) -> bool:
+    path = mi.resolve(node.func)
+    if path is not None and "." in path:
+        head, _, last = path.rpartition(".")
+        h = head.lower()
+        if last in _PROGRESS_METHODS and ("progress" in h
+                                          or "watchdog" in h):
+            return True
+        if last in ("current", "track", "cancel_token") and "progress" in h:
+            return True
+    # progress.current().beat(...): a beat on a getter's transient result —
+    # the getter resolves even though the receiver is a local value
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _PROGRESS_METHODS \
+            and isinstance(func.value, ast.Call):
+        inner = mi.resolve(func.value.func)
+        if inner is not None and "progress" in inner.lower():
+            return True
+    return False
+
+
+class _ProgressInJit(ast.NodeVisitor):
+    """PROGRESSINJIT: progress beats/checkpoints inside traced scope (hot
+    modules / jit-decorated functions) — the SPANINJIT discipline applied
+    to the live-query registry: a beat under a trace reports trace-time
+    progress (baking nothing into the program), and a cancellation check
+    there can never stop a running device program anyway."""
+
+    def __init__(self, mi: ModuleIndex, report):
+        self.mi = mi
+        self.report = report
+
+    def visit_Call(self, node):
+        if _is_progress_call(self.mi, node):
+            self.report("PROGRESSINJIT", node,
+                        "progress beat/checkpoint inside jit-traced scope: "
+                        "it fires at trace time (progress of the compile, "
+                        "not the run) and its kill check cannot interrupt "
+                        "a device program — beat at the host seams around "
+                        "the jitted call")
+        self.generic_visit(node)
+
+
 def _is_failpoint_hit(path: str | None) -> bool:
     if path is None or "." not in path:
         return False
@@ -356,6 +406,7 @@ def lint_tree(tree: ast.AST, hot_module: bool, report) -> None:
                     # run_local pattern), so the whole subtree is checked
                     _SpanInJit(mi, report).visit(node)
                     _MetricInJit(mi, report).visit(node)
+                    _ProgressInJit(mi, report).visit(node)
             elif isinstance(node, ast.ClassDef):
                 walk_defs(node.body, True)
 

@@ -54,7 +54,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from ..obs import trace
+from ..obs import progress, trace
 from ..utils import metrics
 from ..utils.flags import FLAGS, define
 from ..utils.qos import RejectedError
@@ -237,8 +237,21 @@ class BatchDispatcher:
 
     # -- member side -------------------------------------------------------
     def _wait(self, w: _Waiter, run_inline):
+        qp = progress.current()
         with trace.span("batch.enqueue") as sp:
-            ok = w.done.wait(timeout=float(FLAGS.batch_dispatch_wait_s))
+            # sliced wait: each slice is a progress beat and a KILL
+            # cancellation point (the dispatch queue is a pure read path —
+            # abandoning the rendezvous has no side effects; the leader's
+            # combined run just carries one unread lane)
+            deadline = time.perf_counter() + \
+                float(FLAGS.batch_dispatch_wait_s)
+            while True:
+                remaining = deadline - time.perf_counter()
+                ok = w.done.wait(timeout=min(0.05, max(0.0, remaining)))
+                wait_ms = (time.perf_counter() - w.t0) * 1e3
+                qp.beat(phase="exec.queued", queue_wait_ms=wait_ms)
+                if ok or remaining <= 0:
+                    break
             sp.set(queue_wait_ms=round(
                 (time.perf_counter() - w.t0) * 1e3, 3), group=w.group)
         if not ok or isinstance(w.err, CombineFallback):

@@ -656,6 +656,32 @@ def count_shuffle_rounds(plan: PlanNode) -> int:
     return exchange_summary(plan)["rounds"]
 
 
+def progress_totals(plan: PlanNode) -> dict:
+    """HOST-side work estimates for the live progress record (obs/
+    progress.py): operator count, scan count, and the executed exchange
+    rounds a multi-round MPP query will pay — the denominators SHOW
+    PROCESSLIST renders "m/n" against.  A plan-tree walk over host
+    objects; nothing here touches device state or traced scope."""
+    operators = 0
+    scans = 0
+    seen: set = set()
+
+    def walk(n: PlanNode) -> None:
+        nonlocal operators, scans
+        if id(n) in seen:
+            return
+        seen.add(id(n))
+        operators += 1
+        if isinstance(n, ScanNode):
+            scans += 1
+        for c in n.children:
+            walk(c)
+
+    walk(plan)
+    return {"operators": operators, "scans": scans,
+            "rounds": exchange_summary(plan)["rounds"]}
+
+
 def _all_gather_batch(b: ColumnBatch) -> ColumnBatch:
     """Shard-partitioned rows -> replicated full batch (one all_gather)."""
     def ag(x):

@@ -40,15 +40,19 @@ from ..meta.privileges import READ, WRITE, AccessError, PrivilegeManager
 from ..sql.stmt import (CreateUserStmt, CreateViewStmt, DeallocateStmt,
                         DropUserStmt,
                         DropViewStmt, ExecuteStmt, GrantStmt, HandleStmt,
-                        LoadDataStmt, PrepareStmt, RevokeStmt)
+                        KillStmt, LoadDataStmt, PrepareStmt, RevokeStmt)
 from ..plan import paramize
 from ..storage.column_store import ROWID as ROWID_COL
 from ..storage.column_store import (TableStore, check_cold_readable,
                                     schema_to_arrow)
 from ..types import Field, LType, Schema
 from ..analysis.runtime import guard_stats, hot_path_guard
-from ..obs import trace
+from ..obs import progress, trace
+from ..obs.flightrec import (FlightRecorder, device_stats, metric_delta,
+                             metric_marks)
+from ..obs.progress import PROGRESS, QueryKilled
 from ..obs.trace import TRACER
+from ..obs.watchdog import QueryWatchdog
 from ..utils import compilecache, metrics
 from ..utils.flags import FLAGS, define
 
@@ -99,6 +103,13 @@ _SERVER_VARS = {
 }
 
 _CONN_IDS = itertools.count(1)
+
+
+def next_conn_id() -> int:
+    """One connection-id space for embedded Sessions AND wire connections:
+    KILL <id> and the processlist Id column resolve against the same
+    counter no matter which door the client came through."""
+    return next(_CONN_IDS)
 
 _ENV_FNS = ("database", "schema", "user", "current_user", "session_user",
             "system_user", "connection_id", "version")
@@ -348,6 +359,16 @@ class Database:
         # live connections for SHOW PROCESSLIST (id -> dict), kept by the
         # wire server (reference: show processlist over NetworkServer conns)
         self.processlist: dict[int, dict] = {}
+        # always-on flight recorder (obs/flightrec.py): bounded ring of
+        # completed-query summaries; slow/killed/failed queries keep a full
+        # forensic bundle — SELECT * FROM information_schema.flight_recorder
+        self.flightrec = FlightRecorder()
+        # wedged-query detector: scans this Database's live QueryProgress
+        # records for silent beats (obs/watchdog.py); the thread only runs
+        # in cluster mode — embedded single-process tests scan on demand
+        self.watchdog = QueryWatchdog(db=self)
+        if cluster is not None:
+            self.watchdog.start()
         # committed-txn CDC batches whose distributed-binlog append failed:
         # PER-TABLE queues of event batches retried on later flushes instead
         # of silently dropped (bounded; overflow counts in
@@ -388,6 +409,7 @@ class Database:
         would otherwise outlive a discarded Database, paying timeouts
         against dead daemon addresses forever.  Idempotent."""
         self.telemetry.stop()
+        self.watchdog.stop()
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]
@@ -786,31 +808,99 @@ class Session:
             P.check(self.user, db, READ)
 
     # -- public API -------------------------------------------------------
+    def connection_id(self) -> int:
+        """This session's id in the shared processlist/KILL space, lazily
+        assigned from the same counter the wire server draws from."""
+        if not hasattr(self, "_conn_id"):
+            self._conn_id = next_conn_id()
+        return self._conn_id
+
     def execute(self, sql: str) -> Result:
         metrics.queries_total.add(1)
         t0 = time.perf_counter()
-        try:
-            # the per-query trace roots here (or at the wire server's
-            # _query, whichever ran first); stage spans nest under it and
-            # the keep/drop decision (sampling + slow always-keep) lands
-            # when this scope closes (obs/trace.py)
-            with trace.root("query", sql):
-                res = self._execute(sql)
-        except Exception:
-            metrics.queries_failed.add(1)
-            raise
-        finally:
-            dur_ms = (time.perf_counter() - t0) * 1e3
-            metrics.query_latency.observe(dur_ms)
-            if dur_ms > FLAGS.slow_query_ms:
-                metrics.slow_queries.add(1)
+        marks = metric_marks()   # flight-recorder metric baseline
+        err: Optional[BaseException] = None
+        spans: list = []
+        # the progress record opens here (or at the wire server's _query,
+        # whichever ran first — nested opens share the outer record); live
+        # for the statement's whole life so SHOW PROCESSLIST, the watchdog
+        # and KILL from other threads can see it
+        with progress.track(sql, conn_id=self.connection_id(),
+                            user=self.user, db=self.db,
+                            dbname=self.current_db) as qp:
+            try:
+                # the per-query trace roots here (or at the wire server's
+                # _query, whichever ran first); stage spans nest under it and
+                # the keep/drop decision (sampling + slow always-keep) lands
+                # when this scope closes (obs/trace.py)
+                tmark = trace.mark()
+                with trace.root("query", sql):
+                    try:
+                        res = self._execute(sql)
+                    finally:
+                        # live-buffer snapshot must happen before the root
+                        # closes (the ctx dies with it)
+                        spans = trace.since(tmark)
+            except Exception as e:
+                metrics.queries_failed.add(1)
+                err = e
+                raise
+            finally:
+                dur_ms = (time.perf_counter() - t0) * 1e3
+                metrics.query_latency.observe(dur_ms)
+                if dur_ms > FLAGS.slow_query_ms:
+                    metrics.slow_queries.add(1)
+                self._flight_record(sql, qp, dur_ms, err, marks, spans)
         if res.arrow is not None:
             metrics.rows_returned.add(res.arrow.num_rows)
         if res.affected_rows:
             metrics.dml_rows.add(res.affected_rows)
         return res
 
+    def _flight_record(self, sql: str, qp, dur_ms: float,
+                       err: Optional[BaseException], marks: dict,
+                       spans: list) -> None:
+        """Flight-recorder entry for the statement that just finished: a
+        summary always, plus the full forensic bundle (plan, trace spans,
+        metric deltas, device stats, exchange summary) when the query was
+        slow, killed, or failed — the three cases an operator digs into
+        after the fact."""
+        try:
+            killed = isinstance(err, QueryKilled)
+            slow = dur_ms > float(FLAGS.slow_query_ms)
+            summary = {
+                "query_id": getattr(qp, "query_id", 0),
+                "conn_id": getattr(qp, "conn_id", 0),
+                "user": self.user, "db": self.current_db,
+                "text": sql, "dur_ms": round(dur_ms, 3),
+                "status": ("killed" if killed else
+                           "error" if err is not None else "ok"),
+                "error": "" if err is None else
+                         f"{type(err).__name__}: {err}",
+                "phase_ms": {k: round(v, 3)
+                             for k, v in qp.phase_ms().items()},
+                "rows": getattr(qp, "rows_done", 0),
+                "batches": getattr(qp, "batches_done", 0),
+                "rounds": getattr(qp, "round_no", 0),
+            }
+            bundle = None
+            if killed or err is not None or slow:
+                plan = getattr(qp, "plan", None)
+                bundle = {
+                    "plan": (plan.tree_repr() if hasattr(plan, "tree_repr")
+                             else str(plan)) if plan is not None else "",
+                    "spans": spans,
+                    "metric_delta": metric_delta(marks),
+                    "device_stats": device_stats(),
+                    "exchange": getattr(qp, "exchange", None),
+                }
+            self.db.flightrec.record(summary, bundle=bundle)
+        except Exception:
+            # forensics must never turn a working query into a failed one
+            metrics.count_swallowed("session.flight_record")
+
     def _execute(self, sql: str) -> Result:
+        progress.current().beat(phase="parse")
         with trace.span("parse"):
             stmts = parse_sql(sql)
         if self.db.qos is not None:
@@ -901,9 +991,7 @@ class Session:
                             "system_user") and not e.args:
                     return lit(f"{self.user}@localhost")
                 if e.op == "connection_id" and not e.args:
-                    if not hasattr(self, "_conn_id"):
-                        self._conn_id = next(_CONN_IDS)
-                    return lit(self._conn_id)
+                    return lit(self.connection_id())
                 if e.op == "version" and not e.args:
                     return lit(_SERVER_VARS["version"])
                 return Call(e.op, tuple(walk_e(a) for a in e.args))
@@ -1176,6 +1264,8 @@ class Session:
             return self._txn_stmt(s)
         if isinstance(s, ShowStmt):
             return self._show(s)
+        if isinstance(s, KillStmt):
+            return self._kill(s)
         if isinstance(s, CreateUserStmt):
             self.db.privileges.create_user(s.name, s.password, s.if_not_exists)
             return Result()
@@ -1516,6 +1606,9 @@ class Session:
                 # are registered — a standalone frontend adds nothing)
                 if self.db.telemetry.has_daemons():
                     vals.update(self.db.telemetry.status_rows())
+                # frontend watchdog verdict (obs/watchdog.py): ok/stalled
+                # plus episode counters, same rows the health RPC serves
+                vals.update(self.db.watchdog.status_rows())
             items = sorted(vals.items())
             if s.pattern is not None:
                 items = [(k, v) for k, v in items if like(k, s.pattern)]
@@ -1523,17 +1616,44 @@ class Session:
                 "Variable_name": [k for k, _ in items],
                 "Value": [v for _, v in items]}))
         if s.what == "processlist":
-            # snapshot: connection threads insert/pop concurrently
-            rows = sorted(dict(self.db.processlist).items())
+            # wire connections (db.processlist, kept by the MySQL server)
+            # merged with live progress records (obs/progress.py) — an
+            # embedded Session mid-query shows up even with no socket.
+            # Snapshot first: connection threads insert/pop concurrently.
+            now = time.time()
+            merged: dict[int, dict] = {}
+            for cid, ent in dict(self.db.processlist).items():
+                merged[cid] = {
+                    "user": ent.get("user", ""),
+                    "host": ent.get("host", ""),
+                    "db": ent.get("db", ""),
+                    "command": ent.get("command", "Sleep"),
+                    "time_s": int(now - ent.get("since", now)),
+                    "state": "", "info": ent.get("info", "")}
+            for qp in PROGRESS.live(self.db):
+                row = merged.setdefault(qp.conn_id, {
+                    "user": qp.user, "host": qp.host, "db": qp.dbname})
+                row.update(command=qp.command,
+                           time_s=int(qp.elapsed_s()),
+                           state=qp.state(), info=qp.text)
+            rows = sorted(merged.items())
+            # MySQL semantics: Info truncates at 100 chars unless FULL
+            infos = [r.get("info", "") for _, r in rows]
+            if not s.full:
+                infos = [i[:100] for i in infos]
             return Result(
-                columns=["Id", "User", "Host", "db", "Command", "Info"],
+                columns=["Id", "User", "Host", "db", "Command", "Time",
+                         "State", "Info"],
                 arrow=pa.table({
                     "Id": pa.array([i for i, _ in rows], pa.int64()),
                     "User": [r.get("user", "") for _, r in rows],
                     "Host": [r.get("host", "") for _, r in rows],
                     "db": [r.get("db", "") for _, r in rows],
                     "Command": [r.get("command", "Sleep") for _, r in rows],
-                    "Info": [r.get("info", "") for _, r in rows],
+                    "Time": pa.array([r.get("time_s", 0) for _, r in rows],
+                                     pa.int64()),
+                    "State": [r.get("state", "") for _, r in rows],
+                    "Info": infos,
                 }))
         if s.what == "grants":
             user = s.user or self.user
@@ -1560,6 +1680,36 @@ class Session:
                     "Version": pa.array([r[3] for r in rows], pa.int64()),
                 }))
         raise SqlError(f"unsupported SHOW {s.what!r}")
+
+    def _kill(self, s: KillStmt) -> Result:
+        """KILL [QUERY|CONNECTION] <id> (reference: the kill path through
+        state_machine.cpp).  QUERY flips the cancel token of the target
+        connection's live statements — the victim's own thread raises
+        ER_QUERY_INTERRUPTED (1317) at its next progress beat, so no
+        cross-thread exception injection and no torn side effects.
+        CONNECTION additionally marks the wire connection for teardown
+        and severs its socket so even an idle connection dies now."""
+        import socket as _socket
+        tid = int(s.target_id)
+        n = PROGRESS.kill(conn_id=tid, db=self.db,
+                          reason=f"kill {s.kind} {tid}")
+        known = bool(n) or tid in self.db.processlist \
+            or tid == getattr(self, "_conn_id", None)
+        if s.kind == "connection":
+            ent = self.db.processlist.get(tid)
+            if ent is not None:
+                ent["kill"] = True
+                sock = ent.get("_sock")
+                if sock is not None:
+                    # wakes a connection blocked in read(); the serve loop
+                    # sees the kill marker and tears down cleanly
+                    try:
+                        sock.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+        if not known:
+            raise SqlError(f"Unknown thread id: {tid}")
+        return Result()
 
     def _load_data(self, s: LoadDataStmt) -> Result:
         """LOAD DATA INFILE: CSV -> bulk columnar ingest (reference:
@@ -1591,6 +1741,17 @@ class Session:
         if s.command == "checkpoint":
             self.db.checkpoint()
             return Result()
+        if s.command == "flightrec" and s.args:
+            # handle flightrec dump '/path.jsonl' [rec_id] | clear — the
+            # JSON-lines export tools/flightrec.py renders offline
+            op = s.args[0].lower()
+            if op == "dump" and len(s.args) >= 2:
+                rid = int(s.args[2]) if len(s.args) > 2 else None
+                return Result(affected_rows=self.db.flightrec.dump(
+                    s.args[1], rec_id=rid))
+            if op == "clear":
+                self.db.flightrec.clear()
+                return Result()
         if s.command in ("ttl", "ttl_tick"):
             return Result(affected_rows=self.ttl_tick())
         if s.command == "gc":
@@ -3587,6 +3748,8 @@ class Session:
 
     def _select_cached(self, stmt: SelectStmt, text_key, lookup_key,
                        norm, count: bool = True) -> Result:
+        qp = progress.current()
+        qp.beat(phase="plan")
         entry = self._plan_cache.get(lookup_key) if lookup_key else None
         replanned = False
         if entry is not None:
@@ -3658,6 +3821,17 @@ class Session:
         qlog_outcome = getattr(self, "_qlog_outcome", None) or outcome
         trace.event("plan.cache", outcome=qlog_outcome)
         plan = entry["plan"]
+        # forensic-dump reference + progress denominators (host plan walk,
+        # cached on the entry): SHOW PROCESSLIST renders "batch m/n" /
+        # "round m/n" against these before the first scan even stages
+        totals = entry.get("progress_totals")
+        if totals is None:
+            totals = entry["progress_totals"] = \
+                executor.progress_totals(plan)
+        qp.beat(phase="exec.batches", plan=plan,
+                batches_total=totals["scans"],
+                rounds_total=totals["rounds"] if self.mesh is not None
+                else 0)
         # host-side access paths (index gather, zonemap/partition pruning)
         # see this execution's literal values even though the compiled plan
         # does not: _access_path_batch substitutes them into pushed filters
@@ -3674,18 +3848,24 @@ class Session:
             with trace.span("plan.bind"):
                 batches[PARAMS_KEY] = paramize.bind(norm.slots, batches)
         t0 = time.perf_counter()
+        qp.beat(phase="exec.run")
         result = self._maybe_batched_run(entry, batches, shape_key, norm,
                                          lookup_key, full_scan)
+        qp.beat(phase="egress.arrow")
         with trace.span("egress.arrow"):
             table = result.to_arrow()
         dur_ms = (time.perf_counter() - t0) * 1e3
+        # close the egress wall-clock bucket so the query_log row carries
+        # every phase (the beats ride the same seams as the trace spans —
+        # SHOW PROFILE over the trace shows the same splits)
+        qp.beat(phase="finish", rows_done=table.num_rows)
         if text_key is not None:
             # slow-query rows explain WHY: plan-cache outcome + the
             # capacity buckets the scan batches compiled against
             buckets = ";".join(f"{tk}={cap}"
                                for tk, _v, cap in sorted(shape_key))
             self.db.query_log.append((text_key[0], dur_ms, table.num_rows,
-                                      qlog_outcome, buckets))
+                                      qlog_outcome, buckets, qp.phase_ms()))
         return Result(columns=list(table.column_names), arrow=table)
 
     def _param_resolver(self, stmt: SelectStmt):
@@ -3953,6 +4133,18 @@ class Session:
                 count(c)
         count(plan)
 
+        # progress beats per scan staged (host-side, batch boundary — also
+        # a cancellation point, so KILL lands between table loads)
+        qp = progress.current()
+        nscanned = [0, 0]                       # batches staged, rows seen
+        qp.beat(batches_total=len(scan_count))
+
+        def scan_beat(table_key: str, b) -> None:
+            nscanned[0] += 1
+            nscanned[1] += len(b)
+            qp.beat(operator=f"scan {table_key}", batches_done=nscanned[0],
+                    rows_done=nscanned[1])
+
         def walk_plan(n: PlanNode):
             if isinstance(n, ScanNode) and n.table_key not in batches:
                 db, name = n.table_key.split(".", 1)
@@ -3963,6 +4155,7 @@ class Session:
                         b = shard_batch(b, self.mesh)
                     batches[n.table_key] = b
                     key_parts.append((n.table_key, -1, len(b)))
+                    scan_beat(n.table_key, b)
                     for c in n.children:
                         walk_plan(c)
                     return
@@ -3985,6 +4178,7 @@ class Session:
                 batches[n.table_key] = b
                 key_parts.append((n.table_key, store.version,
                                   len(batches[n.table_key])))
+                scan_beat(n.table_key, b)
             for c in n.children:
                 walk_plan(c)
 
@@ -4314,6 +4508,13 @@ class Session:
             }) if rows else _empty_info("cold_segments")
         if name == "query_log":
             log = list(self.db.query_log)
+
+            def ph(e, key):
+                # per-phase wall-clock split (progress beats ride the same
+                # seams as the trace spans — one timing truth with SHOW
+                # PROFILE); pre-upgrade 5-tuples read as 0
+                d = e[5] if len(e) > 5 else {}
+                return round(float(d.get(key, 0.0)), 3)
             return pa.table({
                 "query": [e[0] for e in log],
                 "duration_ms": pa.array([e[1] for e in log], pa.float64()),
@@ -4323,7 +4524,71 @@ class Session:
                 # scan batches compiled against
                 "cache": [e[3] for e in log],
                 "capacity_bucket": [e[4] for e in log],
+                "parse_ms": pa.array([ph(e, "parse") for e in log],
+                                     pa.float64()),
+                "plan_ms": pa.array([ph(e, "plan") for e in log],
+                                    pa.float64()),
+                "exec_ms": pa.array([ph(e, "exec") for e in log],
+                                    pa.float64()),
+                "egress_ms": pa.array([ph(e, "egress") for e in log],
+                                      pa.float64()),
             }) if log else _empty_info("query_log")
+        if name == "processlist":
+            rows = [qp.row() for qp in PROGRESS.live(self.db)]
+            rows.sort(key=lambda r: r["query_id"])
+            return pa.table({
+                "id": pa.array([r["id"] for r in rows], pa.int64()),
+                "user": [r["user"] for r in rows],
+                "host": [r["host"] for r in rows],
+                "db": [r["db"] for r in rows],
+                "command": [r["command"] for r in rows],
+                "time_s": pa.array([r["time_s"] for r in rows], pa.int64()),
+                "state": [r["state"] for r in rows],
+                "info": [r["info"] for r in rows],
+                "query_id": pa.array([r["query_id"] for r in rows],
+                                     pa.int64()),
+                "phase": [r["phase"] for r in rows],
+                "operator": [r["operator"] for r in rows],
+                "batches_done": pa.array([r["batches_done"] for r in rows],
+                                         pa.int64()),
+                "batches_total": pa.array([r["batches_total"] for r in rows],
+                                          pa.int64()),
+                "rows_done": pa.array([r["rows_done"] for r in rows],
+                                      pa.int64()),
+                "rows_est": pa.array([r["rows_est"] for r in rows],
+                                     pa.int64()),
+                "round": pa.array([r["round"] for r in rows], pa.int64()),
+                "rounds_total": pa.array([r["rounds_total"] for r in rows],
+                                         pa.int64()),
+                "queue_wait_ms": pa.array([r["queue_wait_ms"] for r in rows],
+                                          pa.float64()),
+                "elapsed_ms": pa.array([r["elapsed_ms"] for r in rows],
+                                       pa.float64()),
+            }) if rows else _empty_info("processlist")
+        if name == "flight_recorder":
+            import json as _json
+            rows = self.db.flightrec.rows()
+            return pa.table({
+                "rec_id": pa.array([r["rec_id"] for r in rows], pa.int64()),
+                "ts": pa.array([r["ts"] for r in rows], pa.float64()),
+                "query_id": pa.array([r.get("query_id", 0) for r in rows],
+                                     pa.int64()),
+                "conn_id": pa.array([r.get("conn_id", 0) for r in rows],
+                                    pa.int64()),
+                "user": [r.get("user", "") for r in rows],
+                "db": [r.get("db", "") for r in rows],
+                "query": [r.get("text", "") for r in rows],
+                "duration_ms": pa.array([r.get("dur_ms", 0.0) for r in rows],
+                                        pa.float64()),
+                "status": [r.get("status", "") for r in rows],
+                "error": [r.get("error", "") for r in rows],
+                "phase_ms": [_json.dumps(r.get("phase_ms") or {},
+                                         default=str) for r in rows],
+                "rows": pa.array([r.get("rows", 0) for r in rows],
+                                 pa.int64()),
+                "has_bundle": pa.array([bool(r.get("bundle"))
+                                        for r in rows], pa.bool_()),
+            }) if rows else _empty_info("flight_recorder")
         if name == "trace_spans":
             import json as _json
             rows = []
@@ -4586,6 +4851,17 @@ class Session:
         # path never pays the fingerprint walk.
         aot_key = None
 
+        # progress: planned shuffle rounds are the mesh query's round
+        # denominator (cached on the entry — one plan walk per entry life);
+        # the summary also feeds the flight-recorder bundle
+        qp = progress.current()
+        if mesh is not None:
+            summary = entry.get("exchange_summary")
+            if summary is None:
+                summary = entry["exchange_summary"] = exchange_summary(plan)
+            qp.beat(rounds_total=int(summary["rounds"]), round_no=0,
+                    exchange=summary)
+
         def get_aot_key():
             nonlocal aot_key
             if aot_key is None and compilecache.AOT.enabled():
@@ -4599,6 +4875,10 @@ class Session:
 
         compiled_here = False
         for _ in range(int(FLAGS.join_retry_max) + 1):
+            # overflow-retry boundary: between device programs, no side
+            # effects yet — a KILL lands here instead of paying another
+            # trace+compile+run of the whole plan
+            qp.checkpoint()
             pair = entry["compiled"].get(shape_key)
             if pair is not None and len(pair) == 3 \
                     and pair[2] != versions_key:
@@ -4677,6 +4957,11 @@ class Session:
             # join would block on a device round-trip once per node
             # (tpulint HOSTSYNC)
             host_flags = jax.device_get(flags)
+            if mesh is not None:
+                # the one device program carried every planned collective:
+                # all rounds are behind us once the flags landed on host
+                qp.beat(round_no=int(qp.rounds_total)
+                        if qp.query_id else 0)
             for node, flag in zip(raw.join_order, host_flags):
                 needed = int(flag)
                 if isinstance(node, ScalarSourceNode) \

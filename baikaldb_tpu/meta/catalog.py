@@ -193,7 +193,11 @@ class Catalog:
                              Field("duration_ms", LType.FLOAT64),
                              Field("result_rows", LType.INT64),
                              Field("cache", LType.STRING),
-                             Field("capacity_bucket", LType.STRING))),
+                             Field("capacity_bucket", LType.STRING),
+                             Field("parse_ms", LType.FLOAT64),
+                             Field("plan_ms", LType.FLOAT64),
+                             Field("exec_ms", LType.FLOAT64),
+                             Field("egress_ms", LType.FLOAT64))),
         "trace_spans": Schema((Field("query_id", LType.INT64),
                                Field("trace_id", LType.STRING),
                                Field("span_id", LType.STRING),
@@ -289,6 +293,44 @@ class Catalog:
                              Field("hits", LType.INT64),
                              Field("deser_ms", LType.FLOAT64),
                              Field("status", LType.STRING))),
+        # live query introspection (obs/progress.py): one row per in-flight
+        # statement on this engine — phase/operator plus the m/n progress
+        # counters SHOW PROCESSLIST renders into its State cell
+        "processlist": Schema((Field("id", LType.INT64),
+                               Field("user", LType.STRING),
+                               Field("host", LType.STRING),
+                               Field("db", LType.STRING),
+                               Field("command", LType.STRING),
+                               Field("time_s", LType.INT64),
+                               Field("state", LType.STRING),
+                               Field("info", LType.STRING),
+                               Field("query_id", LType.INT64),
+                               Field("phase", LType.STRING),
+                               Field("operator", LType.STRING),
+                               Field("batches_done", LType.INT64),
+                               Field("batches_total", LType.INT64),
+                               Field("rows_done", LType.INT64),
+                               Field("rows_est", LType.INT64),
+                               Field("round", LType.INT64),
+                               Field("rounds_total", LType.INT64),
+                               Field("queue_wait_ms", LType.FLOAT64),
+                               Field("elapsed_ms", LType.FLOAT64))),
+        # always-on flight recorder (obs/flightrec.py): the bounded ring of
+        # completed-query summaries; has_bundle marks slow/killed/failed
+        # rows whose full forensics tools/flightrec.py can dump
+        "flight_recorder": Schema((Field("rec_id", LType.INT64),
+                                   Field("ts", LType.FLOAT64),
+                                   Field("query_id", LType.INT64),
+                                   Field("conn_id", LType.INT64),
+                                   Field("user", LType.STRING),
+                                   Field("db", LType.STRING),
+                                   Field("query", LType.STRING),
+                                   Field("duration_ms", LType.FLOAT64),
+                                   Field("status", LType.STRING),
+                                   Field("error", LType.STRING),
+                                   Field("phase_ms", LType.STRING),
+                                   Field("rows", LType.INT64),
+                                   Field("has_bundle", LType.BOOL))),
         # per-column collected statistics (index/stats): the distinct-count
         # estimate feeding the adaptive-agg decision, plus histogram/MCV
         # collection state — the reference's statistics.proto surface

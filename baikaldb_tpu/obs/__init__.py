@@ -1,10 +1,22 @@
-"""Observability tier: query-lifecycle tracing (obs/trace.py).
+"""Observability tier: tracing, live introspection, forensics, watchdogs.
 
 Counters (utils/metrics.py) answer "how much / how fast on average";
-this package answers "where did THIS query's time go" — span trees from
-the wire protocol down to device execution and back, stitched across RPC
-boundaries, surfaced through SHOW PROFILE / information_schema.trace_spans
-and a Chrome trace_event exporter.
+this package answers the operator's live and postmortem questions:
+
+- obs/trace.py — "where did THIS query's time go": span trees from the
+  wire protocol down to device execution and back, stitched across RPC
+  boundaries (SHOW PROFILE / information_schema.trace_spans / Chrome
+  trace export).
+- obs/progress.py — "what is that query doing RIGHT NOW, and stop it":
+  per-query progress beats feeding SHOW PROCESSLIST, and the cancel
+  tokens KILL flips.
+- obs/flightrec.py — "what was it doing when it went bad": the bounded
+  flight-recorder ring with forensic bundles for slow/killed/failed
+  queries (information_schema.flight_recorder / tools/flightrec.py).
+- obs/watchdog.py — "is anything wedged": stall detection over queries,
+  raft apply lag, and daemon clocks (health RPC / SHOW STATUS health.*).
+- obs/telemetry.py — the fleet metric plane (scrape, merge, Prometheus).
 """
 
 from . import trace  # noqa: F401
+from . import progress  # noqa: F401

@@ -267,6 +267,58 @@ def install_device_gauges(registry) -> None:
     registry.gauge("device_hbm_limit_bytes", fn=mk("bytes_limit"))
 
 
+# -- process-resource gauges -------------------------------------------------
+
+_PROC_STARTED = time.time()
+
+
+def install_process_gauges(registry) -> None:
+    """OS-process gauges sampled at dump time — RSS, thread count, open
+    fds, uptime, GC collections — so a watchdog stall correlates with
+    resource pressure in the same scrape.  Standard library only (/proc +
+    resource + gc); platforms without /proc report NaN, and a raising fn
+    is already swallowed+counted by Gauge.stats()."""
+    import gc
+    import os
+
+    def rss_bytes():
+        try:
+            with open("/proc/self/statm") as f:
+                return float(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, IndexError, ValueError):
+            import resource
+            # ru_maxrss is the PEAK (KiB on linux) — better than nothing
+            # where /proc is absent
+            return float(resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss) * 1024.0
+
+    def thread_count():
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("Threads:"):
+                        return float(line.split()[1])
+        except OSError:
+            pass
+        import threading as _t
+        return float(_t.active_count())      # python threads only
+
+    def open_fds():
+        try:
+            return float(len(os.listdir("/proc/self/fd")))
+        except OSError:
+            return float("nan")
+
+    def gc_collections():
+        return float(sum(s.get("collections", 0) for s in gc.get_stats()))
+
+    registry.gauge("process_rss_bytes", fn=rss_bytes)
+    registry.gauge("process_threads", fn=thread_count)
+    registry.gauge("process_open_fds", fn=open_fds)
+    registry.gauge("process_uptime_s", fn=lambda: time.time() - _PROC_STARTED)
+    registry.gauge("process_gc_collections", fn=gc_collections)
+
+
 # -- the fleet poller --------------------------------------------------------
 
 class Telemetry:
@@ -288,6 +340,7 @@ class Telemetry:
         self._meta_addr: Optional[str] = None
         if device_gauges:
             install_device_gauges(self.registry)
+            install_process_gauges(self.registry)
 
     # -- registration ------------------------------------------------------
     def attach_meta(self, meta_address: str) -> None:

@@ -7,11 +7,14 @@ from __future__ import annotations
 import re
 
 from ..meta.privileges import AccessError
+from ..obs.progress import QueryKilled
 from ..sql.lexer import SqlError
 from ..storage.rowstore import ConflictError
 
 # (pattern, errno, sqlstate) — first match wins
 _PATTERNS = [
+    (r"Query execution was interrupted", 1317, "70100"),
+    (r"Unknown thread id", 1094, "HY000"),
     (r"Duplicate entry", 1062, "23000"),
     (r"locked by", 1205, "HY000"),
     (r"Lock wait", 1205, "HY000"),
@@ -36,6 +39,8 @@ _PATTERNS = [
 def errno_for(exc: BaseException) -> tuple[int, str]:
     """-> (errno, sqlstate) for an engine exception."""
     msg = str(exc)
+    if isinstance(exc, QueryKilled):
+        return 1317, "70100"               # ER_QUERY_INTERRUPTED
     if isinstance(exc, AccessError):
         return (1227, "42000") if "SUPER" in msg else (1045, "28000")
     if isinstance(exc, ConflictError):

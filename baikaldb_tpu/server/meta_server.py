@@ -16,6 +16,8 @@ import threading
 import time
 
 from ..meta.service import HeartbeatRequest, MetaService
+from ..obs.telemetry import install_process_gauges
+from ..obs.watchdog import Watchdog
 from ..utils.metrics import Registry
 from ..utils.net import RpcServer
 
@@ -40,13 +42,15 @@ class MetaServer:
         for name in ("register_store", "create_regions", "table_regions",
                      "drop_regions", "heartbeat", "tso", "instances", "ping",
                      "split_region_key", "merge_regions_key", "alloc_ids",
-                     "metrics", "prometheus", "aot_publish", "aot_lookup",
-                     "aot_manifest"):
+                     "metrics", "prometheus", "health", "aot_publish",
+                     "aot_lookup", "aot_manifest"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
         # daemon-scoped registry (see StoreServer): handler latency via the
         # RpcServer hook, topology gauges sampled live at scrape time
         self.metrics = Registry()
         self.rpc.attach_metrics(self.metrics)
+        install_process_gauges(self.metrics)
+        self.watchdog = Watchdog(name=f"meta@{address}")
         self._started = time.time()
         self.metrics.gauge("uptime_s", fn=lambda: time.time() - self._started)
         self.metrics.gauge("meta_instances",
@@ -83,6 +87,19 @@ class MetaServer:
         return {"text": render_prometheus(
             self.metrics.snapshot(),
             const_labels={"daemon": self.address, "role": "meta"})}
+
+    def rpc_health(self):
+        """Health probe: the meta daemon has no raft clock of its own, so
+        this reports watchdog status (no probes registered = ok), uptime,
+        and topology health counts."""
+        h = self.watchdog.health()
+        h.update(daemon=self.address, role="meta",
+                 uptime_s=round(time.time() - self._started, 3),
+                 instances=len(self.service.instances),
+                 instances_faulty=sum(
+                     1 for i in self.service.instances.values()
+                     if i.status != "NORMAL"))
+        return h
 
     def rpc_register_store(self, address: str, store_id: int):
         with self._mu:

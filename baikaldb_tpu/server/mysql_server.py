@@ -27,9 +27,10 @@ import os
 import socket
 import struct
 import threading
+import time
 from typing import Optional
 
-from ..exec.session import Database, Result, Session
+from ..exec.session import Database, Result, Session, next_conn_id
 from ..sql.lexer import SqlError
 from ..types import LType
 from .errors import errno_for
@@ -122,7 +123,6 @@ class MySQLServer:
         self._listener: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
-        self._conn_ids = iter(range(1, 1 << 31))
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -164,7 +164,9 @@ class MySQLServer:
     # -- per-connection state machine ------------------------------------
     def _serve(self, conn: socket.socket):
         p = Packets(conn)
-        conn_id = next(self._conn_ids)
+        # one id space with embedded Session ids: KILL <id> and the
+        # processlist Id column resolve in the same table either way
+        conn_id = next_conn_id()
         peer = "?"
         try:
             peer = "%s:%d" % conn.getpeername()
@@ -178,10 +180,14 @@ class MySQLServer:
             stmt_ids = iter(range(1, 1 << 31))
             while True:
                 p.reset()
-                self.db.processlist.get(conn_id, {}).update(
-                    command="Sleep", info="")
+                ent = self.db.processlist.get(conn_id, {})
+                if ent.get("kill"):          # KILL CONNECTION landed while
+                    return                   # a command was in flight
+                ent.update(command="Sleep", info="", since=time.time())
                 pkt = p.read()
                 if pkt is None or not pkt:
+                    return
+                if self.db.processlist.get(conn_id, {}).get("kill"):
                     return
                 cmd, body = pkt[0], pkt[1:]
                 if cmd == 0x01:                       # COM_QUIT
@@ -201,8 +207,10 @@ class MySQLServer:
                     continue
                 if cmd == 0x03:                       # COM_QUERY
                     sql = body.decode(errors="replace")
+                    # full text stored; SHOW PROCESSLIST truncates Info at
+                    # render time (100 chars) unless FULL was asked
                     self.db.processlist.get(conn_id, {}).update(
-                        command="Query", info=sql[:100])
+                        command="Query", info=sql, since=time.time())
                     self._query(p, session, sql)
                     continue
                 if cmd == 0x04:                       # COM_FIELD_LIST (legacy)
@@ -273,6 +281,9 @@ class MySQLServer:
             self._err(p, 1045, f"Access denied for user '{user}'", "28000")
             return None
         session = Session(self.db, user=user)
+        # the session answers CONNECTION_ID() and runs queries under this
+        # id: KILL QUERY <id> must find the wire connection's work
+        session._conn_id = conn_id
         if dbname:
             try:
                 session.execute(f"USE `{dbname}`")
@@ -282,7 +293,8 @@ class MySQLServer:
                 return None
         self.db.processlist[conn_id] = {
             "user": user, "host": peer, "db": session.current_db,
-            "command": "Sleep", "info": ""}
+            "command": "Sleep", "info": "", "since": time.time(),
+            "_sock": p.sock}          # KILL CONNECTION severs it mid-read
         self._ok(p)
         return session
 

@@ -26,6 +26,8 @@ from ..chaos import failpoint
 from ..raft.cluster import ReplicatedRegion
 from ..raft.core import LEADER
 from ..types import Field, LType, Schema
+from ..obs.telemetry import install_process_gauges
+from ..obs.watchdog import StoreWatchdog
 from ..utils.metrics import Registry
 from ..utils.net import RpcClient, RpcServer, handler_deadline_s
 
@@ -70,8 +72,9 @@ class StoreServer:
         for name in ("create_region", "drop_region", "raft_msg", "propose",
                      "scan_raw", "region_status", "region_size", "ping",
                      "txn_status", "cold_manifest", "exec_fragment",
-                     "metrics", "prometheus", "aot_put", "aot_fetch",
-                     "aot_put_xla", "aot_fetch_xla", "aot_list"):
+                     "metrics", "prometheus", "health", "aot_put",
+                     "aot_fetch", "aot_put_xla", "aot_fetch_xla",
+                     "aot_list"):
             self.rpc.register(name, getattr(self, "rpc_" + name))
         # the failpoint `panic` action crashes THIS daemon, not just the
         # serving thread (the chaos harness's kill-9 analog)
@@ -82,7 +85,12 @@ class StoreServer:
         # it through rpc_metrics; raft/region gauges refresh per scrape.
         self.metrics = Registry()
         self.rpc.attach_metrics(self.metrics)
+        install_process_gauges(self.metrics)
         self._started = time.time()
+        # raft-clock liveness beat for the watchdog; None until the tick
+        # thread runs (a never-started daemon is not "stalled")
+        self._last_tick: Optional[float] = None
+        self.watchdog = StoreWatchdog(self)
         self.metrics.gauge("uptime_s", fn=lambda: time.time() - self._started)
         self.metrics.gauge("regions_hosted", fn=lambda: len(self.regions))
         self.metrics.gauge("aot_artifacts_hosted",
@@ -126,6 +134,7 @@ class StoreServer:
     def start(self) -> None:
         self.rpc.start()
         threading.Thread(target=self._tick_loop, daemon=True).start()
+        self.watchdog.start()
         if self.meta is not None:
             self.meta.try_call("register_store", address=self.address,
                                store_id=self.store_id)
@@ -133,6 +142,7 @@ class StoreServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self.watchdog.stop()
         self.rpc.stop()
 
     def crash(self) -> None:
@@ -143,6 +153,7 @@ class StoreServer:
         'restarted' daemon is a NEW StoreServer whose replicas catch up
         from peers."""
         self._stop.set()
+        self.watchdog.stop()
         self.rpc.stop(hard=True)
 
     # -- RPC surface ------------------------------------------------------
@@ -248,6 +259,17 @@ class StoreServer:
         return {"daemon": self.address, "role": "store",
                 "store_id": self.store_id, "ts": time.time(),
                 "metrics": self.metrics.snapshot()}
+
+    def rpc_health(self):
+        """Watchdog-backed health probe (idempotent, deadline-friendly):
+        one synchronous stall scan over the raft clock and per-region
+        apply lag, plus the daemon identity a fleet prober wants in the
+        same answer."""
+        h = self.watchdog.health()
+        h.update(daemon=self.address, role="store", store_id=self.store_id,
+                 uptime_s=round(time.time() - self._started, 3),
+                 regions=len(self.regions))
+        return h
 
     def rpc_prometheus(self):
         """Prometheus text exposition of this daemon's registry, served
@@ -512,6 +534,10 @@ class StoreServer:
             except Exception as e:  # noqa: BLE001
                 print(f"store {self.store_id}: tick error "
                       f"{type(e).__name__}: {e}", flush=True)
+            # liveness beat AFTER the tick: a tick wedged inside
+            # _tick_once stops the beat, which is what the watchdog's
+            # raft-clock probe fires on
+            self._last_tick = time.monotonic()
             time.sleep(self.tick_interval)
 
     def _tick_once(self) -> None:

@@ -22,7 +22,7 @@ from .stmt import (AlterTableStmt, ColumnDef, CreateDatabaseStmt,
                    DropDatabaseStmt, DropTableStmt,
                    DropUserStmt, DropViewStmt, ExecuteStmt, ExplainStmt,
                    GrantStmt, HandleStmt, InsertStmt, JoinClause,
-                   LoadDataStmt, OrderItem, PrepareStmt, RevokeStmt,
+                   KillStmt, LoadDataStmt, OrderItem, PrepareStmt, RevokeStmt,
                    SelectItem,
                    SelectStmt, SetStmt, ShowStmt, TableRef, TruncateStmt, TxnStmt,
                    UpdateStmt, UseStmt)
@@ -136,6 +136,8 @@ class Parser:
                 while not self.at_end() and self.peek().value != ";":
                     args.append(self.advance().value)
                 return HandleStmt(cmd.lower(), args)
+            if w == "kill":
+                return self.kill_stmt()
             if w == "prepare":
                 return self.prepare_stmt()
             if w == "execute":
@@ -1115,6 +1117,21 @@ class Parser:
         self.expect_kw("from")
         return self.table_name(), self._like_pat()
 
+    def kill_stmt(self) -> KillStmt:
+        """KILL [QUERY | CONNECTION] <id> — id defaults to CONNECTION
+        semantics like MySQL."""
+        self.advance()                         # KILL (an IDENT, not a KW)
+        kind = "connection"
+        w = self.peek().value.lower()
+        if self.peek().kind == "IDENT" and w in ("query", "connection"):
+            kind = w
+            self.advance()
+        t = self.peek()
+        if t.kind != "NUM" or "." in t.value:
+            raise SqlError(f"expected integer thread id at {t.pos}")
+        self.advance()
+        return KillStmt(kind, int(t.value))
+
     def show_stmt(self) -> ShowStmt:
         """SHOW surface (reference: show_helper.cpp's 5.5k-LoC command map —
         the high-traffic subset)."""
@@ -1144,9 +1161,11 @@ class Parser:
             pat = self._like_pat()
             return ShowStmt(word, pattern=pat)
         if word == "full" and self.peek(1).value.lower() == "processlist":
+            # MySQL semantics: FULL shows the untruncated statement text,
+            # bare SHOW PROCESSLIST truncates Info to 100 chars
             self.advance()
             self.advance()
-            return ShowStmt("processlist")
+            return ShowStmt("processlist", full=True)
         if word == "full" and self.peek(1).value.lower() == "tables":
             self.advance()
             self.advance()

@@ -175,6 +175,7 @@ class ShowStmt:
     pattern: Optional[str] = None
     user: Optional[str] = None
     query_id: Optional[int] = None    # SHOW PROFILE FOR QUERY n
+    full: bool = False                # SHOW FULL PROCESSLIST: untruncated Info
 
 
 @dataclass
@@ -191,6 +192,16 @@ class ExplainStmt:
 @dataclass
 class TxnStmt:
     kind: str      # begin | commit | rollback
+
+
+@dataclass
+class KillStmt:
+    """KILL [QUERY|CONNECTION] <id> (reference: the kill path through
+    state_machine.cpp).  ``target_id`` is a processlist connection id;
+    QUERY cancels the statement it is running, CONNECTION additionally
+    tears the connection down."""
+    kind: str            # query | connection
+    target_id: int
 
 
 @dataclass

@@ -639,6 +639,18 @@ aot_cache_fallbacks = Counter("aot_cache_fallbacks")
 # wall time of deserialize + first executable build for an AOT hit — the
 # cold-start cost that REPLACES compile_ms on warm-started nodes
 aot_cache_deser_ms = LatencyRecorder("aot_cache_deser_ms")
+# live query introspection (obs/progress.py): queries whose cancel token a
+# KILL flipped (the victim raises ER_QUERY_INTERRUPTED at its next beat)
+queries_killed = Counter("queries_killed")
+# fleet watchdogs (obs/watchdog.py): stall detections — a live query with
+# no progress beat for watchdog_stall_s, a raft apply-lag that stopped
+# draining, a wedged daemon tick loop.  Each detection counts ONCE per
+# stalled subject, not per scan
+watchdog_stalls_detected = Counter("watchdog_stalls_detected")
+# flight recorder (obs/flightrec.py): completed-query summaries recorded
+# and the subset that carried a full forensic bundle (slow/killed/failed)
+flightrec_records = Counter("flightrec_records")
+flightrec_bundles = Counter("flightrec_bundles")
 
 
 def count_swallowed(site: str) -> None:

@@ -36,6 +36,7 @@ from __future__ import annotations
 import base64
 import itertools
 import json
+import select
 import socket
 import struct
 import threading
@@ -46,7 +47,7 @@ from random import Random
 from typing import Callable, Optional
 
 from ..chaos import failpoint
-from ..obs import trace
+from ..obs import progress, trace
 from . import metrics
 from .flags import FLAGS, define
 
@@ -458,7 +459,7 @@ class RpcClient:
         "ping", "scan_raw", "txn_status", "region_size", "region_status",
         "instances", "table_regions", "heartbeat", "tso", "raft_msg",
         "drop_region", "drop_regions", "register_store", "cold_manifest",
-        "exec_fragment", "metrics", "prometheus",
+        "exec_fragment", "metrics", "prometheus", "health",
         # AOT artifact tier: reads, plus puts/publishes that are
         # idempotent by construction (same key -> same bytes; the meta
         # manifest is last-writer-wins on identical content)
@@ -516,6 +517,16 @@ class RpcClient:
                     f"rpc {method} to {self.host}:{self.port}: deadline "
                     f"budget ({self.timeout}s) exhausted after "
                     f"{retries} retries")
+            # KILL integration (obs/progress.py): a killed query must not
+            # even send an idempotent call.  Non-idempotent (tokened)
+            # methods are exempt end to end — interrupting a write whose
+            # outcome is unknown would break exactly-once; they run to
+            # their own deadline and the kill lands at the next statement
+            # boundary.
+            tok = progress.cancel_token()
+            if tok is not None and tok.killed() \
+                    and method in self._IDEMPOTENT:
+                raise progress.QueryKilled()
             try:
                 if self._sock is None:
                     self._sock = self._connect(remaining)
@@ -530,7 +541,7 @@ class RpcClient:
                     # the server got (and executes) the request; its
                     # response is lost with the connection
                     raise OSError("rpc.recv dropped (failpoint)")
-                resp = recv_msg(self._sock)
+                resp = self._recv_cancellable(method, deadline)
                 if resp is None:
                     raise OSError("connection closed")
                 return resp
@@ -559,6 +570,29 @@ class RpcClient:
                         f"{retries} retries") from None
                 time.sleep(delay)
                 backoff = min(backoff * 2.0, 1.0)
+
+    def _recv_cancellable(self, method: str, deadline: float):
+        """The response wait, interruptible by KILL for IDEMPOTENT methods
+        only: poll the live query's cancel token between short select()
+        slices, then do the normal blocking receive once bytes are
+        pending.  select-before-recv (never a sliced recv) so a timeout
+        can never strand a partial frame and desync the stream.  On kill
+        the connection is severed — the response may still arrive later,
+        and the next call must start on a clean stream."""
+        tok = progress.cancel_token()
+        if tok is None or method not in self._IDEMPOTENT:
+            return recv_msg(self._sock)
+        while True:
+            if tok.killed():
+                self.close_locked()
+                raise progress.QueryKilled()
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("deadline while polling for response")
+            r, _, _ = select.select([self._sock], [], [],
+                                    min(0.05, remaining))
+            if r:
+                return recv_msg(self._sock)
 
     def try_call(self, method: str, **args):
         """call() that returns None instead of raising on transport/handler

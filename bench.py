@@ -538,6 +538,79 @@ def run_trace_bench() -> dict:
     }
 
 
+def run_progress_bench() -> dict:
+    """Introspection overhead on the point-query steady state: the SAME
+    cached one-shape workload as run_point_bench, measured with progress
+    tracking off (no-op singleton, one flag check per beat site) and then
+    with progress tracking on PLUS a live query watchdog scanning the
+    registry in the background.  The acceptance contract
+    (docs/OBSERVABILITY.md): on <= 1% overhead — every beat is a few
+    host-side attribute writes at span seams already paid for, and the
+    watchdog runs off the query path."""
+    import pyarrow as pa
+
+    from baikaldb_tpu.exec.session import Session
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    n_rows = int(os.environ.get("BENCH_PROGRESS_ROWS", 100_000))
+    n_q = int(os.environ.get("BENCH_PROGRESS_QUERIES", 64))
+    rng = np.random.default_rng(29)
+    base = pa.table({
+        "id": np.arange(n_rows, dtype=np.int64),
+        "v": rng.normal(size=n_rows).astype(np.float64),
+    })
+
+    def phase(tracking_on: bool, its: int) -> float:
+        set_flag("progress_tracking", tracking_on)
+        s = Session()
+        s.execute("CREATE TABLE pr (id BIGINT, v DOUBLE)")
+        s.load_arrow("pr", base)
+        if tracking_on:
+            s.db.watchdog.start()
+        s.query("SELECT v FROM pr WHERE id = 0")      # plan + first compile
+        t0 = time.perf_counter()
+        try:
+            for i in range(its):
+                s.query(f"SELECT v FROM pr "
+                        f"WHERE id = {1 + (i * 9173) % n_rows}")
+            return time.perf_counter() - t0
+        finally:
+            if tracking_on:
+                s.db.watchdog.stop()
+
+    prev = bool(FLAGS.progress_tracking)
+    try:
+        off_dt = phase(False, n_q)
+        on_dt = phase(True, n_q)
+    finally:
+        set_flag("progress_tracking", prev)
+    off_per, on_per = off_dt / n_q, on_dt / n_q
+    platform = None
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:                                   # noqa: BLE001
+        pass
+    return {
+        "metric": f"point-query steady state with progress tracking + "
+                  f"watchdog on vs off ({n_rows / 1e3:.0f}k rows, "
+                  f"{n_q} queries, {platform})",
+        "value": round(n_q / on_dt, 1),
+        "unit": "queries/sec",
+        # >1 means introspection made it slower; contract: <= 1.01
+        "vs_baseline": round(on_per / off_per, 3),
+        "overhead_pct": round((on_per / off_per - 1.0) * 100, 2),
+        "platform": platform,
+        "rows": n_rows,
+        "queries": n_q,
+        "per_query_ms_progress_on": round(on_per * 1e3, 2),
+        "per_query_ms_progress_off": round(off_per * 1e3, 2),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
 def run_telemetry_bench() -> dict:
     """Telemetry-plane overhead guard (eighth JSON line): the point-query
     steady state with the fleet telemetry poller scraping two REAL
@@ -1337,6 +1410,30 @@ def _emit_trace_line(skip_reason: str | None = None):
     print(json.dumps(result))
 
 
+def _emit_progress_line(skip_reason: str | None = None):
+    """Tenth JSON line: introspection-overhead regression guard (progress
+    tracking + watchdog).  Same robustness contract: always prints a line,
+    never raises."""
+    if os.environ.get("BENCH_SKIP_PROGRESS") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "point-query steady state with progress tracking + "
+                      "watchdog on vs off (skipped)",
+            "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+            "platform": "none", "error": skip_reason}))
+        return
+    try:
+        result = run_progress_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "point-query steady state with progress "
+                            "tracking + watchdog on vs off (failed)",
+                  "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+                  "platform": "none",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_point_line(skip_reason: str | None = None):
     """Third JSON line: point-query steady state (parameterized plan-cache
     reuse).  Same robustness contract: always prints a line, never raises."""
@@ -1412,6 +1509,8 @@ def main():
                 _emit_telemetry_line(skip_reason="accelerator probe "
                                      "failed; telemetry phase skipped")
                 _emit_coldstart_line()  # cpu-subprocess: safe when wedged
+                _emit_progress_line(skip_reason="accelerator probe "
+                                    "failed; progress phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -1453,6 +1552,7 @@ def main():
             _emit_multiway_line()
             _emit_telemetry_line()
             _emit_coldstart_line()
+            _emit_progress_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
@@ -1463,6 +1563,7 @@ def main():
     _emit_multiway_line()
     _emit_telemetry_line()
     _emit_coldstart_line()
+    _emit_progress_line()
     return 0
 
 

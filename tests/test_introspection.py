@@ -1,0 +1,489 @@
+"""Live query introspection end-to-end (docs/OBSERVABILITY.md).
+
+Covers: the per-query progress registry (contextvar-scoped, no-op when the
+flag is off); cooperative KILL — token flip by the killer, QueryKilled at
+the victim's next beat, error 1317 on the wire, 1094 for unknown ids;
+SHOW [FULL] PROCESSLIST truncation + live state merging and the
+information_schema.processlist / flight_recorder views; per-phase
+query_log columns; the always-on flight recorder (slow/killed/failed
+bundles, bounded ring, dump + offline viewer); watchdog stall detection
+with per-episode dedup, SHOW STATUS health.* rows and the health RPC;
+process-resource gauges; and the chaos acceptance path — a query wedged
+on an injected store.handler delay killed over the wire in bounded time
+with the connection, daemon and processlist all intact after.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from baikaldb_tpu.chaos.failpoint import clear_all, set_failpoint
+from baikaldb_tpu.exec.session import Database, Session, SqlError
+from baikaldb_tpu.obs import progress
+from baikaldb_tpu.obs.progress import PROGRESS, CancelToken, QueryKilled
+from baikaldb_tpu.raft.core import raft_available
+from baikaldb_tpu.utils import metrics
+from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+needs_raft = pytest.mark.skipif(not raft_available(),
+                                reason="native raft core unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    clear_all()
+    yield
+    clear_all()
+    set_flag("chaos_enable", False)
+
+
+@pytest.fixture()
+def sess():
+    s = Session()
+    s.execute("CREATE TABLE t (id BIGINT, v DOUBLE)")
+    s.execute("INSERT INTO t VALUES (1, 1.5), (2, 2.5), (3, NULL)")
+    return s
+
+
+# ---- progress registry + cancel token --------------------------------------
+
+def test_track_registers_live_row(sess):
+    with progress.track("SELECT 1", conn_id=42, user="alice",
+                        db=sess.db, dbname="default") as qp:
+        qp.beat(phase="exec.batches", operator="scan default.t",
+                batches_done=2, batches_total=8,
+                rows_done=100, rows_est=400)
+        live = PROGRESS.live(sess.db)
+        assert [q.query_id for q in live] == [qp.query_id]
+        row = qp.row()
+        assert row["id"] == 42 and row["user"] == "alice"
+        assert row["phase"] == "exec.batches"
+        assert row["operator"] == "scan default.t"
+        assert (row["batches_done"], row["batches_total"]) == (2, 8)
+        st = qp.state()
+        assert "exec.batches" in st and "batch 2/8" in st \
+            and "rows 100/400" in st
+    assert PROGRESS.live(sess.db) == []      # unregistered on exit
+
+
+def test_state_shows_exchange_rounds(sess):
+    with progress.track("SELECT 1", db=sess.db) as qp:
+        qp.beat(phase="exec.run", round_no=2, rounds_total=4)
+        assert "round 2/4" in qp.state()
+
+
+def test_flag_off_is_noop(sess):
+    prev = bool(FLAGS.progress_tracking)
+    set_flag("progress_tracking", False)
+    try:
+        with progress.track("SELECT 1", db=sess.db) as qp:
+            assert qp.query_id == 0              # the shared no-op record
+            qp.beat(phase="exec.run", rows_done=5)   # must not raise
+            assert PROGRESS.live(sess.db) == []
+            assert progress.current() is qp
+    finally:
+        set_flag("progress_tracking", prev)
+
+
+def test_kill_raises_at_next_beat(sess):
+    before = metrics.queries_killed.value
+    with progress.track("SELECT 1", conn_id=7, db=sess.db) as qp:
+        assert PROGRESS.kill(conn_id=7, db=sess.db) == 1
+        assert metrics.queries_killed.value == before + 1
+        assert qp.token.killed()
+        with pytest.raises(QueryKilled, match="interrupted"):
+            qp.beat()
+    # kill by query_id, and a wrong-database filter matches nothing
+    with progress.track("SELECT 1", db=sess.db) as qp:
+        assert PROGRESS.kill(query_id=qp.query_id, db=Database()) == 0
+        assert PROGRESS.kill(query_id=qp.query_id, db=sess.db) == 1
+
+
+def test_cancel_token_standalone():
+    tok = CancelToken()
+    tok.check()                                  # not killed: no-op
+    tok.kill("test")
+    with pytest.raises(QueryKilled):
+        tok.check()
+    assert isinstance(QueryKilled("x"), RuntimeError)
+
+
+def test_kill_unknown_id_embedded(sess):
+    with pytest.raises(SqlError, match="Unknown thread id"):
+        sess.execute("KILL 999999")
+    with pytest.raises(SqlError, match="Unknown thread id"):
+        sess.execute("KILL QUERY 999999")
+
+
+# ---- SQL surfaces ----------------------------------------------------------
+
+def test_show_processlist_merges_live_queries(sess):
+    long_sql = "SELECT waits FROM elsewhere WHERE pad = '" + "x" * 100 + "'"
+    with progress.track(long_sql, conn_id=77, user="bob",
+                        db=sess.db, dbname="default"):
+        rows = [r for r in sess.query("SHOW PROCESSLIST") if r["Id"] == 77]
+        assert rows and rows[0]["User"] == "bob"
+        assert rows[0]["Command"] == "Query"
+        assert rows[0]["db"] == "default"
+        assert isinstance(rows[0]["State"], str) and rows[0]["State"]
+        # MySQL semantics: Info truncated to 100 chars unless FULL
+        assert len(rows[0]["Info"]) == 100
+        full = [r for r in sess.query("SHOW FULL PROCESSLIST")
+                if r["Id"] == 77]
+        assert full[0]["Info"] == long_sql
+    assert [r for r in sess.query("SHOW PROCESSLIST") if r["Id"] == 77] == []
+
+
+def test_information_schema_processlist(sess):
+    with progress.track("SELECT 1", conn_id=88, user="carol",
+                        db=sess.db, dbname="default") as qp:
+        rows = [r for r in
+                sess.query("SELECT * FROM information_schema.processlist")
+                if r["id"] == 88]
+        assert rows and rows[0]["query_id"] == qp.query_id
+        assert rows[0]["user"] == "carol"
+        for col in ("phase", "operator", "batches_done", "batches_total",
+                    "rows_done", "rows_est", "round", "rounds_total",
+                    "queue_wait_ms", "elapsed_ms"):
+            assert col in rows[0]
+        assert rows[0]["elapsed_ms"] >= 0.0
+
+
+def test_query_log_phase_columns(sess):
+    sess.query("SELECT COUNT(*) FROM t")
+    log = sess.query("SELECT query, parse_ms, plan_ms, exec_ms, egress_ms "
+                     "FROM information_schema.query_log")
+    mine = [r for r in log if "COUNT(*)" in r["query"]][-1]
+    # every phase bucket is present and the exec bucket actually accrued
+    for col in ("parse_ms", "plan_ms", "exec_ms", "egress_ms"):
+        assert mine[col] >= 0.0
+    assert mine["exec_ms"] > 0.0
+
+
+def test_show_status_health_rows(sess):
+    vals = {r["Variable_name"]: r["Value"]
+            for r in sess.query("SHOW STATUS LIKE 'health.%'")}
+    assert vals["health.status"] in ("ok", "stalled")
+    assert vals["health.watchdog"] == "frontend"
+    assert int(vals["health.stalls_detected"]) >= 0
+
+
+# ---- flight recorder -------------------------------------------------------
+
+def test_slow_query_gets_forensic_bundle(sess):
+    prev = FLAGS.slow_query_ms
+    set_flag("slow_query_ms", 0.0)               # everything is "slow"
+    try:
+        sess.query("SELECT v FROM t WHERE id = 2")
+    finally:
+        set_flag("slow_query_ms", prev)
+    rows = sess.query("SELECT * FROM information_schema.flight_recorder")
+    mine = [r for r in rows if "WHERE id = 2" in r["query"]][-1]
+    assert mine["status"] == "ok" and mine["has_bundle"]
+    assert mine["duration_ms"] > 0.0
+    rec = sess.db.flightrec.get(mine["rec_id"])
+    b = rec["bundle"]
+    assert set(b) >= {"plan", "spans", "metric_delta", "device_stats",
+                      "exchange"}
+    assert "Scan" in b["plan"] or "scan" in b["plan"].lower()
+
+
+def test_fast_clean_query_summary_only(sess):
+    prev = FLAGS.slow_query_ms
+    set_flag("slow_query_ms", 1e9)               # nothing is slow
+    try:
+        sess.query("SELECT COUNT(*) FROM t")
+    finally:
+        set_flag("slow_query_ms", prev)
+    rows = sess.query("SELECT * FROM information_schema.flight_recorder")
+    mine = [r for r in rows if "COUNT(*)" in r["query"]][-1]
+    assert not mine["has_bundle"]
+    assert sess.db.flightrec.get(mine["rec_id"])["bundle"] is None
+
+
+def test_failed_query_recorded_with_error(sess):
+    with pytest.raises(SqlError):
+        sess.query("SELECT nope_no_such_column FROM t")
+    rows = sess.query("SELECT * FROM information_schema.flight_recorder")
+    mine = [r for r in rows if "nope_no_such_column" in r["query"]][-1]
+    assert mine["status"] == "error" and mine["error"]
+    assert mine["has_bundle"]
+
+
+def test_ring_is_bounded(sess):
+    prev = int(FLAGS.flightrec_max)
+    set_flag("flightrec_max", 4)
+    try:
+        for i in range(10):
+            sess.db.flightrec.record({"text": f"q{i}", "status": "ok"})
+        rows = sess.db.flightrec.rows()
+        assert len(rows) == 4
+        assert rows[-1]["text"] == "q9"          # newest survive
+    finally:
+        set_flag("flightrec_max", prev)
+        sess.db.flightrec.clear()
+
+
+def test_dump_and_offline_viewer(sess, tmp_path):
+    import tools.flightrec as viewer
+
+    prev = FLAGS.slow_query_ms
+    set_flag("slow_query_ms", 0.0)
+    try:
+        sess.query("SELECT SUM(v) FROM t")
+    finally:
+        set_flag("slow_query_ms", prev)
+    path = str(tmp_path / "records.jsonl")
+    r = sess.execute(f"handle flightrec dump '{path}'")
+    assert r.affected_rows >= 1 and os.path.exists(path)
+    recs = viewer.load(path)
+    assert any("SUM(v)" in (rec.get("text") or "") for rec in recs)
+    assert "SUM(v)" in viewer.fmt_summary(recs)
+    bundled = [rec for rec in recs if rec.get("bundle")][-1]
+    out = viewer.fmt_record(bundled)
+    assert "phases:" in out and "plan:" in out
+    sess.execute("handle flightrec clear")
+    # the ring holds at most the clear statement's own completion record
+    assert all("SUM(v)" not in r["text"] for r in sess.db.flightrec.rows())
+
+
+# ---- watchdog --------------------------------------------------------------
+
+def test_watchdog_stall_episode_dedup(sess):
+    wd = sess.db.watchdog
+    base = wd.health()["stalls_detected"]
+    with progress.track("SELECT wedge", db=sess.db) as qp:
+        qp.beat_mono -= 2 * float(FLAGS.watchdog_stall_s) + 1
+        wd.scan_now()
+        h = wd.health()
+        assert h["status"] == "stalled"
+        assert h["stalls_detected"] == base + 1
+        assert qp.stalled
+        wd.scan_now()                        # same episode: counted once
+        assert wd.health()["stalls_detected"] == base + 1
+        qp.beat()                            # a beat ends the episode
+        wd.scan_now()
+        assert wd.health()["status"] == "ok"
+        assert not qp.stalled
+        qp.beat_mono -= 2 * float(FLAGS.watchdog_stall_s) + 1
+        wd.scan_now()                        # a RE-stall is a new episode
+        assert wd.health()["stalls_detected"] == base + 2
+
+
+def test_watchdog_counter_in_registry(sess):
+    before = metrics.watchdog_stalls_detected.value
+    with progress.track("SELECT wedge", db=sess.db) as qp:
+        qp.beat_mono -= 2 * float(FLAGS.watchdog_stall_s) + 1
+        sess.db.watchdog.scan_now()
+    assert metrics.watchdog_stalls_detected.value == before + 1
+
+
+def test_meta_health_rpc():
+    from baikaldb_tpu.server.meta_server import MetaServer
+    from baikaldb_tpu.utils.net import RpcClient
+
+    m = MetaServer("127.0.0.1:0")
+    m.start()
+    try:
+        c = RpcClient(f"127.0.0.1:{m.rpc.port}")
+        h = c.call("health")
+        c.close()
+        assert h["status"] == "ok" and h["role"] == "meta"
+        assert h["stalls_detected"] == 0 and "uptime_s" in h
+    finally:
+        m.stop()
+
+
+def test_process_gauges_installed(sess):
+    snap = metrics.REGISTRY.snapshot()
+    for name in ("process_rss_bytes", "process_threads",
+                 "process_open_fds", "process_uptime_s",
+                 "process_gc_collections"):
+        assert name in snap, name
+        assert snap[name]["kind"] == "gauge"
+    rss = snap["process_rss_bytes"]["rows"][0]["value"]
+    assert rss > 1e6                             # a real interpreter RSS
+
+
+# ---- wire protocol: KILL CONNECTION ----------------------------------------
+
+def test_kill_connection_over_wire():
+    from baikaldb_tpu.client.mysql_client import Connection, MySQLError
+    from baikaldb_tpu.server.mysql_server import MySQLServer
+
+    srv = MySQLServer().start()
+    try:
+        victim = Connection(port=srv.port)
+        cid = int(victim.query("SELECT CONNECTION_ID()").rows[0][0])
+        killer = Connection(port=srv.port)
+        with pytest.raises(MySQLError) as ei:
+            killer.query("KILL 999999")
+        assert ei.value.code == 1094             # ER_NO_SUCH_THREAD
+        killer.query(f"KILL {cid}")
+        time.sleep(0.3)
+        with pytest.raises(Exception):
+            victim.query("SELECT 1")             # socket severed
+        # the killer and the daemon survive; the victim left processlist
+        rows = killer.query("SHOW PROCESSLIST").rows
+        assert all(r[0] != str(cid) for r in rows)
+        killer.close()
+    finally:
+        srv.stop()
+
+
+# ---- chaos acceptance: KILL a wedged query over the wire -------------------
+
+@pytest.fixture(scope="module")
+def mini_cluster():
+    if not raft_available():
+        pytest.skip("native raft core unavailable")
+    from baikaldb_tpu.server.meta_server import MetaServer
+    from baikaldb_tpu.server.store_server import StoreServer
+
+    meta = MetaServer("127.0.0.1:0")
+    meta.start()
+    meta_addr = f"127.0.0.1:{meta.rpc.port}"
+    stores = []
+    for sid in (1, 2, 3):
+        st = StoreServer(sid, "127.0.0.1:0", meta_addr, tick_interval=0.02)
+        st.address = f"127.0.0.1:{st.rpc.port}"
+        st.start()
+        stores.append(st)
+    seed = Session(Database(cluster=meta_addr))
+    seed.execute("CREATE TABLE kt (id BIGINT, v DOUBLE, PRIMARY KEY (id))")
+    for i in range(6):
+        seed.execute(f"INSERT INTO kt VALUES ({i}, {float(i)})")
+    yield meta_addr, stores
+    clear_all()
+    for st in stores:
+        st.stop()
+    meta.stop()
+
+
+def _wedged_frontend(meta_addr):
+    """A FRESH frontend over the cluster: its first scan must refetch the
+    table from the store replicas over RPC — the seam store.handler delays
+    wedge."""
+    from baikaldb_tpu.server.mysql_server import MySQLServer
+
+    db = Database(cluster=meta_addr)
+    srv = MySQLServer(db=db).start()
+    return db, srv
+
+
+@needs_raft
+def test_kill_wedged_query_bounded(mini_cluster):
+    from baikaldb_tpu.client.mysql_client import Connection, MySQLError
+    from baikaldb_tpu.utils.net import RpcClient
+
+    meta_addr, stores = mini_cluster
+    db, srv = _wedged_frontend(meta_addr)
+    try:
+        victim = Connection(port=srv.port)
+        victim.query("CREATE TABLE kt (id BIGINT, v DOUBLE, "
+                     "PRIMARY KEY (id))")
+        cid = int(victim.query("SELECT CONNECTION_ID()").rows[0][0])
+        set_failpoint("store.handler", "delay(1500)")
+        err, dt = [None], [0.0]
+
+        def run_victim():
+            t0 = time.monotonic()
+            try:
+                victim.query("SELECT COUNT(*) FROM kt")
+            except MySQLError as e:
+                err[0] = e
+            dt[0] = time.monotonic() - t0
+
+        th = threading.Thread(target=run_victim)
+        th.start()
+        killer = Connection(port=srv.port)
+        # wait until the wedged query is LIVE in SHOW PROCESSLIST with a
+        # progress state — the introspection half of the acceptance bar
+        state = None
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            rows = killer.query("SHOW PROCESSLIST").rows
+            mine = [r for r in rows
+                    if r[0] == str(cid) and r[4] == "Query" and r[6]]
+            if mine:
+                state = mine[0][6]
+                break
+            time.sleep(0.05)
+        assert state, "wedged query never surfaced in SHOW PROCESSLIST"
+        time.sleep(0.2)                      # let it sink into the RPC wait
+        t0 = time.monotonic()
+        killer.query(f"KILL QUERY {cid}")
+        th.join(timeout=15)
+        assert not th.is_alive()
+        kill_latency = time.monotonic() - t0
+        assert err[0] is not None and err[0].code == 1317
+        # bounded: well under 2x the injected per-RPC delay (the token is
+        # polled every 50ms inside the response wait)
+        assert kill_latency < 3.0
+        clear_all()
+        # connection and daemons survive; the processlist row cleared
+        assert victim.query("SELECT 1").rows == [("1",)]
+        rows = killer.query("SHOW PROCESSLIST").rows
+        assert all(not (r[0] == str(cid) and r[6]) for r in rows)
+        for st in stores:
+            c = RpcClient(st.address)
+            h = c.call("health")
+            c.close()
+            assert h["role"] == "store" and h["status"] in ("ok", "stalled")
+        # the kill left a forensic bundle behind
+        fr = Session(db).query("SELECT status, has_bundle, query FROM "
+                               "information_schema.flight_recorder")
+        killed = [r for r in fr if r["status"] == "killed"]
+        assert killed and killed[-1]["has_bundle"]
+        victim.close()
+        killer.close()
+    finally:
+        clear_all()
+        srv.stop()
+
+
+@needs_raft
+def test_killed_distributed_write_at_most_once(mini_cluster):
+    from baikaldb_tpu.client.mysql_client import Connection, MySQLError
+
+    meta_addr, _stores = mini_cluster
+    db, srv = _wedged_frontend(meta_addr)
+    try:
+        victim = Connection(port=srv.port)
+        victim.query("CREATE TABLE kt (id BIGINT, v DOUBLE, "
+                     "PRIMARY KEY (id))")
+        cid = int(victim.query("SELECT CONNECTION_ID()").rows[0][0])
+        set_failpoint("store.handler", "delay(800)")
+        err = [None]
+
+        def run_victim():
+            try:
+                victim.query("INSERT INTO kt VALUES (200, 9.0)")
+            except MySQLError as e:
+                err[0] = e
+
+        th = threading.Thread(target=run_victim)
+        th.start()
+        time.sleep(0.4)                      # mid-write
+        killer = Connection(port=srv.port)
+        killer.query(f"KILL QUERY {cid}")
+        th.join(timeout=30)
+        assert not th.is_alive()
+        clear_all()
+        # exactly-once side effects: the write either fully landed or
+        # never did — a FRESH frontend reads the replicas' truth, and a
+        # retry/resend under the injected delay must not duplicate it
+        chk = Session(Database(cluster=meta_addr))
+        chk.execute("CREATE TABLE kt (id BIGINT, v DOUBLE, "
+                    "PRIMARY KEY (id))")
+        n = chk.query("SELECT COUNT(*) n FROM kt WHERE id = 200")[0]["n"]
+        assert n in (0, 1)
+        if err[0] is not None:               # interrupted: error is 1317
+            assert err[0].code == 1317
+        victim.close()
+        killer.close()
+    finally:
+        clear_all()
+        srv.stop()

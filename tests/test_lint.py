@@ -419,6 +419,51 @@ def test_metricinjit_dispatch_layer_clean(tmp_path):
     assert out == []
 
 
+# ---- PROGRESSINJIT ---------------------------------------------------------
+
+def test_progressinjit_in_hot_module(tmp_path):
+    # hot module: a beat there fires at TRACE time and its kill check
+    # cannot interrupt a running device program
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.obs import progress
+        def f(x, qp=None):
+            progress.current().beat(phase="exec")
+            return x
+        """)
+    assert out == [("PROGRESSINJIT", 3)]
+
+
+def test_progressinjit_jit_decorated_host_module(tmp_path):
+    out = lint_src(tmp_path, """\
+        import jax
+        from baikaldb_tpu.obs import progress
+        @jax.jit
+        def f(x):
+            progress.current().checkpoint()
+            tok = progress.cancel_token()
+            return x
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == [("PROGRESSINJIT", 5), ("PROGRESSINJIT", 6)]
+
+
+def test_progressinjit_host_seam_clean(tmp_path):
+    # the sanctioned pattern: beat at the host seams AROUND the jitted
+    # call — and an unrelated .beat attribute is not a progress call
+    out = lint_src(tmp_path, """\
+        from baikaldb_tpu.obs import progress
+        class Drum:
+            def beat(self):
+                return 1
+        def dispatch(fn, batches):
+            qp = progress.current()
+            qp.beat(phase="exec.run")
+            out = fn(batches)
+            Drum().beat()
+            return out
+        """, rel="baikaldb_tpu/server/fixture.py")
+    assert out == []
+
+
 # ---- suppression channels -------------------------------------------------
 
 def test_inline_suppression(tmp_path):

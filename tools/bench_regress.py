@@ -26,6 +26,12 @@ warm-started node that starts paying compiles again
 (``warm_*.warm_compiles`` > baseline) or loses AOT hits fails CI, and
 ``--coldstart-pct N`` bounds the ``restart_to_steady_ms`` wall-clock
 regression (default 50; 0 disables).
+
+The introspection line (progress tracking + watchdog on vs off) carries
+its own contract in ``overhead_pct``: the candidate must stay within
+``--progress-pct`` (default 1.0, the docs/OBSERVABILITY.md bound; 0
+disables).  This is an absolute ceiling, not a baseline diff — turning
+introspection on must never cost more than the documented budget.
 """
 
 from __future__ import annotations
@@ -39,7 +45,8 @@ def load_capture(path: str) -> dict:
     """Parse a bench_tpch --json capture ({"header": ..., "queries": ...})
     or a bench.py JSON-lines capture (the cold-start row is extracted).
     Unknown/summary lines are ignored."""
-    out: dict = {"header": None, "queries": {}, "coldstart": None}
+    out: dict = {"header": None, "queries": {}, "coldstart": None,
+                 "progress": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -58,6 +65,9 @@ def load_capture(path: str) -> dict:
             elif str(row.get("metric", "")).startswith(
                     "restart-to-steady") and "cold" in row:
                 out["coldstart"] = row
+            elif str(row.get("metric", "")).startswith(
+                    "point-query steady state with progress"):
+                out["progress"] = row
     return out
 
 
@@ -96,6 +106,21 @@ def compare_coldstart(base: dict, cand: dict, pct: float) -> list:
                 f"{b['restart_to_steady_ms']} -> "
                 f"{c['restart_to_steady_ms']} (> +{pct}%)")
     return problems
+
+
+def compare_progress(cand: dict, pct: float) -> list:
+    """Introspection-overhead ceiling on the candidate capture: the
+    progress-tracking line's ``overhead_pct`` must stay within ``pct``
+    (skipped/failed lines — value 0 or an error field — are ignored)."""
+    c = cand.get("progress")
+    if pct <= 0 or c is None or c.get("error") or not c.get("value"):
+        return []
+    over = c.get("overhead_pct")
+    if over is not None and over > pct:
+        return [f"progress: introspection overhead {over}% > {pct}% budget "
+                f"(progress tracking + watchdog must stay off the hot "
+                f"path)"]
+    return []
 
 
 def compare(base: dict, cand: dict, wall_clock_pct: float = 0.0) -> list:
@@ -144,20 +169,27 @@ def main(argv=None) -> int:
     ap.add_argument("--coldstart-pct", type=float, default=50.0,
                     help="flag restart_to_steady_ms regressions beyond "
                          "this percentage (0 = counters only)")
+    ap.add_argument("--progress-pct", type=float, default=1.0,
+                    help="introspection overhead_pct ceiling on the "
+                         "candidate's progress-tracking line (0 = skip)")
     args = ap.parse_args(argv)
     base = load_capture(args.baseline)
     cand = load_capture(args.candidate)
-    if not base["queries"] and base["coldstart"] is None:
+    if not base["queries"] and base["coldstart"] is None \
+            and cand["progress"] is None:
         print(f"bench_regress: no query or cold-start rows in "
               f"{args.baseline}", file=sys.stderr)
         return 2
     problems = compare(base, cand, args.wall_clock_pct)
     problems += compare_coldstart(base, cand, args.coldstart_pct)
+    problems += compare_progress(cand, args.progress_pct)
     compared = []
     if base["queries"]:
         compared.append(f"{len(base['queries'])} queries")
     if base["coldstart"] is not None and cand["coldstart"] is not None:
         compared.append("cold-start line")
+    if cand["progress"] is not None:
+        compared.append("introspection line")
     if problems:
         for p in problems:
             print(f"REGRESSION {p}")
