@@ -111,6 +111,21 @@ CATALOG: dict[str, str] = {
                         "falls back to its own inline execution, exactly-"
                         "once preserved; panic: same fallback — the "
                         "frontend combiner has no daemon to crash)",
+    "region.split_fence": "live split, before the fence/routing switch "
+                          "(drop: the split aborts cleanly — child "
+                          "retires, parent routing untouched)",
+    "region.handoff": "live split bulk row handoff into the child region "
+                      "(drop: the copy fails, split aborts; parent keeps "
+                      "serving its whole range)",
+    "migrate.snapshot": "live migration snapshot catch-up of the new "
+                        "learner (drop: the learner is never added, "
+                        "migration aborts with membership unchanged)",
+    "migrate.promote": "live migration learner->voter promotion (drop: "
+                       "promotion skipped, the learner is torn back down "
+                       "— clean rollback)",
+    "meta.balance_tick": "MetaService.tick control loop (drop: the tick "
+                         "emits no orders — a stalled balancer; the data "
+                         "plane must stay correct without it)",
 }
 
 _SPEC_RE = re.compile(

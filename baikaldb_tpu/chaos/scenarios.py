@@ -46,13 +46,13 @@ def _p(lat_ms: list, q: float) -> float:
     return round(s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))], 3)
 
 
-def _fleet_session(seed: int):
+def _fleet_session(seed: int, stores: int = 3):
     from ..exec.session import Database, Session
     from ..meta.service import MetaService
     from ..raft.fleet import StoreFleet
 
     fleet = StoreFleet(MetaService(peer_count=3),
-                       ["c1:1", "c2:1", "c3:1"], seed=7 + seed)
+                       [f"c{i + 1}:1" for i in range(stores)], seed=7 + seed)
     db = Database(fleet=fleet)
     s = Session(db)
     s.execute("CREATE DATABASE chaos")
@@ -397,11 +397,284 @@ def dispatch_overload(seed: int = 4, clients: int = 12, queries: int = 8,
             "problems": problems}
 
 
+def _region_invariants(fleet, tier) -> list[str]:
+    """Never-half-routed checks shared by the elastic-region scenarios:
+    the tier's ranges tile the keyspace with no gap or overlap, every tier
+    region is SERVING in meta, and the meta registry / fleet group table /
+    tier routing lists agree exactly on which regions exist."""
+    problems = []
+    if tier._starts[0] != b"" or tier._ends[-1] != b"":
+        problems.append("tier range endpoints no longer span the keyspace")
+    for i in range(len(tier.metas) - 1):
+        if tier._ends[i] != tier._starts[i + 1]:
+            problems.append(
+                f"range gap/overlap between regions "
+                f"{tier.metas[i].region_id} and {tier.metas[i + 1].region_id}")
+    tier_rids = {m.region_id for m in tier.metas}
+    meta_rids = {rid for rid, r in fleet.meta.regions.items()
+                 if r.table_id == tier.table_id}
+    if tier_rids != meta_rids:
+        problems.append(f"meta/tier region sets diverged "
+                        f"(tier={sorted(tier_rids)} meta={sorted(meta_rids)})")
+    for m in tier.metas:
+        rm = fleet.meta.regions.get(m.region_id)
+        if rm is not None and rm.state != "SERVING":
+            problems.append(f"region {m.region_id} stuck {rm.state}")
+        if m.region_id not in fleet.groups:
+            problems.append(f"region {m.region_id} routed but its raft "
+                            f"group left the fleet")
+    for rid in fleet.groups:
+        if fleet.meta.regions.get(rid) is None:
+            problems.append(f"raft group {rid} leaked (no meta entry)")
+    return problems
+
+
+def _replica_convergence(tier) -> tuple[list, list[str]]:
+    """Per-region replica states after a settle; diverged replicas are
+    problems.  Returns (states for the digest, problems)."""
+    problems = []
+    states = []
+    for m, g in zip(tier.metas, tier.groups):
+        g.bus.advance(30)
+        per = []
+        for nid in sorted(g.bus.nodes):
+            node = g.bus.nodes[nid]
+            node.apply_committed()
+            per.append(sorted((r["k"], r["v"])
+                              for r in node.rows_in_range()))
+        if any(st != per[0] for st in per[1:]):
+            problems.append(f"replicas of region {m.region_id} did not "
+                            f"converge after heal")
+        states.append(per[0])
+    return states, problems
+
+
+def split_chaos(seed: int = 5, writes: int = 40) -> dict:
+    """Partition the fleet mid-split (the tentpole contract): a live
+    fenced split runs while SQL INSERTs keep flowing, and the seeded fault
+    is one of — partition the leader's store away from the fleet at the
+    bulk-copy or catch-up phase (the split must COMPLETE through elections
+    on the majority side), or drop the ``region.handoff`` /
+    ``region.split_fence`` seam (the split must ABORT cleanly and a retry
+    must complete).  Ends with exactly-once rows, key-ordered binlog,
+    converged replicas, and a fully-routed region table — then a lowered
+    ``region_split_rows`` proves the meta-tick -> split-order -> online
+    split path end to end.  Fleet plane: bit-identical replay."""
+    from ..storage.replicated import SplitError
+    from ..utils.flags import FLAGS, set_flag
+
+    rng = random.Random((seed << 8) ^ 0x73706C)
+    fleet, db, s = _fleet_session(seed)
+    tier = fleet.row_tiers["chaos.ck"]
+    schedule: list[list] = []
+    problems: list[str] = []
+    next_key = 0
+
+    def put(n: int):
+        nonlocal next_key
+        for _ in range(min(n, writes - next_key)):
+            s.execute(f"INSERT INTO ck VALUES ({next_key}, "
+                      f"{next_key * next_key})")
+            next_key += 1
+
+    put(writes // 2)
+    parent = tier.metas[0].region_id
+    fault = rng.choice(["partition_begin", "partition_copied",
+                        "handoff_drop", "fence_drop"])
+    mid_writes = 3 + rng.randrange(4)
+    schedule.append(["fault_plan", fault, mid_writes])
+
+    def hook(phase: str):
+        schedule.append(["phase", phase])
+        put(mid_writes)             # writes continue during the live split
+        if fault == f"partition_{phase}":
+            ldr = fleet.meta.regions[parent].leader
+            fleet.partition_store(ldr)
+            schedule.append(["partition", ldr, phase])
+
+    try:
+        if fault == "handoff_drop":
+            failpoint.set_failpoint("region.handoff", "1*drop")
+        elif fault == "fence_drop":
+            failpoint.set_failpoint("region.split_fence", "1*drop")
+        try:
+            child = tier.split_region_online(parent, chaos_hook=hook)
+            schedule.append(["split_ok", parent, child.region_id])
+        except SplitError:
+            schedule.append(["split_abort", parent])
+            fleet.heal_all()
+            try:                    # aborted cleanly -> a retry completes
+                child = tier.split_region_online(parent)
+                schedule.append(["split_retry_ok", parent, child.region_id])
+            except SplitError as e:
+                problems.append(f"split retry failed: {e}")
+    finally:
+        failpoint.clear("region.handoff")
+        failpoint.clear("region.split_fence")
+        fleet.heal_all()
+    put(writes - next_key)          # lands across BOTH sides of the split
+    # tick-driven path: with the threshold lowered, heartbeats feed the
+    # load gauges and meta's next tick emits split orders the fleet
+    # executes as further online splits
+    prev_rows = int(FLAGS.region_split_rows)
+    set_flag("region_split_rows", max(4, writes // 4))
+    try:
+        fleet.heartbeat_all()
+        fleet.heartbeat_all()
+        orders = fleet.meta.tick()
+        applied = fleet.apply_orders(orders)
+        schedule.append(["tick", sorted([o.kind, o.region_id]
+                                        for o in orders), applied])
+        if not any(o.kind == "split" for o in orders):
+            problems.append("meta tick emitted no split order despite "
+                            "rows over threshold")
+    finally:
+        set_flag("region_split_rows", prev_rows)
+    rows = s.query("SELECT k, v FROM ck ORDER BY k")
+    events = [e for e in db.binlog.read(0, 1 << 20)
+              if e.table == "ck" and e.event_type == "insert"]
+    problems += _check_exactly_once(rows, events, writes)
+    seen = [int(r["k"]) for e in events for r in (e.rows or [])]
+    if seen != sorted(seen):
+        problems.append("binlog order diverged from write order")
+    if len(tier.metas) < 2:
+        problems.append("no split happened")
+    problems += _region_invariants(fleet, tier)
+    replicas, conv = _replica_convergence(tier)
+    problems += conv
+    state = {"rows": rows,
+             "binlog": [[e.event_type, e.rows] for e in events],
+             "regions": [[m.region_id, tier._starts[i].hex(),
+                          tier._ends[i].hex()]
+                         for i, m in enumerate(tier.metas)],
+             "replicas": replicas}
+    return {"writes": writes, "fault_schedule": schedule,
+            "faults": len(schedule),
+            "regions": len(tier.metas),
+            "state_digest": _digest({"schedule": schedule, "state": state}),
+            "problems": problems}
+
+
+def migrate_chaos(seed: int = 6, writes: int = 36) -> dict:
+    """Kill the leader mid-migration (the tentpole contract): a learner-
+    first live migration moves a replica off the region's current leader
+    store to the fleet's idle fourth store while SQL INSERTs keep flowing.
+    The seeded fault is one of — kill the leader's node at the start or
+    at learner catch-up (the migration must COMPLETE through elections),
+    or drop the ``migrate.snapshot`` / ``migrate.promote`` seam (clean
+    rollback, then a retry completes).  Ends with exactly-once rows,
+    key-ordered binlog, converged replicas, and meta's membership exactly
+    equal to the raft group's — completed or rolled back, never half-
+    moved.  Fleet plane: bit-identical replay."""
+    from ..raft.fleet import MigrateError
+
+    rng = random.Random((seed << 8) ^ 0x6D6967)
+    fleet, db, s = _fleet_session(seed, stores=4)
+    tier = fleet.row_tiers["chaos.ck"]
+    rid = tier.metas[0].region_id
+    g = tier.groups[0]
+    schedule: list[list] = []
+    problems: list[str] = []
+    next_key = 0
+
+    def put(n: int):
+        nonlocal next_key
+        for _ in range(min(n, writes - next_key)):
+            s.execute(f"INSERT INTO ck VALUES ({next_key}, "
+                      f"{next_key * next_key})")
+            next_key += 1
+
+    put(writes // 2)
+    rm = fleet.meta.regions[rid]
+    source = rm.leader              # move the LEADER's replica: the move
+    #                                 must transfer leadership away first
+    target = next(a for a in sorted(fleet.addresses) if a not in rm.peers)
+    fault = rng.choice(["kill_leader_start", "kill_leader_learner",
+                        "snapshot_drop", "promote_drop"])
+    mid_writes = 3 + rng.randrange(4)
+    schedule.append(["fault_plan", fault, source, target, mid_writes])
+    killed: list[int] = []
+
+    def hook(phase: str):
+        schedule.append(["phase", phase])
+        put(mid_writes)         # writes continue during the live migration
+        if fault == f"kill_leader_{phase}":
+            try:
+                victim = g.leader()
+            except RuntimeError:
+                return
+            g.bus.kill(victim)
+            killed.append(victim)
+            schedule.append(["kill_leader", victim, phase])
+
+    try:
+        if fault == "snapshot_drop":
+            failpoint.set_failpoint("migrate.snapshot", "1*drop")
+        elif fault == "promote_drop":
+            failpoint.set_failpoint("migrate.promote", "1*drop")
+        try:
+            fleet.migrate_replica(rid, source, target, chaos_hook=hook)
+            schedule.append(["migrate_ok", source, target])
+        except MigrateError:
+            schedule.append(["migrate_abort", source, target])
+            for nid in killed:
+                g.bus.revive(nid)
+            killed.clear()
+            try:                # rolled back cleanly -> a retry completes
+                fleet.migrate_replica(rid, source, target)
+                schedule.append(["migrate_retry_ok", source, target])
+            except MigrateError as e:
+                problems.append(f"migration retry failed: {e}")
+    finally:
+        failpoint.clear("migrate.snapshot")
+        failpoint.clear("migrate.promote")
+        for nid in killed:
+            g.bus.revive(nid)
+    put(writes - next_key)
+    rows = s.query("SELECT k, v FROM ck ORDER BY k")
+    events = [e for e in db.binlog.read(0, 1 << 20)
+              if e.table == "ck" and e.event_type == "insert"]
+    problems += _check_exactly_once(rows, events, writes)
+    seen = [int(r["k"]) for e in events for r in (e.rows or [])]
+    if seen != sorted(seen):
+        problems.append("binlog order diverged from write order")
+    # membership: completed-or-rolled-back, never half-moved — meta's
+    # registry must equal the raft group's real voter set
+    rm = fleet.meta.regions[rid]
+    raft_peers = sorted(fleet._addr[n] for n in g.peers())
+    if sorted(rm.peers) != raft_peers:
+        problems.append(f"meta peers {sorted(rm.peers)} != raft voters "
+                        f"{raft_peers}")
+    if g.bus.nodes[g.leader()].core.learners():
+        problems.append("migration left a dangling learner behind")
+    if source in raft_peers:
+        problems.append(f"replica never left {source} (migration neither "
+                        f"completed nor cleanly retried)")
+    if target not in raft_peers:
+        problems.append(f"replica never reached {target}")
+    if rm.state != "SERVING":
+        problems.append(f"region stuck {rm.state}")
+    problems += _region_invariants(fleet, tier)
+    replicas, conv = _replica_convergence(tier)
+    problems += conv
+    state = {"rows": rows,
+             "binlog": [[e.event_type, e.rows] for e in events],
+             "membership": raft_peers,
+             "replicas": replicas}
+    return {"writes": writes, "fault_schedule": schedule,
+            "faults": len(schedule),
+            "membership": raft_peers,
+            "state_digest": _digest({"schedule": schedule, "state": state}),
+            "problems": problems}
+
+
 SCENARIOS = {
     "kill_leader": kill_leader,
     "partition": partition,
     "rpc_chaos": rpc_chaos,
     "dispatch_overload": dispatch_overload,
+    "split_chaos": split_chaos,
+    "migrate_chaos": migrate_chaos,
 }
 
 

@@ -4770,6 +4770,37 @@ class Session:
                 "default_value": [str(r[2]) for r in rows],
                 "help": [r[3] for r in rows],
             }) if rows else _empty_info("flags")
+        if name == "regions":
+            fleet = self.db.fleet
+            if fleet is None:
+                return _empty_info("regions")
+            # table_id -> table name via the registered row tiers; regions
+            # whose tier is gone (or was never materialized through a tier)
+            # fall back to the numeric id
+            names = {t.table_id: t.table_key
+                     for t in fleet.row_tiers.values()}
+            rms = sorted(fleet.meta.regions.values(),
+                         key=lambda r: r.region_id)
+            return pa.table({
+                "region_id": pa.array([r.region_id for r in rms],
+                                      pa.int64()),
+                "table_name": [names.get(r.table_id, str(r.table_id))
+                               for r in rms],
+                "start_key": [r.start_key for r in rms],
+                "end_key": [r.end_key for r in rms],
+                "peers": [",".join(r.peers) for r in rms],
+                "learners": [",".join(r.learners) for r in rms],
+                "leader": [r.leader for r in rms],
+                "state": [r.state for r in rms],
+                "version": pa.array([r.version for r in rms], pa.int64()),
+                "num_rows": pa.array([r.num_rows for r in rms], pa.int64()),
+                "apply_lag": pa.array([r.apply_lag for r in rms],
+                                      pa.int64()),
+                "proposal_queue": pa.array([r.proposal_queue for r in rms],
+                                           pa.int64()),
+                "write_rate": pa.array([r.write_rate for r in rms],
+                                       pa.int64()),
+            }) if rms else _empty_info("regions")
         if name == "ddl_work":
             ws = list(self.db.ddl.works.values())
             return pa.table({

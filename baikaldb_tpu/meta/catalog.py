@@ -238,6 +238,23 @@ class Catalog:
                                  Field("seq", LType.INT64),
                                  Field("file", LType.STRING),
                                  Field("watermark", LType.INT64))),
+        # elastic regions (meta/service.py + raft/fleet.py): one row per
+        # region in meta's routing registry — key range, placement, and
+        # the SERVING/SPLITTING/MIGRATING lifecycle with the load gauges
+        # (rows, apply_lag, write_rate) the split/balance triggers consume
+        "regions": Schema((Field("region_id", LType.INT64),
+                           Field("table_name", LType.STRING),
+                           Field("start_key", LType.STRING),
+                           Field("end_key", LType.STRING),
+                           Field("peers", LType.STRING),
+                           Field("learners", LType.STRING),
+                           Field("leader", LType.STRING),
+                           Field("state", LType.STRING),
+                           Field("version", LType.INT64),
+                           Field("num_rows", LType.INT64),
+                           Field("apply_lag", LType.INT64),
+                           Field("proposal_queue", LType.INT64),
+                           Field("write_rate", LType.INT64))),
         "failpoints": Schema((Field("name", LType.STRING),
                               Field("spec", LType.STRING),
                               Field("hits", LType.INT64),

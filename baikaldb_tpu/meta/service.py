@@ -25,7 +25,31 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..chaos import failpoint
+from ..utils.flags import FLAGS, define
+
 NORMAL, FAULTY, DEAD, MIGRATE = "NORMAL", "FAULTY", "DEAD", "MIGRATE"
+
+# region lifecycle (reference: RegionStatus IDLE/DOING — region.h:254):
+# SERVING regions route + balance; SPLITTING/MIGRATING regions are mid-
+# membership-change and are skipped by further balance decisions until the
+# fleet commits or aborts the change
+SERVING, SPLITTING, MIGRATING = "SERVING", "SPLITTING", "MIGRATING"
+
+# same name+default as storage/replicated.py (define() dedupes): meta's
+# load-driven trigger and the store-side size trigger share one threshold
+define("region_split_rows", 200_000,
+       "auto-split a replicated region when it exceeds this many keys "
+       "(reference: region_split_lines)")
+define("region_split_skew", 4.0,
+       "load-driven split trigger: a region whose per-heartbeat write rate "
+       "exceeds this multiple of its table's mean region write rate is a "
+       "hotspot and splits even below region_split_rows (0 disables the "
+       "skew trigger)")
+define("region_split_min_rows", 512,
+       "floor for the write-skew split trigger: a hot region below this "
+       "many rows never load-splits (splitting a tiny region cannot shed "
+       "load)")
 
 
 @dataclass
@@ -57,9 +81,20 @@ class RegionMeta:
     start_key: str = ""
     end_key: str = ""
     # non-voting read replicas (reference: learner list, region.h:261-267;
-    # learner_load_balance, region_manager.cpp:197).  LAST field: older
-    # code constructs RegionMeta positionally
+    # learner_load_balance, region_manager.cpp:197).  Older code constructs
+    # RegionMeta positionally up to here — fields below are keyword-only in
+    # practice (defaults, appended later)
     learners: list[str] = field(default_factory=list)
+    # lifecycle (SERVING/SPLITTING/MIGRATING): non-SERVING regions are mid-
+    # membership-change, skipped by balance/split decisions
+    state: str = SERVING
+    # load gauges from the leader's heartbeats (PR 8 telemetry): raft
+    # commit-applied gap, proposal backlog, and rows written since the
+    # previous leader heartbeat (the write-rate unit is rows/heartbeat —
+    # interval-free, so the trigger is deterministic under FakeClock)
+    apply_lag: int = 0
+    proposal_queue: int = 0
+    write_rate: int = 0
 
 
 @dataclass
@@ -67,14 +102,22 @@ class HeartbeatRequest:
     """store -> meta (reference: StoreHeartBeatRequest,
     meta.interface.proto:743)."""
     address: str
-    regions: dict[int, tuple[int, int]] = field(default_factory=dict)
-    # region_id -> (version, num_rows)
+    regions: dict[int, tuple] = field(default_factory=dict)
+    # region_id -> (version, num_rows[, apply_lag, proposal_queue]):
+    # the gauge tail is optional — old stores send 2-tuples, new stores
+    # append their per-region raft gauges (PR 8 telemetry)
     leader_ids: list[int] = field(default_factory=list)
 
 
 @dataclass
 class BalanceOrder:
-    kind: str                     # add_peer | remove_peer | trans_leader
+    # add_peer | remove_peer | trans_leader | migrate | split.
+    # "migrate" is the learner-first live move (source -> target replica,
+    # writes flowing throughout); "split" asks the owning tier for a fenced
+    # live split (no target/source).  Dead-store migration still emits the
+    # add_peer/remove_peer pair — a dead source has nothing to snapshot
+    # from, learner-first catch-up happens against the surviving quorum.
+    kind: str
     region_id: int
     target: str = ""
     source: str = ""
@@ -158,6 +201,9 @@ class MetaService:
         self._params: dict[str, dict] = {}
         # table_id -> next cluster-wide row/auto-incr id (alloc_ids)
         self._id_alloc: dict[int, int] = {}
+        # region_id -> rows at the last LEADER heartbeat: the write-rate
+        # differencing state (rows/heartbeat, see RegionMeta.write_rate)
+        self._hb_rows: dict[int, int] = {}
         self._mu = threading.RLock()
 
     # -- cluster ---------------------------------------------------------
@@ -241,22 +287,69 @@ class MetaService:
             return new
 
     def split_region_key(self, region_id: int, split_key_hex: str) -> RegionMeta:
-        """Key-range split finalize: the new region inherits the parent's
-        peers (reference: split keeps placement, later balance may move it)
-        and both sides get a bumped version so stale-routed requests can be
-        rejected (region.cpp:4864)."""
+        """Key-range split finalize in one step (the legacy store-side size
+        split, where copy + fence happen under the tier lock): begin +
+        commit back-to-back."""
+        with self._mu:
+            new = self.begin_split(region_id, split_key_hex)
+            return self.commit_split(region_id, new.region_id)
+
+    def begin_split(self, region_id: int, split_key_hex: str) -> RegionMeta:
+        """Open a fenced live split: register the child region on the
+        parent's peers with state SPLITTING, ROUTING UNCHANGED — the parent
+        keeps serving its whole range while the fleet bulk-copies rows into
+        the child (region.cpp:4472 split init).  ``commit_split`` flips the
+        routing atomically; ``abort_split`` retires the child with the
+        parent untouched, so no failure leaves a half-routed region."""
         with self._mu:
             old = self.regions[region_id]
+            # SPLITTING is allowed: the tick trigger marks the region when
+            # it emits the order, before the fleet executes it here
+            if old.state == MIGRATING:
+                raise ValueError(
+                    f"region {region_id} is {old.state}, cannot split")
             rid = next(self._region_ids)
             self._last_region_id = max(self._last_region_id, rid)
             new = RegionMeta(rid, old.table_id, peers=list(old.peers),
                              leader=old.leader, start_key=split_key_hex,
                              end_key=old.end_key)
-            old.end_key = split_key_hex
-            old.version += 1
-            new.version = old.version
+            new.version = old.version + 1
+            new.state = SPLITTING
+            old.state = SPLITTING
             self.regions[rid] = new
             return new
+
+    def commit_split(self, region_id: int, child_id: int) -> RegionMeta:
+        """Atomic routing switch (the add_version finalize,
+        region.cpp:4864): the parent's range shrinks to end at the child's
+        start key and both sides return to SERVING with a bumped version,
+        in one registry mutation — a router sees either the old world or
+        the new, never a gap or an overlap."""
+        with self._mu:
+            old = self.regions[region_id]
+            new = self.regions[child_id]
+            old.end_key = new.start_key
+            old.version = new.version = max(old.version + 1, new.version)
+            old.state = new.state = SERVING
+            return new
+
+    def abort_split(self, region_id: int, child_id: int) -> None:
+        """Abandon an open split: the child retires, the parent (whose
+        routing never changed) returns to SERVING."""
+        with self._mu:
+            self.regions.pop(child_id, None)
+            self._hb_rows.pop(child_id, None)
+            old = self.regions.get(region_id)
+            if old is not None and old.state == SPLITTING:
+                old.state = SERVING
+
+    def set_region_state(self, region_id: int, state: str) -> None:
+        """Fleet-side lifecycle marking (a live migration brackets itself
+        with MIGRATING/SERVING so balance ticks skip the region mid-move)."""
+        with self._mu:
+            r = self.regions.get(region_id)
+            if r is not None:
+                r.state = state
 
     def merge_regions_key(self, left_id: int, right_id: int) -> RegionMeta:
         """Merge the right region into its left neighbor: the survivor
@@ -264,8 +357,10 @@ class MetaService:
         with self._mu:
             left = self.regions[left_id]
             right = self.regions.pop(right_id)
+            self._hb_rows.pop(right_id, None)
             left.end_key = right.end_key
             left.version = max(left.version, right.version) + 1
+            left.state = SERVING
             return left
 
     def drop_regions(self, region_ids: list[int]) -> None:
@@ -273,6 +368,7 @@ class MetaService:
         with self._mu:
             for rid in region_ids:
                 self.regions.pop(int(rid), None)
+                self._hb_rows.pop(int(rid), None)
 
     def alloc_ids(self, table_id: int, n: int, floor: int = 0) -> int:
         """Allocate ``n`` cluster-wide monotonic ids for a table (the
@@ -322,15 +418,26 @@ class MetaService:
             inst.last_heartbeat = self.clock()
             if inst.status == FAULTY:
                 inst.status = NORMAL
-            for rid, (version, num_rows) in req.regions.items():
-                r = self.regions.get(rid)
-                if r is not None:
-                    r.num_rows = num_rows
-                    r.version = max(r.version, version)
             for rid in req.leader_ids:
                 r = self.regions.get(rid)
                 if r is not None and req.address in r.peers:
                     r.leader = req.address
+            for rid, stats in req.regions.items():
+                r = self.regions.get(rid)
+                if r is None:
+                    continue
+                version, num_rows = int(stats[0]), int(stats[1])
+                r.version = max(r.version, version)
+                r.num_rows = num_rows
+                if r.leader and req.address != r.leader:
+                    continue    # load gauges are leader-authoritative
+                if len(stats) >= 4:
+                    r.apply_lag = int(stats[2])
+                    r.proposal_queue = int(stats[3])
+                prev = self._hb_rows.get(rid)
+                if prev is not None:
+                    r.write_rate = max(0, num_rows - prev)
+                self._hb_rows[rid] = num_rows
             resp = HeartbeatResponse(schema_version=self.schema_version)
             resp.orders.extend(self._orders_for(req.address))
             resp.param_overrides = dict(self._params.get("*", {}))
@@ -346,7 +453,14 @@ class MetaService:
 
     def tick(self) -> list[BalanceOrder]:
         """Health check + global balancing (reference: meta background
-        threads store_healthy_check_function + *_load_balance)."""
+        threads store_healthy_check_function + *_load_balance).  Iteration
+        is sorted by region id everywhere, so a fixed heartbeat sequence
+        yields an identical order list across runs (the chaos-digest
+        determinism contract)."""
+        if failpoint.ENABLED:
+            if failpoint.hit("meta.balance_tick"):
+                return []    # drop: the control loop misses this beat —
+                #              the fleet must stay correct without orders
         with self._mu:
             now = self.clock()
             for inst in self.instances.values():
@@ -359,13 +473,49 @@ class MetaService:
                     inst.status = FAULTY
             orders = []
             orders.extend(self._migrate_dead_peers())
+            orders.extend(self._split_check())
             orders.extend(self._peer_balance())
             orders.extend(self._leader_balance())
             return orders
 
+    def _regions_sorted(self) -> list[RegionMeta]:
+        return [self.regions[rid] for rid in sorted(self.regions)]
+
+    def _split_check(self) -> list[BalanceOrder]:
+        """Load-driven split trigger: a SERVING region splits when it
+        crosses the row threshold, or when its write rate is a
+        ``region_split_skew`` outlier against its table's other regions
+        (the hotspot case — rows alone never catch a skewed key range).
+        The region is marked SPLITTING here so consecutive ticks don't
+        stack duplicate orders; the fleet's split commit/abort returns it
+        to SERVING."""
+        split_rows = int(FLAGS.region_split_rows)
+        skew = float(FLAGS.region_split_skew)
+        min_rows = int(FLAGS.region_split_min_rows)
+        if split_rows <= 0:
+            return []
+        by_table: dict[int, list[RegionMeta]] = {}
+        for r in self._regions_sorted():
+            by_table.setdefault(r.table_id, []).append(r)
+        orders = []
+        for _tid, rs in sorted(by_table.items()):
+            total_rate = sum(r.write_rate for r in rs)
+            for r in rs:
+                if r.state != SERVING:
+                    continue
+                hot_rows = r.num_rows >= split_rows
+                others = max(1.0, (total_rate - r.write_rate)
+                             / max(1, len(rs) - 1))
+                hot_skew = (skew > 0 and r.num_rows >= min_rows
+                            and r.write_rate >= skew * others)
+                if hot_rows or hot_skew:
+                    orders.append(BalanceOrder("split", r.region_id))
+                    r.state = SPLITTING
+        return orders
+
     def _migrate_dead_peers(self) -> list[BalanceOrder]:
         orders = []
-        for r in self.regions.values():
+        for r in self._regions_sorted():
             bad = [p for p in r.peers
                    if self.instances.get(p) is None
                    or self.instances[p].status in (DEAD, MIGRATE)]
@@ -384,17 +534,23 @@ class MetaService:
         return orders
 
     def _peer_balance(self) -> list[BalanceOrder]:
-        """Move peers off overloaded instances (region_manager.cpp:189)."""
+        """Move peers off overloaded instances (region_manager.cpp:189) via
+        ONE ``migrate`` order per move: the fleet executes it learner-first
+        (add learner -> snapshot catch-up -> promote -> remove old peer)
+        with writes flowing throughout.  The registry is updated eagerly —
+        meta owns intent; the fleet records the real membership back when
+        (and only when) the move commits."""
         counts = self._peer_counts()
-        healthy = [i.address for i in self._healthy()]
+        healthy = sorted(i.address for i in self._healthy())
         if len(healthy) < 2:
             return []
         avg = sum(counts[a] for a in healthy) / len(healthy)
         orders = []
         for addr in healthy:
             while counts[addr] > avg + self.balance_threshold:
-                region = next((r for r in self.regions.values()
-                               if addr in r.peers), None)
+                region = next((r for r in self._regions_sorted()
+                               if addr in r.peers and r.state == SERVING),
+                              None)
                 if region is None:
                     break
                 rooms = {self.instances[q].logical_room for q in region.peers
@@ -403,10 +559,8 @@ class MetaService:
                                            prefer_rooms_not_in=rooms)
                 if tgt is None or counts[tgt] + 1 > avg + self.balance_threshold:
                     break
-                orders.append(BalanceOrder("add_peer", region.region_id,
+                orders.append(BalanceOrder("migrate", region.region_id,
                                            target=tgt, source=addr))
-                orders.append(BalanceOrder("remove_peer", region.region_id,
-                                           source=addr))
                 region.peers = [q for q in region.peers if q != addr] + [tgt]
                 if region.leader == addr:
                     region.leader = region.peers[0]
@@ -419,13 +573,15 @@ class MetaService:
         healthy = {i.address for i in self._healthy()}
         if len(healthy) < 2:
             return []
-        lcount = {a: 0 for a in healthy}
+        lcount = {a: 0 for a in sorted(healthy)}
         for r in self.regions.values():
             if r.leader in lcount:
                 lcount[r.leader] += 1
         avg = sum(lcount.values()) / len(lcount)
         orders = []
-        for r in self.regions.values():
+        for r in self._regions_sorted():
+            if r.state != SERVING:
+                continue
             if r.leader in lcount and lcount[r.leader] > avg + self.balance_threshold:
                 cands = [p for p in r.peers if p in healthy and
                          lcount.get(p, 1 << 30) < avg]

@@ -581,6 +581,29 @@ class RaftGroup:
         self.bus.nodes.pop(new_id, None)
         return False
 
+    def promote_learner(self, learner_id: int, max_ticks: int = 400) -> bool:
+        """Promote an EXISTING caught-up learner replica to voter (the
+        learner-first migration finalize).  The native core treats an
+        add-voter config entry for a known learner as a promotion (it
+        leaves the learner set and joins the voter set on every replica);
+        unlike ``add_peer`` no new replica is created — the learner already
+        holds the replicated state."""
+        if learner_id not in self.bus.nodes:
+            return False
+        ldr = self.leader()
+        if learner_id not in self.bus.nodes[ldr].core.learners():
+            return False
+        payload = struct.pack("<Bq", 0, learner_id)
+        idx = self.bus.nodes[ldr].core.propose(payload, kind=CONFIG)
+        if idx < 0:
+            return False
+        for _ in range(max_ticks):
+            self.bus.pump()
+            if self.bus.nodes[ldr].core.commit_index >= idx:
+                return True
+            self.bus.advance(1)
+        return False
+
     def remove_learner(self, learner_id: int, max_ticks: int = 400) -> bool:
         ldr = self.leader()
         payload = struct.pack("<Bq", 3, learner_id)

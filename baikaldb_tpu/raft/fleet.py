@@ -12,12 +12,21 @@ gap where balance orders commanded nothing."""
 
 from __future__ import annotations
 
-from typing import Optional
+import time
+from typing import Callable, Optional
 
-from ..meta.service import BalanceOrder, HeartbeatRequest, MetaService
+from ..chaos import failpoint
+from ..meta.service import (MIGRATING, SERVING, BalanceOrder,
+                            HeartbeatRequest, MetaService)
 from ..types import Schema
+from ..utils import metrics
 from .cluster import RaftGroup, ReplicatedRegion
 from .core import LEADER
+
+
+class MigrateError(RuntimeError):
+    """A live replica migration failed and was rolled back (membership
+    unchanged — learner torn down, meta registry restored)."""
 
 
 class StoreFleet:
@@ -96,20 +105,26 @@ class StoreFleet:
 
     # -- control loop -----------------------------------------------------
     def heartbeat_all(self):
-        """Every live store reports its REAL raft state to meta."""
+        """Every live store reports its REAL raft state to meta — version,
+        rows, and the PR 8 per-region load gauges (apply lag, proposal
+        backlog) meta's load-driven split trigger consumes."""
         for a in self.addresses:
             nid = self._ids[a]
-            regions: dict[int, tuple[int, int]] = {}
+            regions: dict[int, tuple] = {}
             leader_ids = []
             dead = False
-            for rid, g in self.groups.items():
+            for rid, g in sorted(self.groups.items()):
                 node = g.bus.nodes.get(nid)
                 if node is None:
                     continue
                 if nid in g.bus.down:
                     dead = True
                     continue
-                regions[rid] = (1, len(node.rows()))
+                regions[rid] = (1, len(node.rows()),
+                                max(0, node.core.commit_index
+                                    - node.applied_index),
+                                max(0, node.core.last_index
+                                    - node.core.commit_index))
                 if node.core.role == LEADER:
                     leader_ids.append(rid)
             if not dead:
@@ -135,6 +150,182 @@ class StoreFleet:
             if nid in g.bus.nodes:
                 g.bus.kill(nid)
 
+    def revive_store(self, address: str):
+        """Bring a killed store back across every region it hosts."""
+        nid = self._ids[address]
+        for g in self.groups.values():
+            if nid in g.bus.nodes:
+                g.bus.revive(nid)
+
+    def partition_store(self, address: str):
+        """Partition one store away from the rest of the fleet on EVERY
+        region bus it participates in (a split/migration spans multiple
+        raft groups — parent + child — so a fleet partition must cover
+        them all, not just one group)."""
+        nid = self._ids[address]
+        for g in self.groups.values():
+            if nid in g.bus.nodes:
+                rest = [n for n in g.bus.nodes if n != nid]
+                if rest:
+                    g.bus.partition([nid], rest)
+
+    def heal_all(self):
+        """Heal every region bus in the fleet."""
+        for g in self.groups.values():
+            g.bus.heal()
+
+    # -- elastic regions ---------------------------------------------------
+    def tier_of_region(self, region_id: int):
+        """The SQL row tier hosting a region, if any (bare test regions
+        created straight through create_table_regions have none)."""
+        with self.tier_lock:
+            tiers = list(self.row_tiers.values())
+        for tier in tiers:
+            if any(m.region_id == region_id for m in tier.metas):
+                return tier
+        return None
+
+    def retire_region(self, region_id: int) -> None:
+        """Tear one region fully down: raft group out of the fleet, meta
+        entry out of routing.  The single teardown seam — split aborts,
+        merges and tier release all funnel here so neither registry can
+        leak a dead group the other still routes to."""
+        self.groups.pop(region_id, None)
+        try:
+            self.meta.drop_regions([region_id])
+        except Exception:       # meta itself quorumless: group is gone,
+            metrics.count_swallowed("fleet.retire_region")  # routing entry
+            #                         dies with the next meta recovery
+
+    def migrate_replica(self, region_id: int, source: str, target: str,
+                        chaos_hook: Optional[Callable[[str], None]] = None
+                        ) -> bool:
+        """Move one replica ``source`` -> ``target`` LIVE, learner-first
+        (reference: peer balance through braft learner catch-up;
+        region_manager.cpp:189 + raft_control):
+
+        1. the leader compacts, so the new learner bootstraps from ONE
+           snapshot install (the PR 10 artifact-replication bulk-copy
+           shape) instead of replaying the whole log,
+        2. add learner on ``target`` -> snapshot + log catch-up
+           (``migrate.snapshot`` failpoint),
+        3. promote the caught-up learner to voter (``migrate.promote``),
+        4. transfer leadership away from ``source`` if it leads,
+        5. remove the ``source`` peer; meta records the real membership.
+
+        Writes flow throughout — the group keeps a quorum at every step
+        (3 voters -> 3 voters + learner -> 4 voters -> 3 voters).  On any
+        failure before promotion the learner is torn down and membership
+        is restored unchanged (MigrateError); ``chaos_hook(phase)`` lets
+        scenarios inject kills/writes between phases deterministically.
+        """
+        rm = self.meta.regions.get(region_id)
+        g = self.groups.get(region_id)
+        if rm is None or g is None:
+            raise ValueError(f"unknown region {region_id}")
+        src_id, tgt_id = self._ids.get(source), self._id_of(target)
+        if src_id is None or src_id not in g.bus.nodes:
+            raise ValueError(f"{source!r} hosts no replica of "
+                             f"region {region_id}")
+        if tgt_id in g.bus.nodes:
+            raise ValueError(f"{target!r} already hosts a replica of "
+                             f"region {region_id}")
+        t0 = time.perf_counter()
+        self.meta.set_region_state(region_id, MIGRATING)
+        learner_added = promoted = False
+        try:
+            if chaos_hook is not None:
+                chaos_hook("start")
+            # bulk copy: one snapshot install, not a log replay from 1
+            ldr = g.bus.nodes[g.leader()]
+            ldr.compact()
+            if failpoint.ENABLED:
+                if failpoint.hit("migrate.snapshot", region=region_id,
+                                 target=target):
+                    raise MigrateError(
+                        f"region {region_id}: snapshot transfer to "
+                        f"{target} failed (injected)")
+            if not g.add_learner(tgt_id):
+                raise MigrateError(f"region {region_id}: add_learner "
+                                   f"{target} did not commit")
+            learner_added = True
+            if chaos_hook is not None:
+                chaos_hook("learner")
+            # catch-up gate: the learner must have applied everything the
+            # leader has committed before it may count toward quorum
+            learner = g.bus.nodes[tgt_id]
+            for _ in range(400):
+                learner.apply_committed()
+                if learner.applied_index >= \
+                        g.bus.nodes[g.leader()].core.commit_index:
+                    break
+                g.bus.pump()
+                g.bus.advance(1)
+            else:
+                raise MigrateError(f"region {region_id}: learner {target} "
+                                   f"never caught up")
+            if failpoint.ENABLED:
+                if failpoint.hit("migrate.promote", region=region_id,
+                                 target=target):
+                    raise MigrateError(
+                        f"region {region_id}: promotion of {target} "
+                        f"failed (injected)")
+            if not g.promote_learner(tgt_id):
+                raise MigrateError(f"region {region_id}: promote "
+                                   f"{target} did not commit")
+            promoted = True
+            if chaos_hook is not None:
+                chaos_hook("promoted")
+            # leadership must leave the outgoing peer BEFORE removal
+            if g.leader() == src_id:
+                if g.bus.nodes[src_id].core.transfer_leader(tgt_id):
+                    g.bus.pump()
+                    g.bus.elect()
+                if g.leader() == src_id:
+                    raise MigrateError(
+                        f"region {region_id}: could not transfer "
+                        f"leadership off {source}")
+            if not g.remove_peer(src_id):
+                raise MigrateError(f"region {region_id}: remove_peer "
+                                   f"{source} did not commit")
+            if chaos_hook is not None:
+                chaos_hook("removed")
+        except MigrateError:
+            # pre-promotion failure: tear the learner down — membership is
+            # exactly what it was.  Post-promotion failure (remove_peer of
+            # the source did not commit): the target IS a raft voter now;
+            # tearing it down would fight the committed config, so the
+            # region stays at 4 voters and meta records that real state —
+            # a consistent (if temporarily wide) membership, never a
+            # half-routed one.
+            if learner_added and not promoted and tgt_id in g.bus.nodes:
+                g.remove_learner(tgt_id)
+            self._record_membership(region_id, g)
+            metrics.region_migrate_aborts.add(1)
+            raise
+        finally:
+            self.meta.set_region_state(region_id, SERVING)
+        self._record_membership(region_id, g)
+        metrics.region_migrations.add(1)
+        metrics.region_handoff_ms.observe((time.perf_counter() - t0) * 1e3)
+        return True
+
+    def _record_membership(self, region_id: int, g: RaftGroup) -> None:
+        """Write the raft group's REAL membership back into meta's registry
+        (the one owner of routing state)."""
+        try:
+            ldr = g.leader()
+        except RuntimeError:
+            return                      # quorumless: nothing to record
+        peers = sorted(self._addr[n] for n in g.bus.nodes[ldr].core.peers()
+                       if n in self._addr)
+        learners = sorted(self._addr[n]
+                          for n in g.bus.nodes[ldr].core.learners()
+                          if n in self._addr)
+        self.meta.update_region_membership(
+            region_id, peers=peers, leader=self._addr.get(ldr, ""),
+            learners=learners)
+
     def apply_orders(self, orders: list[BalanceOrder]) -> int:
         """Execute meta's balance orders as real raft operations
         (reference: store applying heartbeat-response orders,
@@ -144,7 +335,29 @@ class StoreFleet:
             g = self.groups.get(o.region_id)
             if g is None:
                 continue
-            if o.kind == "add_peer":
+            if o.kind == "split":
+                tier = self.tier_of_region(o.region_id)
+                if tier is None:
+                    # bare (tierless) region: nothing can execute a split —
+                    # clear the SPLITTING mark so balancing resumes
+                    self.meta.set_region_state(o.region_id, SERVING)
+                    continue
+                from ..storage.replicated import SplitError
+                try:
+                    tier.split_region_online(o.region_id)
+                    done += 1
+                except SplitError:
+                    pass           # aborted cleanly; next tick retries
+            elif o.kind == "migrate":
+                try:
+                    if self.migrate_replica(o.region_id, o.source,
+                                            o.target):
+                        done += 1
+                except (MigrateError, ValueError):
+                    # rolled back (or stale order): meta re-learns real
+                    # membership from heartbeats and may retry
+                    self._record_membership(o.region_id, g)
+            elif o.kind == "add_peer":
                 if g.add_peer(self._id_of(o.target)):
                     done += 1
             elif o.kind == "remove_peer":

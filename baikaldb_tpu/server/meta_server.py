@@ -141,7 +141,8 @@ class MetaServer:
     def rpc_heartbeat(self, address: str, regions: dict, leader_ids: list):
         req = HeartbeatRequest(
             address,
-            {int(rid): (int(v), int(n)) for rid, (v, n) in regions.items()},
+            {int(rid): tuple(int(x) for x in stats)
+             for rid, stats in regions.items()},
             [int(x) for x in leader_ids])
         resp = self.service.heartbeat(req)
         self._c_heartbeats.add(1)

@@ -570,7 +570,12 @@ class StoreServer:
                 regions = {}
                 leaders = []
                 for rid, r in self.regions.items():
-                    regions[str(rid)] = [1, len(r.table.scan_raw())]
+                    commit = r.core.commit_index
+                    regions[str(rid)] = [
+                        1, len(r.table.scan_raw()),
+                        max(0, commit - r.applied_index),
+                        max(0, r.core.last_index - commit),
+                    ]
                     if r.core.role == LEADER:
                         leaders.append(rid)
             self.meta.try_call("heartbeat", address=self.address,

@@ -31,8 +31,11 @@ from __future__ import annotations
 
 import bisect
 import threading
-from typing import TYPE_CHECKING, Optional
+import time
+from typing import TYPE_CHECKING, Callable, Optional
 
+from ..chaos import failpoint
+from ..meta.service import SERVING
 from ..raft.cluster import CMD_COLD, RaftGroup
 from ..raft.core import LEADER
 from ..raft.twopc import TwoPhaseCoordinator, TwoPhaseError, next_txn_id
@@ -354,6 +357,10 @@ class ReplicatedRowTier:
         done = 0
         i = 0
         while i < len(self.groups):
+            rm = self.fleet.meta.regions.get(self.metas[i].region_id)
+            if rm is not None and rm.state != SERVING:
+                i += 1      # mid live-split/migration: the fleet owns it
+                continue
             node = self._leader_node(self.metas[i], self.groups[i])
             if node.table.num_live_keys() >= threshold:
                 try:
@@ -427,7 +434,130 @@ class ReplicatedRowTier:
         self._starts.insert(idx + 1, mid)
         self._ends[idx] = mid
         self._ends.insert(idx + 1, old_end)
+        metrics.region_splits.add(1)
         return new_m
+
+    def split_region_online(self, region_id: int,
+                            chaos_hook: Optional[Callable[[str], None]]
+                            = None):
+        """Live, fenced split of one region — the tick-driven path (the
+        reference's full lifecycle: region.cpp:4472 split init, :6573
+        no-stop-write data copy, :7198 log catch-up, :4864 add_version
+        finalize).  Unlike :meth:`split_region` (write-path size trigger,
+        copy under the tier lock), the bulk copy here runs with the tier
+        lock RELEASED — the parent keeps serving reads and writes:
+
+        1. under the lock: pick the median split key, snapshot the upper
+           half, register the child in meta (``begin_split`` — state
+           SPLITTING, ROUTING UNCHANGED) and materialize its raft group
+           on the parent's peers,
+        2. outside the lock: bulk-replicate the snapshot into the child
+           (``region.handoff`` failpoint) while writes keep landing in
+           the parent,
+        3. under the lock again (the fence — writers are briefly held):
+           replicate the delta the parent absorbed meanwhile, raft-commit
+           both sides' new ranges (``region.split_fence`` failpoint fires
+           before the fence), then flip routing atomically — meta
+           ``commit_split`` + the tier's parallel lists in one critical
+           section — and trim the parent.
+
+        Any failure before the routing flip aborts cleanly: the child
+        retires, ``abort_split`` restores the parent to SERVING, routing
+        was never touched — a half-routed region cannot exist.
+        ``chaos_hook(phase)`` ("begin", "copied") runs with the lock
+        released so scenarios can inject writes/partitions mid-split.
+        """
+        meta = self.fleet.meta
+        t0 = time.perf_counter()
+        with self._mu:
+            idx = next((i for i, m in enumerate(self.metas)
+                        if m.region_id == region_id), None)
+            if idx is None:
+                meta.set_region_state(region_id, SERVING)
+                raise SplitError(f"region {region_id} not in tier "
+                                 f"{self.table_key}")
+            g, m = self.groups[idx], self.metas[idx]
+            try:
+                node = self._leader_node(m, g)
+            except RuntimeError:
+                meta.set_region_state(region_id, SERVING)
+                raise SplitError(f"region {region_id} has no electable "
+                                 f"quorum") from None
+            pairs = [(k, v) for k, v in node.table.scan_raw()
+                     if node._covers(k)]
+            mid = pairs[len(pairs) // 2][0] if len(pairs) >= 2 else None
+            if mid is None or mid == pairs[0][0]:
+                meta.set_region_state(region_id, SERVING)
+                raise SplitError(f"region {region_id} has no usable "
+                                 f"split key")
+            snap = {k: v for k, v in pairs if k >= mid}
+            if failpoint.ENABLED:
+                if failpoint.hit("region.split_fence", region=region_id):
+                    meta.set_region_state(region_id, SERVING)
+                    raise SplitError(f"region {region_id}: split fence "
+                                     f"failed (injected)")
+            child = meta.begin_split(region_id, mid.hex())
+            new_g = self.fleet.materialize_region(
+                child, schema=self.row_schema, key_columns=self.key_columns)
+        # -- phase 2: bulk handoff, tier lock RELEASED (parent serves) ----
+        ok = True
+        if chaos_hook is not None:
+            chaos_hook("begin")
+        if failpoint.ENABLED:
+            if failpoint.hit("region.handoff", region=region_id,
+                             child=child.region_id):
+                ok = False
+        moved = [(0, k, v) for k, v in sorted(snap.items())]
+        ok = ok and ((not moved) or new_g.write(moved))
+        if ok and chaos_hook is not None:
+            chaos_hook("copied")
+        # -- phase 3: fence + delta catch-up + atomic routing switch ------
+        if ok:
+            with self._mu:
+                idx = next((i for i, mm in enumerate(self.metas)
+                            if mm.region_id == region_id), None)
+                ok = idx is not None
+                node = None
+                if ok:
+                    g = self.groups[idx]
+                    try:
+                        node = self._leader_node(self.metas[idx], g)
+                    except RuntimeError:
+                        ok = False
+                if ok:
+                    # writes that landed >= mid since the snapshot: new or
+                    # changed values copy over, vanished keys delete —
+                    # exact because the lock now excludes further writes
+                    upper = {k: v for k, v in node.table.scan_raw()
+                             if k >= mid and node._covers(k)}
+                    delta = [(0, k, v) for k, v in sorted(upper.items())
+                             if snap.get(k) != v]
+                    delta += [(1, k, b"")
+                              for k in sorted(set(snap) - set(upper))]
+                    ok = (not delta) or new_g.write(delta)
+                    old_end = self._ends[idx]
+                    ok = ok and new_g.set_range(child.version, mid, old_end)
+                    ok = ok and g.set_range(child.version,
+                                            self._starts[idx], mid)
+                    if ok:
+                        meta.commit_split(region_id, child.region_id)
+                        self.metas.insert(idx + 1, child)
+                        self.groups.insert(idx + 1, new_g)
+                        self._starts.insert(idx + 1, mid)
+                        self._ends[idx] = mid
+                        self._ends.insert(idx + 1, old_end)
+                        g.trim()    # GC of moved rows; reads filter by
+                        #             ownership either way
+                        metrics.region_splits.add(1)
+                        metrics.region_handoff_ms.observe(
+                            (time.perf_counter() - t0) * 1e3)
+                        return child
+        # -- abort: routing never switched, parent unchanged --------------
+        self.fleet.retire_region(child.region_id)
+        meta.abort_split(region_id, child.region_id)
+        metrics.region_split_aborts.add(1)
+        raise SplitError(f"live split of region {region_id} aborted "
+                         f"(no quorum on copy/fence)")
 
     def maybe_merge(self) -> int:
         """Merge adjacent undersized regions (combined keys under a quarter
@@ -497,10 +627,14 @@ class ReplicatedRowTier:
         if not ok:
             raise SplitError(
                 f"merge of region {right_m.region_id} aborted (no quorum)")
-        self.fleet.groups.pop(right_m.region_id, None)
+        # merge_regions_key already retired the right from meta routing;
+        # retire_region drops the raft group too (idempotent on meta) so
+        # neither registry leaks a group the other no longer routes to
+        self.fleet.retire_region(right_m.region_id)
         self._ends[idx] = self._ends[idx + 1]
         for lst in (self.metas, self.groups, self._starts, self._ends):
             del lst[idx + 1]
+        metrics.region_merges.add(1)
         return merged
 
     # -- maintenance -------------------------------------------------------
@@ -671,8 +805,7 @@ class ReplicatedRowTier:
         routing table (DROP TABLE / schema reset — without this, dropped
         tables' replicas would heartbeat and balance forever)."""
         for m in self.metas:
-            self.fleet.groups.pop(m.region_id, None)
-        self.fleet.meta.drop_regions([m.region_id for m in self.metas])
+            self.fleet.retire_region(m.region_id)
 
     def alloc_rowids(self, n: int, floor: int = 0) -> int:
         """Cluster-wide rowid range from meta (auto-incr FSM shape): two

@@ -587,6 +587,16 @@ failpoint_trips = Counter("failpoint_trips")
 # leaderless regions served by the most advanced live replica (learner
 # included) instead of failing the read — bounded-degradation valve
 learner_fallback_reads = Counter("learner_fallback_reads")
+# elastic regions (meta tick -> fleet): completed / aborted live splits and
+# learner-first migrations, plus the fenced-handoff window each one paid
+# (the only interval where the tier lock blocks writers).  Surfaced by
+# SHOW STATUS as region.* and gated by tools/bench_regress.py
+region_splits = Counter("region.splits")
+region_split_aborts = Counter("region.split_aborts")
+region_merges = Counter("region.merges")
+region_migrations = Counter("region.migrations")
+region_migrate_aborts = Counter("region.migrate_aborts")
+region_handoff_ms = LatencyRecorder("region.handoff_ms")
 # cross-query batched dispatch (exec/dispatch.py): combiner ticks that ran
 # a batched executable, the group sizes they combined (percentiles over the
 # occupancy distribution), per-member queue wait, and wall time of the

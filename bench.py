@@ -797,6 +797,105 @@ def run_chaos_bench() -> dict:
     }
 
 
+def run_elastic_bench() -> dict:
+    """Elastic-regions line: SQL write latency + throughput while the
+    fleet executes a forced live split AND a forced learner-first
+    migration on the serving region, against the identical workload at
+    steady state (fresh fleet, no topology change).  Both runs go
+    through the in-process raft fleet (LocalBus), so the numbers are
+    deterministic apart from host timing.  The hard contract gated by
+    tools/bench_regress.py: zero lost writes, the split and the
+    migration both actually happened (counters), and the elastic-phase
+    write p99 stays within a documented multiple of steady state."""
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.fleet import StoreFleet
+    from baikaldb_tpu.utils import metrics as _m
+
+    n_writes = int(os.environ.get("BENCH_ELASTIC_WRITES", 200))
+
+    def mk():
+        fleet = StoreFleet(MetaService(peer_count=3),
+                           [f"eb{i + 1}:1" for i in range(4)], seed=29)
+        s = Session(Database(fleet=fleet))
+        s.execute("CREATE DATABASE eb")
+        s.execute("USE eb")
+        s.execute("CREATE TABLE t (k BIGINT, v BIGINT, PRIMARY KEY (k))")
+        return fleet, s
+
+    def pq(lat: list, q: float) -> float:
+        srt = sorted(lat)
+        return round(srt[min(len(srt) - 1, int(q * (len(srt) - 1) + 0.5))],
+                     3)
+
+    # steady state: same writes, nothing moving
+    _fleet, s = mk()
+    lat_steady: list[float] = []
+    t0 = time.perf_counter()
+    for i in range(n_writes):
+        w0 = time.perf_counter()
+        s.execute(f"INSERT INTO t VALUES ({i}, {i})")
+        lat_steady.append((time.perf_counter() - w0) * 1e3)
+    steady_dt = time.perf_counter() - t0
+
+    # elastic phase: the same write stream keeps flowing while the
+    # serving region live-splits and a replica live-migrates off the
+    # leader's store (hooks land writes inside every phase of both)
+    fleet, s = mk()
+    tier = fleet.row_tiers["eb.t"]
+    lat_el: list[float] = []
+    issued = 0
+
+    def put(n: int):
+        nonlocal issued
+        for _ in range(n):
+            k = issued
+            issued += 1
+            w0 = time.perf_counter()
+            s.execute(f"INSERT INTO t VALUES ({k}, {k})")
+            lat_el.append((time.perf_counter() - w0) * 1e3)
+
+    splits0 = _m.region_splits.value
+    migr0 = _m.region_migrations.value
+    hand0 = _m.region_handoff_ms.stats()["count"]
+    t0 = time.perf_counter()
+    put(n_writes // 2)
+    rid = tier.metas[0].region_id
+    tier.split_region_online(rid, chaos_hook=lambda ph: put(4))
+    rm = fleet.meta.regions[rid]
+    target = next(a for a in sorted(fleet.addresses)
+                  if a not in rm.peers)
+    fleet.migrate_replica(rid, rm.leader, target,
+                          chaos_hook=lambda ph: put(2))
+    put(max(0, n_writes - issued))
+    el_dt = time.perf_counter() - t0
+    rows = {r["k"] for r in s.query("SELECT k FROM t")}
+    hstats = _m.region_handoff_ms.stats()
+    return {
+        "metric": f"elastic regions: write p99 + q/s during forced live "
+                  f"split + migration vs steady state "
+                  f"({n_writes} writes, 4 stores)",
+        "value": round(issued / el_dt, 1),
+        "unit": "writes/sec",
+        # <1 means the elastic phase was slower than steady state
+        "vs_baseline": round((issued / el_dt) / (n_writes / steady_dt), 3),
+        "steady_writes_per_sec": round(n_writes / steady_dt, 1),
+        "steady_p50_ms": pq(lat_steady, 0.50),
+        "steady_p99_ms": pq(lat_steady, 0.99),
+        "elastic_p50_ms": pq(lat_el, 0.50),
+        "elastic_p99_ms": pq(lat_el, 0.99),
+        "splits": _m.region_splits.value - splits0,
+        "migrations": _m.region_migrations.value - migr0,
+        "handoffs": hstats["count"] - hand0,
+        "handoff_p99_ms": hstats["p99_ms"],
+        "lost_writes": issued - len(rows),
+        "regions": len(tier.metas),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
 def run_concurrency_bench() -> dict:
     """Concurrent point-query scaling (the batched-dispatch headline):
     q/s and p99 vs client count, dispatcher on vs off.
@@ -1387,6 +1486,38 @@ def _emit_chaos_line(skip_reason: str | None = None):
     print(json.dumps(result))
 
 
+def _emit_elastic_line(skip_reason: str | None = None):
+    """Elastic-regions JSON line: write p99/throughput during a forced
+    live split + migration vs steady state.  Same robustness contract:
+    always prints a line, never raises.  Runs on the in-process raft
+    fleet, so a wedged accelerator doesn't gate it — only a missing
+    native raft core does."""
+    if os.environ.get("BENCH_SKIP_ELASTIC") == "1":
+        return
+    fail_shape = {"metric": "elastic regions: write p99 + q/s during "
+                            "forced live split + migration vs steady "
+                            "state (skipped)",
+                  "value": 0, "unit": "writes/sec", "vs_baseline": 0.0,
+                  "platform": "none"}
+    if skip_reason is None:
+        try:
+            from baikaldb_tpu.raft import raft_available
+            if not raft_available():
+                skip_reason = "native raft core unavailable"
+        except Exception as e:                          # noqa: BLE001
+            skip_reason = f"{type(e).__name__}: {e}"
+    if skip_reason is not None:
+        print(json.dumps({**fail_shape, "error": skip_reason}))
+        return
+    try:
+        result = run_elastic_bench()
+    except Exception as e:                              # noqa: BLE001
+        fail_shape["metric"] = fail_shape["metric"].replace("(skipped)",
+                                                            "(failed)")
+        result = {**fail_shape, "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_trace_line(skip_reason: str | None = None):
     """Fourth JSON line: tracing-overhead regression guard.  Same
     robustness contract: always prints a line, never raises."""
@@ -1511,6 +1642,8 @@ def main():
                 _emit_coldstart_line()  # cpu-subprocess: safe when wedged
                 _emit_progress_line(skip_reason="accelerator probe "
                                     "failed; progress phase skipped")
+                _emit_elastic_line(skip_reason="accelerator probe "
+                                   "failed; elastic phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -1553,6 +1686,7 @@ def main():
             _emit_telemetry_line()
             _emit_coldstart_line()
             _emit_progress_line()
+            _emit_elastic_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
@@ -1564,6 +1698,7 @@ def main():
     _emit_telemetry_line()
     _emit_coldstart_line()
     _emit_progress_line()
+    _emit_elastic_line()
     return 0
 
 

@@ -32,6 +32,12 @@ its own contract in ``overhead_pct``: the candidate must stay within
 ``--progress-pct`` (default 1.0, the docs/OBSERVABILITY.md bound; 0
 disables).  This is an absolute ceiling, not a baseline diff — turning
 introspection on must never cost more than the documented budget.
+
+The elastic-regions line (write p99/throughput during a forced live
+split + migration) is gated on its own deterministic counters: zero
+``lost_writes``, nonzero ``splits``/``migrations``/``handoffs``, and an
+elastic-phase write p99 within ``--elastic-p99-x`` times (default 25)
+the same capture's steady-state p99.
 """
 
 from __future__ import annotations
@@ -46,7 +52,7 @@ def load_capture(path: str) -> dict:
     or a bench.py JSON-lines capture (the cold-start row is extracted).
     Unknown/summary lines are ignored."""
     out: dict = {"header": None, "queries": {}, "coldstart": None,
-                 "progress": None}
+                 "progress": None, "elastic": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -68,6 +74,8 @@ def load_capture(path: str) -> dict:
             elif str(row.get("metric", "")).startswith(
                     "point-query steady state with progress"):
                 out["progress"] = row
+            elif str(row.get("metric", "")).startswith("elastic regions"):
+                out["elastic"] = row
     return out
 
 
@@ -123,6 +131,39 @@ def compare_progress(cand: dict, pct: float) -> list:
     return []
 
 
+def compare_elastic(cand: dict, p99_factor: float) -> list:
+    """Elastic-regions contract on the candidate capture (skipped/failed
+    lines are ignored).  The hard gates are the deterministic counters:
+    ZERO lost writes through a live split + migration, and both topology
+    changes actually executed (splits/migrations/handoff observations
+    nonzero — a refactor that silently stops moving anything would
+    otherwise pass on latency alone).  The write-p99 gate is a documented
+    GENEROUS multiple of the same capture's steady-state p99
+    (``--elastic-p99-x``, default 25; 0 disables): the elastic phase
+    includes the region bulk copy and a snapshot catch-up, so a tight
+    bound would flake on shared CI hosts — the multiplier only catches
+    order-of-magnitude stalls (a write blocked for the whole handoff)."""
+    c = cand.get("elastic")
+    if c is None or c.get("error") or not c.get("value"):
+        return []
+    problems = []
+    if c.get("lost_writes", 0) != 0:
+        problems.append(f"elastic: {c['lost_writes']} writes lost during "
+                        f"live split/migration (must be 0)")
+    for k in ("splits", "migrations", "handoffs"):
+        if c.get(k, 0) < 1:
+            problems.append(f"elastic: {k}={c.get(k, 0)} — the forced "
+                            f"topology change never happened")
+    if p99_factor > 0 and c.get("steady_p99_ms"):
+        lim = c["steady_p99_ms"] * p99_factor
+        if c.get("elastic_p99_ms", 0.0) > lim:
+            problems.append(
+                f"elastic: write p99 {c['elastic_p99_ms']}ms during "
+                f"split+migration > {p99_factor}x steady-state p99 "
+                f"({c['steady_p99_ms']}ms)")
+    return problems
+
+
 def compare(base: dict, cand: dict, wall_clock_pct: float = 0.0) -> list:
     """-> list of human-readable regression strings (empty = clean)."""
     problems = []
@@ -172,17 +213,22 @@ def main(argv=None) -> int:
     ap.add_argument("--progress-pct", type=float, default=1.0,
                     help="introspection overhead_pct ceiling on the "
                          "candidate's progress-tracking line (0 = skip)")
+    ap.add_argument("--elastic-p99-x", type=float, default=25.0,
+                    help="elastic-regions write-p99 ceiling as a multiple "
+                         "of the same capture's steady-state p99 (0 = "
+                         "counters only)")
     args = ap.parse_args(argv)
     base = load_capture(args.baseline)
     cand = load_capture(args.candidate)
     if not base["queries"] and base["coldstart"] is None \
-            and cand["progress"] is None:
+            and cand["progress"] is None and cand["elastic"] is None:
         print(f"bench_regress: no query or cold-start rows in "
               f"{args.baseline}", file=sys.stderr)
         return 2
     problems = compare(base, cand, args.wall_clock_pct)
     problems += compare_coldstart(base, cand, args.coldstart_pct)
     problems += compare_progress(cand, args.progress_pct)
+    problems += compare_elastic(cand, args.elastic_p99_x)
     compared = []
     if base["queries"]:
         compared.append(f"{len(base['queries'])} queries")
@@ -190,6 +236,8 @@ def main(argv=None) -> int:
         compared.append("cold-start line")
     if cand["progress"] is not None:
         compared.append("introspection line")
+    if cand["elastic"] is not None:
+        compared.append("elastic-regions line")
     if problems:
         for p in problems:
             print(f"REGRESSION {p}")

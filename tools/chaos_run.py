@@ -3,6 +3,8 @@ exactly-once assertions.
 
     python -m tools.chaos_run --seed 7                 # all scenarios
     python -m tools.chaos_run --seed 7 --scenario kill_leader --writes 40
+    python -m tools.chaos_run --seed 5 --scenario split_chaos
+    python -m tools.chaos_run --seed 6 --scenario migrate_chaos
 
 Prints ONE JSON line per scenario: the fault schedule actually injected,
 a sha256 digest of the deterministic final state (fleet-plane scenarios
@@ -12,9 +14,12 @@ iff every scenario's invariants held.
 
 Determinism contract (docs/CHAOS.md): run the same seed twice and diff
 the ``fault_schedule`` and ``state_digest`` fields — identical for the
-fleet-plane scenarios (kill_leader, partition); for rpc_chaos (real
-threads/sockets) the digest covers the final rows, which must still be
-identical, while the crash entry's store id is timing-informational.
+fleet-plane scenarios (kill_leader, partition, split_chaos — a live
+fenced split partitioned or seam-dropped mid-flight — and migrate_chaos
+— a learner-first migration with the leader killed or its seam
+dropped); for rpc_chaos (real threads/sockets) the digest covers the
+final rows, which must still be identical, while the crash entry's
+store id is timing-informational.
 """
 
 from __future__ import annotations
