@@ -28,7 +28,8 @@ _INLINE_RE = re.compile(
     r"([A-Z]+(?:\s*,\s*[A-Z]+)*)")
 
 RULES = ("HOSTSYNC", "RETRACE", "TRACERLEAK", "LOCKORDER", "BAREEXC",
-         "SPANINJIT", "FAILPOINTHOT", "METRICINJIT", "PROGRESSINJIT")
+         "SPANINJIT", "FAILPOINTHOT", "METRICINJIT", "PROGRESSINJIT",
+         "DONATED")
 
 
 @dataclass(frozen=True)

@@ -352,6 +352,149 @@ class _FailpointHot(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
 
+def _dotted(node) -> str | None:
+    """``a.b.c`` spelling of a Name/Attribute chain, or None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return None if base is None else f"{base}.{node.attr}"
+    return None
+
+
+def _donated_positions(call: ast.Call) -> tuple | None:
+    """The donate_argnums positions of a ``jax.jit(...)`` call as a tuple
+    of ints, or None when absent/non-literal."""
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            vals = tuple(el.value for el in v.elts
+                         if isinstance(el, ast.Constant)
+                         and isinstance(el.value, int))
+            return vals or None
+        return None
+    return None
+
+
+class _DonatedUse:
+    """DONATED: a buffer read after it was passed in a donated argument
+    position of a jitted call.  ``jax.jit(step, donate_argnums=(0, 1))``
+    hands the inputs' device buffers to the executable for reuse — on TPU
+    a later read of the SAME python reference returns whatever the program
+    scribbled there, silently (CPU merely declines the donation, so tests
+    pass while the accelerator corrupts).  Per-statement linear scan of
+    each scope: a call through a name bound to a donating jax.jit kills
+    the names fed at donated positions; any later Load of a killed name
+    reports; assignment (including the ``acc = step(acc, chunk)``
+    self-recycle idiom) revives the target."""
+
+    def __init__(self, mi: ModuleIndex, report):
+        self.mi = mi
+        self.report = report
+        self.donated: dict[str, tuple] = {}
+
+    def run(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            v = node.value
+            if not isinstance(v, ast.Call):
+                continue
+            path = self.mi.resolve(v.func)
+            if path is None or not (path.endswith("jax.jit")
+                                    or path.endswith("pjit")):
+                continue
+            pos = _donated_positions(v)
+            name = _dotted(node.targets[0])
+            if pos and name:
+                self.donated[name] = pos
+        if not self.donated:
+            return
+        self._scan_block(tree.body, set())
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_block(node.body, set())
+
+    # -- linear per-scope walk ------------------------------------------
+    def _scan_block(self, body, dead: set) -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue        # separate scope: scanned by run()
+            if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                # conservative join over iterations: only intra-body
+                # use-after-donation is claimed (the classic bug —
+                # folding a chunk then reading it in the same body)
+                dead.clear()
+                self._scan_block(st.body, dead)
+                self._scan_block(st.orelse, dead)
+                dead.clear()
+                continue
+            if isinstance(st, ast.If):
+                self._check_reads(st.test, dead)
+                d1, d2 = set(dead), set(dead)
+                self._scan_block(st.body, d1)
+                self._scan_block(st.orelse, d2)
+                dead |= d1 | d2
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._check_reads(item.context_expr, dead)
+                self._scan_block(st.body, dead)
+                continue
+            if isinstance(st, ast.Try):
+                self._scan_block(st.body, dead)
+                for h in st.handlers:
+                    self._scan_block(h.body, dead)
+                self._scan_block(st.orelse, dead)
+                self._scan_block(st.finalbody, dead)
+                continue
+            # reads happen before this statement's own donations land
+            self._check_reads(st, dead)
+            self._apply_donations(st, dead)
+            self._clear_assigned(st, dead)
+
+    def _check_reads(self, node, dead: set) -> None:
+        if not dead:
+            return
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in dead:
+                self.report("DONATED", n,
+                            f"{n.id!r} was donated to a jitted call "
+                            "(donate_argnums) — its device buffer is "
+                            "recycled by the executable; reading it here "
+                            "returns garbage on TPU")
+
+    def _apply_donations(self, st, dead: set) -> None:
+        for n in ast.walk(st):
+            if not isinstance(n, ast.Call):
+                continue
+            pos = self.donated.get(_dotted(n.func) or "")
+            if not pos:
+                continue
+            for p in pos:
+                if p < len(n.args):
+                    for sub in ast.walk(n.args[p]):
+                        if isinstance(sub, ast.Name):
+                            dead.add(sub.id)
+
+    def _clear_assigned(self, st, dead: set) -> None:
+        targets = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    dead.discard(n.id)
+
+
 class _BareExc(ast.NodeVisitor):
     """BAREEXC: handlers that swallow everything.  A bare ``except:`` (or
     ``except BaseException:``) traps KeyboardInterrupt/SystemExit; an
@@ -395,6 +538,7 @@ def lint_tree(tree: ast.AST, hot_module: bool, report) -> None:
     _JitMisuse(mi, report).visit(tree)
     _BareExc(mi, report).visit(tree)
     _FailpointHot(mi, report, hot_module).visit(tree)
+    _DonatedUse(mi, report).run(tree)
 
     def walk_defs(body, in_class: bool):
         for node in body:

@@ -668,6 +668,106 @@ def migrate_chaos(seed: int = 6, writes: int = 36) -> dict:
             "problems": problems}
 
 
+def stream_chaos(seed: int = 7, rows: int = 384, chunk_rows: int = 64,
+                 writes: int | None = None, drop_pct: int = 25,
+                 delay_ms: float = 1.0) -> dict:
+    """Out-of-core streaming scan under cold-tier faults: a streamed
+    scan->filter->GROUP BY folds the table's Parquet chunk segments while
+    the ``coldfs.get`` seam is armed — first with a hard ``2*drop`` (the
+    first two segment reads fail, proving the bounded-backoff retry
+    path), then with a seeded ``P%drop`` + a second pass of pure latency
+    (``delay``).  The retry policy is PR 5's: doubling backoff with full
+    jitter, ``stream_retry_max`` attempts, counted in ``stream_retries``.
+
+    Invariants: every armed run returns BIT-IDENTICAL rows to the
+    unfaulted resident path, and every chunk folds exactly once per scan
+    (``stream_chunks`` moves by exactly the chunk count — a retried read
+    re-stages bytes, never re-folds a chunk).  The fold is single-scan
+    deterministic data, so the digest (rows + fault plan) pins per seed
+    across runs."""
+    import shutil
+    import tempfile
+
+    from ..exec.session import Database, Session
+    from ..utils import metrics
+    from ..utils.flags import FLAGS, set_flag
+
+    if writes is not None:              # chaos_run --writes compatibility
+        rows = max(chunk_rows, int(writes))
+    prev = {k: getattr(FLAGS, k) for k in
+            ("chaos_seed", "streaming_scan", "streaming_min_rows",
+             "streaming_chunk_rows", "stream_backoff_ms")}
+    set_flag("chaos_seed", int(seed))
+    set_flag("streaming_scan", True)
+    set_flag("streaming_min_rows", 1)
+    set_flag("streaming_chunk_rows", int(chunk_rows))
+    set_flag("stream_backoff_ms", 0.5)      # keep retry sleeps cheap
+    cold = tempfile.mkdtemp(prefix="stream_chaos_")
+    schedule: list[list] = []
+    problems: list[str] = []
+    sql = ("SELECT g, COUNT(*) n, SUM(v) s, AVG(v) a FROM sc "
+           "WHERE id >= 0 GROUP BY g ORDER BY g")
+    try:
+        s = Session(Database(cold_dir=cold))
+        s.execute("CREATE TABLE sc (id BIGINT, g BIGINT, v DOUBLE, "
+                  "PRIMARY KEY (id))")
+        for lo in range(0, rows, 128):
+            vals = ", ".join(f"({i}, {i % 5}, {float(i % 97)})"
+                             for i in range(lo, min(lo + 128, rows)))
+            s.execute(f"INSERT INTO sc VALUES {vals}")
+        set_flag("streaming_scan", False)
+        want = s.query(sql)             # unfaulted resident ground truth
+        set_flag("streaming_scan", True)
+        n_chunks = -(-rows // chunk_rows)
+
+        def streamed_run(tag: str, spec: str | None):
+            c0 = metrics.stream_chunks.value
+            r0 = metrics.stream_retries.value
+            if spec is not None:
+                failpoint.set_failpoint("coldfs.get", spec)
+            try:
+                got = s.query(sql)
+            finally:
+                if spec is not None:
+                    failpoint.clear("coldfs.get")
+            folded = metrics.stream_chunks.value - c0
+            retried = metrics.stream_retries.value - r0
+            schedule.append([tag, spec, folded, retried])
+            if got != want:
+                problems.append(f"{tag}: streamed rows diverged from the "
+                                f"resident path")
+            if folded != n_chunks:
+                problems.append(f"{tag}: {folded} chunk folds for "
+                                f"{n_chunks} chunks — not exactly-once")
+            return retried
+
+        # pass 1 (unfaulted): builds + persists the chunk segments, and
+        # pins the fault-free fold
+        streamed_run("clean", None)
+        # pass 2: hard drop — the first two segment reads FAIL, retries
+        # must recover mid-streamed-scan
+        retried = streamed_run("hard_drop", "2*drop")
+        if retried < 2:
+            problems.append(f"hard_drop: only {retried} retries for a "
+                            f"2*drop (the failpoint never bit)")
+        # pass 3: seeded probabilistic drops — the schedule is a pure
+        # function of (chaos_seed, hit index)
+        streamed_run("seeded_drop", f"{drop_pct}%drop")
+        # pass 4: pure latency — staging slows, results must not change
+        streamed_run("latency", f"delay({delay_ms})")
+    finally:
+        failpoint.clear("coldfs.get")
+        for k, v in prev.items():
+            set_flag(k, v)
+        shutil.rmtree(cold, ignore_errors=True)
+    return {"rows": rows, "chunks": n_chunks,
+            "fault_schedule": schedule, "faults": len(schedule) - 1,
+            "state_digest": _digest({"schedule": schedule,
+                                     "rows": [sorted(r.items())
+                                              for r in want]}),
+            "problems": problems}
+
+
 SCENARIOS = {
     "kill_leader": kill_leader,
     "partition": partition,
@@ -675,6 +775,7 @@ SCENARIOS = {
     "dispatch_overload": dispatch_overload,
     "split_chaos": split_chaos,
     "migrate_chaos": migrate_chaos,
+    "stream_chaos": stream_chaos,
 }
 
 

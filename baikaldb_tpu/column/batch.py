@@ -249,15 +249,19 @@ def _arrow_ltype_map():
     return _ARROW_LTYPE
 
 
-def _arrow_to_column(arr, typ) -> Column:
+def _arrow_to_numpy(arr, typ):
+    """Host half of the Arrow->device codec: -> (np data, np validity-or-
+    None, ltype, dictionary-or-None).  The streaming chunk layer
+    (storage/streamchunks.py) encodes a whole snapshot through this once —
+    table-wide string dictionaries, the null-fill discipline — and slices
+    chunks host-side; resident ingest wraps the same arrays in jnp below."""
     import pyarrow as pa
     import pyarrow.compute as pc
 
     if pa.types.is_string(typ) or pa.types.is_large_string(typ) or pa.types.is_dictionary(typ):
         d, codes = Dictionary.from_arrow(arr)
         validity = codes != NULL_CODE if arr.null_count else None
-        return Column(jnp.asarray(codes), None if validity is None else jnp.asarray(validity),
-                      LType.STRING, d)
+        return codes, validity, LType.STRING, d
     if pa.types.is_decimal(typ):
         arr = pc.cast(arr, pa.float64())
         typ = pa.float64()
@@ -283,10 +287,16 @@ def _arrow_to_column(arr, typ) -> Column:
         np_data = work.to_numpy(zero_copy_only=False)
         if np_data.dtype.kind == "f":
             np_data = np.nan_to_num(np_data)
-        np_data = np_data.astype(ltype.np_dtype, copy=False)
-        return Column(jnp.asarray(np_data), jnp.asarray(validity), ltype)
+        return np_data.astype(ltype.np_dtype, copy=False), validity, ltype, None
     np_data = work.to_numpy(zero_copy_only=False)
-    return Column(jnp.asarray(np_data.astype(ltype.np_dtype, copy=False)), None, ltype)
+    return np_data.astype(ltype.np_dtype, copy=False), None, ltype, None
+
+
+def _arrow_to_column(arr, typ) -> Column:
+    data, validity, ltype, d = _arrow_to_numpy(arr, typ)
+    return Column(jnp.asarray(data),
+                  None if validity is None else jnp.asarray(validity),
+                  ltype, d)
 
 
 def _column_to_arrow(c: Column, data: np.ndarray, valid: np.ndarray | None):

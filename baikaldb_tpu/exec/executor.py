@@ -36,8 +36,8 @@ from ..ops.compact import shrink
 from ..plan.nodes import (AggNode, DistinctNode, ExchangeNode, FilterNode,
                           JoinNode, LimitNode, MembershipNode, MultiJoinNode,
                           PlanNode, ProjectNode, ScalarSourceNode, ScanNode,
-                          ShrinkNode, SortNode, UnionNode, ValuesNode,
-                          WindowNode)
+                          ShrinkNode, SortNode, StreamResultNode, UnionNode,
+                          ValuesNode, WindowNode)
 from ..column.batch import concat_batches
 from ..parallel.mesh import AXIS, shard_map
 from ..types import LType
@@ -563,6 +563,10 @@ def _eval(node: PlanNode, batches: dict, overflows: list, ctx=None) -> ColumnBat
             c = eval_output(e, empty)
             cols.append(_broadcast(c, 1))
         return ColumnBatch(tuple(node.names), cols)
+
+    if isinstance(node, StreamResultNode):
+        # the chunk-folded aggregate's finalized batch (exec/streaming.py)
+        return batches[node.key]
 
     raise ExecError(f"unknown plan node {type(node).__name__}")
 

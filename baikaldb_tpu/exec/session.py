@@ -64,7 +64,7 @@ define("param_queries", True,
        "entry and one compiled executable serve every literal variant of a "
        "query shape; 0 restores SQL-text-keyed caching with baked literals")
 from .dispatch import BatchDispatcher
-from . import executor
+from . import executor, streaming
 from .executor import (_CapBox, compile_plan, count_shuffle_rounds,
                        exchange_summary)
 
@@ -3923,21 +3923,29 @@ class Session:
         # describe the plan that actually runs, not a truncated first attempt
         entry = {"plan": plan, "compiled": {}, "versions": {}}
         self._run_plan(entry, batches, shape_key)
-        raw = compile_plan(plan, trace=True,
-                           mesh=self.mesh if batches else None)
-        fn = jax.jit(raw)
-        with trace.span("exec.first"):
-            with hot_path_guard():
-                out, flags, counts = fn(batches)
-            jax.block_until_ready(jax.tree.leaves(counts))
-        with trace.span("exec.steady"):
-            with hot_path_guard():
-                out, flags, counts = fn(batches)
-            jax.block_until_ready(jax.tree.leaves(counts))
-        # materialize every per-node counter in one explicit transfer —
-        # int(c) per operator is a device round-trip each (tpulint HOSTSYNC)
-        by_node = {id(n): int(c) for n, c in
-                   zip(raw.trace_order, jax.device_get(counts))}
+        if streaming.stream_source(batches) is not None:
+            # chunk-folded execution: there is no single jitted program to
+            # re-run under the counting tracer (the scan input is a host
+            # chunk iterator) — ops render uncounted; the measured fold
+            # telemetry landed in the run's `stream` event instead
+            by_node: dict = {}
+        else:
+            raw = compile_plan(plan, trace=True,
+                               mesh=self.mesh if batches else None)
+            fn = jax.jit(raw)
+            with trace.span("exec.first"):
+                with hot_path_guard():
+                    out, flags, counts = fn(batches)
+                jax.block_until_ready(jax.tree.leaves(counts))
+            with trace.span("exec.steady"):
+                with hot_path_guard():
+                    out, flags, counts = fn(batches)
+                jax.block_until_ready(jax.tree.leaves(counts))
+            # materialize every per-node counter in one explicit transfer —
+            # int(c) per operator is a device round-trip each
+            # (tpulint HOSTSYNC)
+            by_node = {id(n): int(c) for n, c in
+                       zip(raw.trace_order, jax.device_get(counts))}
 
         def render(node: PlanNode, indent: int):
             rows = by_node.get(id(node))
@@ -4109,6 +4117,14 @@ class Session:
                          f"keys={a.get('keys', '[]')} "
                          f"multiway={a['multiway']} agg={a['agg']} "
                          f"shuffle_retries_total={a['retries_total']}")
+        for s in find("stream"):
+            a = s["attrs"]
+            lines.append(f"-- stream: chunks={a['chunks']}/"
+                         f"{a['chunks_total']} skipped={a['skipped']} "
+                         f"bytes_h2d={a['bytes_h2d']} "
+                         f"prefetch_wait_ms={a['prefetch_wait_ms']} "
+                         f"stage_ms={a['stage_ms']} "
+                         f"restarts={a['restarts']}")
         lines.append(f"-- trace: spans={len(spans)} "
                      "(SHOW PROFILE shows the same span records)")
         return lines
@@ -4173,8 +4189,16 @@ class Session:
                     if self.mesh is not None:
                         b = self._sharded_batch(n.table_key, store)
                     else:
-                        b = store.device_table_batch()
-                        full_scan.add(n.table_key)
+                        # out-of-core: an eligible scan->filter->aggregate
+                        # plan over a big-enough table stages a ChunkSource
+                        # (chunk ids post zone-map pruning) instead of the
+                        # whole table; _run_plan folds it chunk by chunk.
+                        # NOT a full_scan member: presort permutations and
+                        # the batched dispatcher need resident positions
+                        b = self._maybe_stream_source(plan, n, store)
+                        if b is None:
+                            b = store.device_table_batch()
+                            full_scan.add(n.table_key)
                 batches[n.table_key] = b
                 key_parts.append((n.table_key, store.version,
                                   len(batches[n.table_key])))
@@ -4307,6 +4331,41 @@ class Session:
         return None
 
     _ACCESS_CACHE_MAX = 16
+
+    def _maybe_stream_source(self, plan, n, store):
+        """A ChunkSource for this scan when the plan is chunk-foldable
+        (exec/streaming.py) and the table clears the size gate; None keeps
+        the resident path.  Host-side and per-execution, like the access
+        paths — the chunk-level zone maps see this execution's literals."""
+        from ..index.selector import analyze_conjuncts
+        from ..storage.streamchunks import ChunkSource, chunk_set
+
+        if not bool(FLAGS.streaming_scan) or self._sql_txn is not None:
+            return None
+        if store.num_rows < int(FLAGS.streaming_min_rows):
+            return None
+        if streaming.eligible(plan, n) is None:
+            return None
+        try:
+            cs = chunk_set(store, n.table_key, self.db.cold_fs())
+        except Exception:       # noqa: BLE001 — staging is best-effort
+            metrics.count_swallowed("session.stream_stage")
+            return None
+        ranges = {}
+        if n.pushed_filter is not None:
+            pf = n.pushed_filter
+            subst = getattr(self, "_param_subst", None)
+            if subst:
+                pf = paramize.substitute_params(pf, subst)
+            try:
+                ranges = analyze_conjuncts(pf).ranges
+            except Exception:   # noqa: BLE001 — prune is conservative
+                metrics.count_swallowed("session.stream_prune")
+                ranges = {}
+        keep = cs.pruned(ranges)
+        n.access_desc = (f"stream({len(keep)}/{cs.n_chunks} chunks, "
+                         f"{cs.capacity} rows each)")
+        return ChunkSource(cs, keep)
 
     def _evict_access(self, table_key: str, version: int):
         """Drop access-path batches of older versions of this table, and
@@ -4559,6 +4618,10 @@ class Session:
                                      pa.int64()),
                 "round": pa.array([r["round"] for r in rows], pa.int64()),
                 "rounds_total": pa.array([r["rounds_total"] for r in rows],
+                                         pa.int64()),
+                "chunk_no": pa.array([r["chunk_no"] for r in rows],
+                                     pa.int64()),
+                "chunks_total": pa.array([r["chunks_total"] for r in rows],
                                          pa.int64()),
                 "queue_wait_ms": pa.array([r["queue_wait_ms"] for r in rows],
                                           pa.float64()),
@@ -4859,6 +4922,15 @@ class Session:
 
     def _run_plan(self, entry: dict, batches: dict, shape_key) -> ColumnBatch:
         plan = entry["plan"]
+        if streaming.stream_source(batches) is not None:
+            # out-of-core path: the scan staged a ChunkSource, so this
+            # execution is a chunk fold driven from the host
+            # (exec/streaming.py), not one jitted program over resident
+            # batches — none of the executable caching below applies
+            out = streaming.run_streamed(self, entry, batches,
+                                         progress.current())
+            with trace.span("egress.compact"):
+                return self._egress_compact(out)
         # a plan with no scans has no sharded state (distribute leaves it
         # fully replicated) — run it as a plain single-device program
         mesh = self.mesh if batches else None

@@ -330,6 +330,8 @@ class Catalog:
                                Field("rows_est", LType.INT64),
                                Field("round", LType.INT64),
                                Field("rounds_total", LType.INT64),
+                               Field("chunk_no", LType.INT64),
+                               Field("chunks_total", LType.INT64),
                                Field("queue_wait_ms", LType.FLOAT64),
                                Field("elapsed_ms", LType.FLOAT64))),
         # always-on flight recorder (obs/flightrec.py): the bounded ring of

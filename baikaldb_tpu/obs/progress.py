@@ -117,7 +117,8 @@ class QueryProgress:
     __slots__ = ("query_id", "conn_id", "user", "host", "db", "dbname",
                  "text", "command", "phase", "operator", "batches_done",
                  "batches_total", "rows_done", "rows_est", "round_no",
-                 "rounds_total", "queue_wait_ms", "started", "beat_mono",
+                 "rounds_total", "chunk_no", "chunks_total",
+                 "queue_wait_ms", "started", "beat_mono",
                  "token", "plan", "exchange", "stalled", "_phase_mono",
                  "_phase_ms")
 
@@ -139,6 +140,8 @@ class QueryProgress:
         self.rows_est = 0
         self.round_no = 0
         self.rounds_total = 0
+        self.chunk_no = 0            # streamed scan: chunks folded so far
+        self.chunks_total = 0        # streamed scan: chunks kept post-prune
         self.queue_wait_ms = 0.0
         self.started = time.time()
         self.beat_mono = time.monotonic()
@@ -195,6 +198,7 @@ class QueryProgress:
             "batches_total": self.batches_total,
             "rows_done": self.rows_done, "rows_est": self.rows_est,
             "round": self.round_no, "rounds_total": self.rounds_total,
+            "chunk_no": self.chunk_no, "chunks_total": self.chunks_total,
             "queue_wait_ms": round(self.queue_wait_ms, 3),
             "elapsed_ms": round(self.elapsed_s() * 1e3, 3),
         }
@@ -211,6 +215,8 @@ class QueryProgress:
             parts.append(f"rows {self.rows_done}/{self.rows_est}")
         if self.rounds_total:
             parts.append(f"round {self.round_no}/{self.rounds_total}")
+        if self.chunks_total:
+            parts.append(f"chunk {self.chunk_no}/{self.chunks_total}")
         if self.stalled:
             parts.append("STALLED")
         return " ".join(parts)

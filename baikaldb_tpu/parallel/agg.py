@@ -78,6 +78,16 @@ def merge_partial_agg_specs(parts: list[AggSpec]) -> list[AggSpec]:
     return [AggSpec(MERGE_OP[p.op], p.out_name, p.out_name) for p in parts]
 
 
+def rewrap_partial(part: ColumnBatch) -> ColumnBatch:
+    """Partial rows as a PLAIN batch: drop the kernel's traced group count
+    (the next aggregate recomputes liveness from sel) and make the mask
+    explicit — every partial-merge consumer (the shuffled local arm here,
+    exec/streaming.py's chunk fold) needs the same uniform structure."""
+    sel = part.sel if part.sel is not None \
+        else jnp.ones(len(part), dtype=bool)
+    return ColumnBatch(part.names, part.columns, sel, None)
+
+
 def _merge_collective(op: str, x, axis_name: str):
     if op == "sum":
         return jax.lax.psum(x, axis_name)
@@ -166,7 +176,7 @@ def dist_group_aggregate_partial_shuffled(batch: ColumnBatch,
     def local(b: ColumnBatch):
         part, p_ovf = group_aggregate_sorted(b, key_names, parts, mg_part,
                                              with_overflow=True)
-        part = ColumnBatch(part.names, part.columns, part.sel, None)
+        part = rewrap_partial(part)
         shuf, needed = repartition_collective(part, key_names, n, cap)
         final, f_ovf = group_aggregate_sorted(shuf, key_names, merge_specs,
                                               len(shuf), with_overflow=True)
@@ -178,7 +188,7 @@ def dist_group_aggregate_partial_shuffled(batch: ColumnBatch,
 
     def probe_fn(b):
         part = group_aggregate_sorted(b, key_names, parts, mg_part)
-        part = ColumnBatch(part.names, part.columns, part.sel, None)
+        part = rewrap_partial(part)
         shuf = ColumnBatch(
             part.names,
             [Column(jnp.zeros((n * cap,), c.data.dtype),

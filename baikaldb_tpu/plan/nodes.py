@@ -343,6 +343,19 @@ class ValuesNode(PlanNode):
         return f"Values({len(self.rows)} rows)"
 
 
+@dataclass
+class StreamResultNode(PlanNode):
+    """Leaf standing in for a chunk-folded aggregate (exec/streaming.py):
+    the streamed fold produces the aggregate's finalized batch outside any
+    single program, then the ancestors above the AggNode (project / sort /
+    limit) run as a normal remainder plan reading this batch from the
+    batches dict under ``key``."""
+    key: str = ""
+
+    def _label(self):
+        return f"StreamResult({self.key})"
+
+
 # -- plan fingerprinting ----------------------------------------------------
 
 # runtime-settled / display-only attributes: NOT part of what the executor

@@ -661,6 +661,17 @@ watchdog_stalls_detected = Counter("watchdog_stalls_detected")
 # and the subset that carried a full forensic bundle (slow/killed/failed)
 flightrec_records = Counter("flightrec_records")
 flightrec_bundles = Counter("flightrec_bundles")
+# out-of-core streaming scans (exec/streaming.py): chunks folded, chunks
+# zone-map-skipped before any transfer, coldfs segment-read retries, fold
+# restarts after a group-capacity overflow, bytes moved host->device, and
+# how long the fold loop waited on the prefetcher (0-ish wait = the H2D
+# copy fully overlapped the previous chunk's compute)
+stream_chunks = Counter("stream_chunks")
+stream_chunks_skipped = Counter("stream_chunks_skipped")
+stream_retries = Counter("stream_retries")
+stream_restarts = Counter("stream_restarts")
+stream_bytes_h2d = Counter("stream_bytes_h2d")
+stream_prefetch_wait_ms = LatencyRecorder("stream_prefetch_wait_ms")
 
 
 def count_swallowed(site: str) -> None:

@@ -1350,6 +1350,140 @@ def run_coldstart_bench() -> dict:
     }
 
 
+def run_stream_bench() -> dict:
+    """Data-scale line: the out-of-core streaming scan (exec/streaming.py)
+    vs the resident path over the SAME filter+GROUP BY SQL.  The table is
+    many multiples of the per-chunk device budget (steady-state residency
+    is TWO chunks), so the streamed number is the throughput the engine
+    keeps once a table no longer fits on device — the resident path's
+    ceiling is device memory, the streamed path's is staging bandwidth.
+    Correctness is asserted in-line: streamed rows == resident rows
+    (integer-valued doubles, so the fold order cannot move bits).  The
+    per-query fold telemetry (chunks, skipped, bytes H2D, prefetch wait
+    vs serial stage time — the overlap measurement) is parsed from
+    EXPLAIN ANALYZE's ``-- stream:`` line; tools/bench_regress.py gates
+    on it."""
+    import re
+    import shutil
+    import tempfile
+
+    import jax
+    import pyarrow as pa
+
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    platform = jax.devices()[0].platform
+    n_rows = int(os.environ.get(
+        "BENCH_STREAM_ROWS", 2_000_000 if platform != "cpu" else 262_144))
+    chunk = int(os.environ.get("BENCH_STREAM_CHUNK", 1 << 15))
+    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    n_groups = 64
+
+    ids = np.arange(n_rows, dtype=np.int64)
+    g_np = (ids % n_groups).astype(np.int64)
+    v_np = (ids % 251).astype(np.float64)
+
+    prev = {k: getattr(FLAGS, k) for k in
+            ("streaming_scan", "streaming_min_rows", "streaming_chunk_rows")}
+    cold = tempfile.mkdtemp(prefix="bench_stream_")
+    sql = ("SELECT g, COUNT(*) n, SUM(v) s, AVG(v) a, MIN(v) mn, MAX(v) mx "
+           "FROM st WHERE v >= 1.0 GROUP BY g")
+    try:
+        set_flag("streaming_scan", True)
+        set_flag("streaming_min_rows", 1)
+        set_flag("streaming_chunk_rows", chunk)
+        s = Session(Database(cold_dir=cold))
+        s.execute("CREATE TABLE st (id BIGINT, g BIGINT, v DOUBLE, "
+                  "PRIMARY KEY (id))")
+        s.db.stores["default.st"].insert_arrow(
+            pa.table({"id": ids, "g": g_np, "v": v_np}))
+
+        def timed():
+            t0 = time.perf_counter()
+            out = s.query(sql)
+            return time.perf_counter() - t0, out
+
+        timed()             # compile + build/persist the chunk segments
+        streamed = None
+        st_times = []
+        for _ in range(repeats):
+            dt, streamed = timed()
+            st_times.append(dt)
+        ea = "\n".join(str(r[next(iter(r))]) for r in
+                       s.query("EXPLAIN ANALYZE " + sql))
+        m = re.search(r"-- stream: chunks=(\d+)/(\d+) skipped=(\d+) "
+                      r"bytes_h2d=(\d+) prefetch_wait_ms=([\d.]+) "
+                      r"stage_ms=([\d.]+) restarts=(\d+)", ea)
+        if m is None:
+            raise RuntimeError("EXPLAIN ANALYZE carried no -- stream: line "
+                               "(the scan did not stream)")
+        set_flag("streaming_scan", False)
+        timed()             # compile the resident program
+        resident = None
+        rs_times = []
+        for _ in range(repeats):
+            dt, resident = timed()
+            rs_times.append(dt)
+        if streamed != resident:
+            raise RuntimeError("streamed result diverged from resident")
+    finally:
+        for k, vv in prev.items():
+            set_flag(k, vv)
+        shutil.rmtree(cold, ignore_errors=True)
+    st_dt = float(np.median(st_times))
+    rs_dt = float(np.median(rs_times))
+    return {
+        "metric": f"out-of-core stream: filter+GROUP BY rows/sec folding "
+                  f"{m.group(1)} x {chunk}-row chunks vs resident "
+                  f"({n_rows / 1e6:.1f}M rows, {platform})",
+        "value": round(n_rows / st_dt, 1),
+        "unit": "rows/sec",
+        # <1: the fold pays staging; the streamed path's win is CAPACITY
+        # (2-chunk residency), not speed at sizes the resident path fits
+        "vs_baseline": round(rs_dt / st_dt, 3),
+        "platform": platform,
+        "rows": n_rows,
+        "chunk_rows": chunk,
+        "table_over_chunk_budget_x": round(n_rows / (2.0 * chunk), 1),
+        "resident_rows_per_sec": round(n_rows / rs_dt, 1),
+        "chunks": int(m.group(1)),
+        "chunks_total": int(m.group(2)),
+        "skipped": int(m.group(3)),
+        "bytes_h2d": int(m.group(4)),
+        "prefetch_wait_ms": float(m.group(5)),
+        "stage_ms": float(m.group(6)),
+        "restarts": int(m.group(7)),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
+def _emit_stream_line(skip_reason: str | None = None):
+    """Out-of-core streaming JSON line: chunk-folded scan throughput vs
+    the resident path, plus the fold telemetry bench_regress gates on.
+    Same robustness contract: always prints a line, never raises."""
+    if os.environ.get("BENCH_SKIP_STREAM") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "out-of-core stream: filter+GROUP BY rows/sec "
+                      "chunk-folded vs resident (skipped)",
+            "value": 0, "unit": "rows/sec", "vs_baseline": 0.0,
+            "platform": "none", "error": skip_reason}))
+        return
+    try:
+        result = run_stream_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "out-of-core stream: filter+GROUP BY rows/sec "
+                            "chunk-folded vs resident (failed)",
+                  "value": 0, "unit": "rows/sec", "vs_baseline": 0.0,
+                  "platform": "none",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_coldstart_line(skip_reason: str | None = None):
     """Ninth JSON line: restart-to-steady cold vs AOT warm-start.  Runs
     entirely in forced-CPU subprocesses + in-process daemons, so it is
@@ -1644,6 +1778,8 @@ def main():
                                     "failed; progress phase skipped")
                 _emit_elastic_line(skip_reason="accelerator probe "
                                    "failed; elastic phase skipped")
+                _emit_stream_line(skip_reason="accelerator probe "
+                                  "failed; stream phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -1687,6 +1823,7 @@ def main():
             _emit_coldstart_line()
             _emit_progress_line()
             _emit_elastic_line()
+            _emit_stream_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
@@ -1699,6 +1836,7 @@ def main():
     _emit_coldstart_line()
     _emit_progress_line()
     _emit_elastic_line()
+    _emit_stream_line()
     return 0
 
 

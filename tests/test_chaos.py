@@ -347,3 +347,21 @@ def test_rpc_chaos_scenario_exactly_once():
     assert r["faults"] >= 1                     # the leader daemon crashed
     assert r["rpc_retries"] > 0                 # drops forced resends
     assert r["p99_ms"] > 0
+
+
+def test_stream_chaos_scenario_exactly_once():
+    """Cold-tier faults mid-streamed-scan: coldfs.get drops retry under
+    the bounded-backoff policy, every chunk folds exactly once, and the
+    streamed rows stay bit-identical to the resident path.  The digest
+    (rows + fault plan) replays per seed."""
+    from baikaldb_tpu.chaos.scenarios import run_scenario
+
+    a = run_scenario("stream_chaos", 9, rows=256, chunk_rows=64)
+    assert a["ok"], a
+    assert a["chunks"] == 4
+    assert a["faults"] >= 3                     # hard, seeded, latency arms
+    # hard_drop pass: the failpoint bit and the retries recovered it
+    hard = next(e for e in a["fault_schedule"] if e[0] == "hard_drop")
+    assert hard[3] >= 2
+    b = run_scenario("stream_chaos", 9, rows=256, chunk_rows=64)
+    assert b["ok"] and b["state_digest"] == a["state_digest"]

@@ -526,6 +526,69 @@ def test_param_feed_fixture_hostsync_flagged(tmp_path):
     assert out == [("HOSTSYNC", 6)]
 
 
+# ---- DONATED --------------------------------------------------------------
+
+def test_donated_read_after_fold(tmp_path):
+    """The classic bug: fold a chunk through a donating jit, then read the
+    SAME chunk reference afterwards — on TPU the executable has recycled
+    its buffer."""
+    out = lint_src(tmp_path, """\
+        import jax
+        def run(chunks, acc):
+            step = jax.jit(lambda a, c: a + c, donate_argnums=(0, 1))
+            for cur in chunks:
+                acc = step(acc, cur)
+                total = cur.sum()
+            return acc, total
+        """)
+    assert out == [("DONATED", 6)]
+
+
+def test_donated_clean_recycle_and_pre_read(tmp_path):
+    """Clean counterparts: reading the buffer BEFORE the donating call, and
+    the carry self-reassignment idiom (``acc = step(acc, cur)``) — the
+    streaming fold's exact shape."""
+    out = lint_src(tmp_path, """\
+        import jax
+        def run(chunks, acc):
+            step = jax.jit(lambda a, c: a + c, donate_argnums=(0, 1))
+            for cur in chunks:
+                n = cur.sum()
+                acc = step(acc, cur)
+            return acc, n
+        """)
+    assert out == []
+
+
+def test_donated_self_attribute_target(tmp_path):
+    """The streaming.py spelling: the jitted step lives on ``self`` and the
+    non-carry donated operand is read after the call."""
+    out = lint_src(tmp_path, """\
+        import jax
+        class R:
+            def setup(self, fn):
+                self._jit_step = jax.jit(fn, donate_argnums=(1,))
+            def fold(self, acc, dev, params):
+                acc = self._jit_step(acc, dev, params)
+                return acc, dev.nbytes
+        """)
+    assert out == [("DONATED", 7)]
+
+
+def test_donated_only_listed_positions(tmp_path):
+    """Arguments OUTSIDE donate_argnums stay readable — params here is
+    position 2, not donated."""
+    out = lint_src(tmp_path, """\
+        import jax
+        def run(acc, dev, params):
+            step = jax.jit(lambda a, d, p: a + d + p,
+                           donate_argnums=(0, 1))
+            acc = step(acc, dev, params)
+            return acc, params
+        """)
+    assert out == []
+
+
 # ---- the CI policy: the tree stays clean ----------------------------------
 
 def test_tree_is_clean():

@@ -1,0 +1,272 @@
+"""Out-of-core streaming scans (exec/streaming.py + storage/streamchunks.py).
+
+The contract under test: for every plan streaming accepts, the chunk fold
+is BIT-IDENTICAL to the resident path — the off-switch is a no-op on
+results.  All fixtures use integer-valued doubles so sums/sumsq are exact
+in f64 regardless of fold order (the partial-merge protocol changes the
+addition order; exactness makes order irrelevant, which is what makes
+"bit-identical" testable).
+
+Matrix: grouped SUM/COUNT/AVG/STDDEV over int, string and NULL keys with
+groups spanning chunk boundaries; scalar aggregates; zone-map chunk skip;
+non-dividing chunk sizes; the off-switch; overflow-restart of the sorted
+accumulator; and the observability surfaces (EXPLAIN ANALYZE ``-- stream:``
+line, access path, processlist columns, stream_* metrics).
+"""
+
+import re
+
+import pytest
+
+from baikaldb_tpu.exec.session import Database, Session
+from baikaldb_tpu.exec.streaming import StreamRunner
+from baikaldb_tpu.utils import metrics
+from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+CHUNK = 64
+ROWS = 500                      # ~8 chunks: >= 4x the per-chunk budget
+
+_STREAM_FLAGS = ("streaming_scan", "streaming_min_rows",
+                 "streaming_chunk_rows")
+
+
+@pytest.fixture
+def sess(tmp_path):
+    prev = {k: getattr(FLAGS, k) for k in _STREAM_FLAGS}
+    set_flag("streaming_scan", True)
+    set_flag("streaming_min_rows", 1)       # every table is "too big"
+    set_flag("streaming_chunk_rows", CHUNK)
+    s = Session(Database(cold_dir=str(tmp_path / "afs")))
+    try:
+        yield s
+    finally:
+        for k, v in prev.items():
+            set_flag(k, v)
+
+
+def _load(s, n=ROWS, batch=100):
+    """id 0..n-1 in insert order (zone maps see monotone id ranges);
+    g cycles 0..6 and sv cycles 'a'..'d'/NULL so every group's rows span
+    every chunk; v/w integer-valued doubles."""
+    s.execute("CREATE TABLE t (id BIGINT, g BIGINT, sv VARCHAR(8), "
+              "v DOUBLE, w DOUBLE, PRIMARY KEY (id))")
+    svs = ["'a'", "'b'", "'c'", "'d'", "NULL"]
+    for lo in range(0, n, batch):
+        rows = ", ".join(
+            f"({i}, {i % 7}, {svs[i % 5]}, {float(i % 101)}, "
+            f"{float((i * 3) % 53)})"
+            for i in range(lo, min(lo + batch, n)))
+        s.execute(f"INSERT INTO t VALUES {rows}")
+
+
+def _both(s, sql):
+    """(streamed, resident) results for ``sql`` with the same cached plan;
+    returns them with the stream_chunks delta of the streamed run."""
+    c0 = metrics.stream_chunks.value
+    streamed = s.query(sql)
+    folded = metrics.stream_chunks.value - c0
+    set_flag("streaming_scan", False)
+    try:
+        resident = s.query(sql)
+    finally:
+        set_flag("streaming_scan", True)
+    return streamed, resident, folded
+
+
+# ---- bit-identity ---------------------------------------------------------
+
+def test_grouped_agg_bit_identical(sess):
+    _load(sess)
+    streamed, resident, folded = _both(
+        sess,
+        "SELECT g, SUM(v) s, COUNT(*) n, COUNT(w) nw, AVG(v) a, "
+        "STDDEV(v) sd, MIN(w) mn, MAX(w) mx "
+        "FROM t WHERE id < 400 GROUP BY g ORDER BY g")
+    assert streamed == resident
+    assert len(streamed) == 7
+    assert folded >= 4          # the whole table folded chunk by chunk
+
+
+def test_scalar_agg_bit_identical(sess):
+    _load(sess)
+    streamed, resident, folded = _both(
+        sess,
+        "SELECT SUM(v) s, COUNT(*) n, COUNT(sv) ns, AVG(w) a, "
+        "MIN(v) mn, MAX(v) mx FROM t WHERE v > 10.0")
+    assert streamed == resident
+    assert folded >= 4
+
+
+def test_string_and_null_keys_span_chunks(sess):
+    """sv cycles with period 5 against a 64-row chunk: every group
+    (including the NULL group) has members in every chunk, so the merge
+    must fold the same key across chunk boundaries."""
+    _load(sess)
+    streamed, resident, folded = _both(
+        sess,
+        "SELECT sv, COUNT(*) n, SUM(v) s, AVG(w) a FROM t "
+        "GROUP BY sv ORDER BY n DESC, sv")
+    assert streamed == resident
+    assert len(streamed) == 5           # 'a'..'d' + the NULL key group
+    assert folded >= 4
+
+
+def test_multi_key_grouped_stddev(sess):
+    _load(sess)
+    streamed, resident, _ = _both(
+        sess,
+        "SELECT g, sv, COUNT(*) n, STDDEV(v) sd, VARIANCE(w) vr "
+        "FROM t GROUP BY g, sv ORDER BY g, n, sv")
+    assert streamed == resident
+
+
+def test_non_dividing_chunk_size(sess):
+    _load(sess, n=100)                  # 64 + 36: a ragged tail chunk
+    streamed, resident, folded = _both(
+        sess, "SELECT g, SUM(v) s, COUNT(*) n FROM t GROUP BY g ORDER BY g")
+    assert streamed == resident
+    assert folded == 2
+
+
+def test_scalar_stddev_falls_back_resident(sess):
+    """Keyless STDDEV uses the mean-centered kernel formula — no
+    bit-identical partial form, so eligibility must reject it (the query
+    still answers, on the resident path)."""
+    _load(sess, n=100)
+    c0 = metrics.stream_chunks.value
+    got = sess.query("SELECT STDDEV(v) sd FROM t")
+    assert metrics.stream_chunks.value == c0    # nothing folded
+    set_flag("streaming_scan", False)
+    try:
+        assert got == sess.query("SELECT STDDEV(v) sd FROM t")
+    finally:
+        set_flag("streaming_scan", True)
+
+
+# ---- zone maps ------------------------------------------------------------
+
+def test_zonemap_skips_chunks(sess):
+    """id is monotone in insert order, so chunk zone maps carry disjoint
+    id ranges: WHERE id >= 384 keeps only the last two chunks — the rest
+    skip BEFORE any host->device transfer."""
+    _load(sess)
+    skip0 = metrics.stream_chunks_skipped.value
+    streamed, resident, folded = _both(
+        sess,
+        "SELECT g, COUNT(*) n, SUM(v) s FROM t WHERE id >= 384 "
+        "GROUP BY g ORDER BY g")
+    assert streamed == resident
+    assert metrics.stream_chunks_skipped.value - skip0 >= 4
+    assert folded <= 2
+
+
+def test_zonemap_prunes_everything(sess):
+    """No chunk survives: the fold still runs once over a dead chunk so
+    COUNT renders 0 (a row), not an empty result set."""
+    _load(sess, n=100)
+    streamed, resident, folded = _both(
+        sess, "SELECT COUNT(*) n, SUM(v) s FROM t WHERE id > 100000")
+    assert streamed == resident == [{"n": 0, "s": None}]
+    assert folded == 0                  # dead folds don't count chunks
+
+
+# ---- the off-switch -------------------------------------------------------
+
+def test_off_switch_resident_path(sess):
+    _load(sess, n=100)
+    set_flag("streaming_scan", False)
+    c0 = metrics.stream_chunks.value
+    got = sess.query("SELECT g, SUM(v) s FROM t GROUP BY g ORDER BY g")
+    assert metrics.stream_chunks.value == c0
+    assert len(got) == 7
+
+
+def test_min_rows_gate(sess):
+    set_flag("streaming_min_rows", 10_000)
+    _load(sess, n=100)
+    c0 = metrics.stream_chunks.value
+    sess.query("SELECT SUM(v) s FROM t")
+    assert metrics.stream_chunks.value == c0    # table under the floor
+
+
+# ---- overflow restart -----------------------------------------------------
+
+def test_sorted_overflow_restart(sess, monkeypatch):
+    """Clamp the sorted accumulator to 4 slots after the first compile:
+    500 (sv, v) groups overflow it mid-fold, the runner doubles and
+    re-folds — results stay bit-identical and stream_restarts moves."""
+    _load(sess)
+    orig = StreamRunner._ensure_step
+    state = {"clamped": False}
+
+    def clamped(self, source, params):
+        orig(self, source, params)
+        if not state["clamped"] and self.keys \
+                and self.agg.strategy == "sorted" and self.acc_cap > 4:
+            state["clamped"] = True
+            self.acc_cap = 4
+            self._jit_step = None
+            orig(self, source, params)
+
+    monkeypatch.setattr(StreamRunner, "_ensure_step", clamped)
+    r0 = metrics.stream_restarts.value
+    streamed, resident, _ = _both(
+        sess,
+        "SELECT sv, v, COUNT(*) n, SUM(w) s FROM t "
+        "GROUP BY sv, v ORDER BY sv, v, n")
+    assert state["clamped"]             # the clamp actually bit
+    assert metrics.stream_restarts.value - r0 >= 1
+    assert streamed == resident
+    assert len(streamed) == 500
+
+
+# ---- parameterized re-runs share the runner -------------------------------
+
+def test_param_rebind_same_plan(sess):
+    """Two literals, one plan shape: the cached StreamRunner re-folds with
+    new bound params, no re-trace needed for correctness."""
+    _load(sess)
+    for bound in (100, 300):
+        streamed, resident, _ = _both(
+            sess,
+            f"SELECT g, SUM(v) s, COUNT(*) n FROM t WHERE id < {bound} "
+            "GROUP BY g ORDER BY g")
+        assert streamed == resident
+
+
+# ---- observability surfaces -----------------------------------------------
+
+def test_explain_analyze_stream_line(sess):
+    _load(sess)
+    out = sess.query("EXPLAIN ANALYZE SELECT g, SUM(v) s FROM t "
+                     "WHERE id < 400 GROUP BY g")
+    text = "\n".join(r[next(iter(r))] for r in out)
+    m = re.search(r"-- stream: chunks=(\d+)/(\d+) skipped=(\d+) "
+                  r"bytes_h2d=(\d+) prefetch_wait_ms=([\d.]+) "
+                  r"stage_ms=([\d.]+) restarts=(\d+)", text)
+    assert m, text
+    chunks, total, skipped = int(m.group(1)), int(m.group(2)), int(m.group(3))
+    assert total == 8 and chunks + skipped <= total and chunks >= 4
+    assert int(m.group(4)) > 0          # real bytes moved host->device
+    # the overlap measurement: prefetch wait is what the fold loop BLOCKED
+    # on, staging is the serial copy cost.  Overlap keeps wait under the
+    # serial cost; generous slack absorbs CI timer jitter.
+    wait, stage = float(m.group(5)), float(m.group(6))
+    assert wait <= stage * 1.5 + 50.0
+    assert "stream(" in text            # scan access path names the chunks
+
+
+def test_processlist_has_chunk_columns(sess):
+    _load(sess, n=100)
+    sess.query("SELECT SUM(v) s FROM t")
+    rows = sess.query("SELECT * FROM information_schema.processlist")
+    assert rows and "chunk_no" in rows[0] and "chunks_total" in rows[0]
+
+
+def test_stream_metrics_move(sess):
+    _load(sess)
+    c0 = metrics.stream_chunks.value
+    b0 = metrics.stream_bytes_h2d.value
+    sess.query("SELECT g, SUM(v) s FROM t GROUP BY g")
+    assert metrics.stream_chunks.value - c0 >= 4
+    assert metrics.stream_bytes_h2d.value > b0

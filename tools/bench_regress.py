@@ -38,6 +38,15 @@ split + migration) is gated on its own deterministic counters: zero
 ``lost_writes``, nonzero ``splits``/``migrations``/``handoffs``, and an
 elastic-phase write p99 within ``--elastic-p99-x`` times (default 25)
 the same capture's steady-state p99.
+
+The out-of-core stream line (chunk-folded scan at a data scale above
+the chunk budget) is gated on its fold counters — the scan actually
+streamed (>= 2 chunks, nonzero host->device bytes), every chunk folded
+exactly once (``chunks + skipped == chunks_total``), zero accumulator
+restarts — plus the overlap contract: fold-loop blocked-on-staging time
+within ``--stream-wait-x`` (default 1.05) times the serial staging cost
++5ms.  bit-identity vs the resident path is asserted inside bench.py
+itself before the line is ever emitted.
 """
 
 from __future__ import annotations
@@ -52,7 +61,7 @@ def load_capture(path: str) -> dict:
     or a bench.py JSON-lines capture (the cold-start row is extracted).
     Unknown/summary lines are ignored."""
     out: dict = {"header": None, "queries": {}, "coldstart": None,
-                 "progress": None, "elastic": None}
+                 "progress": None, "elastic": None, "stream": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -76,6 +85,8 @@ def load_capture(path: str) -> dict:
                 out["progress"] = row
             elif str(row.get("metric", "")).startswith("elastic regions"):
                 out["elastic"] = row
+            elif str(row.get("metric", "")).startswith("out-of-core stream"):
+                out["stream"] = row
     return out
 
 
@@ -164,6 +175,47 @@ def compare_elastic(cand: dict, p99_factor: float) -> list:
     return problems
 
 
+def compare_stream(cand: dict, wait_factor: float) -> list:
+    """Out-of-core streaming contract on the candidate capture
+    (skipped/failed lines are ignored).  Hard gates are the deterministic
+    fold counters: the scan actually streamed (>= 2 chunks folded, real
+    bytes host->device), every surviving chunk folded exactly once
+    (chunks + skipped == chunks_total), and no accumulator restarts in
+    the steady benchmark shape.  The prefetch gate is the overlap
+    contract: the time the fold loop BLOCKED on staging must stay within
+    ``--stream-wait-x`` times the serial staging cost (+5ms slack; 0
+    disables) — a broken double-buffer serializes every chunk and blows
+    well past it, while CI timer jitter does not."""
+    c = cand.get("stream")
+    if c is None or c.get("error") or not c.get("value"):
+        return []
+    problems = []
+    if c.get("chunks", 0) < 2:
+        problems.append(f"stream: chunks={c.get('chunks', 0)} — the scan "
+                        f"never actually chunk-folded")
+    if c.get("bytes_h2d", 0) <= 0:
+        problems.append("stream: bytes_h2d=0 — no host->device staging "
+                        "was measured")
+    if c.get("chunks_total") is not None and \
+            c.get("chunks", 0) + c.get("skipped", 0) != c["chunks_total"]:
+        problems.append(
+            f"stream: chunks {c.get('chunks')} + skipped "
+            f"{c.get('skipped')} != total {c['chunks_total']} (a chunk "
+            f"was lost or double-counted)")
+    if c.get("restarts", 0) > 0:
+        problems.append(f"stream: {c['restarts']} accumulator restarts "
+                        f"in the fixed benchmark shape (capacity "
+                        f"estimate regressed)")
+    if wait_factor > 0 and c.get("stage_ms") is not None:
+        lim = c["stage_ms"] * wait_factor + 5.0
+        if c.get("prefetch_wait_ms", 0.0) > lim:
+            problems.append(
+                f"stream: prefetch_wait_ms {c['prefetch_wait_ms']} > "
+                f"{wait_factor}x stage_ms ({c['stage_ms']}) + 5 — the "
+                f"double-buffer is not overlapping staging with compute")
+    return problems
+
+
 def compare(base: dict, cand: dict, wall_clock_pct: float = 0.0) -> list:
     """-> list of human-readable regression strings (empty = clean)."""
     problems = []
@@ -217,11 +269,16 @@ def main(argv=None) -> int:
                     help="elastic-regions write-p99 ceiling as a multiple "
                          "of the same capture's steady-state p99 (0 = "
                          "counters only)")
+    ap.add_argument("--stream-wait-x", type=float, default=1.05,
+                    help="out-of-core stream prefetch-wait ceiling as a "
+                         "multiple of the same capture's serial stage "
+                         "time, +5ms slack (0 = counters only)")
     args = ap.parse_args(argv)
     base = load_capture(args.baseline)
     cand = load_capture(args.candidate)
     if not base["queries"] and base["coldstart"] is None \
-            and cand["progress"] is None and cand["elastic"] is None:
+            and cand["progress"] is None and cand["elastic"] is None \
+            and cand["stream"] is None:
         print(f"bench_regress: no query or cold-start rows in "
               f"{args.baseline}", file=sys.stderr)
         return 2
@@ -229,6 +286,7 @@ def main(argv=None) -> int:
     problems += compare_coldstart(base, cand, args.coldstart_pct)
     problems += compare_progress(cand, args.progress_pct)
     problems += compare_elastic(cand, args.elastic_p99_x)
+    problems += compare_stream(cand, args.stream_wait_x)
     compared = []
     if base["queries"]:
         compared.append(f"{len(base['queries'])} queries")
@@ -238,6 +296,8 @@ def main(argv=None) -> int:
         compared.append("introspection line")
     if cand["elastic"] is not None:
         compared.append("elastic-regions line")
+    if cand["stream"] is not None:
+        compared.append("out-of-core stream line")
     if problems:
         for p in problems:
             print(f"REGRESSION {p}")
