@@ -20,7 +20,9 @@ import os
 import re
 from dataclasses import dataclass, field
 
+from .callgraph import CallGraph
 from .locks import LockGraph
+from .ownership import OwnershipGraph
 from .rules import lint_tree
 
 _INLINE_RE = re.compile(
@@ -29,7 +31,7 @@ _INLINE_RE = re.compile(
 
 RULES = ("HOSTSYNC", "RETRACE", "TRACERLEAK", "LOCKORDER", "BAREEXC",
          "SPANINJIT", "FAILPOINTHOT", "METRICINJIT", "PROGRESSINJIT",
-         "DONATED")
+         "DONATED", "GUARDEDBY", "LOCKHELDBLOCK", "ATOMICITY")
 
 
 @dataclass(frozen=True)
@@ -169,6 +171,8 @@ def run_lint(paths: list[str], config: LintConfig | None = None,
         if config.suppression_file else Suppressions()
     files = _collect_files(paths)
     graph = LockGraph()
+    owners = OwnershipGraph()
+    callgraph = CallGraph()
     raw: list[Violation] = []
     sources: dict[str, list[str]] = {}
     findex: dict[str, _FuncIndex] = {}
@@ -201,13 +205,20 @@ def run_lint(paths: list[str], config: LintConfig | None = None,
 
         lint_tree(tree, config.is_hot(rel), report)
         graph.add_file(rel, tree)
+        owners.add_file(rel, tree)
+        callgraph.add_file(rel, tree)
 
     lock_findings, lock_order, lock_edges = graph.check(sync_sites)
     for lf in lock_findings:
         raw.append(Violation("LOCKORDER", lf.module, lf.line, 0, lf.msg))
-    # introspection for tests/docs: the derived order + raw A->B edges
+    owner_findings, ownership = owners.check(callgraph)
+    for of in owner_findings:
+        raw.append(Violation(of.rule, of.module, of.line, 0, of.msg))
+    # introspection for tests/docs: the derived order + raw A->B edges +
+    # the inferred guarded-by map the runtime witness arms from
     run_lint.last_lock_order = lock_order
     run_lint.last_lock_edges = lock_edges
+    run_lint.last_ownership = ownership
 
     out = []
     for v in raw:
@@ -226,3 +237,4 @@ def run_lint(paths: list[str], config: LintConfig | None = None,
 
 run_lint.last_lock_order = []
 run_lint.last_lock_edges = []
+run_lint.last_ownership = {}

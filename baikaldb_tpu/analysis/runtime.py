@@ -15,9 +15,17 @@ arming two guards when the ``debug_guards`` flag is "log" or "disallow":
   a higher one is an inversion — the dynamic half of LOCKORDER.
   tests/test_lint.py cross-checks the declared ranks against the static
   acquisition graph, so the two layers cannot drift apart.
+- the **lockset witness** is the dynamic half of GUARDEDBY
+  (analysis/ownership.py): classes call ``register_witness`` with their
+  statically-inferred ``{attr: lock}`` ownership, and arming the flag
+  installs ``_OwnedAttr`` data descriptors that assert every access to an
+  owned attribute happens while the owning ``GuardedLock`` is held by the
+  accessing thread — the static model checked against real interleavings
+  by the stress/chaos suites.
 
 Trips surface in ``metrics`` (``guard_transfer_trips`` /
-``guard_lock_trips``) and on the EXPLAIN ANALYZE ``-- guards:`` line.
+``guard_lock_trips`` / ``guard_owner_trips``) and on the EXPLAIN ANALYZE
+``-- guards:`` line.
 
 CPU caveat: on the CPU backend device->host reads are zero-copy views, so
 jax's transfer guard never fires there — the transfer half of debug_guards
@@ -42,6 +50,7 @@ define("debug_guards", "off",
 
 guard_transfer_trips = metrics.Counter("guard_transfer_trips")
 guard_lock_trips = metrics.Counter("guard_lock_trips")
+guard_owner_trips = metrics.Counter("guard_owner_trips")
 
 # the flag is re-read on every lock acquisition of the hottest paths:
 # cache the resolved mode and refresh through the flag listener instead
@@ -52,6 +61,118 @@ def _refresh_mode(value=None) -> None:
     global _MODE
     mode = str(FLAGS.debug_guards if value is None else value).lower()
     _MODE = mode if mode in ("log", "disallow") else "off"
+    _arm_witnesses(_MODE != "off")
+
+
+# -- lockset witness (dynamic GUARDEDBY) ---------------------------------
+
+# registered classes: cls -> (static_id, {attr: lock_attr}); descriptors
+# are installed/removed as the flag flips so production classes stay
+# plain-attribute fast when guards are off
+_WITNESSES: dict = {}
+_ARMED = False
+
+
+class _OwnedAttr:
+    """Data descriptor asserting accesses to a lock-owned instance
+    attribute happen while the owning lock is held BY THIS THREAD.  The
+    value itself lives in the instance ``__dict__`` (the descriptor wins
+    the lookup because it is a data descriptor); the first ``__set__``
+    (construction, before the object is published) is exempt."""
+
+    def __init__(self, name: str, lock_attr: str, static_id: str):
+        self.name = name
+        self.lock_attr = lock_attr
+        self.static_id = static_id
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        try:
+            val = obj.__dict__[self.name]
+        except KeyError:
+            raise AttributeError(self.name) from None
+        self._check(obj, "read")
+        return val
+
+    def __set__(self, obj, value):
+        if self.name in obj.__dict__:      # first set = construction
+            self._check(obj, "write")
+        obj.__dict__[self.name] = value
+
+    def __delete__(self, obj):
+        self._check(obj, "delete")
+        del obj.__dict__[self.name]
+
+    def _check(self, obj, verb: str) -> None:
+        if _MODE == "off":      # descriptors may outlive a flag flip
+            return
+        lk = getattr(obj, self.lock_attr, None)
+        if lk is None:
+            return
+        if isinstance(lk, GuardedLock):
+            held = lk.held_by_me()
+        else:                   # plain lock: best effort (any holder)
+            held = bool(getattr(lk, "locked", lambda: True)())
+        if held:
+            return
+        guard_owner_trips.add(1)
+        msg = (f"lockset witness: {verb} of {self.static_id}.{self.name} "
+               f"without holding self.{self.lock_attr} (statically "
+               "inferred owner — analysis/ownership.py)")
+        if _MODE == "disallow":
+            raise RuntimeError(msg)
+        import sys
+        print(f"tpulint-guard: {msg}", file=sys.stderr)
+
+
+def register_witness(cls, static_id: str,
+                     attrs: dict | None = None) -> None:
+    """Enroll ``cls`` in the lockset witness.  ``attrs`` ({attr:
+    lock_attr}) defaults to the static pass's inferred ownership for
+    ``static_id`` (``analysis.ownership.package_ownership()``), resolved
+    lazily at ARM time so import-time registration costs nothing.
+    Installs immediately if guards are already armed."""
+    if getattr(cls, "__slots__", None) is not None:
+        return                  # no instance __dict__ to host the values
+    _WITNESSES[cls] = (static_id, attrs)
+    if _ARMED:
+        _install_witness(cls, static_id, attrs)
+
+
+def _resolve_attrs(static_id: str, attrs: dict | None) -> dict:
+    if attrs is not None:
+        return attrs
+    from .ownership import package_ownership
+    return dict(package_ownership().get(static_id, {}))
+
+
+def _install_witness(cls, static_id, attrs) -> None:
+    for attr, lock_attr in _resolve_attrs(static_id, attrs).items():
+        if not isinstance(cls.__dict__.get(attr), _OwnedAttr):
+            setattr(cls, attr, _OwnedAttr(attr, lock_attr, static_id))
+
+
+def _arm_witnesses(on: bool) -> None:
+    global _ARMED
+    if on == _ARMED:
+        return
+    _ARMED = on
+    for cls, (static_id, attrs) in _WITNESSES.items():
+        if on:
+            _install_witness(cls, static_id, attrs)
+        else:
+            for attr, cur in list(cls.__dict__.items()):
+                if isinstance(cur, _OwnedAttr):
+                    delattr(cls, attr)
+
+
+def witness_stats() -> dict:
+    """Introspection: armed state + per-class witnessed attrs (resolved
+    view — triggers the static parse when defaults are in play)."""
+    return {"armed": _ARMED,
+            "classes": {sid: sorted(_resolve_attrs(sid, attrs))
+                        for sid, attrs in _WITNESSES.values()}}
 
 
 _refresh_mode()
@@ -169,9 +290,17 @@ class GuardedLock:
         lk = self._lk
         return lk.locked() if hasattr(lk, "locked") else False
 
+    def held_by_me(self) -> bool:
+        """Whether THIS thread is inside the lock.  Stack-based, so only
+        meaningful while debug_guards is armed (acquisitions made with
+        guards off were never pushed — the same best-effort window as
+        the order check, see the class docstring)."""
+        return self in self._stack()
+
 
 def guard_stats() -> dict:
     """The EXPLAIN ANALYZE / SHOW METRICS payload."""
     return {"mode": guard_mode(),
             "transfer_trips": guard_transfer_trips.value,
-            "lock_trips": guard_lock_trips.value}
+            "lock_trips": guard_lock_trips.value,
+            "owner_trips": guard_owner_trips.value}

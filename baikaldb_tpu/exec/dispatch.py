@@ -146,8 +146,15 @@ class BatchDispatcher:
     """One per Database: engine-wide, so queries from DIFFERENT sessions
     (connections) coalesce — that is the whole point."""
 
+    # ranked below store.table_lock(10): the combiner only holds its lock
+    # for map bookkeeping — never across device work or store calls
+    RANK = 4
+
     def __init__(self):
-        self._mu = threading.Lock()
+        # the lockset witness (debug_guards) asserts the dispatcher maps
+        # are only touched under this lock
+        from ..analysis.runtime import GuardedLock
+        self._mu = GuardedLock("dispatch.combine_mu", rank=self.RANK)
         self._groups: dict = {}          # group_key -> _Group (queued only)
         self._inflight: dict = {}        # group_key -> runs in flight
         # ck_base -> the plan object every batched compile of this statement
@@ -409,9 +416,12 @@ class BatchDispatcher:
                         pair = None
                     elif pair is not None:
                         self._compiled.move_to_end(ck)
+                    # membership read under the same lock as the .add in
+                    # the fallback path — combiner ticks race session
+                    # threads here
+                    aot_ok = aot_key not in self._aot_bad
                 if pair is None and compilecache.AOT.enabled() \
-                        and get_aot_key() is not None \
-                        and aot_key not in self._aot_bad:
+                        and get_aot_key() is not None and aot_ok:
                     art = compilecache.AOT.load(aot_key)
                     if art is not None and isinstance(
                             (art.extra or {}).get("egress_meta"), tuple):
@@ -492,7 +502,8 @@ class BatchDispatcher:
                 if isinstance(raw, AotRawShim):
                     # live data outgrew the artifact's baked caps: drop it
                     # for this process and compile fresh
-                    self._aot_bad.add(aot_key)
+                    with self._mu:
+                        self._aot_bad.add(aot_key)
                     metrics.aot_cache_fallbacks.add(1)
                 with self._mu:
                     self._compiled.pop(ck, None)   # caps changed: re-trace
@@ -519,3 +530,17 @@ class BatchDispatcher:
         # carry their compacted host batch
         return [None if m.err is not None else o
                 for m, o in zip(ws, outs)]
+
+
+# lockset witness enrollment: debug_guards=log|disallow installs
+# per-attribute assertions from the static ownership map (the dispatcher
+# is the canonical witnessed class — its maps are mutated by every
+# session thread plus the combiner)
+from ..analysis.runtime import LOCK_RANKS as _LOCK_RANKS  # noqa: E402
+from ..analysis.runtime import register_witness  # noqa: E402
+
+register_witness(BatchDispatcher,
+                 "baikaldb_tpu/exec/dispatch.py:BatchDispatcher")
+# rank visible at import (docs/LINT.md table is pinned against the
+# registry by test_lint.py without constructing a dispatcher)
+_LOCK_RANKS.setdefault("dispatch.combine_mu", BatchDispatcher.RANK)

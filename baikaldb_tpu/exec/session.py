@@ -4011,7 +4011,8 @@ class Session:
         gs = guard_stats()
         trace.event("guards", mode=gs["mode"],
                     transfer_trips=gs["transfer_trips"],
-                    lock_trips=gs["lock_trips"])
+                    lock_trips=gs["lock_trips"],
+                    owner_trips=gs["owner_trips"])
         # cross-query batched dispatch: whether this statement's shape is
         # served by the combiner under concurrency, plus engine-wide tick
         # telemetry (EXPLAIN ANALYZE itself always runs inline)
@@ -4102,7 +4103,8 @@ class Session:
             a = s["attrs"]
             lines.append(f"-- guards: mode={a['mode']} "
                          f"transfer_trips={a['transfer_trips']} "
-                         f"lock_trips={a['lock_trips']}")
+                         f"lock_trips={a['lock_trips']} "
+                         f"owner_trips={a.get('owner_trips', 0)}")
         for s in find("dispatch"):
             a = s["attrs"]
             lines.append(f"-- dispatch: enabled={int(a['enabled'])} "

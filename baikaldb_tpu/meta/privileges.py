@@ -101,31 +101,36 @@ class PrivilegeManager:
             u.grants.pop(db, None)
 
     # -- checks -----------------------------------------------------------
+    # (the read paths hold _mu too: grant/revoke mutate UserInfo.grants
+    # in place, so a lockless reader could see a half-applied grant)
     def authenticate(self, name: str, salt: bytes, response: bytes) -> bool:
-        u = self.users.get(name)
-        if u is None:
-            return False
-        if u.auth is None:
-            return len(response) == 0
-        return scramble_check(u.auth, salt, response)
+        with self._mu:
+            u = self.users.get(name)
+            if u is None:
+                return False
+            if u.auth is None:
+                return len(response) == 0
+            return scramble_check(u.auth, salt, response)
 
     def check(self, name: str, db: str, need: str):
         """Raise unless ``name`` holds ``need`` ("read"|"write") on ``db``."""
-        u = self.users.get(name)
-        if u is None:
-            raise AccessError(f"Access denied for user {name!r}")
-        if u.is_super or db == "information_schema" and need == READ:
-            return
-        lv = u.grants.get(db) or u.grants.get("*")
+        with self._mu:
+            u = self.users.get(name)
+            if u is None:
+                raise AccessError(f"Access denied for user {name!r}")
+            if u.is_super or db == "information_schema" and need == READ:
+                return
+            lv = u.grants.get(db) or u.grants.get("*")
         if lv is None or (need == WRITE and lv != WRITE):
             raise AccessError(f"Access denied for user {name!r} to "
                               f"database {db!r}")
 
     def grants_of(self, name: str) -> list[tuple[str, str]]:
-        u = self.users.get(name)
-        if u is None:
-            return []
-        if u.is_super:
-            return [("*", "ALL")]
-        return sorted((db, "ALL" if lv == WRITE else "SELECT")
-                      for db, lv in u.grants.items())
+        with self._mu:
+            u = self.users.get(name)
+            if u is None:
+                return []
+            if u.is_super:
+                return [("*", "ALL")]
+            return sorted((db, "ALL" if lv == WRITE else "SELECT")
+                          for db, lv in u.grants.items())

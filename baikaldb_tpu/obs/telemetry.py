@@ -325,12 +325,19 @@ class Telemetry:
     """One per Database: registered daemon addresses, their cached
     snapshots with staleness state, and the merged fleet view."""
 
+    # below store.table_lock(10): scrape bookkeeping never wraps storage
+    RANK = 6
+
     def __init__(self, local_name: str = "frontend", registry=None,
                  device_gauges: bool = True):
         self.local_name = local_name
         self.registry = registry if registry is not None \
             else metrics.REGISTRY
-        self._mu = threading.Lock()          # registration + cache dict
+        # registration + cache dict; ranked GuardedLock so the lockset
+        # witness can assert _clients/_cache stay under it (RPC scrapes
+        # themselves run OUTSIDE the lock — see poll)
+        from ..analysis.runtime import GuardedLock
+        self._mu = GuardedLock("telemetry.scrape_mu", rank=self.RANK)
         self._clients: dict[str, object] = {}
         # addr -> {"snapshot", "ts", "ok", "error"}; kept across failures
         # so a down daemon's last-known rows survive, marked stale
@@ -602,3 +609,12 @@ def start_http_exporter(render: Callable[[], str], port: int,
     threading.Thread(target=srv.serve_forever, daemon=True,
                      name=f"metrics-http-{srv.server_address[1]}").start()
     return srv
+
+
+# lockset witness enrollment (see analysis/runtime.py): the poller thread
+# and inline-scrape query threads share the client/cache maps
+from ..analysis.runtime import LOCK_RANKS as _LOCK_RANKS  # noqa: E402
+from ..analysis.runtime import register_witness  # noqa: E402
+
+register_witness(Telemetry, "baikaldb_tpu/obs/telemetry.py:Telemetry")
+_LOCK_RANKS.setdefault("telemetry.scrape_mu", Telemetry.RANK)

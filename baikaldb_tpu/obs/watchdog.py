@@ -49,9 +49,15 @@ class Watchdog:
     the base class handles episode dedup, counters, the background
     thread, and the health/status renderings."""
 
+    # ranked below store.table_lock(10): StoreWatchdog.probe reads the
+    # region map while holding the scan lock, never the other way around
+    RANK = 8
+
     def __init__(self, name: str = "frontend"):
+        from ..analysis.runtime import GuardedLock
+
         self.name = name
-        self._mu = threading.Lock()
+        self._mu = GuardedLock("watchdog.scan_mu", rank=self.RANK)
         self._live: dict[str, dict] = {}      # subject -> stall record
         self._detected_total = 0
         self._last_scan = 0.0
@@ -64,10 +70,13 @@ class Watchdog:
 
     # -- scanning ----------------------------------------------------------
     def scan_now(self) -> list[dict]:
-        """One synchronous scan; -> the currently-live stall records."""
-        found = dict(self.probe())
-        now = time.time()
+        """One synchronous scan; -> the currently-live stall records.
+        The probe runs under _mu too: the background thread and a health
+        RPC can scan concurrently, and StoreWatchdog.probe mutates its
+        _apply_seen tracking dict in place."""
         with self._mu:
+            found = dict(self.probe())
+            now = time.time()
             self._last_scan = now
             for subject, detail in found.items():
                 rec = self._live.get(subject)
@@ -197,3 +206,12 @@ class StoreWatchdog(Watchdog):
         for rid in stale:                     # dropped/migrated region
             self._apply_seen.pop(rid, None)
         return out
+
+
+# lockset witness enrollment (see analysis/runtime.py): stall records are
+# mutated by the scan thread and health RPCs concurrently
+from ..analysis.runtime import LOCK_RANKS as _LOCK_RANKS  # noqa: E402
+from ..analysis.runtime import register_witness  # noqa: E402
+
+register_witness(Watchdog, "baikaldb_tpu/obs/watchdog.py:Watchdog")
+_LOCK_RANKS.setdefault("watchdog.scan_mu", Watchdog.RANK)

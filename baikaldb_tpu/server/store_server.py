@@ -266,9 +266,11 @@ class StoreServer:
         apply lag, plus the daemon identity a fleet prober wants in the
         same answer."""
         h = self.watchdog.health()
+        with self._mu:
+            n_regions = len(self.regions)
         h.update(daemon=self.address, role="store", store_id=self.store_id,
                  uptime_s=round(time.time() - self._started, 3),
-                 regions=len(self.regions))
+                 regions=n_regions)
         return h
 
     def rpc_prometheus(self):
@@ -325,7 +327,7 @@ class StoreServer:
         from ..raft.cluster import (CMD_PREPARE, CMD_WRITE, decode_cmd,
                                     decode_ops)
 
-        region = self.regions.get(int(region_id))
+        region = self._region(region_id)
         if region is None:
             return {"status": "no_region"}
         if failpoint.ENABLED:
@@ -386,7 +388,7 @@ class StoreServer:
         return None
 
     def rpc_scan_raw(self, region_id: int):
-        region = self.regions.get(int(region_id))
+        region = self._region(region_id)
         if region is None:
             return {"status": "no_region"}
         with self._mu:
@@ -422,7 +424,7 @@ class StoreServer:
         from ..obs import trace
         from ..plan.fragment import run_fragment
 
-        region = self.regions.get(int(region_id))
+        region = self._region(region_id)
         if region is None:
             return {"status": "no_region"}
         with self._mu, trace.span("store.fragment",
@@ -466,7 +468,7 @@ class StoreServer:
         """Prepared (in-doubt) txns + decision records of one region — the
         reference's in-doubt recovery query (region.cpp:684
         exec_txn_query_primary_region)."""
-        region = self.regions.get(int(region_id))
+        region = self._region(region_id)
         if region is None:
             return {"status": "no_region"}
         with self._mu:
@@ -487,7 +489,7 @@ class StoreServer:
         """This region's raft-committed cold-tier manifest (segment files
         live on the external FS; the manifest is the consensus truth —
         region_olap.cpp:727-882)."""
-        region = self.regions.get(int(region_id))
+        region = self._region(region_id)
         if region is None:
             return {"status": "no_region"}
         with self._mu:
@@ -502,7 +504,7 @@ class StoreServer:
     def rpc_region_size(self, region_id: int):
         """Live-key count + committed range of this region (the split
         trigger's size signal; leaders only so the count is current)."""
-        region = self.regions.get(int(region_id))
+        region = self._region(region_id)
         if region is None:
             return {"status": "no_region"}
         with self._mu:
@@ -553,16 +555,26 @@ class StoreServer:
             if client is not None:
                 client.try_call("raft_msg", region_id=rid, msg=msg)
 
+    def _region(self, region_id: int):
+        """Region lookup under the core lock — rpc_create_region /
+        rpc_drop_region mutate the map from other serve threads, and a
+        dict read racing a resize is exactly the torn lookup GUARDEDBY
+        exists for.  Handlers re-take _mu for the region's state."""
+        with self._mu:
+            return self.regions.get(int(region_id))
+
     def _client_of(self, store_id: int) -> Optional[RpcClient]:
         if store_id == self.store_id:
             return None
-        c = self._peer_clients.get(store_id)
-        if c is None:
-            addr = self._peer_addr.get(store_id)
-            if addr is None:
-                return None
-            c = self._peer_clients[store_id] = RpcClient(addr, timeout=2.0)
-        return c
+        with self._mu:
+            c = self._peer_clients.get(store_id)
+            if c is None:
+                addr = self._peer_addr.get(store_id)
+                if addr is None:
+                    return None
+                c = self._peer_clients[store_id] = RpcClient(addr,
+                                                             timeout=2.0)
+            return c
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.is_set():

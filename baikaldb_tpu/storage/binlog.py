@@ -97,6 +97,14 @@ class Binlog:
         self._oldest_ts = 0       # checkpoint/GC watermark (reference:
         #                           oldest-ts tracking, region_binlog.cpp:449)
         self._table = None
+        # serializes backend file writes against compaction's rewrite+swap:
+        # _persist runs OUTSIDE _mu by design (durable-before-visible with
+        # no readers stalled behind disk I/O), so without this a concurrent
+        # _compact_log_locked could os.replace the log while an append is
+        # mid-write_batch to the old table — that append's event would
+        # vanish from the post-swap file (lost on recovery).  Order: _mu is
+        # taken first when both are held; nothing takes _mu under _wal_mu.
+        self._wal_mu = threading.Lock()
         self._path = path
         self._cursors: dict[str, int] = {}
         self._trimmed_since_compact = 0
@@ -129,8 +137,11 @@ class Binlog:
             self.tso.restore((max_ts >> Tso.LOGICAL_BITS) + 1)
 
     def _persist(self, ops: list[tuple[int, bytes, bytes]]):
-        if self._table is not None and ops:
-            self._table.write_batch(ops)   # appends + flushes the WAL
+        if self._path is None or not ops:
+            return
+        with self._wal_mu:      # the swap (compaction) can't run mid-write
+            if self._table is not None:
+                self._table.write_batch(ops)   # appends + flushes the WAL
 
     def _compact_log_locked(self):
         """Rewrite the backing log to live state only (ring + cursors +
@@ -138,24 +149,25 @@ class Binlog:
         compaction that keeps recovery O(capacity).  Caller holds _mu."""
         from .rowstore import RowTable
 
-        tmp = self._path + ".compact"
-        if os.path.exists(tmp):
-            os.remove(tmp)
-        nt = RowTable(_schema(), ["k"], wal_path=tmp)
-        ops = [(0, _ekey(e.commit_ts),
-                json.dumps(asdict(e), default=str).encode())
-               for e in self._events]
-        ops += [(0, _CUR + n.encode(), struct.pack("<Q", p))
-                for n, p in self._cursors.items()]
-        if self._oldest_ts:
-            ops.append((0, _GCW, struct.pack("<Q", self._oldest_ts)))
-        if ops:
-            nt.write_batch(ops)
-        # POSIX rename: nt keeps writing the (renamed) file; the old
-        # table's file handle dies with the object
-        os.replace(tmp, self._path)
-        self._table = nt
-        self._trimmed_since_compact = 0
+        with self._wal_mu:      # no append may be mid-write to the old log
+            tmp = self._path + ".compact"
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            nt = RowTable(_schema(), ["k"], wal_path=tmp)
+            ops = [(0, _ekey(e.commit_ts),
+                    json.dumps(asdict(e), default=str).encode())
+                   for e in self._events]
+            ops += [(0, _CUR + n.encode(), struct.pack("<Q", p))
+                    for n, p in self._cursors.items()]
+            if self._oldest_ts:
+                ops.append((0, _GCW, struct.pack("<Q", self._oldest_ts)))
+            if ops:
+                nt.write_batch(ops)
+            # POSIX rename: nt keeps writing the (renamed) file; the old
+            # table's file handle dies with the object
+            os.replace(tmp, self._path)
+            self._table = nt
+            self._trimmed_since_compact = 0
 
     # -- writes ------------------------------------------------------------
     def append(self, event_type: str, database: str, table: str,

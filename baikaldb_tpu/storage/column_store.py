@@ -262,6 +262,9 @@ class TableStore:
     scans) AND mirror into the row tier for WAL durability; the device cache
     refreshes lazily."""
 
+    # rank 10 — acquired FIRST on the write path (see __init__ comment)
+    RANK = 10
+
     def __init__(self, info: TableInfo, region_rows: int = DEFAULT_REGION_ROWS,
                  wal_path: str | None = None):
         self.info = info
@@ -273,7 +276,8 @@ class TableStore:
         # statically-derived order (tools/tpulint.py --lock-order),
         # asserted when debug_guards is on
         from ..analysis.runtime import GuardedLock
-        self._lock = GuardedLock("store.table_lock", rank=10, reentrant=True)
+        self._lock = GuardedLock("store.table_lock", rank=self.RANK,
+                                 reentrant=True)
         self._mutations = 0
         self._next_region = 1
         self._next_rowid = 1
@@ -404,8 +408,9 @@ class TableStore:
         rowid watermark (shared by WAL and replicated recovery)."""
         if rows:
             self._apply_deltas(rows)
-        for r in rows:
-            self._next_rowid = max(self._next_rowid, int(r[ROWID]) + 1)
+        with self._lock:        # reentrant; watermark races with inserts
+            for r in rows:
+                self._next_rowid = max(self._next_rowid, int(r[ROWID]) + 1)
 
     def _apply_deltas(self, rows: list[dict]):
         """Replay WAL rows (inserts / updates / __del markers) over cold."""
@@ -1100,18 +1105,21 @@ class TableStore:
     def _ensure_pk_index(self):
         if self._pk_codec is None:
             return None
-        if self._pk_index is None or self._pk_stale:
-            idx: dict = {}
-            with self._lock:
+        # staleness check + rebuild + publish under one critical section:
+        # two lookups racing a write could otherwise both see stale, and
+        # the later (older) rebuild would overwrite the fresher index
+        with self._lock:
+            if self._pk_index is None or self._pk_stale:
+                idx: dict = {}
                 for reg in self.regions:
                     if not reg.num_rows:
                         continue
                     keys = self._encode_pk_table(reg.data)
                     for k, rid in zip(keys, reg.rowids):
                         idx[k] = int(rid)
-            self._pk_index = idx
-            self._pk_stale = False
-        return self._pk_index
+                self._pk_index = idx
+                self._pk_stale = False
+            return self._pk_index
 
     def _encode_pk_table(self, table: pa.Table) -> list[bytes]:
         cols, valids = [], []
@@ -1607,3 +1615,10 @@ def _coerce(table: pa.Table, schema: pa.Schema) -> pa.Table:
         else:
             cols.append(table.column(f.name).cast(f.type))
     return pa.table(cols, schema=schema)
+
+
+# rank visible at import: docs/LINT.md's rank table is pinned against the
+# runtime registry by test_lint.py without building a store
+from ..analysis.runtime import LOCK_RANKS as _LOCK_RANKS  # noqa: E402
+
+_LOCK_RANKS.setdefault("store.table_lock", TableStore.RANK)

@@ -127,6 +127,9 @@ def write_ops_atomic(pairs: list[tuple["ReplicatedRowTier", list]]) -> None:
 class ReplicatedRowTier:
     """One table's raft-replicated row tier: range-routed region groups."""
 
+    # rank 30 — the INNERMOST lock of the write path (see __init__ comment)
+    RANK = 30
+
     def __init__(self, fleet: "StoreFleet", table_id: int, table_key: str,
                  row_schema: Schema, key_columns: list[str],
                  split_rows: int = 0):
@@ -155,7 +158,7 @@ class ReplicatedRowTier:
         # binlog retry lock (20) are both held when write_ops lands here,
         # and code under this lock never takes either of them back
         from ..analysis.runtime import GuardedLock
-        self._mu = GuardedLock("replicated.tier_mu", rank=30,
+        self._mu = GuardedLock("replicated.tier_mu", rank=self.RANK,
                                reentrant=True)
 
     @classmethod
@@ -791,12 +794,18 @@ class ReplicatedRowTier:
         reference where column DDL rewrites region state through raft
         (ddl_manager.cpp + region apply)."""
         self.release_regions()
-        self.row_schema = row_schema
-        self.metas = self.fleet.create_table_regions(
+        metas = self.fleet.create_table_regions(
             self.table_id, 1, schema=row_schema,
             key_columns=self.key_columns)
-        self.groups = [self.fleet.group(m.region_id) for m in self.metas]
-        self._starts, self._ends = [b""], [b""]
+        groups = [self.fleet.group(m.region_id) for m in metas]
+        # fleet calls stay outside the lock; the five routing attrs swap
+        # together under it so a concurrent reader never sees new metas
+        # with old starts (torn routing mid-ALTER)
+        with self._mu:
+            self.row_schema = row_schema
+            self.metas = metas
+            self.groups = groups
+            self._starts, self._ends = [b""], [b""]
         if ops:
             self.write_ops(ops)
 
@@ -804,7 +813,9 @@ class ReplicatedRowTier:
         """Retire this tier's raft groups from the fleet and the meta
         routing table (DROP TABLE / schema reset — without this, dropped
         tables' replicas would heartbeat and balance forever)."""
-        for m in self.metas:
+        with self._mu:
+            metas = list(self.metas)
+        for m in metas:
             self.fleet.retire_region(m.region_id)
 
     def alloc_rowids(self, n: int, floor: int = 0) -> int:
@@ -814,7 +825,9 @@ class ReplicatedRowTier:
 
     def compact_all(self) -> None:
         """Snapshot every replica's state into its core, truncating logs."""
-        for g in self.groups:
+        with self._mu:
+            groups = list(self.groups)
+        for g in groups:
             for node in g.bus.nodes.values():
                 node.compact()
 
@@ -826,3 +839,10 @@ class ReplicatedRowTier:
             except RuntimeError:
                 return False
             return True
+
+
+# rank visible at import: docs/LINT.md's rank table is pinned against the
+# runtime registry by test_lint.py without building a tier
+from ..analysis.runtime import LOCK_RANKS as _LOCK_RANKS  # noqa: E402
+
+_LOCK_RANKS.setdefault("replicated.tier_mu", ReplicatedRowTier.RANK)

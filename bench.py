@@ -611,6 +611,73 @@ def run_progress_bench() -> dict:
     }
 
 
+def run_guard_bench() -> dict:
+    """Runtime-guard overhead on the point-query steady state: the SAME
+    cached one-shape workload measured with ``debug_guards=off`` (plain C
+    locks, plain attributes) and with ``debug_guards=disallow`` — which
+    arms the GuardedLock rank bookkeeping AND the lockset-witness data
+    descriptors over every enrolled class's owned attributes
+    (analysis/runtime.py).  The contract (docs/LINT.md): the assertions
+    are a diagnostic mode, but they must stay cheap enough to leave on in
+    stress/chaos CI — single-digit-percent, not multiples."""
+    import pyarrow as pa
+
+    from baikaldb_tpu.exec.session import Session
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    n_rows = int(os.environ.get("BENCH_GUARD_ROWS", 100_000))
+    n_q = int(os.environ.get("BENCH_GUARD_QUERIES", 64))
+    rng = np.random.default_rng(31)
+    base = pa.table({
+        "id": np.arange(n_rows, dtype=np.int64),
+        "v": rng.normal(size=n_rows).astype(np.float64),
+    })
+
+    def phase(guards_on: bool, its: int) -> float:
+        set_flag("debug_guards", "disallow" if guards_on else "off")
+        s = Session()
+        s.execute("CREATE TABLE gd (id BIGINT, v DOUBLE)")
+        s.load_arrow("gd", base)
+        s.query("SELECT v FROM gd WHERE id = 0")      # plan + first compile
+        t0 = time.perf_counter()
+        for i in range(its):
+            s.query(f"SELECT v FROM gd "
+                    f"WHERE id = {1 + (i * 9173) % n_rows}")
+        return time.perf_counter() - t0
+
+    prev = str(FLAGS.debug_guards)
+    try:
+        off_dt = phase(False, n_q)
+        on_dt = phase(True, n_q)
+    finally:
+        set_flag("debug_guards", prev)
+    off_per, on_per = off_dt / n_q, on_dt / n_q
+    platform = None
+    try:
+        import jax
+        platform = jax.devices()[0].platform
+    except Exception:                                   # noqa: BLE001
+        pass
+    return {
+        "metric": f"point-query steady state with debug_guards=disallow "
+                  f"(lockset witness + rank asserts) vs off "
+                  f"({n_rows / 1e3:.0f}k rows, {n_q} queries, {platform})",
+        "value": round(n_q / on_dt, 1),
+        "unit": "queries/sec",
+        # >1 means arming the guards made it slower
+        "vs_baseline": round(on_per / off_per, 3),
+        "overhead_pct": round((on_per / off_per - 1.0) * 100, 2),
+        "platform": platform,
+        "rows": n_rows,
+        "queries": n_q,
+        "per_query_ms_guards_on": round(on_per * 1e3, 2),
+        "per_query_ms_guards_off": round(off_per * 1e3, 2),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
 def run_telemetry_bench() -> dict:
     """Telemetry-plane overhead guard (eighth JSON line): the point-query
     steady state with the fleet telemetry poller scraping two REAL
@@ -1699,6 +1766,30 @@ def _emit_progress_line(skip_reason: str | None = None):
     print(json.dumps(result))
 
 
+def _emit_guard_line(skip_reason: str | None = None):
+    """Runtime-guard JSON line: debug_guards=disallow (lockset witness +
+    rank asserts) overhead on the point-query steady state.  Same
+    robustness contract: always prints a line, never raises."""
+    if os.environ.get("BENCH_SKIP_GUARD") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "point-query steady state with debug_guards="
+                      "disallow vs off (skipped)",
+            "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+            "platform": "none", "error": skip_reason}))
+        return
+    try:
+        result = run_guard_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "point-query steady state with debug_guards="
+                            "disallow vs off (failed)",
+                  "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+                  "platform": "none",
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_point_line(skip_reason: str | None = None):
     """Third JSON line: point-query steady state (parameterized plan-cache
     reuse).  Same robustness contract: always prints a line, never raises."""
@@ -1776,6 +1867,8 @@ def main():
                 _emit_coldstart_line()  # cpu-subprocess: safe when wedged
                 _emit_progress_line(skip_reason="accelerator probe "
                                     "failed; progress phase skipped")
+                _emit_guard_line(skip_reason="accelerator probe "
+                                 "failed; guard phase skipped")
                 _emit_elastic_line(skip_reason="accelerator probe "
                                    "failed; elastic phase skipped")
                 _emit_stream_line(skip_reason="accelerator probe "
@@ -1822,6 +1915,7 @@ def main():
             _emit_telemetry_line()
             _emit_coldstart_line()
             _emit_progress_line()
+            _emit_guard_line()
             _emit_elastic_line()
             _emit_stream_line()
             return 0
@@ -1835,6 +1929,7 @@ def main():
     _emit_telemetry_line()
     _emit_coldstart_line()
     _emit_progress_line()
+    _emit_guard_line()
     _emit_elastic_line()
     _emit_stream_line()
     return 0

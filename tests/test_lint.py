@@ -7,6 +7,7 @@ zero violations against tools/tpulint_suppressions.txt forever.
 """
 
 import os
+import re
 import subprocess
 import sys
 import textwrap
@@ -591,6 +592,144 @@ def test_donated_only_listed_positions(tmp_path):
 
 # ---- the CI policy: the tree stays clean ----------------------------------
 
+# ---- GUARDEDBY ------------------------------------------------------------
+
+def test_guardedby_unguarded_read_and_write(tmp_path):
+    # _jobs is owned by _mu (majority of mutation sites hold it) and Pool
+    # is concurrent (poll_loop/serve are thread-entry names): the lockless
+    # read and the lockless write both race
+    out = lint_src(tmp_path, """\
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._jobs = {}
+
+            def add(self, key, val):
+                with self._mu:
+                    self._jobs[key] = val
+
+            def drop(self, key):
+                with self._mu:
+                    self._jobs.pop(key, None)
+
+            def poll_loop(self):
+                return len(self._jobs)
+
+            def serve(self):
+                self._jobs["x"] = 1
+        """)
+    assert out == [("GUARDEDBY", 17), ("GUARDEDBY", 20)]
+
+
+def test_guardedby_swap_publish_read_clean(tmp_path):
+    # every mutation of _snap is a whole-attribute rebind under the lock:
+    # the lockless read is an atomic reference load (the copy-then-rebind
+    # publish idiom) — the swap-publish downgrade keeps it clean
+    out = lint_src(tmp_path, """\
+        import threading
+
+        class Catalog:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._snap = {}
+
+            def publish(self, key, val):
+                with self._mu:
+                    nxt = dict(self._snap)
+                    nxt[key] = val
+                    self._snap = nxt
+
+            def poll_loop(self):
+                return self._snap.get("x")
+        """)
+    assert out == []
+
+
+# ---- LOCKHELDBLOCK --------------------------------------------------------
+
+def test_lockheldblock_sleep_under_lock(tmp_path):
+    out = lint_src(tmp_path, """\
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def poll_loop(self):
+                with self._mu:
+                    time.sleep(0.05)
+        """)
+    assert out == [("LOCKHELDBLOCK", 10)]
+
+
+def test_lockheldblock_snapshot_then_sleep_clean(tmp_path):
+    out = lint_src(tmp_path, """\
+        import threading
+        import time
+
+        class Poller:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._n = 0
+
+            def poll_loop(self):
+                with self._mu:
+                    n = self._n
+                time.sleep(0.05)
+                return n
+        """)
+    assert out == []
+
+
+# ---- ATOMICITY ------------------------------------------------------------
+
+def test_atomicity_check_then_act(tmp_path):
+    # the if-test reads _ents without the lock, the body re-acquires it to
+    # act — ATOMICITY on the if, plus GUARDEDBY on the lockless test read
+    out = lint_src(tmp_path, """\
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._ents = {}
+
+            def ensure(self, key):
+                if key not in self._ents:
+                    with self._mu:
+                        self._ents[key] = 1
+
+            def poll_loop(self):
+                with self._mu:
+                    return dict(self._ents)
+        """)
+    assert out == [("ATOMICITY", 9), ("GUARDEDBY", 9)]
+
+
+def test_atomicity_lock_around_check_and_act_clean(tmp_path):
+    out = lint_src(tmp_path, """\
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._ents = {}
+
+            def ensure(self, key):
+                with self._mu:
+                    if key not in self._ents:
+                        self._ents[key] = 1
+
+            def poll_loop(self):
+                with self._mu:
+                    return dict(self._ents)
+        """)
+    assert out == []
+
+
 def test_tree_is_clean():
     cfg = LintConfig(suppression_file=os.path.join(
         REPO, "tools", "tpulint_suppressions.txt"))
@@ -660,6 +799,91 @@ def test_declared_ranks_match_static_graph():
             assert ra < rb, f"declared ranks contradict static edge {a}->{b}"
             checked += 1
     assert checked >= 1, "no ranked edge was cross-checked"
+
+
+def test_doc_rank_table_matches_registry():
+    """docs/LINT.md's rank table is the documentation of record; it must
+    agree EXACTLY with the runtime registry (values) and with the source
+    (completeness: every GuardedLock in the package is documented)."""
+    # importing the owning modules registers every production rank
+    import baikaldb_tpu.exec.dispatch  # noqa: F401
+    import baikaldb_tpu.exec.session  # noqa: F401
+    import baikaldb_tpu.obs.telemetry  # noqa: F401
+    import baikaldb_tpu.obs.watchdog  # noqa: F401
+    import baikaldb_tpu.storage.column_store  # noqa: F401
+    import baikaldb_tpu.storage.replicated  # noqa: F401
+
+    rows: dict[str, int] = {}
+    with open(os.path.join(REPO, "docs", "LINT.md"), encoding="utf-8") as f:
+        for line in f:
+            m = re.match(r"\|\s*`([a-z_.]+)`\s*\|\s*(\d+)\s*\|", line)
+            if m:
+                rows[m.group(1)] = int(m.group(2))
+    assert len(rows) >= 6, "the docs/LINT.md rank table went missing"
+    for name, rank in rows.items():
+        assert LOCK_RANKS.get(name) == rank, \
+            f"docs/LINT.md says {name}={rank}, registry says " \
+            f"{LOCK_RANKS.get(name)} — update the table or the code"
+    # completeness: every GuardedLock constructed in the package source
+    # must have a documented rank (tests' ad-hoc locks don't count)
+    src_names: set[str] = set()
+    for dirpath, dirnames, files in os.walk(
+            os.path.join(REPO, "baikaldb_tpu")):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in files:
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as f:
+                    src_names.update(re.findall(
+                        r'GuardedLock\(\s*"([^"]+)"', f.read()))
+    assert src_names == set(rows), \
+        f"rank table out of sync with source: doc-only=" \
+        f"{set(rows) - src_names}, undocumented={src_names - set(rows)}"
+
+
+def test_static_ownership_matches_runtime_witness():
+    """The static GUARDEDBY map and the runtime lockset witness cannot
+    drift: the attrs the witness arms on BatchDispatcher are exactly the
+    exported static ownership, pinned to the known inferred content."""
+    from baikaldb_tpu.analysis.ownership import package_ownership
+    from baikaldb_tpu.analysis.runtime import witness_stats
+    import baikaldb_tpu.exec.dispatch  # noqa: F401 — enrolls the class
+
+    sid = "baikaldb_tpu/exec/dispatch.py:BatchDispatcher"
+    own = package_ownership()
+    # pin the inferred map itself: a rule or code change that silently
+    # alters what the witness asserts must show up here
+    assert own[sid] == {"_groups": "_mu", "_inflight": "_mu",
+                        "occupancy": "_mu", "_compiled": "_mu",
+                        "_plans": "_mu", "_aot_bad": "_mu"}
+    stats = witness_stats()
+    assert stats["classes"][sid] == sorted(own[sid])
+    # and the whole-package run agrees with the cached per-process view
+    cfg = LintConfig(suppression_file=os.path.join(
+        REPO, "tools", "tpulint_suppressions.txt"))
+    run_lint([os.path.join(REPO, "baikaldb_tpu")], cfg, root=REPO)
+    assert run_lint.last_ownership[sid] == own[sid]
+
+
+def test_witness_trips_on_unguarded_access():
+    """Arming debug_guards installs the descriptors; an unguarded read of
+    witnessed state raises in disallow mode and counts an owner trip,
+    while the same read under the lock passes."""
+    from baikaldb_tpu.analysis.runtime import guard_owner_trips
+    from baikaldb_tpu.exec.dispatch import BatchDispatcher
+
+    d = BatchDispatcher()
+    before = guard_owner_trips.value
+    set_flag("debug_guards", "disallow")
+    try:
+        with pytest.raises(RuntimeError, match="lockset witness"):
+            d._plans            # noqa: B018 — the read IS the assertion
+        assert guard_owner_trips.value == before + 1
+        with d._mu:
+            assert isinstance(d._plans, object)   # guarded: passes
+    finally:
+        set_flag("debug_guards", "off")
+    d._plans                    # noqa: B018 — disarmed: plain attribute
 
 
 def test_guarded_lock_runtime_trips():

@@ -44,6 +44,16 @@ _RULE_HELP = {
     "METRICINJIT": "metric add/observe (utils/metrics.py) inside "
                    "jit-traced scope — counts fire per trace, not per "
                    "execution, or capture tracers",
+    "PROGRESSINJIT": "progress beats (obs/progress.py) inside jit-traced "
+                     "scope — beats fire per trace, not per execution",
+    "DONATED": "donated buffer reused after the jit call that consumed it",
+    "GUARDEDBY": "read/write of lock-owned state without the owning lock "
+                 "on a >= 2-thread path (lockset race detection)",
+    "LOCKHELDBLOCK": "RPC / device sync / time.sleep / file I/O while "
+                     "holding a lock — every queued thread inherits the "
+                     "stall",
+    "ATOMICITY": "check-then-act on lock-owned state with the lock "
+                 "dropped between check and act",
 }
 
 
@@ -84,13 +94,18 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--lock-order", action="store_true",
                     help="print the statically-derived lock order and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output: one JSON document with "
+                         "per-violation rule/file/line/col/detail plus "
+                         "summary counts (stable ordering — CI can diff "
+                         "two runs textually); exit codes unchanged")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="print only the summary line")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for r in RULES:
-            print(f"{r:<11} {_RULE_HELP[r]}")
+            print(f"{r:<13} {_RULE_HELP.get(r, '')}")
         return 0
 
     rules = tuple(r.strip().upper() for r in args.rules.split(",") if r)
@@ -117,12 +132,26 @@ def main(argv: list[str] | None = None) -> int:
             print(name)
         return 0
 
-    if not args.quiet:
-        for v in violations:
-            print(v.render())
     counts: dict[str, int] = {}
     for v in violations:
         counts[v.rule] = counts.get(v.rule, 0) + 1
+
+    if args.json:
+        import json
+        # run_lint's (path, line, col, rule) sort + sort_keys makes the
+        # document byte-stable for a given tree: lint-state diffs are
+        # plain textual diffs of two runs
+        doc = {"violations": [{"rule": v.rule, "file": v.path,
+                               "line": v.line, "col": v.col,
+                               "detail": v.msg} for v in violations],
+               "counts": {r: counts[r] for r in sorted(counts)},
+               "total": len(violations)}
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 1 if violations else 0
+
+    if not args.quiet:
+        for v in violations:
+            print(v.render())
     detail = ", ".join(f"{r}={n}" for r, n in sorted(counts.items()))
     print(f"tpulint: {len(violations)} violation(s)"
           + (f" ({detail})" if detail else ""))
