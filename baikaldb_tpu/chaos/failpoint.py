@@ -126,6 +126,16 @@ CATALOG: dict[str, str] = {
     "meta.balance_tick": "MetaService.tick control loop (drop: the tick "
                          "emits no orders — a stalled balancer; the data "
                          "plane must stay correct without it)",
+    "fragment.dispatch": "pushed-fragment per-region dispatch, frontend "
+                         "side before the spec leaves (drop: this "
+                         "attempt is abandoned; the bounded retry loop "
+                         "re-dispatches, then falls back to the pulled "
+                         "image path)",
+    "fragment.exec": "store-daemon fragment execution, after the spec "
+                     "arrived but before any region rows are read "
+                     "(drop: the handler fails; the pushed attempt "
+                     "fails whole and the frontend falls back to the "
+                     "pulled image path, partials stay exactly-once)",
 }
 
 _SPEC_RE = re.compile(

@@ -768,6 +768,160 @@ def stream_chaos(seed: int = 7, rows: int = 384, chunk_rows: int = 64,
             "problems": problems}
 
 
+def fragment_chaos(seed: int = 8, rows: int = 240,
+                   writes: int | None = None, drop_pct: int = 35,
+                   queries: int = 5) -> dict:
+    """Pushed-down fragment dispatch (exec/fragments.py) under daemon
+    faults and a forced mid-query split, on the daemon plane (in-process
+    meta + 3 store daemons over real TCP).
+
+    Passes, each compared against the frontend-pulled ground truth
+    (``pushdown_reads`` off — the bit-identity the off-switch guarantees):
+
+    1. ``clean`` — pushed dispatch, no faults.
+    2. ``exec_drop`` × ``queries`` — ``fragment.exec`` armed with a seeded
+       ``P%drop``: a tripped daemon dies before reading any region row,
+       the pushed attempt fails, and the query falls back to the pulled
+       image path (``fragment_fallbacks``).  Results never change.
+    3. ``split_retarget`` — ANOTHER frontend live-splits the region, so
+       this frontend's routing is stale when its dispatch is in flight:
+       the range-validated read raises StaleRoutingError, the dispatcher
+       throws the whole attempt away, refreshes routing and re-slices
+       over both children (``fragment_retargets``).
+    4. ``dispatch_drop`` — ``fragment.dispatch`` armed ``1*drop`` (the
+       attempt is abandoned frontend-side, the bounded retry loop lands
+       the next one), then ``drop`` (every attempt dies → image fallback).
+
+    The exactly-once contract is audited on every successful dispatch via
+    the per-daemon ``scanned`` counts riding the payloads: their sum must
+    equal the table's row count — a retarget or retry that double-folded
+    a region (or dropped one) cannot sum to it.  Thread/socket timing is
+    not replayable, but the outcome schedule (which passes fell back,
+    how many partials) is a pure function of the seed, so the digest
+    pins per seed."""
+    from ..exec.fragments import recent_dispatches
+    from ..exec.session import Database, Session
+    from ..server.meta_server import MetaServer
+    from ..server.store_server import StoreServer
+    from ..utils import metrics
+    from ..utils.flags import FLAGS, set_flag
+
+    if writes is not None:              # chaos_run --writes compatibility
+        rows = max(40, int(writes))
+    prev = {k: getattr(FLAGS, k) for k in
+            ("chaos_seed", "pushdown_reads", "fragment_pushdown",
+             "fragment_retry_max")}
+    set_flag("chaos_seed", int(seed))
+    set_flag("fragment_pushdown", True)
+    meta = MetaServer("127.0.0.1:0")
+    meta.start()
+    stores: list = []
+    schedule: list[list] = []
+    problems: list[str] = []
+    sql = ("SELECT g, COUNT(*) n, SUM(v) s, MIN(v) lo, MAX(v) hi "
+           "FROM fc WHERE v >= 0 GROUP BY g ORDER BY g")
+    ddl = ("CREATE TABLE fc (id BIGINT NOT NULL, g BIGINT, v BIGINT, "
+           "PRIMARY KEY (id))")
+    try:
+        meta_addr = f"127.0.0.1:{meta.rpc.port}"
+        for sid in (1, 2, 3):
+            st = StoreServer(sid, "127.0.0.1:0", meta_addr,
+                             tick_interval=0.02, seed=seed * 13 + sid)
+            st.address = f"127.0.0.1:{st.rpc.port}"
+            st.start()
+            stores.append(st)
+        writer = Session(Database(cluster=meta_addr))
+        writer.db.telemetry.stop()
+        writer.execute(ddl)
+        for lo in range(0, rows, 120):
+            vals = ", ".join(f"({i}, {i % 7}, {(i * 37) % 101})"
+                             for i in range(lo, min(lo + 120, rows)))
+            writer.execute(f"INSERT INTO fc VALUES {vals}")
+        set_flag("pushdown_reads", "off")
+        want = writer.query(sql)        # frontend-pulled ground truth
+        set_flag("pushdown_reads", "always")
+        reader = Session(Database(cluster=meta_addr))
+        reader.db.telemetry.stop()
+        reader.execute(ddl)
+
+        def pushed_run(tag: str):
+            f0 = metrics.fragment_fallbacks.value
+            got = reader.query(sql)
+            fell = metrics.fragment_fallbacks.value - f0
+            ring = recent_dispatches()
+            last = ring[-1] if ring else {}
+            schedule.append([tag, last.get("status", "none"),
+                             int(last.get("dispatched", 0)),
+                             int(last.get("retargeted", 0)), int(fell)])
+            if got != want:
+                problems.append(f"{tag}: pushed rows diverged from the "
+                                f"pulled ground truth")
+            if last.get("status") == "ok" \
+                    and int(last.get("scanned", 0)) != rows:
+                problems.append(
+                    f"{tag}: {last.get('scanned')} rows folded for {rows} "
+                    f"live rows — partials not exactly-once")
+            return last, fell
+
+        # pass 1: clean pushed dispatch
+        last, fell = pushed_run("clean")
+        if fell or last.get("status") != "ok":
+            problems.append("clean: pushed dispatch fell back unfaulted")
+        # pass 2: seeded daemon-side execution drops -> image fallback
+        failpoint.set_failpoint("fragment.exec", f"{drop_pct}%drop")
+        try:
+            for q in range(int(queries)):
+                pushed_run(f"exec_drop{q}")
+        finally:
+            failpoint.clear("fragment.exec")
+        # pass 3: live split by ANOTHER frontend mid-flight -> re-target
+        writer.db.stores["default.fc"].replicated.split_region(0)
+        last, fell = pushed_run("split_retarget")
+        if not last.get("retargeted"):
+            problems.append("split_retarget: dispatch never re-targeted "
+                            "after the live split")
+        if fell:
+            problems.append("split_retarget: re-target fell back instead "
+                            "of re-slicing")
+        # pass 4: frontend-side dispatch drops — one abandoned attempt
+        # (retry lands), then all attempts (image fallback)
+        t0 = metrics.failpoint_trips.value
+        failpoint.set_failpoint("fragment.dispatch", "1*drop")
+        try:
+            last, fell = pushed_run("dispatch_retry")
+        finally:
+            failpoint.clear("fragment.dispatch")
+        if metrics.failpoint_trips.value - t0 < 1:
+            problems.append("dispatch_retry: the failpoint never bit")
+        if fell or last.get("status") != "ok":
+            problems.append("dispatch_retry: bounded retry did not land "
+                            "the second attempt")
+        failpoint.set_failpoint("fragment.dispatch", "drop")
+        try:
+            last, fell = pushed_run("dispatch_exhaust")
+        finally:
+            failpoint.clear("fragment.dispatch")
+        if not fell:
+            problems.append("dispatch_exhaust: exhausted dispatch did "
+                            "not fall back to the pulled path")
+    finally:
+        failpoint.clear("fragment.exec")
+        failpoint.clear("fragment.dispatch")
+        for k, v in prev.items():
+            set_flag(k, v)
+        for st in stores:
+            st.stop()
+        meta.stop()
+    return {"rows": rows, "fault_schedule": schedule,
+            "faults": len(schedule) - 2,
+            "state_digest": _digest({"schedule": schedule,
+                                     "rows": [sorted(r.items())
+                                              for r in want]}),
+            "problems": problems,
+            "retargets": sum(s[3] for s in schedule),
+            "fallbacks": sum(s[4] for s in schedule)}
+
+
 SCENARIOS = {
     "kill_leader": kill_leader,
     "partition": partition,
@@ -776,6 +930,7 @@ SCENARIOS = {
     "split_chaos": split_chaos,
     "migrate_chaos": migrate_chaos,
     "stream_chaos": stream_chaos,
+    "fragment_chaos": fragment_chaos,
 }
 
 

@@ -50,6 +50,21 @@ class ExecError(RuntimeError):
 from ..utils import metrics  # noqa: E402
 from ..utils.flags import FLAGS, define  # noqa: E402
 
+# Pushed-down fragments (exec/fragments.py) merge daemon partials HOST-side
+# under parallel.agg.WIRE_MERGE while this executor merges mesh partials
+# under ops.hashagg.MERGE_OP — the same semantic in two planes.  Pin them
+# at import: a kind whose wire merge drifted from its device merge would
+# make pushed results silently diverge from the image path (the
+# off-switch's bit-identity guarantee), so fail loudly instead.
+from ..parallel.agg import WIRE_MERGE as _WIRE_MERGE  # noqa: E402
+
+_drift = {k for k, op in _WIRE_MERGE.items() if MERGE_OP.get(k) != op}
+if _drift:
+    raise ExecError(
+        f"wire/device partial-merge drift for agg kinds {sorted(_drift)}: "
+        "parallel.agg.WIRE_MERGE must match ops.hashagg.MERGE_OP")
+del _drift
+
 import threading  # noqa: E402
 
 # set (thread-locally) by utils/compilecache._analyze while it AOT

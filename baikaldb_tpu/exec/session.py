@@ -65,6 +65,9 @@ define("param_queries", True,
        "query shape; 0 restores SQL-text-keyed caching with baked literals")
 from .dispatch import BatchDispatcher
 from . import executor, streaming
+from . import fragments as _fragments  # noqa: F401 — registers the
+# fragment_pushdown / fragment_retry_max flags at session load (SET and
+# the CLI must see them before the first pushed dispatch)
 from .executor import (_CapBox, compile_plan, count_shuffle_rounds,
                        exchange_summary)
 
@@ -2443,7 +2446,8 @@ class Session:
         push, info, key = cand
         from ..plan.fragment import merge_push_results
         from ..storage.remote_tier import (PushdownUnsupported,
-                                           RemoteRowTier, ReplicationError)
+                                           RemoteRowTier, ReplicationError,
+                                           StaleRoutingError)
 
         store = self.db.stores.get(key)
         if store is None:
@@ -2452,10 +2456,23 @@ class Session:
         if not isinstance(tier, RemoteRowTier):
             return None
         try:
-            payloads = tier.exec_fragment(push.frag)
-        except (PushdownUnsupported, ReplicationError):
+            if bool(FLAGS.fragment_pushdown):
+                # parallel dispatcher: hash-addressed specs, one thread per
+                # region owner, split/migration re-targeting
+                # (exec/fragments.py).  Same payloads in the same region
+                # order as the serial loop -> bit-identical merge
+                from .fragments import dispatch_fragments
+
+                payloads, _fstats = dispatch_fragments(tier, push.frag)
+            else:
+                payloads = tier.exec_fragment(push.frag)
+        except (PushdownUnsupported, ReplicationError,
+                StaleRoutingError):
+            metrics.fragment_fallbacks.add(1)
             return None          # image path retries / surfaces the error
-        names, rows = merge_push_results(push, payloads)
+        with trace.span("fragment.merge", table=key,
+                        regions=len(payloads)):
+            names, rows = merge_push_results(push, payloads)
         return self._host_rows_result(names, rows)
 
     @staticmethod
@@ -3917,6 +3934,19 @@ class Session:
 
     def _explain_analyze_measure(self, stmt: SelectStmt) -> None:
         """Run + instrument; all output lands in the active trace."""
+        cand = self._pushdown_candidate(stmt)
+        if cand is not None:
+            # pushed-fragment execution: the dispatcher's `fragments`
+            # event (dispatched/local/retargeted/partial_rows/bytes_saved)
+            # IS the measurement — render the store/frontend plan split
+            # and skip the image-path instrumentation, which would measure
+            # a plan that does not run
+            pushed = self._try_pushdown(stmt)
+            if pushed is not None:
+                for line in self._render_pushdown(*cand).splitlines():
+                    trace.event("op", label=line)
+                return
+            # dispatch fell back: measure the image path below
         plan = self._plan_select(stmt)
         batches, shape_key, full_scan = self._collect_batches(plan)
         # settle join caps first (the overflow-retry loop), so traced counts
@@ -4127,6 +4157,13 @@ class Session:
                          f"prefetch_wait_ms={a['prefetch_wait_ms']} "
                          f"stage_ms={a['stage_ms']} "
                          f"restarts={a['restarts']}")
+        for s in find("fragments"):
+            a = s["attrs"]
+            lines.append(f"-- fragments: dispatched={a['dispatched']} "
+                         f"local={a['local']} "
+                         f"retargeted={a['retargeted']} "
+                         f"partial_rows={a['partial_rows']} "
+                         f"bytes_saved={a['bytes_saved']}")
         lines.append(f"-- trace: spans={len(spans)} "
                      "(SHOW PROFILE shows the same span records)")
         return lines
@@ -4746,6 +4783,26 @@ class Session:
                 "mcv_count": pa.array([r[7] for r in rows], pa.int64()),
                 "hist_buckets": pa.array([r[8] for r in rows], pa.int64()),
             }) if rows else _empty_info("column_stats")
+        if name == "fragments":
+            from .fragments import recent_dispatches
+            recs = recent_dispatches()
+            return pa.table({
+                "frag_key": [r["frag_key"] for r in recs],
+                "table_name": [r["table"] for r in recs],
+                "mode": [r["mode"] for r in recs],
+                "dispatched": pa.array([r["dispatched"] for r in recs],
+                                       pa.int64()),
+                "local": pa.array([r["local"] for r in recs], pa.int64()),
+                "retargeted": pa.array([r["retargeted"] for r in recs],
+                                       pa.int64()),
+                "partial_rows": pa.array([r["partial_rows"] for r in recs],
+                                         pa.int64()),
+                "scanned": pa.array([r["scanned"] for r in recs],
+                                    pa.int64()),
+                "bytes_saved": pa.array([r["bytes_saved"] for r in recs],
+                                        pa.int64()),
+                "status": [r["status"] for r in recs],
+            }) if recs else _empty_info("fragments")
         if name == "failpoints":
             from ..chaos import failpoint as _fp
             rows = _fp.describe()

@@ -37,8 +37,6 @@ for everything streaming accepts.
 from __future__ import annotations
 
 import copy
-import queue
-import threading
 import time
 import warnings
 
@@ -57,6 +55,7 @@ from ..plan.nodes import (AggNode, FilterNode, LimitNode, PlanNode,
 from ..storage.streamchunks import ChunkSource
 from ..utils import metrics
 from ..utils.flags import FLAGS, define
+from ..utils.prefetch import staged
 from . import executor
 
 define("streaming_scan", True,
@@ -330,37 +329,18 @@ class StreamRunner:
         # renders 0, not a missing row
         dead = not source.keep
         ids = source.keep or [0]
-        q: queue.Queue = queue.Queue(maxsize=1)     # + the one folding = 2
-        stop = threading.Event()
 
-        def put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.05)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+        def load(i):
+            t0 = time.perf_counter()
+            dev, nbytes = cs.load_chunk(i, dead=dead)
+            return dev, nbytes, (time.perf_counter() - t0) * 1e3
 
-        def stage():
-            try:
-                for i in ids:
-                    if stop.is_set():
-                        return
-                    t0 = time.perf_counter()
-                    dev, nbytes = cs.load_chunk(i, dead=dead)
-                    ms = (time.perf_counter() - t0) * 1e3
-                    if not put((i, dev, nbytes, ms)):
-                        return
-            # not swallowed: the exception object IS the queue item the
-            # driver re-raises (panic failpoints derive from BaseException)
-            except BaseException as e:  # tpulint: disable=BAREEXC
-                put(e)
-
-        t = threading.Thread(target=stage, name="stream-prefetch",
-                             daemon=True)
+        # the shared double-buffer discipline (utils/prefetch.staged):
+        # chunk i+1 stages on a daemon thread while chunk i folds — the
+        # same staging the store daemons use for cold-segment fragment
+        # folds, so both planes keep one prefetch truth
+        it = staged(ids, load, name="stream-prefetch")
         carry = (_dead_zeros(self._acc_struct), jnp.asarray(False))
-        t.start()
         try:
             for m, i in enumerate(ids):
                 if qp is not None:
@@ -368,12 +348,9 @@ class StreamRunner:
                             chunk_no=m, chunks_total=len(ids))
                 with trace.span("stream.prefetch", chunk=i) as sp:
                     t0 = time.perf_counter()
-                    item = q.get()
+                    _i, (dev, nbytes, stage_ms) = next(it)
                     wait = (time.perf_counter() - t0) * 1e3
                     sp.set(wait_ms=round(wait, 3))
-                if isinstance(item, BaseException):
-                    raise item
-                _i, dev, nbytes, stage_ms = item
                 metrics.stream_prefetch_wait_ms.observe(wait)
                 metrics.stream_bytes_h2d.add(nbytes)
                 stats["prefetch_wait_ms"] += wait
@@ -387,13 +364,7 @@ class StreamRunner:
             if qp is not None:
                 qp.beat(chunk_no=len(ids), chunks_total=len(ids))
         finally:
-            stop.set()
-            try:
-                while True:
-                    q.get_nowait()
-            except queue.Empty:
-                pass
-            t.join(timeout=10.0)
+            it.close()      # stops the stager and drains on early exit
         return carry
 
     def _run_finalize(self, acc: ColumnBatch, params) -> ColumnBatch:

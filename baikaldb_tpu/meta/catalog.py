@@ -255,6 +255,20 @@ class Catalog:
                            Field("apply_lag", LType.INT64),
                            Field("proposal_queue", LType.INT64),
                            Field("write_rate", LType.INT64))),
+        # pushed-down fragment dispatches (exec/fragments.py RECENT ring):
+        # one row per recent dispatch — regions fanned out, cold folds done
+        # in place (local), split/migration re-targets, partial rows and
+        # bytes that never crossed the wire; newest last
+        "fragments": Schema((Field("frag_key", LType.STRING),
+                             Field("table_name", LType.STRING),
+                             Field("mode", LType.STRING),
+                             Field("dispatched", LType.INT64),
+                             Field("local", LType.INT64),
+                             Field("retargeted", LType.INT64),
+                             Field("partial_rows", LType.INT64),
+                             Field("scanned", LType.INT64),
+                             Field("bytes_saved", LType.INT64),
+                             Field("status", LType.STRING))),
         "failpoints": Schema((Field("name", LType.STRING),
                               Field("spec", LType.STRING),
                               Field("hits", LType.INT64),

@@ -355,62 +355,114 @@ def _stmt_has_aggs(stmt: SelectStmt) -> bool:
 
 # -- store side -------------------------------------------------------------
 
-def run_fragment(rows, frag: dict) -> dict:
-    """Execute a fragment against decoded region rows (store daemon side).
+class FragmentProgram:
+    """One fragment parsed ONCE into evaluator closures — the daemon-side
+    executable.  Store daemons cache these by content hash (the AOT key
+    riding each FragmentSpec), so a re-dispatch of a published fragment
+    skips the wire -> AST build entirely and ``fragment_warm_compiles``
+    stays pinned at 0.  ``run`` is reentrant: no state survives a call."""
 
-    ``rows``: iterable of row dicts (deleted rows already excluded).
-    Returns a JSON-safe payload: rows mode ->
-    {"mode": "rows", "rows": [[v, ...], ...], "scanned": n}; agg mode ->
-    {"mode": "agg", "groups": [[[kv, ...], [partial, ...]], ...],
-     "scanned": n}.  Raises RowEvalError on unsupported expressions or
-    cap overflow (the RPC layer turns that into an error response; the
-    frontend falls back)."""
-    filt = expr_from_wire(frag["filter"]) \
-        if frag.get("filter") is not None else None
-    mode = frag.get("mode")
-    scanned = 0
-    if mode == "rows":
-        outputs = [(n, expr_from_wire(w)) for n, w in frag["outputs"]]
-        limit = frag.get("limit")
-        out = []
+    __slots__ = ("mode", "filter", "outputs", "limit", "keys", "aggs",
+                 "cap")
+
+    def __init__(self, frag: dict):
+        self.mode = frag.get("mode")
+        self.filter = expr_from_wire(frag["filter"]) \
+            if frag.get("filter") is not None else None
+        if self.mode == "rows":
+            self.outputs = [(n, expr_from_wire(w))
+                            for n, w in frag["outputs"]]
+            self.limit = frag.get("limit")
+            self.keys, self.aggs, self.cap = [], [], 0
+            return
+        if self.mode != "agg":
+            raise RowEvalError(f"bad fragment mode {self.mode!r}")
+        self.outputs, self.limit = [], None
+        self.keys = [(n, expr_from_wire(w)) for n, w in frag["keys"]]
+        self.aggs = [(kind, expr_from_wire(w) if w is not None else None,
+                      out)
+                     for kind, w, out in frag["aggs"]]
+        self.cap = int(frag.get("group_cap") or GROUP_CAP)
+
+    def run(self, rows) -> dict:
+        """Execute over decoded region rows (deleted rows already
+        excluded).  Returns a JSON-safe payload: rows mode ->
+        {"mode": "rows", "rows": [[v, ...], ...], "scanned": n}; agg mode
+        -> {"mode": "agg", "groups": [[[kv, ...], [partial, ...]], ...],
+        "scanned": n}.  Raises RowEvalError on unsupported expressions or
+        cap overflow (the RPC layer turns that into an error response;
+        the frontend falls back)."""
+        filt = self.filter
+        scanned = 0
+        if self.mode == "rows":
+            out = []
+            for row in rows:
+                scanned += 1
+                if filt is not None and not truthy(eval_row(filt, row)):
+                    continue
+                if len(out) >= ROW_CAP:
+                    # abort BEFORE materializing an unbounded result: past
+                    # this size the raw-pull fallback is the better
+                    # transfer anyway
+                    raise RowEvalError("pushed fragment row cap exceeded")
+                out.append([val_to_wire(eval_row(e, row))
+                            for _, e in self.outputs])
+                if self.limit is not None and len(out) >= self.limit:
+                    break
+            return {"mode": "rows", "rows": out, "scanned": scanned}
+        groups: dict = {}
         for row in rows:
             scanned += 1
             if filt is not None and not truthy(eval_row(filt, row)):
                 continue
-            if len(out) >= ROW_CAP:
-                # abort BEFORE materializing an unbounded result: past this
-                # size the raw-pull fallback is the better transfer anyway
-                raise RowEvalError("pushed fragment row cap exceeded")
-            out.append([val_to_wire(eval_row(e, row)) for _, e in outputs])
-            if limit is not None and len(out) >= limit:
-                break
-        return {"mode": "rows", "rows": out, "scanned": scanned}
-    if mode != "agg":
-        raise RowEvalError(f"bad fragment mode {mode!r}")
-    keys = [(n, expr_from_wire(w)) for n, w in frag["keys"]]
-    aggs = [(kind, expr_from_wire(w) if w is not None else None, out)
-            for kind, w, out in frag["aggs"]]
-    cap = int(frag.get("group_cap") or GROUP_CAP)
-    groups: dict = {}
-    for row in rows:
-        scanned += 1
-        if filt is not None and not truthy(eval_row(filt, row)):
-            continue
-        kv = tuple(eval_row(e, row) for _, e in keys)
-        g = groups.get(kv)
-        if g is None:
-            if len(groups) >= cap:
-                raise RowEvalError("pushed fragment group cap exceeded")
-            g = groups[kv] = [_init_partial(kind) for kind, _, _ in aggs]
-        for i, (kind, arg, _) in enumerate(aggs):
-            g[i] = _step_partial(kind, g[i],
-                                 eval_row(arg, row)
-                                 if arg is not None else None)
-    return {"mode": "agg",
-            "groups": [[[val_to_wire(v) for v in kv],
-                        [val_to_wire(p) for p in g]]
-                       for kv, g in groups.items()],
-            "scanned": scanned}
+            kv = tuple(eval_row(e, row) for _, e in self.keys)
+            g = groups.get(kv)
+            if g is None:
+                if len(groups) >= self.cap:
+                    raise RowEvalError(
+                        "pushed fragment group cap exceeded")
+                g = groups[kv] = [_init_partial(kind)
+                                  for kind, _, _ in self.aggs]
+            for i, (kind, arg, _) in enumerate(self.aggs):
+                g[i] = _step_partial(kind, g[i],
+                                     eval_row(arg, row)
+                                     if arg is not None else None)
+        return {"mode": "agg",
+                "groups": [[[val_to_wire(v) for v in kv],
+                            [val_to_wire(p) for p in g]]
+                           for kv, g in groups.items()],
+                "scanned": scanned}
+
+
+def frag_canonical(frag: dict) -> bytes:
+    """The ONE canonical wire encoding of a fragment body (sorted-key
+    JSON): publisher, content hash, and daemon blob store must all agree
+    byte-for-byte or the artifact ladder silently misses."""
+    import json as _json
+
+    return _json.dumps(frag, sort_keys=True).encode()
+
+
+def frag_wire_key(frag: dict) -> str:
+    """Content hash of a fragment body — the AOT-style artifact key a
+    FragmentSpec ships INSTEAD of the body.  Daemons resolve it down the
+    warm ladder (program cache -> frag blob tier -> peer store); equal
+    fragments from any frontend share one key, so a re-dispatch never
+    re-ships or re-parses the plan."""
+    import hashlib
+
+    return hashlib.sha256(frag_canonical(frag)).hexdigest()[:24]
+
+
+def compile_fragment(frag: dict) -> FragmentProgram:
+    """Build the daemon-side executable for one wire fragment."""
+    return FragmentProgram(frag)
+
+
+def run_fragment(rows, frag: dict) -> dict:
+    """One-shot compile + execute (the pre-fragment_execute RPC path and
+    any caller without a program cache)."""
+    return FragmentProgram(frag).run(rows)
 
 
 def _init_partial(kind: str):
@@ -441,20 +493,18 @@ def _step_partial(kind: str, acc, v):
 
 
 def merge_partial(kind: str, a, b):
-    """Combine two region partials (frontend side)."""
-    if kind in ("count", "count_star"):
-        return int(a) + int(b)
-    if a is None:
-        return b
-    if b is None:
-        return a
-    if kind == "sum":
-        return a + b
-    if kind == "min":
-        return min(a, b)
-    if kind == "max":
-        return max(a, b)
-    raise RowEvalError(f"bad agg kind {kind!r}")
+    """Combine two region partials (frontend side) under the SAME
+    sum-of-sums / min / max discipline the device merge applies to
+    partial columns (parallel/agg.py merge_partial_agg_specs) — one merge
+    truth for wire partials and mesh partials.  Imported lazily: this
+    module also runs inside store daemons, which must not pull the jax
+    stack."""
+    from ..parallel.agg import merge_host_partial
+
+    try:
+        return merge_host_partial(kind, a, b)
+    except KeyError:
+        raise RowEvalError(f"bad agg kind {kind!r}") from None
 
 
 def host_sort_rows(rows: list, order: list) -> list:

@@ -44,7 +44,8 @@ def schema_from_wire(fields: list) -> Schema:
 class StoreServer:
     def __init__(self, store_id: int, address: str, meta_address: str = "",
                  tick_interval: float = 0.05, seed: Optional[int] = None,
-                 aot_dir: Optional[str] = None):
+                 aot_dir: Optional[str] = None,
+                 cold_dir: Optional[str] = None):
         self.store_id = store_id
         self.address = address
         host, port = address.rsplit(":", 1)
@@ -69,9 +70,24 @@ class StoreServer:
         if aot_dir:
             from ..storage.coldfs import ExternalFS
             self._aot_fs = ExternalFS(aot_dir)
+        # cold-tier FS handle: with it, pushed fragments fold a region's
+        # evicted Parquet segments IN PLACE (PR 15's streamed-fold data,
+        # but scanned next to the bytes); without it, a cold region makes
+        # the daemon answer cold:True and the frontend falls back
+        self._cold_fs = None
+        if cold_dir:
+            from ..storage.coldfs import ExternalFS
+            self._cold_fs = ExternalFS(cold_dir)
+        # compiled fragment programs keyed by the frag body's content hash
+        # (plan/fragment.frag_key): the warm tier of the fragment artifact
+        # ladder — in-mem program -> frag blob (disk via aot_dir) -> peer
+        # fetch -> inline body from the frontend (counted as a compile)
+        self._frag_mu = threading.Lock()
+        self._frag_programs: dict[str, object] = {}
         for name in ("create_region", "drop_region", "raft_msg", "propose",
                      "scan_raw", "region_status", "region_size", "ping",
                      "txn_status", "cold_manifest", "exec_fragment",
+                     "fragment_execute", "frag_put", "frag_fetch",
                      "metrics", "prometheus", "health", "aot_put",
                      "aot_fetch", "aot_put_xla", "aot_fetch_xla",
                      "aot_list"):
@@ -97,6 +113,19 @@ class StoreServer:
                            fn=lambda: len(self.rpc_aot_list()["artifacts"]))
         self._c_proposals = self.metrics.counter("raft_proposals")
         self._c_redirects = self.metrics.counter("raft_not_leader")
+        # pushed-fragment execution plane (scraped into cluster_metrics):
+        # fragments run here, programs warm-started from the frag blob
+        # tier (disk) or a peer store, programs compiled from an inline
+        # body because every warm source missed, and cold segments folded
+        # in place instead of shipping to the frontend
+        self._c_frag_execs = self.metrics.counter("fragment_execs")
+        self._c_frag_warm_loads = self.metrics.counter("fragment_warm_loads")
+        self._c_frag_peer_fetches = self.metrics.counter(
+            "fragment_peer_fetches")
+        self._c_frag_compiles = self.metrics.counter(
+            "fragment_warm_compiles")
+        self._c_frag_cold_segments = self.metrics.counter(
+            "fragment_cold_segments")
         region_labels = ("region",)
         self._region_gauges = {
             # 1 when this replica leads the region (sum over the fleet per
@@ -199,6 +228,18 @@ class StoreServer:
 
     def rpc_aot_fetch_xla(self, name: str):
         return {"data": self._aot_get("xla", str(name))}
+
+    def rpc_frag_put(self, key: str, data: bytes):
+        """Publish a serialized fragment body under its content hash (the
+        ``frag`` namespace of the artifact blob tier).  The frontend
+        pre-publishes to every owning store before the first dispatch so a
+        re-dispatched fragment never ships its body again — the daemon
+        warm-starts from this blob (or a peer's)."""
+        self._aot_put("frag", str(key), data)
+        return {"stored": True}
+
+    def rpc_frag_fetch(self, key: str):
+        return {"data": self._aot_get("frag", str(key))}
 
     def rpc_aot_list(self):
         with self._aot_mu:
@@ -464,6 +505,135 @@ class StoreServer:
         payload.update(status="ok", cold=False, start=start, end=end)
         return payload
 
+    # -- pushed-down fragment execution (exec/fragments.py dispatcher) -----
+    def _frag_program(self, frag_key: str, frag, peers):
+        """Resolve a compiled FragmentProgram for ``frag_key`` down the
+        warm ladder: in-memory cache -> frag blob tier (disk when aot_dir
+        is set) -> peer store fetch -> the inline body (counted as a
+        warm-compile miss).  Returns ``(program, error_response)`` — with
+        no inline body and every warm source missed, the error asks the
+        frontend to re-publish (``need_frag``)."""
+        import json as _json
+
+        from ..plan.fragment import compile_fragment, frag_canonical
+
+        with self._frag_mu:
+            prog = self._frag_programs.get(frag_key)
+        if prog is not None:
+            return prog, None
+        data = self._aot_get("frag", frag_key)
+        if data is not None:
+            prog = compile_fragment(_json.loads(bytes(data).decode()))
+            self._c_frag_warm_loads.add(1)
+        else:
+            for _sid, addr in list(peers or ()):
+                if addr == self.address:
+                    continue
+                resp = RpcClient(addr, timeout=2.0).try_call(
+                    "frag_fetch", key=frag_key)
+                blob = resp.get("data") if resp else None
+                if blob:
+                    blob = bytes(blob)
+                    prog = compile_fragment(_json.loads(blob.decode()))
+                    self._aot_put("frag", frag_key, blob)
+                    self._c_frag_peer_fetches.add(1)
+                    break
+        if prog is None:
+            if frag is None:
+                return None, {"status": "need_frag"}
+            prog = compile_fragment(frag)
+            self._aot_put("frag", frag_key, frag_canonical(frag))
+            self._c_frag_compiles.add(1)
+        with self._frag_mu:
+            self._frag_programs[frag_key] = prog
+        return prog, None
+
+    def rpc_fragment_execute(self, region_id: int, frag_key: str,
+                             frag: Optional[dict] = None, peers: list = (),
+                             route_start: bytes = b"",
+                             route_end: bytes = b""):
+        """Execute a pushed-down fragment IN PLACE over this region — hot
+        tier AND (with ``cold_dir``) its evicted cold segments — and return
+        only the partial result.  The N-daemon analog of
+        ``rpc_exec_fragment``: the body travels by content hash
+        (``frag_key``) and warm-starts from the frag blob tier, so a
+        re-dispatched fragment ships no plan bytes and compiles nothing.
+
+        Rows are filtered to the intersection of the frontend's routed
+        range and this replica's committed range, and cold rows are
+        re-keyed + range-checked per row (split children share segment
+        files) — two daemons folding sibling regions each take exactly
+        their slice, which is what makes the merged partials exactly-once.
+        ``scanned``/``raw_bytes``/``cold_bytes`` ride back for the
+        frontend's bytes-saved accounting and the chaos scenario's
+        exactly-once audit."""
+        from ..obs import trace
+        from ..storage.replicated import region_fragment_rows
+
+        region = self._region(region_id)
+        if region is None:
+            return {"status": "no_region"}
+        if failpoint.ENABLED:
+            if failpoint.hit("fragment.exec", region=int(region_id),
+                             store=self.store_id):
+                # drop: the handler dies before any region row is read —
+                # the frontend rotates peers / re-dispatches, and since
+                # only RETURNED payloads are merged, partials stay
+                # exactly-once
+                raise RuntimeError(
+                    f"failpoint fragment.exec (region {int(region_id)})")
+        with self._mu:
+            gate = self._read_gate(region)
+            if gate is not None:
+                return gate
+            region.apply_committed()
+            pairs = region.table.scan_raw()
+            start, end = region.start_key, region.end_key
+            manifest = list(region.cold_manifest)
+            row_codec = region.table.row_codec
+            key_codec = region.table.key_codec
+        prog, err = self._frag_program(str(frag_key), frag, peers)
+        if err is not None:
+            # answered by the LEADER with its committed range: the
+            # frontend's read loop adopts this daemon as the hint, so the
+            # inline-body retry lands here without another rotation
+            err.update(status="ok", need_frag=True, start=start, end=end)
+            return err
+        if manifest and self._cold_fs is None:
+            # evicted rows live on an FS this daemon cannot reach:
+            # answering from the hot tier alone would silently drop them
+            return {"status": "ok", "cold": True, "start": start,
+                    "end": end}
+        s = max(route_start or b"", start or b"")
+        if not route_end:
+            e = end
+        elif not end:
+            e = route_end
+        else:
+            e = min(route_end, end)
+        stats: dict = {}
+        scanned = [0]
+
+        def rows():
+            for row in region_fragment_rows(pairs, manifest, self._cold_fs,
+                                            row_codec, key_codec, s or b"",
+                                            e, stats):
+                scanned[0] += 1
+                yield row
+
+        with trace.span("fragment.exec", region=int(region_id),
+                        store=self.store_id):
+            payload = prog.run(rows())       # heavy work off the lock
+        self._c_frag_execs.add(1)
+        if stats.get("cold_segments"):
+            self._c_frag_cold_segments.add(int(stats["cold_segments"]))
+        payload.update(status="ok", cold=bool(manifest), start=start,
+                       end=end, store_id=self.store_id,
+                       scanned=int(scanned[0]),
+                       raw_bytes=int(stats.get("raw_bytes", 0)),
+                       cold_bytes=int(stats.get("cold_bytes", 0)))
+        return payload
+
     def rpc_txn_status(self, region_id: int):
         """Prepared (in-doubt) txns + decision records of one region — the
         reference's in-doubt recovery query (region.cpp:684
@@ -608,10 +778,16 @@ def main() -> None:
                     help="directory for hosted AOT executable artifacts "
                          "(empty = in-memory only; set it to survive "
                          "daemon restarts)")
+    ap.add_argument("--cold-dir", default="",
+                    help="cold-tier segment directory (the frontend's "
+                         "cold_fs_dir); set it so pushed fragments fold "
+                         "evicted segments in place instead of falling "
+                         "back to the frontend")
     args = ap.parse_args()
     srv = StoreServer(args.store_id, args.address, args.meta,
                       tick_interval=args.tick,
-                      aot_dir=args.aot_dir or None)
+                      aot_dir=args.aot_dir or None,
+                      cold_dir=args.cold_dir or None)
     srv.start()
     if args.metrics_port:
         from ..obs.telemetry import start_http_exporter

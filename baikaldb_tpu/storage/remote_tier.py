@@ -239,6 +239,10 @@ class RemoteRowTier:
         # 0 = read the live region_split_rows flag at each check
         self.split_rows = split_rows
         self._writes_since_check = 0
+        # fragment bodies already pushed to this table's stores by content
+        # hash: a published fragment re-dispatches as hash-only, so its
+        # plan bytes cross the wire exactly once per frontend
+        self._frag_published: set[str] = set()
         existing = cluster.meta.call("table_regions", table_id=self.table_id)
         if existing:
             self.regions = sorted((self._from_wire(w) for w in existing),
@@ -779,6 +783,51 @@ class RemoteRowTier:
         if resp.get("cold"):
             raise PushdownUnsupported(
                 f"region {region.region_id} has cold segments")
+        return resp
+
+    def frag_publish(self, frag_key: str, frag: dict) -> None:
+        """Push one fragment body (canonical encoding, content-addressed)
+        to EVERY store hosting a region of this table — the AOT-publish
+        step of the pushed dispatch.  Idempotent and best-effort: a store
+        the publish missed answers ``need_frag`` and gets the body inline
+        (counted as a warm-compile miss)."""
+        from ..plan.fragment import frag_canonical
+
+        data = frag_canonical(frag)
+        for addr in sorted({a for r in self.regions for _, a in r.peers}):
+            self.cluster.store(addr).try_call("frag_put", key=frag_key,
+                                              data=data)
+        self._frag_published.add(frag_key)
+
+    def fragment_execute_region(self, region: _RemoteRegion, frag_key: str,
+                                frag: dict) -> dict:
+        """One region's pushed fragment: leader-routed ``fragment_execute``
+        carrying ONLY the content hash; the daemon warm-starts the program
+        from its artifact tier (memory -> disk blob -> peer).  When every
+        warm source misses (``need_frag``: daemon restarted after the
+        publish, or joined late), the body ships inline once — the only
+        path that compiles, so ``fragment_warm_compiles`` stays 0 for any
+        re-dispatch of a published fragment.  Range staleness raises
+        StaleRoutingError exactly like raw scans; the dispatcher
+        (exec/fragments.py) refreshes routing and re-targets."""
+        kw = dict(frag_key=frag_key,
+                  peers=[[sid, a] for sid, a in region.peers],
+                  route_start=region.start_key, route_end=region.end_key)
+        resp = self._leader_read_loop(
+            region, "fragment_execute",
+            handler_error=PushdownUnsupported, **kw)
+        if resp.get("need_frag"):
+            metrics.fragment_warm_compiles.add(1)
+            resp = self._leader_read_loop(
+                region, "fragment_execute",
+                handler_error=PushdownUnsupported, frag=frag, **kw)
+        if resp.get("need_frag") or "mode" not in resp:
+            # cold manifest present but the daemon has no cold-FS handle
+            # (no --cold-dir), or the body retry still missed: this region
+            # cannot be served in place
+            raise PushdownUnsupported(
+                f"region {region.region_id}: store cannot execute the "
+                f"fragment in place")
         return resp
 
     def scan_rows(self) -> list[dict]:

@@ -841,6 +841,58 @@ class ReplicatedRowTier:
             return True
 
 
+def region_fragment_rows(pairs, manifest, fs, row_codec, key_codec,
+                         lo, hi, stats):
+    """Yield one region's LIVE rows — hot tier over cold tier — inside the
+    byte range [``lo``, ``hi``) (``hi`` falsy = unbounded), for a store
+    daemon executing a pushed-down fragment in place.
+
+    Ordering/precedence mirrors ``column_store.attach_replicated``: the hot
+    row tier is authoritative (its keys — *including* ``__del`` tombstones —
+    mask every cold version of the same key), then cold segments replay
+    newest-seq-first with a seen-key set so only the latest cold version of
+    a key survives.  Cold rows are re-keyed via ``key_codec.encode_one`` and
+    range-checked per row: split children can share a parent segment file,
+    so two daemons folding sibling regions must each take only their slice
+    or the merged partials would double-count.
+
+    ``stats`` accumulates ``raw_bytes`` (hot key+value bytes scanned) and
+    ``cold_bytes`` (segment blob bytes fetched) — the numerator of the
+    fragment bytes-saved accounting.  Segment fetches are double-buffered
+    through :func:`utils.prefetch.staged` so the network/disk read of
+    segment N+1 overlaps the fold of segment N.
+    """
+    from ..utils.prefetch import staged
+    from .coldfs import segment_rows
+
+    seen: set[bytes] = set()
+    for k, v in pairs:
+        if k < lo or (hi and k >= hi):
+            continue
+        stats["raw_bytes"] = stats.get("raw_bytes", 0) + len(k) + len(v)
+        seen.add(k)
+        row = row_codec.decode(v)
+        if not row.get("__del"):
+            yield row
+    if not manifest:
+        return
+    files, dedup = [], set()
+    for _seq, f, _w in sorted(manifest, reverse=True):
+        if f not in dedup:
+            dedup.add(f)
+            files.append(f)
+    stats["cold_segments"] = stats.get("cold_segments", 0) + len(files)
+    for _f, blob in staged(files, fs.get, name="fragment-cold"):
+        stats["cold_bytes"] = stats.get("cold_bytes", 0) + len(blob)
+        for row in segment_rows(blob):
+            k = key_codec.encode_one(row)
+            if k < lo or (hi and k >= hi) or k in seen:
+                continue
+            seen.add(k)
+            if not row.get("__del"):
+                yield row
+
+
 # rank visible at import: docs/LINT.md's rank table is pinned against the
 # runtime registry by test_lint.py without building a tier
 from ..analysis.runtime import LOCK_RANKS as _LOCK_RANKS  # noqa: E402

@@ -56,12 +56,16 @@ def _wait_ping(address: str, timeout: float = 15.0) -> None:
 
 
 def spawn_cluster(n_stores: int = 3, base_port: int = 9100,
-                  mysql_port: int = 0, n_mysql: int = 1):
+                  mysql_port: int = 0, n_mysql: int = 1,
+                  aot_dir: str = "", cold_dir: str = ""):
     """-> (meta_address, {"meta", "stores", "mysql", "mysqls"}).
     mysql_port=0 skips frontends (tests drive Session directly);
     ``n_mysql`` > 1 spawns frontends on consecutive ports — the
     reference's N-baikaldb deploy (throughput scales per frontend
-    process; see RemoteRowTier's single-WRITER note)."""
+    process; see RemoteRowTier's single-WRITER note).  ``aot_dir`` /
+    ``cold_dir`` plumb the daemons' fragment-artifact blob tier and
+    cold-segment filesystem (per-store subdirectories, so daemons warm
+    fragment programs from disk and fold their own cold tier in place)."""
     meta_addr = f"127.0.0.1:{base_port}"
     procs = {"meta": _spawn(["baikaldb_tpu.server.meta_server",
                              "--address", meta_addr,
@@ -70,9 +74,13 @@ def spawn_cluster(n_stores: int = 3, base_port: int = 9100,
     _wait_ping(meta_addr)
     for i in range(1, n_stores + 1):
         addr = f"127.0.0.1:{base_port + i}"
-        procs["stores"].append(_spawn(
-            ["baikaldb_tpu.server.store_server", "--store-id", str(i),
-             "--address", addr, "--meta", meta_addr]))
+        cmd = ["baikaldb_tpu.server.store_server", "--store-id", str(i),
+               "--address", addr, "--meta", meta_addr]
+        if aot_dir:
+            cmd += ["--aot-dir", os.path.join(aot_dir, f"store{i}")]
+        if cold_dir:
+            cmd += ["--cold-dir", os.path.join(cold_dir, f"store{i}")]
+        procs["stores"].append(_spawn(cmd))
         _wait_ping(addr)
     if mysql_port and n_mysql > 0:
         for j in range(n_mysql):
@@ -107,10 +115,16 @@ def main() -> None:
     ap.add_argument("--mysql-port", type=int, default=28000)
     ap.add_argument("--frontends", type=int, default=1,
                     help="MySQL frontends on consecutive ports")
+    ap.add_argument("--aot-dir", default="",
+                    help="fragment/AOT blob root (per-store subdirs)")
+    ap.add_argument("--cold-dir", default="",
+                    help="cold-segment FS root (per-store subdirs)")
     args = ap.parse_args()
     meta_addr, procs = spawn_cluster(args.stores, args.base_port,
                                      args.mysql_port,
-                                     n_mysql=args.frontends)
+                                     n_mysql=args.frontends,
+                                     aot_dir=args.aot_dir,
+                                     cold_dir=args.cold_dir)
     print(f"meta     @ {meta_addr} (pid {procs['meta'].pid})")
     for i, p in enumerate(procs["stores"], 1):
         print(f"store {i}  @ 127.0.0.1:{args.base_port + i} (pid {p.pid})")

@@ -672,6 +672,20 @@ stream_retries = Counter("stream_retries")
 stream_restarts = Counter("stream_restarts")
 stream_bytes_h2d = Counter("stream_bytes_h2d")
 stream_prefetch_wait_ms = LatencyRecorder("stream_prefetch_wait_ms")
+# pushed-down fragment execution (exec/fragments.py): per-region fragment
+# dispatches to store daemons, re-dispatches after a mid-flight split/
+# migration re-target (StaleRoutingError -> refresh -> re-slice), whole
+# queries that fell back to the frontend-pulled image path, raw region
+# bytes that did NOT cross the wire because only partials came back
+# (daemon-scanned bytes minus partial payload bytes), and dispatches
+# where no daemon could warm-start the fragment from its artifact tier
+# (disk -> peer both missed; the body had to ship inline) — pinned at 0
+# on any re-dispatch of a published fragment
+fragments_dispatched = Counter("fragments_dispatched")
+fragment_retargets = Counter("fragment_retargets")
+fragment_fallbacks = Counter("fragment_fallbacks")
+fragment_bytes_saved = Counter("fragment_bytes_saved")
+fragment_warm_compiles = Counter("fragment_warm_compiles")
 
 
 def count_swallowed(site: str) -> None:

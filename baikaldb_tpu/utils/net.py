@@ -459,12 +459,15 @@ class RpcClient:
         "ping", "scan_raw", "txn_status", "region_size", "region_status",
         "instances", "table_regions", "heartbeat", "tso", "raft_msg",
         "drop_region", "drop_regions", "register_store", "cold_manifest",
-        "exec_fragment", "metrics", "prometheus", "health",
+        "exec_fragment", "fragment_execute", "metrics", "prometheus",
+        "health",
         # AOT artifact tier: reads, plus puts/publishes that are
         # idempotent by construction (same key -> same bytes; the meta
         # manifest is last-writer-wins on identical content)
         "aot_fetch", "aot_fetch_xla", "aot_list", "aot_lookup",
         "aot_manifest", "aot_put", "aot_put_xla", "aot_publish",
+        # fragment artifact tier: same discipline (same key -> same bytes)
+        "frag_put", "frag_fetch",
     })
 
     # Fire-and-forget at the transport: raft IS its own retry protocol

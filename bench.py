@@ -1527,6 +1527,153 @@ def run_stream_bench() -> dict:
     }
 
 
+def run_fragment_bench() -> dict:
+    """Pushed-down fragment line: the SAME scan->filter->GROUP BY SQL
+    executed (a) pushed — per-region fragments dispatched to 3 in-process
+    store daemons that fold locally and return only aggregate partials —
+    vs (b) frontend-pulled — a cold frontend pulls whole regions over the
+    wire and aggregates on the image path.  The table is pre-split into 3
+    regions so the pushed dispatch actually fans out.  Deterministic
+    gates for tools/bench_regress.py: fragments were dispatched, daemon
+    scans saved real frontend ingress bytes (``bytes_saved`` > 0), and
+    the steady repeat loop paid ZERO fragment warm compiles anywhere
+    (frontend inline resends AND daemon-side compiles) — the
+    content-hash artifact ladder must serve every re-dispatch."""
+    from baikaldb_tpu.exec.session import Database, Session
+    from baikaldb_tpu.server.meta_server import MetaServer
+    from baikaldb_tpu.server.store_server import StoreServer
+    from baikaldb_tpu.utils import metrics as _m
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+    from baikaldb_tpu.utils.net import WIRE_STATS
+
+    n_rows = int(os.environ.get("BENCH_FRAGMENT_ROWS", 6000))
+    repeats = int(os.environ.get("BENCH_REPEATS", 5))
+    pad = "x" * 64
+    ddl = ("CREATE TABLE fb (id BIGINT NOT NULL, g BIGINT, v BIGINT, "
+           "pad VARCHAR(80), PRIMARY KEY (id))")
+    sql = ("SELECT g, COUNT(*) n, SUM(v) s, MIN(v) lo, MAX(v) hi FROM fb "
+           "WHERE v >= 0 GROUP BY g ORDER BY g")
+    prev = {k: getattr(FLAGS, k) for k in ("pushdown_reads",
+                                           "fragment_pushdown")}
+    meta = MetaServer("127.0.0.1:0")
+    meta.start()
+    stores = []
+    try:
+        meta_addr = f"127.0.0.1:{meta.rpc.port}"
+        for sid in (1, 2, 3):
+            st = StoreServer(sid, "127.0.0.1:0", meta_addr,
+                             tick_interval=0.02)
+            st.address = f"127.0.0.1:{st.rpc.port}"
+            st.start()
+            stores.append(st)
+        writer = Session(Database(cluster=meta_addr))
+        writer.db.telemetry.stop()
+        writer.execute(ddl)
+        for lo in range(0, n_rows, 200):
+            vals = ", ".join(
+                f"({i}, {i % 16}, {(i * 13) % 997}, '{pad}')"
+                for i in range(lo, min(lo + 200, n_rows)))
+            writer.execute(f"INSERT INTO fb VALUES {vals}")
+        tier = writer.db.stores["default.fb"].replicated
+        tier.split_region(0)
+        tier.split_region(0)            # 3 regions: the dispatch fans out
+
+        def fresh():
+            s = Session(Database(cluster=meta_addr))
+            s.db.telemetry.stop()
+            s.execute(ddl)
+            return s
+
+        # pushed: daemons fold, partials cross the wire
+        set_flag("pushdown_reads", "always")
+        set_flag("fragment_pushdown", True)
+        push_s = fresh()
+        push_s.query(sql)               # publish + daemon warm-up
+        d0 = _m.fragments_dispatched.value
+        bs0 = _m.fragment_bytes_saved.value
+        wc0 = _m.fragment_warm_compiles.value + \
+            sum(st.metrics.counter("fragment_warm_compiles").value
+                for st in stores)
+        in0 = WIRE_STATS["recv_bytes"]
+        t0 = time.perf_counter()
+        pushed = None
+        for _ in range(repeats):
+            pushed = push_s.query(sql)
+        push_dt = time.perf_counter() - t0
+        push_ingress = WIRE_STATS["recv_bytes"] - in0
+        dispatched = _m.fragments_dispatched.value - d0
+        bytes_saved = _m.fragment_bytes_saved.value - bs0
+        warm_compiles = (_m.fragment_warm_compiles.value +
+                         sum(st.metrics.counter(
+                             "fragment_warm_compiles").value
+                             for st in stores)) - wc0
+        # pulled: a COLD frontend funnels whole regions, aggregates itself
+        set_flag("pushdown_reads", "off")
+        fresh().query(sql)              # compile the image program once
+        in0 = WIRE_STATS["recv_bytes"]
+        t0 = time.perf_counter()
+        pulled = None
+        for _ in range(repeats):
+            pulled = fresh().query(sql)     # cold: every query re-pulls
+        pull_dt = time.perf_counter() - t0
+        pull_ingress = WIRE_STATS["recv_bytes"] - in0
+        if pushed != pulled:
+            raise RuntimeError("pushed result diverged from pulled")
+    finally:
+        for k, v in prev.items():
+            set_flag(k, v)
+        for st in stores:
+            st.stop()
+        meta.stop()
+    push_rps = n_rows * repeats / push_dt
+    pull_rps = n_rows * repeats / pull_dt
+    return {
+        "metric": f"pushed fragments: scan->filter->GROUP BY rows/sec, "
+                  f"3-daemon store-side execution vs frontend-pulled "
+                  f"({n_rows} rows, 3 regions)",
+        "value": round(push_rps, 1),
+        "unit": "rows/sec",
+        # >1: daemons fold in place, the frontend stops being the funnel
+        "vs_baseline": round(push_rps / pull_rps, 3),
+        "pulled_rows_per_sec": round(pull_rps, 1),
+        "rows": n_rows,
+        "regions": len(tier.regions),
+        "repeats": repeats,
+        "fragments_dispatched": int(dispatched),
+        "bytes_saved": int(bytes_saved),
+        "fragment_warm_compiles": int(warm_compiles),
+        "pushed_ingress_bytes_per_query": round(push_ingress / repeats),
+        "pulled_ingress_bytes_per_query": round(pull_ingress / repeats),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
+def _emit_fragment_line(skip_reason: str | None = None):
+    """Pushed-fragment JSON line: store-side execution vs the frontend
+    funnel, plus the dispatch counters bench_regress gates on.  Same
+    robustness contract: always prints a line, never raises."""
+    if os.environ.get("BENCH_SKIP_FRAGMENT") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "pushed fragments: scan->filter->GROUP BY rows/sec "
+                      "store-side vs frontend-pulled (skipped)",
+            "value": 0, "unit": "rows/sec", "vs_baseline": 0.0,
+            "error": skip_reason}))
+        return
+    try:
+        result = run_fragment_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "pushed fragments: scan->filter->GROUP BY "
+                            "rows/sec store-side vs frontend-pulled "
+                            "(failed)",
+                  "value": 0, "unit": "rows/sec", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
 def _emit_stream_line(skip_reason: str | None = None):
     """Out-of-core streaming JSON line: chunk-folded scan throughput vs
     the resident path, plus the fold telemetry bench_regress gates on.
@@ -1873,6 +2020,8 @@ def main():
                                    "failed; elastic phase skipped")
                 _emit_stream_line(skip_reason="accelerator probe "
                                   "failed; stream phase skipped")
+                _emit_fragment_line(skip_reason="accelerator probe "
+                                    "failed; fragment phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -1918,6 +2067,7 @@ def main():
             _emit_guard_line()
             _emit_elastic_line()
             _emit_stream_line()
+            _emit_fragment_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
@@ -1932,6 +2082,7 @@ def main():
     _emit_guard_line()
     _emit_elastic_line()
     _emit_stream_line()
+    _emit_fragment_line()
     return 0
 
 

@@ -61,7 +61,8 @@ def load_capture(path: str) -> dict:
     or a bench.py JSON-lines capture (the cold-start row is extracted).
     Unknown/summary lines are ignored."""
     out: dict = {"header": None, "queries": {}, "coldstart": None,
-                 "progress": None, "elastic": None, "stream": None}
+                 "progress": None, "elastic": None, "stream": None,
+                 "fragments": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -87,6 +88,8 @@ def load_capture(path: str) -> dict:
                 out["elastic"] = row
             elif str(row.get("metric", "")).startswith("out-of-core stream"):
                 out["stream"] = row
+            elif str(row.get("metric", "")).startswith("pushed fragments"):
+                out["fragments"] = row
     return out
 
 
@@ -216,6 +219,32 @@ def compare_stream(cand: dict, wait_factor: float) -> list:
     return problems
 
 
+def compare_fragments(cand: dict) -> list:
+    """Pushed-fragment contract on the candidate capture (skipped/failed
+    lines are ignored).  All gates are deterministic counters: fragments
+    actually dispatched to the daemons, daemon-side folding saved real
+    frontend ingress (``bytes_saved`` > 0), and the steady repeat loop
+    paid ZERO fragment warm compiles — frontend inline resends and
+    daemon-side compiles both, since the content-hash artifact ladder
+    must serve every re-dispatch of a published fragment."""
+    c = cand.get("fragments")
+    if c is None or c.get("error") or not c.get("value"):
+        return []
+    problems = []
+    if c.get("fragments_dispatched", 0) <= 0:
+        problems.append("fragments: fragments_dispatched=0 — the pushed "
+                        "path never actually dispatched")
+    if c.get("bytes_saved", 0) <= 0:
+        problems.append("fragments: bytes_saved=0 — store-side execution "
+                        "saved no frontend ingress")
+    if c.get("fragment_warm_compiles", 0) > 0:
+        problems.append(
+            f"fragments: {c['fragment_warm_compiles']} warm compiles in "
+            f"the steady repeat loop (the artifact ladder stopped "
+            f"serving re-dispatches)")
+    return problems
+
+
 def compare(base: dict, cand: dict, wall_clock_pct: float = 0.0) -> list:
     """-> list of human-readable regression strings (empty = clean)."""
     problems = []
@@ -278,7 +307,7 @@ def main(argv=None) -> int:
     cand = load_capture(args.candidate)
     if not base["queries"] and base["coldstart"] is None \
             and cand["progress"] is None and cand["elastic"] is None \
-            and cand["stream"] is None:
+            and cand["stream"] is None and cand["fragments"] is None:
         print(f"bench_regress: no query or cold-start rows in "
               f"{args.baseline}", file=sys.stderr)
         return 2
@@ -287,6 +316,7 @@ def main(argv=None) -> int:
     problems += compare_progress(cand, args.progress_pct)
     problems += compare_elastic(cand, args.elastic_p99_x)
     problems += compare_stream(cand, args.stream_wait_x)
+    problems += compare_fragments(cand)
     compared = []
     if base["queries"]:
         compared.append(f"{len(base['queries'])} queries")
@@ -298,6 +328,8 @@ def main(argv=None) -> int:
         compared.append("elastic-regions line")
     if cand["stream"] is not None:
         compared.append("out-of-core stream line")
+    if cand["fragments"] is not None:
+        compared.append("pushed-fragments line")
     if problems:
         for p in problems:
             print(f"REGRESSION {p}")
