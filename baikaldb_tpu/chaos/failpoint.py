@@ -136,6 +136,18 @@ CATALOG: dict[str, str] = {
                      "(drop: the handler fails; the pushed attempt "
                      "fails whole and the frontend falls back to the "
                      "pulled image path, partials stay exactly-once)",
+    "tso.allocate": "TSO batched-range grant, after the propose returned "
+                    "(drop: the grant response is lost in flight — the "
+                    "range is burned and the client re-proposes; "
+                    "monotonicity must survive because the source never "
+                    "re-issues a granted range)",
+    "mvcc.gc": "per-table MVCC history sweep (drop: this sweep is "
+               "skipped — a wedged GC; version debt grows but pinned "
+               "snapshots stay correct)",
+    "snapshot.pin": "snapshot pin registration (drop: the pin is "
+                    "refused — an automatic analytical pin degrades to "
+                    "an unpinned read; explicit SET SNAPSHOT surfaces "
+                    "the refusal to the client)",
 }
 
 _SPEC_RE = re.compile(

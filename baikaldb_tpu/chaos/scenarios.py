@@ -922,6 +922,157 @@ def fragment_chaos(seed: int = 8, rows: int = 240,
             "fallbacks": sum(s[4] for s in schedule)}
 
 
+def snapshot_chaos(seed: int = 7, writes: int = 48) -> dict:
+    """Hammer writes + a forced live split during a pinned-snapshot
+    aggregate (the tentpole contract): a session pins an explicit MVCC
+    snapshot, records a GROUP BY aggregate, and that aggregate must stay
+    BIT-IDENTICAL while seeded insert/update/delete traffic rewrites the
+    table, a live region split runs mid-query (checked at every split
+    phase via the chaos hook), a ``tso.allocate`` grant is lost (burned
+    range — monotonicity must survive the re-propose), one GC sweep is
+    failpoint-wedged and the next must still respect the oldest pin, and
+    a fresh session re-pinning the RECORDED ts reproduces the aggregate
+    (quiesced replay).  Also: an explicit pin refusal (``snapshot.pin``)
+    must surface to the client, the ``mvcc=0`` off-switch must read
+    bit-identically to the unpinned read, and TSO timestamps must stay
+    strictly monotonic across a meta raft leader kill.  Fleet plane:
+    bit-identical replay (wall-clock timestamps excluded from the
+    digest by design)."""
+    from ..exec.session import Session
+    from ..meta.replicated_meta import ReplicatedMeta
+    from ..storage.mvcc import TsoClient
+    from ..utils.flags import FLAGS, set_flag
+
+    rng = random.Random((seed << 8) ^ 0x736E70)
+    fleet, db, s = _fleet_session(seed)
+    s.execute("CREATE TABLE sv (k BIGINT, g BIGINT, v BIGINT, "
+              "PRIMARY KEY (k))")
+    tier = fleet.row_tiers["chaos.sv"]
+    schedule: list[list] = []
+    problems: list[str] = []
+    next_key = 0
+
+    def put(n: int):
+        nonlocal next_key
+        for _ in range(n):
+            k = next_key
+            s.execute(f"INSERT INTO sv VALUES ({k}, {k % 4}, {k * k})")
+            next_key += 1
+
+    def hammer(n: int):
+        nonlocal next_key
+        for _ in range(n):
+            r = rng.random()
+            if r < 0.5 or next_key < 4:
+                put(1)
+            elif r < 0.8:
+                k = rng.randrange(next_key)
+                s.execute(f"UPDATE sv SET v = v + 7 WHERE k = {k}")
+                schedule.append(["update", k])
+            else:
+                k = rng.randrange(next_key)
+                s.execute(f"DELETE FROM sv WHERE k = {k}")
+                schedule.append(["delete", k])
+
+    put(writes // 2)
+    AGG = "SELECT g, COUNT(*), SUM(v) FROM sv GROUP BY g ORDER BY g"
+    s.execute("SET SNAPSHOT = 'now'")
+    snap_ts = s._snapshot[1]
+    base = s.query(AGG)
+    schedule.append(["pin", next_key])
+
+    def check(tag: str):
+        if s.query(AGG) != base:
+            problems.append(f"{tag}: pinned aggregate diverged under "
+                            f"writes")
+        schedule.append(["agg", tag])
+
+    parent = tier.metas[0].region_id
+
+    def hook(phase: str):
+        schedule.append(["phase", phase])
+        hammer(4)                   # writes flow during the live split
+        check(f"mid_split_{phase}")  # ... while the pinned agg re-runs
+
+    failpoint.set_failpoint("tso.allocate", "1*drop")
+    failpoint.set_failpoint("mvcc.gc", "1*drop")
+    try:
+        try:
+            child = tier.split_region_online(parent, chaos_hook=hook)
+            schedule.append(["split_ok", parent, child.region_id])
+        except Exception as e:      # noqa: BLE001 — report, don't die
+            problems.append(f"live split under pinned snapshot failed: "
+                            f"{type(e).__name__}: {e}")
+        hammer(max(writes - next_key, 8))
+        check("after_split")
+        # GC respects the pin: the watermark must not pass it, the first
+        # sweep is failpoint-wedged (skipped), the second really sweeps —
+        # and the pinned aggregate must still reproduce afterwards
+        if db.mvcc.snapshots.watermark(db.mvcc.tso.last_ts()) > snap_ts:
+            problems.append("gc watermark passed the oldest pin")
+        db.mvcc.gc(db.stores.values())      # wedged by mvcc.gc 1*drop
+        reclaimed = db.mvcc.gc(db.stores.values())
+        schedule.append(["gc", reclaimed >= 0])
+        check("after_gc")
+        # quiesced replay: a FRESH session pins the RECORDED ts and must
+        # read the exact aggregate the original pin saw
+        s2 = Session(db, "chaos")
+        s2.execute(f"SET SNAPSHOT = {snap_ts}")
+        replay_ok = s2.query(AGG) == base
+        if not replay_ok:
+            problems.append("quiesced replay at the recorded ts diverged")
+        s2.execute("SET SNAPSHOT = 0")
+        schedule.append(["replay", replay_ok])
+    finally:
+        failpoint.clear("tso.allocate")
+        failpoint.clear("mvcc.gc")
+    # off-switch: mvcc=0 must read bit-identically to the unpinned read
+    s.execute("SET SNAPSHOT = 0")
+    live = s.query(AGG)
+    prev_mvcc = bool(FLAGS.mvcc)
+    set_flag("mvcc", 0)
+    try:
+        if s.query(AGG) != live:
+            problems.append("mvcc=0 off-switch diverged from the "
+                            "unpinned read")
+    finally:
+        set_flag("mvcc", 1 if prev_mvcc else 0)
+    # explicit pin refusal surfaces; the next attempt lands
+    failpoint.set_failpoint("snapshot.pin", "1*drop")
+    try:
+        refused = False
+        try:
+            s.execute("SET SNAPSHOT = 'now'")
+        except Exception:           # noqa: BLE001 — the refusal IS the test
+            refused = True
+        if not refused:
+            problems.append("refused explicit pin did not surface")
+        s.execute("SET SNAPSHOT = 'now'")
+        s.execute("SET SNAPSHOT = 0")
+        schedule.append(["pin_refused", refused])
+    finally:
+        failpoint.clear("snapshot.pin")
+    # TSO strict monotonicity across a meta raft leader kill: enough
+    # allocations after the kill to force batched-range refills through
+    # the NEW leader (the save-ahead lease covers the failover)
+    rm = ReplicatedMeta(seed=5 + seed)
+    cli = TsoClient(rm.tso_gen)
+    seq = [cli.next_ts() for _ in range(5)]
+    rm.kill_leader()
+    seq += [cli.next_ts() for _ in range(3 * int(FLAGS.tso_batch_size))]
+    if any(b <= a for a, b in zip(seq, seq[1:])):
+        problems.append("TSO regressed across meta leader failover")
+    schedule.append(["tso_failover", len(seq)])
+    rows = s.query("SELECT k, g, v FROM sv ORDER BY k")
+    state = {"rows": rows, "pinned": base,
+             "regions": len(tier.metas)}
+    return {"writes": next_key, "fault_schedule": schedule,
+            "faults": len(schedule),
+            "regions": len(tier.metas),
+            "state_digest": _digest({"schedule": schedule, "state": state}),
+            "problems": problems}
+
+
 SCENARIOS = {
     "kill_leader": kill_leader,
     "partition": partition,
@@ -931,6 +1082,7 @@ SCENARIOS = {
     "migrate_chaos": migrate_chaos,
     "stream_chaos": stream_chaos,
     "fragment_chaos": fragment_chaos,
+    "snapshot_chaos": snapshot_chaos,
 }
 
 

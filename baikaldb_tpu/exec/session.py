@@ -16,6 +16,7 @@ import itertools
 import threading
 import time
 from collections import OrderedDict, deque
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -328,6 +329,13 @@ class Database:
             raise ValueError("data_dir cannot combine with fleet/cluster "
                              "mode: durability lives in the replicated tier")
         self.stores: dict[str, TableStore] = {}
+        # MVCC plane (storage/mvcc.py): one TSO client per Database — in
+        # fleet mode it draws batched grants from the meta service's
+        # oracle, so every frontend on the fleet shares one clock — plus
+        # the snapshot pin registry feeding the GC watermark
+        from ..storage.mvcc import MvccRuntime
+        self.mvcc = MvccRuntime(
+            fleet.meta.tso.gen if fleet is not None else None)
         # fleet telemetry plane (obs/telemetry.py): registered daemon
         # addresses polled into information_schema.cluster_metrics /
         # SHOW STATUS cluster.* rows; cheap until daemons register (no
@@ -413,6 +421,7 @@ class Database:
         against dead daemon addresses forever.  Idempotent."""
         self.telemetry.stop()
         self.watchdog.stop()
+        self.mvcc.stop_gc()
 
     def store(self, key: str) -> TableStore:
         return self.stores[key]
@@ -519,13 +528,20 @@ class Database:
                             "the cold_fs_dir flag)")
         return self._cold_fs
 
+    def _new_store(self, info) -> TableStore:
+        """A TableStore joined to this Database's MVCC plane (shared TSO
+        clock + snapshot pin registry)."""
+        st = TableStore(info)
+        st.attach_mvcc(self.mvcc)
+        return st
+
     def make_store(self, info) -> TableStore:
         """Create a table's store; durable (WAL-attached) under data_dir,
         raft-replicated when the Database is fleet-bound."""
         key = f"{info.database}.{info.name}"
         if self.fleet is not None:
             from ..storage.replicated import ReplicatedRowTier
-            st = TableStore(info)
+            st = self._new_store(info)
             tier = ReplicatedRowTier.get_or_create(
                 self.fleet, info.table_id, key, st._row_schema(),
                 [ROWID_COL])
@@ -540,7 +556,7 @@ class Database:
             return st
         if self.cluster is not None:
             from ..storage.remote_tier import RemoteRowTier
-            st = TableStore(info)
+            st = self._new_store(info)
             tier = RemoteRowTier.get_or_create(
                 self.cluster, key, st._row_schema(), [ROWID_COL])
             fs = self.cold_fs()
@@ -572,9 +588,9 @@ class Database:
             st.attach_replicated(tier, cold_rows=cold)
             return st
         if not self.data_dir:
-            return TableStore(info)
+            return self._new_store(info)
         import os
-        st = TableStore(info)
+        st = self._new_store(info)
         pq_dir = os.path.join(self.data_dir, key)
         if os.path.isdir(pq_dir):
             st.load_parquet(pq_dir)
@@ -694,6 +710,13 @@ class Session:
         # PREPARE name FROM '...' bodies (text, re-parsed per EXECUTE; the
         # auto-parameterized plan cache dedups the compiled executables)
         self._prepared: dict[str, str] = {}
+        # explicit MVCC snapshot (SET SNAPSHOT): (pin_id, snap_ts) in the
+        # Database's pin registry, or None.  Automatic analytical pins are
+        # per-SELECT (scoped inside _select) and never land here.
+        self._snapshot: Optional[tuple[int, int]] = None
+        # the snapshot ts the CURRENT query runs at (0 = unpinned read) —
+        # query_log / EXPLAIN ANALYZE read it; set per-SELECT
+        self._snap_ts: int = 0
 
     def _log_binlog(self, event_type, db_name, table, rows=None, statement="",
                     affected=0):
@@ -1065,6 +1088,9 @@ class Session:
         success."""
         from ..utils.flags import FlagError
         for name, value in [(s.name, s.value)] + list(s.more):
+            if name.lower() == "snapshot":
+                self._set_snapshot(value)
+                continue
             if name.lower().startswith("failpoint."):
                 from ..chaos import failpoint as _fp
                 spec = "" if value is None else str(value)
@@ -1090,6 +1116,42 @@ class Session:
             else:
                 self.session_vars[name] = value
         return Result()
+
+    def _set_snapshot(self, value) -> None:
+        """SET SNAPSHOT = 'now' | <ts> | 0/''/OFF — pin (or release) this
+        session's MVCC read timestamp.  Every subsequent SELECT sees
+        exactly the state committed at the pinned instant, regardless of
+        concurrent writes; the pin holds the GC watermark until released
+        (or it expires past ``snapshot_max_age_s``).  Refusals from the
+        ``snapshot.pin`` failpoint surface to the client — an explicit pin
+        must not silently degrade to an unpinned read."""
+        from ..storage.mvcc import SnapshotRefused
+        raw = "" if value is None else str(value).strip()
+        if raw.lower() in ("", "0", "off", "none"):
+            if self._snapshot is not None:
+                self.db.mvcc.snapshots.unpin(self._snapshot[0])
+                self._snapshot = None
+            return
+        if not bool(FLAGS.mvcc):
+            raise SqlError("SET SNAPSHOT requires mvcc=1")
+        if raw.lower() == "now":
+            ts = self.db.mvcc.now_ts()
+        else:
+            try:
+                ts = int(raw)
+            except ValueError:
+                raise SqlError(
+                    f"SET SNAPSHOT expects 'now', a timestamp, or 0/OFF "
+                    f"(got {raw!r})") from None
+        try:
+            with trace.span("snapshot.pin", ts=ts, explicit=True):
+                pid = self.db.mvcc.snapshots.pin(
+                    ts, query="SET SNAPSHOT", holder=self.user)
+        except SnapshotRefused as e:
+            raise SqlError(str(e)) from None
+        if self._snapshot is not None:
+            self.db.mvcc.snapshots.unpin(self._snapshot[0])
+        self._snapshot = (pid, ts)
 
     # -- prepared statements (textual PREPARE/EXECUTE; the wire server's
     # COM_STMT_* path binds ?s into text and rides the same normalizer) ----
@@ -2357,6 +2419,12 @@ class Session:
         mode = str(FLAGS.pushdown_reads)
         if mode == "off" or self._sql_txn is not None:
             return None
+        if self._snap_dirty(stmt):
+            # pinned snapshot with version churn: store daemons evaluate
+            # the physically-latest region image; the versioned read needs
+            # the frontend's MVCC state, so the pin routes this query to
+            # the resident path (quiet tables keep the pushed path)
+            return None
         t = stmt.table
         if t is None:
             return None
@@ -2574,6 +2642,14 @@ class Session:
         from ..index.rollup import try_rewrite
         if getattr(self, "_in_rollup_refresh", False):
             return None      # the refresh GROUP BY must hit the base table
+        if self._snap_ts:
+            # pinned snapshot (explicit SET SNAPSHOT / nested scope): the
+            # rollup tracks commit-time freshness, not the pin — and its
+            # refresh would write AFTER the pin, hiding its own rows from
+            # the versioned read.  Scan the base table versioned instead.
+            # (The automatic analytical pin defers to the rollup in
+            # _snapshot_scope, so this gate only fires for explicit pins.)
+            return None
         if self._sql_txn is not None:
             # inside a transaction the rollup can't see this txn's buffered
             # writes (and refresh would write under the user's locks): scan
@@ -3654,6 +3730,93 @@ class Session:
         return Result(columns=order_names, arrow=table)
 
     def _select(self, stmt: SelectStmt, cache_key=None) -> Result:
+        """MVCC snapshot scope around the planner: resolve the read
+        timestamp (explicit SET SNAPSHOT pin, else an automatic pin for
+        eligible analytical statements), hold it in ``self._snap_ts`` for
+        the whole execution — every batch-staging seam underneath reads
+        it — and release an automatic pin when the query finishes."""
+        with self._snapshot_pinned(stmt):
+            return self._select_impl(stmt, cache_key)
+
+    @contextmanager
+    def _snapshot_pinned(self, stmt: SelectStmt):
+        """Enter this SELECT's snapshot scope (see _snapshot_scope)."""
+        pin = self._snapshot_scope(stmt)
+        if pin is None:
+            yield
+            return
+        pid, ts = pin
+        prev = self._snap_ts
+        self._snap_ts = ts
+        try:
+            yield
+        finally:
+            self._snap_ts = prev
+            if pid is not None:
+                self.db.mvcc.snapshots.unpin(pid)
+
+    def _snapshot_scope(self, stmt: SelectStmt):
+        """(pin_id | None, snap_ts) for this SELECT, or None to read
+        unpinned.  An explicit session pin (SET SNAPSHOT) always applies
+        and is NOT released per-query (pin_id None here).  Otherwise an
+        analytical statement (GROUP BY / aggregates) pins a fresh
+        timestamp automatically for its own duration, so a long scan sees
+        one consistent state under live writes — but only outside SQL
+        transactions (the txn's own locks already isolate it) and off the
+        mesh path (sharded device batches stage through their own seam;
+        documented limitation).  A chaos-refused automatic pin degrades
+        to the unpinned read it would have been before MVCC."""
+        if not bool(FLAGS.mvcc):
+            return None
+        if self._snap_ts:
+            return None     # nested SELECT (subquery): inherit the scope
+        if self._snapshot is not None:
+            return (None, self._snapshot[1])
+        if self._sql_txn is not None or self.mesh is not None:
+            return None
+        from ..expr.ast import AggCall
+        analytical = bool(stmt.group_by) or any(
+            isinstance(it.expr, AggCall) for it in stmt.items)
+        if not analytical:
+            return None
+        if self._try_rollup(stmt, refresh=False) is not None:
+            # a rollup covers this aggregate: the version-gated refresh
+            # already materializes ONE consistent cut of the base table,
+            # and pinning first would hide the refresh's own writes
+            return None
+        if self._pushdown_candidate(stmt) is not None:
+            # served by daemon-plane fragments over their own region
+            # images; snapshot_ts does not travel with fragments yet
+            # (ROADMAP), so a pin only adds TSO/registry round-trips
+            return None
+        from ..storage.mvcc import SnapshotRefused
+        ts = self.db.mvcc.now_ts()
+        try:
+            with trace.span("snapshot.pin", ts=ts, explicit=False):
+                pid = self.db.mvcc.snapshots.pin(
+                    ts, query="auto", holder=self.user)
+        except SnapshotRefused:
+            metrics.count_swallowed("snapshot.autopin")
+            return None
+        return (pid, ts)
+
+    def _snap_dirty(self, stmt) -> bool:
+        """Does the pinned snapshot actually diverge from the live image
+        of this statement's table?  Quiet tables keep their fast paths
+        (egress, point lookup, pushdown): those read the current image,
+        which IS the snapshot state when nothing committed past the pin."""
+        if not self._snap_ts:
+            return False
+        t = getattr(stmt, "table", None)
+        if t is None or getattr(stmt, "joins", None):
+            return True     # multi-table: stage per-table versioned batches
+        dbname = t.database or self.current_db
+        store = self.db.stores.get(f"{dbname}.{t.name}")
+        if store is None:
+            return False    # view / info-schema / unstaged: nothing to pin
+        return store.mvcc_needs_versioned(self._snap_ts)
+
+    def _select_impl(self, stmt: SelectStmt, cache_key=None) -> Result:
         """Plan cache (reference: state_machine.cpp:1984): one logical plan
         per SQL text, one compiled executable per (table versions, shapes)."""
         from ..expr.ast import AggCall
@@ -3664,10 +3827,16 @@ class Session:
         if pushed is not None:
             return pushed
         from . import egress as egress_mod
-        eg = egress_mod.extract(stmt, self)
+        # pinned snapshot over a table with version churn: egress streaming
+        # and rowstore point lookups read the physically-latest image
+        # directly — route them through the versioned batch staging.  A
+        # quiet table's live image IS the snapshot state, so its fast
+        # paths stay engaged (bit-identical by construction).
+        snap_dirty = self._snap_dirty(stmt)
+        eg = None if snap_dirty else egress_mod.extract(stmt, self)
         if eg is not None:
             return self._select_egress(eg, cache_key)
-        point = self._try_point_lookup(stmt)
+        point = None if snap_dirty else self._try_point_lookup(stmt)
         if point is not None:
             return point
         rewritten = self._try_rollup(stmt)
@@ -3859,7 +4028,7 @@ class Session:
                 batches, shape_key, full_scan = self._collect_batches(plan)
         finally:
             self._param_subst = None
-        entry["versions"] = {tk: v for tk, v, _ in shape_key}
+        entry["versions"] = {p[0]: p[1] for p in shape_key}
         if norm is not None:
             from ..expr.params import PARAMS_KEY
             with trace.span("plan.bind"):
@@ -3879,10 +4048,11 @@ class Session:
         if text_key is not None:
             # slow-query rows explain WHY: plan-cache outcome + the
             # capacity buckets the scan batches compiled against
-            buckets = ";".join(f"{tk}={cap}"
-                               for tk, _v, cap in sorted(shape_key))
+            buckets = ";".join(f"{p[0]}={p[2]}"
+                               for p in sorted(shape_key))
             self.db.query_log.append((text_key[0], dur_ms, table.num_rows,
-                                      qlog_outcome, buckets, qp.phase_ms()))
+                                      qlog_outcome, buckets, qp.phase_ms(),
+                                      self._snap_ts))
         return Result(columns=list(table.column_names), arrow=table)
 
     def _param_resolver(self, stmt: SelectStmt):
@@ -3925,7 +4095,8 @@ class Session:
         there is no second timing path."""
         with trace.root("explain_analyze", force=True):
             m = trace.mark()
-            self._explain_analyze_measure(stmt)
+            with self._snapshot_pinned(stmt):
+                self._explain_analyze_measure(stmt)
             spans = trace.since(m)
         lines = self._render_analyze(spans)
         txt = "\n".join(lines)
@@ -3988,8 +4159,8 @@ class Session:
         # capacity buckets + compile telemetry: which shapes this query
         # compiled against, and the engine-wide retrace/compile counters
         # (steady state = xla_retraces stops moving between identical runs)
-        scans = [(tk, cap, batches[tk]) for tk, _v, cap in sorted(shape_key)
-                 if isinstance(batches.get(tk), ColumnBatch)]
+        scans = [(p[0], p[2], batches[p[0]]) for p in sorted(shape_key)
+                 if isinstance(batches.get(p[0]), ColumnBatch)]
         # one fused transfer for all live counts (not an int() per table)
         lives = jax.device_get([b.live_count() for _, _, b in scans])
         for (tk, cap, _b), live in zip(scans, lives):
@@ -4104,6 +4275,14 @@ class Session:
             a = s["attrs"]
             lines.append(f"-- batch: {a['table']} {a['kind']}="
                          f"{a['capacity']} live={a['live']}")
+        snaps = find("snapshot")
+        if snaps:
+            # one line per query: the pinned ts is shared; versions sum
+            a0 = snaps[0]["attrs"]
+            vs = sum(s["attrs"].get("versions_scanned", 0) for s in snaps)
+            lines.append(f"-- snapshot: ts={a0['ts']} "
+                         f"versions_scanned={vs} "
+                         f"gc_watermark={a0['gc_watermark']}")
         for s in find("xla"):
             a = s["attrs"]
             lines.append(f"-- xla: retraces_total={a['retraces_total']} "
@@ -4168,6 +4347,42 @@ class Session:
                      "(SHOW PROFILE shows the same span records)")
         return lines
 
+    def _snapshot_batch(self, table_key: str, store) -> \
+            Optional[ColumnBatch]:
+        """Versioned device batch at the pinned ``self._snap_ts``: the
+        live image concatenated with the history versions alive at the
+        snapshot, with the MVCC visibility predicate
+        (storage/mvcc.visibility_mask) ANDed into the batch's sel mask —
+        the versioned read stays INSIDE the jitted plan as a sel-mask, no
+        host-side row filtering.  None when the resident image already
+        equals the snapshot (quiet table): the caller reuses the cached
+        unversioned batch, so the pin is free AND bit-identical there."""
+        import jax.numpy as jnp
+
+        from ..column.batch import bucket_capacity, pad_batch
+        from ..storage.mvcc import visibility_mask
+
+        snap = self._snap_ts
+        with trace.span("mvcc.visibility", table=table_key, ts=snap):
+            sv = store.snapshot_versions(snap)
+            wm = self.db.mvcc.snapshots.watermark(
+                self.db.mvcc.tso.last_ts())
+            if sv is None:
+                trace.event("snapshot", ts=snap, table=table_key,
+                            versions_scanned=0, gc_watermark=wm)
+                return None
+            tbl, cts, dts, nver = sv
+            b = ColumnBatch.from_arrow(tbl)
+            mask = visibility_mask(jnp.asarray(cts), jnp.asarray(dts),
+                                   jnp.int64(snap))
+            b = b.and_sel(mask)
+            if bool(FLAGS.batch_bucketing):
+                b = pad_batch(b, bucket_capacity(
+                    len(b), int(FLAGS.batch_bucket_min)))
+            trace.event("snapshot", ts=snap, table=table_key,
+                        versions_scanned=nver, gc_watermark=wm)
+            return b
+
     def _collect_batches(self, plan: PlanNode):
         from ..plan.nodes import ScanNode
 
@@ -4219,7 +4434,18 @@ class Session:
                     info = self.db.catalog.get_table(db, name)
                     store = self.db.stores[n.table_key] = self.db.make_store(info)
                 b = None
-                if self.mesh is None and scan_count[n.table_key] == 1:
+                snapped = False
+                # pinned snapshot: a table with version churn past the pin
+                # stages the versioned image (replacing index-gathered
+                # subsets and streamed chunk sources, which read the
+                # physically-latest image); a QUIET table declines here
+                # (b stays None) and keeps every fast path below — its
+                # live image is the snapshot state, bit-identical
+                if self._snap_ts and self.mesh is None:
+                    b = self._snapshot_batch(n.table_key, store)
+                    snapped = b is not None
+                if b is None and \
+                        self.mesh is None and scan_count[n.table_key] == 1:
                     if n.ann is not None:
                         b = self._ann_batch(n, store)
                     if b is None:
@@ -4239,15 +4465,21 @@ class Session:
                             b = store.device_table_batch()
                             full_scan.add(n.table_key)
                 batches[n.table_key] = b
-                key_parts.append((n.table_key, store.version,
-                                  len(batches[n.table_key])))
+                # snapped batches append a constant marker, NOT the ts:
+                # executables are shape-keyed, and two pins at different
+                # timestamps with the same shapes must share one compile
+                key_parts.append(
+                    (n.table_key, store.version,
+                     len(batches[n.table_key])) if not snapped else
+                    (n.table_key, store.version,
+                     len(batches[n.table_key]), "snap"))
                 scan_beat(n.table_key, b)
             for c in n.children:
                 walk_plan(c)
 
         walk_plan(plan)
 
-        captured = {tk: v for tk, v, _ in key_parts}
+        captured = {p[0]: p[1] for p in key_parts}
 
         def walk_presort(n: PlanNode):
             spec = getattr(n, "presort", None)
@@ -4630,7 +4862,22 @@ class Session:
                                     pa.float64()),
                 "egress_ms": pa.array([ph(e, "egress") for e in log],
                                       pa.float64()),
+                # MVCC read timestamp the query ran at (0 = unpinned);
+                # pre-MVCC 6-tuples read as 0
+                "snapshot_ts": pa.array(
+                    [int(e[6]) if len(e) > 6 else 0 for e in log],
+                    pa.int64()),
             }) if log else _empty_info("query_log")
+        if name == "snapshots":
+            rows = self.db.mvcc.snapshots.describe()
+            return pa.table({
+                "snapshot_ts": pa.array([r["snapshot_ts"] for r in rows],
+                                        pa.int64()),
+                "age_ms": pa.array([r["age_ms"] for r in rows],
+                                   pa.int64()),
+                "query": [r["query"] for r in rows],
+                "holder": [r["holder"] for r in rows],
+            }) if rows else _empty_info("snapshots")
         if name == "processlist":
             rows = [qp.row() for qp in PROGRESS.live(self.db)]
             rows.sort(key=lambda r: r["query_id"])
@@ -4955,7 +5202,7 @@ class Session:
         # literal-dependent (two members' same-shaped inputs would hold
         # DIFFERENT rows), information_schema (version -1) renders fresh
         # per call, and host presort permutations are per-plan-object state
-        for tk, v, _cap in shape_key:
+        for tk, v, *_rest in shape_key:
             if v < 0 or tk not in full_scan:
                 return inline()
         if any(k.startswith("__presort__") for k in batches):
@@ -4969,7 +5216,7 @@ class Session:
         # stored plan.
         group_key = (lookup_key, shape_key, entry["plan_sig"])
         ck_base = (lookup_key, entry["plan_sig"],
-                   tuple((tk, cap) for tk, _v, cap in shape_key),
+                   tuple((p[0],) + tuple(p[2:]) for p in shape_key),
                    int(FLAGS.radix_join_buckets),
                    int(FLAGS.radix_join_min_build))
         try:
@@ -5000,8 +5247,11 @@ class Session:
         # execution flags join the key: flipping SET GLOBAL
         # radix_join_buckets must re-trace, not silently reuse an executable
         # compiled under the other strategy
-        versions_key = tuple((tk, v) for tk, v, _cap in shape_key)
-        shape_key = (tuple((tk, cap) for tk, _v, cap in shape_key),
+        versions_key = tuple((p[0], p[1]) for p in shape_key)
+        # snapped batches keep their "snap" marker in the compile key: the
+        # versioned staging can change the batch's pytree structure vs the
+        # cached resident image at the same capacity
+        shape_key = (tuple((p[0],) + tuple(p[2:]) for p in shape_key),
                      int(FLAGS.radix_join_buckets),
                      int(FLAGS.radix_join_min_build))
 
@@ -5111,8 +5361,8 @@ class Session:
                             sig = entry["plan_sig"] = plan_signature(plan)
                         compilecache.EXECUTABLES.record_compile(
                             "plan", entry.get("text") or "<unnamed>", sig,
-                            ";".join(f"{tk}={cap}"
-                                     for tk, cap in shape_key[0]),
+                            ";".join(f"{p[0]}={p[1]}"
+                                     for p in shape_key[0]),
                             cms, fn, (batches,))
             grew = False
             # ONE explicit transfer for every overflow flag: int(flag) per

@@ -197,7 +197,14 @@ class Catalog:
                              Field("parse_ms", LType.FLOAT64),
                              Field("plan_ms", LType.FLOAT64),
                              Field("exec_ms", LType.FLOAT64),
-                             Field("egress_ms", LType.FLOAT64))),
+                             Field("egress_ms", LType.FLOAT64),
+                             Field("snapshot_ts", LType.INT64))),
+        # live MVCC snapshot pins (SET SNAPSHOT + automatic analytical
+        # pins): what holds the GC watermark right now
+        "snapshots": Schema((Field("snapshot_ts", LType.INT64),
+                             Field("age_ms", LType.INT64),
+                             Field("query", LType.STRING),
+                             Field("holder", LType.STRING))),
         "trace_spans": Schema((Field("query_id", LType.INT64),
                                Field("trace_id", LType.STRING),
                                Field("span_id", LType.STRING),

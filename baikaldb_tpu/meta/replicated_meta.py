@@ -380,6 +380,12 @@ class ReplicatedMeta:
         return _TsoFacade(self)
 
     def tso_gen(self, count: int = 1) -> int:
+        """One raft propose per GRANT, not per timestamp: the leader's
+        clock rides the command, every replica applies the same
+        deterministic `gen_at`, and the save-ahead lease in the meta
+        snapshot keeps grants monotonic across leader kills — this is
+        the refill seam behind storage/mvcc.TsoClient's batched
+        ranges."""
         import time as _time
 
         return self._propose({"op": "tso", "count": count,

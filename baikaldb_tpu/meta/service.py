@@ -135,7 +135,15 @@ class HeartbeatResponse:
 
 class Tso:
     """Hybrid timestamp oracle (reference: tso_state_machine.cpp — physical ms
-    << 18 | logical, batched, monotonic across restarts via save-ahead)."""
+    << 18 | logical, batched, monotonic across restarts via save-ahead).
+
+    A grant of ``count`` timestamps IS the integer interval
+    ``[first, first + count)`` — logical overflow carries into the
+    physical bits by ordinary arithmetic — which is what lets
+    storage/mvcc.TsoClient serve allocations as in-memory bumps inside a
+    granted range and pay one raft propose per ``tso_batch_size``
+    (MVCC commit_ts stamping and snapshot pins both draw from it;
+    tests/test_tso.py pins the contract)."""
 
     LOGICAL_BITS = 18
 

@@ -50,12 +50,23 @@ class TwoPhaseCoordinator:
         self.groups = groups
 
     def write(self, per_group_ops: dict[int, list], crash_after: str = "",
-              txn_id: int | None = None) -> int:
+              txn_id: int | None = None, commit_ts: int = 0) -> int:
         """ops per region_id; returns the txn id.  Raises TwoPhaseError on a
-        failed prepare (everything rolled back)."""
+        failed prepare (everything rolled back).
+
+        ``commit_ts``: the transaction's MVCC commit timestamp, stamped at
+        DECIDE time — it rides the decision record's raft log entry as a
+        trailing 8-byte field, so the one instant every region's versions
+        become visible at is itself quorum-persisted.  Replica apply reads
+        only ``body[0]`` (the outcome byte), so old snapshots/replicas
+        decode the extended record unchanged."""
         from ..obs import trace
 
         txn = txn_id or next_txn_id()
+        decide_commit = bytes([CMD_COMMIT])
+        if commit_ts:
+            import struct
+            decide_commit += struct.pack("<Q", int(commit_ts))
         by_region = {g.region_id: g for g in self.groups}
         # phase 1: PREPARE everywhere (each is itself raft-committed)
         prepared = []
@@ -87,8 +98,7 @@ class TwoPhaseCoordinator:
             if failpoint.ENABLED:
                 dropped = failpoint.hit("2pc.decide", txn=txn)
             decided = (not dropped) and \
-                self.primary.propose_cmd(CMD_DECIDE, txn,
-                                         bytes([CMD_COMMIT]))
+                self.primary.propose_cmd(CMD_DECIDE, txn, decide_commit)
         if not decided:
             # A failed propose does NOT mean the decision failed to commit —
             # a timeout can lose the ack, not the entry.  Rolling prepares

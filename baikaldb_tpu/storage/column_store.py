@@ -143,6 +143,7 @@ class TxnContext:
         self.store = store
         self.row_txn: Txn = store.row_table.begin()
         self._snap = None
+        self._mvcc_pre = None
 
     def _capture(self):
         """Called by the store (under its lock) before the first mutation."""
@@ -150,8 +151,14 @@ class TxnContext:
             st = self.store
             self._snap = (list(st.regions),
                           [(r, r.data, r.rowids, r.version) for r in st.regions])
+            # MVCC preimage rides the same capture: rollback must also
+            # unwind PENDING stamps and this txn's history entries
+            self._mvcc_pre = st._mvcc.capture()
 
-    def commit(self):
+    def commit(self, commit_ts: int | None = None):
+        """``commit_ts``: the decide-time MVCC stamp — multi-table commits
+        (commit_group) pass ONE timestamp for the whole transaction; None
+        allocates a fresh one for this table alone."""
         try:
             if self.store.replicated is not None:
                 # SQL COMMIT on a replicated table: the buffered write set
@@ -174,10 +181,25 @@ class TxnContext:
                 # non-durable store: the buffered rows would never be read —
                 # just release the row locks
                 self.row_txn.rollback()
+            self._stamp_commit(commit_ts)
         finally:
             # release the writer lease even on a failed WAL write, or every
             # later statement on this table would conflict forever
             self.store._end_txn(self)
+
+    def _stamp_commit(self, commit_ts: int | None = None):
+        """Replace this txn's PENDING version stamps with the decide-time
+        commit_ts — after the write is durable, before the lease releases
+        (single-writer, so every PENDING stamp is ours)."""
+        from ..utils.flags import FLAGS
+        if not FLAGS.mvcc:
+            return
+        st = self.store
+        with st._lock:
+            if commit_ts is None:
+                commit_ts = st._mvcc_ts()
+            st._mvcc.restamp_pending(int(commit_ts))
+            st._mvcc_maybe_gc(int(commit_ts))
 
     def _restore_preimage(self):
         st = self.store
@@ -193,6 +215,8 @@ class TxnContext:
                     r.version = max(r.version, version) + 1
                 st._mutations += 1
                 st._pk_stale = True
+            if self._mvcc_pre is not None:
+                st._mvcc.restore(self._mvcc_pre)
 
     def rollback(self):
         self.row_txn.rollback()
@@ -209,9 +233,16 @@ def commit_group(tctxs: list["TxnContext"]) -> None:
     separate.cpp:653); either all tables' writes replicate or none do, and
     every column cache rolls back to its pre-image on failure.  Non-
     replicated stores fall back to their per-table commit (WAL flush)."""
+    from ..utils.flags import FLAGS
     from .remote_tier import RemoteRowTier, write_ops_atomic_remote
     from .replicated import ReplicatedRowTier, write_ops_atomic
 
+    # ONE decide-time commit timestamp for the whole transaction: every
+    # table's versions become visible at the same instant, so a snapshot
+    # either sees all of this transaction or none of it
+    commit_ts = None
+    if FLAGS.mvcc and tctxs:
+        commit_ts = tctxs[0].store._mvcc_ts()
     fleet = [t for t in tctxs
              if isinstance(t.store.replicated, ReplicatedRowTier)]
     remote = [t for t in tctxs
@@ -228,7 +259,12 @@ def commit_group(tctxs: list["TxnContext"]) -> None:
                 pairs.append((t.store.replicated, t.row_txn.pending_ops()))
                 t.row_txn.rollback()  # buffer only ever held the row locks
             try:
-                atomic(pairs)
+                if atomic is write_ops_atomic:
+                    # the fleet 2PC persists the commit_ts in the decision
+                    # record's log entry (raft/twopc.py)
+                    atomic(pairs, commit_ts=commit_ts or 0)
+                else:
+                    atomic(pairs)
             except Exception:
                 for t in group:
                     t._restore_preimage()
@@ -250,9 +286,10 @@ def commit_group(tctxs: list["TxnContext"]) -> None:
             raise
         else:
             for t in group:
+                t._stamp_commit(commit_ts)
                 t.store._end_txn(t)
     for t in others:
-        t.commit()
+        t.commit(commit_ts=commit_ts)
 
 
 class TableStore:
@@ -303,6 +340,15 @@ class TableStore:
         # reference allocates ranges from meta's auto_incr_state_machine;
         # single-process: the store IS the allocator)
         self._auto_incr: Optional[int] = None
+        # MVCC version bookkeeping (storage/mvcc.py): commit stamps +
+        # dead-version history kept BESIDE the resident Arrow image, all
+        # mutated under this table's lock.  The TSO client / snapshot
+        # registry are engine-shared (attach_mvcc); a standalone store
+        # lazily builds a process-local oracle on first stamp
+        from .mvcc import MvccState
+        self._mvcc = MvccState()
+        self._tso = None
+        self._snap_reg = None
         self._build_row_tier(None)
         # primary-key uniqueness index (lazy; bulk loads mark it stale)
         pk = info.primary_key() if hasattr(info, "primary_key") else None
@@ -569,6 +615,116 @@ class TableStore:
             self._table_device = b
             self._table_device_key = key
             return self._table_device
+
+    # -- MVCC (storage/mvcc.py) ------------------------------------------
+    def attach_mvcc(self, runtime) -> None:
+        """Share the engine's MVCC plane (Database.mvcc): one TSO client
+        and one snapshot registry across every table, so commit order is
+        a total order engine-wide."""
+        self._tso = runtime.tso
+        self._snap_reg = runtime.snapshots
+
+    def _mvcc_ts(self) -> int:
+        """A fresh commit timestamp (lazy local oracle when unattached)."""
+        if self._tso is None:
+            from .mvcc import TsoClient
+            self._tso = TsoClient()
+        return self._tso.next_ts()
+
+    def _mvcc_stamp_new(self, rowids, tctx) -> None:
+        """Stamp freshly-appended rows: PENDING inside a transaction
+        (restamped at decide time), a fresh ts for autocommit."""
+        from ..utils.flags import FLAGS
+        from .mvcc import PENDING
+        if not FLAGS.mvcc:
+            return
+        cts = PENDING if tctx is not None else self._mvcc_ts()
+        self._mvcc.stamp(rowids, cts)
+        if tctx is None:
+            self._mvcc_maybe_gc(cts)
+
+    def _mvcc_record_dead(self, rows: list[dict], rowids, tctx,
+                          ts: int | None = None) -> int:
+        """Old versions of deleted/updated rows enter history; returns the
+        delete_ts used (PENDING in-txn) so updates can stamp the new
+        versions with the same instant."""
+        from ..utils.flags import FLAGS
+        from .mvcc import PENDING
+        if not FLAGS.mvcc:
+            return 0
+        dts = PENDING if tctx is not None else (ts or self._mvcc_ts())
+        self._mvcc.record_dead(rows, rowids, dts)
+        return dts
+
+    def _mvcc_maybe_gc(self, now_ts: int, threshold: int = 512) -> None:
+        """Opportunistic commit-seam sweep: keeps version debt bounded
+        without a background thread.  Caller holds the table lock; the
+        registry lock (rank 12) nests INSIDE it (rank 10) — ascending."""
+        if len(self._mvcc.history) < threshold:
+            return
+        wm = self._snap_reg.watermark(now_ts) if self._snap_reg is not None \
+            else now_ts
+        self._mvcc.gc(wm)
+
+    def mvcc_gc(self, watermark: int) -> int:
+        """One watermark-driven sweep (MvccRuntime.gc / the GC thread)."""
+        with self._lock:
+            return self._mvcc.gc(int(watermark))
+
+    def mvcc_needs_versioned(self, snap_ts: int) -> bool:
+        """True when a read pinned at ``snap_ts`` cannot be served by the
+        CURRENT resident image: some commit landed after the snapshot, or
+        a version alive at it has since died.  Cheap (no image build) —
+        the session uses it to keep the fast paths (egress, point lookup,
+        access-path gathers, streaming, pushdown) engaged on quiet tables
+        under a pin, where live and snapshot images are identical."""
+        snap_ts = int(snap_ts)
+        with self._lock:
+            mv = self._mvcc
+            return bool(mv.versions_at(snap_ts)) or \
+                any(c > snap_ts for c in mv.live_cts.values())
+
+    def snapshot_versions(self, snap_ts: int):
+        """The versioned read image at ``snap_ts``, or None when the
+        CURRENT resident image already equals it (no commit after the
+        snapshot, no relevant dead version) — the fast path that makes an
+        automatic pin free on quiet tables and keeps it bit-identical to
+        the unpinned read.
+
+        Returns ``(table, cts, dts, versions_scanned)``: the live image
+        concatenated with history versions alive at snap_ts, plus aligned
+        int64 commit/delete timestamp arrays for the device-side
+        visibility mask.  Built atomically under the table lock, so the
+        caller gets ONE instant even while writes flow — and because the
+        history rides the table (frontend-level), a region split or
+        migration mid-query never moves it."""
+        from .mvcc import MAX_TS
+        snap_ts = int(snap_ts)
+        with self._lock:
+            mv = self._mvcc
+            hist = mv.versions_at(snap_ts)
+            if not hist and not any(c > snap_ts
+                                    for c in mv.live_cts.values()):
+                return None
+            live = self.snapshot()
+            regions = self.regions
+            rowids = (np.concatenate([r.rowids for r in regions])
+                      if regions else np.empty(0, dtype=np.int64))
+            lc = mv.live_cts
+            cts = np.fromiter((lc.get(int(rid), 0) for rid in rowids),
+                              dtype=np.int64, count=len(rowids))
+            dts = np.full(len(rowids), MAX_TS, dtype=np.int64)
+            if hist:
+                htbl = pa.Table.from_pylist([h[0] for h in hist],
+                                            schema=live.schema)
+                live = pa.concat_tables([live, htbl])
+                cts = np.concatenate(
+                    [cts, np.fromiter((h[1] for h in hist), dtype=np.int64,
+                                      count=len(hist))])
+                dts = np.concatenate(
+                    [dts, np.fromiter((h[2] for h in hist), dtype=np.int64,
+                                      count=len(hist))])
+            return live, cts, dts, len(hist)
 
     def column_stats(self, column: str) -> dict:
         """Host-side column statistics for planner decisions (the analog of
@@ -1237,6 +1393,7 @@ class TableStore:
             self._mutations += 1
             self._pk_stale = True
             self._append_table(table, rowids)
+            self._mvcc_stamp_new(rowids, tctx)
 
     def insert_rows(self, rows: list[dict], tctx: Optional[TxnContext] = None):
         """Hot insert (SQL INSERT ... VALUES): duplicate-PK checked, written
@@ -1256,6 +1413,7 @@ class TableStore:
                     for r, rid in zip(rows, rowids)]
             self._write_hot(recs, tctx)
             self._append_table(table, rowids)
+            self._mvcc_stamp_new(rowids, tctx)
             if new_keys and self._pk_index is not None and not self._pk_stale:
                 for k, rid in zip(new_keys, rowids):
                     self._pk_index[k] = int(rid)
@@ -1281,6 +1439,10 @@ class TableStore:
             fresh = (self._pk_codec is not None and
                      self._pk_index is not None and not self._pk_stale)
             dead_keys: list[bytes] = []
+            from ..utils.flags import FLAGS as _FLAGS
+            mvcc_on = bool(_FLAGS.mvcc)
+            dead_rows: list[dict] = []
+            dead_rids: list[int] = []
             for r in self.regions:
                 if not r.num_rows:
                     continue
@@ -1292,6 +1454,12 @@ class TableStore:
                     if collect_cols is not None:
                         collected.append(
                             r.data.filter(pa.array(mask)).select(collect_cols))
+                    if mvcc_on:
+                        # the outgoing versions: tombstoned into history at
+                        # phase 2 so a pinned snapshot still sees them
+                        dead_rows.extend(
+                            r.data.filter(pa.array(mask)).to_pylist())
+                        dead_rids.extend(int(x) for x in r.rowids[mask])
                     markers.extend({ROWID: int(rid), "__del": True}
                                    for rid in r.rowids[mask])
                     masks.append((r, mask))
@@ -1303,6 +1471,8 @@ class TableStore:
             self._write_hot(markers, tctx)
             # phase 2: the delete is durable/replicated — apply to columns
             self._mutations += 1
+            if mvcc_on:
+                self._mvcc_record_dead(dead_rows, dead_rids, tctx)
             for r, mask in masks:
                 r.data = r.data.filter(pa.array(~mask))
                 r.rowids = r.rowids[~mask]
@@ -1338,6 +1508,10 @@ class TableStore:
             # so a failed hot-tier write (raft no-quorum on replicated
             # tables) leaves the columnar cache consistent
             staged: list[tuple[Region, pa.Table]] = []
+            from ..utils.flags import FLAGS as _FLAGS
+            mvcc_on = bool(_FLAGS.mvcc)
+            old_vers: list[dict] = []
+            old_rids: list[int] = []
             for r in self.regions:
                 if not r.num_rows:
                     continue
@@ -1352,6 +1526,12 @@ class TableStore:
                                         .select(collect_cols))
                         new_rows_t.append(new_data.filter(pa.array(mask))
                                           .select(collect_cols))
+                    if mvcc_on:
+                        # pre-update versions close at the commit instant;
+                        # the new versions open at the same instant
+                        old_vers.extend(
+                            r.data.filter(pa.array(mask)).to_pylist())
+                        old_rids.extend(int(x) for x in r.rowids[mask])
                     new_rows = new_data.filter(pa.array(mask)).to_pylist()
                     hot.extend(dict(row, **{ROWID: int(rid)})
                                for row, rid in zip(new_rows, r.rowids[mask]))
@@ -1380,6 +1560,11 @@ class TableStore:
             self._write_hot(hot, tctx)
             # phase 2: durable/replicated — install the new region tables
             self._mutations += 1
+            if mvcc_on and old_vers:
+                dts = self._mvcc_record_dead(old_vers, old_rids, tctx)
+                # newest-wins is structural: the dying version's interval
+                # closes exactly where the new version's opens
+                self._mvcc.stamp(old_rids, dts)
             if self._pk_cols is not None and (
                     changed_cols is None or
                     any(c in self._pk_cols for c in changed_cols)):
@@ -1480,6 +1665,9 @@ class TableStore:
             self._pk_stale = True
             self.regions = [Region(self._alloc_region_id(),
                                    self.arrow_schema.empty_table())]
+            # TRUNCATE is a version horizon: prior stamps and history
+            # describe an image that no longer exists
+            self._mvcc.reset()
             self._reset_wal()
             if self.durable_dir:
                 self.save_parquet(self.durable_dir)
@@ -1517,6 +1705,11 @@ class TableStore:
                 raise ConflictError("ALTER while a transaction is open")
             self._mutations += 1
             self._pk_stale = True
+            # history rows carry the OLD schema's columns; rewriting them
+            # is not worth it (ALTER is a checkpoint boundary like the WAL
+            # reset below) — snapshots pinned before the ALTER re-read the
+            # post-ALTER image, exactly like the pre-MVCC engine
+            self._mvcc.reset()
             self.info.schema = new_schema
             self.info.version += 1
             self.arrow_schema = schema_to_arrow(new_schema)
@@ -1578,6 +1771,7 @@ class TableStore:
         with self._lock:
             self._mutations += 1
             self._pk_stale = True
+            self._mvcc.reset()      # the image is replaced wholesale
             self.regions = []
             for f in files:
                 t = pq.read_table(os.path.join(directory, f))

@@ -78,14 +78,18 @@ def _schema_arrow(schema: Schema):
     return schema_to_arrow(schema)
 
 
-def write_ops_atomic(pairs: list[tuple["ReplicatedRowTier", list]]) -> None:
+def write_ops_atomic(pairs: list[tuple["ReplicatedRowTier", list]],
+                     commit_ts: int = 0) -> None:
     """Commit several tiers' write batches as ONE transaction: a single
     primary-first 2PC across the union of every touched region group (the
     reference's global-index DML, where LockPrimaryNode/LockSecondaryNode
     span main-table and index regions — separate.cpp:653).  All tiers must
     belong to the same fleet (region ids are fleet-unique, allocated by
     meta).  Raises ReplicationError on quorum loss; nothing applies unless
-    the decision record commits."""
+    the decision record commits.
+
+    ``commit_ts``: the transaction's MVCC decide-time stamp, persisted in
+    the decision record's log entry (raft/twopc.py) — 0 = unstamped."""
     pairs = [(t, ops) for t, ops in pairs if ops]
     if not pairs:
         return
@@ -117,7 +121,8 @@ def write_ops_atomic(pairs: list[tuple["ReplicatedRowTier", list]]) -> None:
         else:
             try:
                 TwoPhaseCoordinator(groups).write(by_region,
-                                                  txn_id=next_txn_id())
+                                                  txn_id=next_txn_id(),
+                                                  commit_ts=commit_ts)
             except TwoPhaseError as e:
                 raise ReplicationError(str(e)) from None
         for t in tiers:

@@ -15,6 +15,13 @@ ctypes; this module adds:
 
 This tier feeds the columnar tier (storage/column_store.py) the way the
 reference's row Regions feed the cold Parquet tier (region_olap.cpp).
+
+MVCC division of labor: the snapshot isolation HERE is engine-internal
+(per-table write sequence numbers ordering a RowTable's own history —
+the RocksDB-sequence analog).  Cross-table analytical snapshots are the
+job of storage/mvcc.py: globally ordered commit_ts from the meta TSO,
+stamped at 2PC decide time, with visibility evaluated as a sel-mask on
+the columnar tier.  The two never exchange timestamps.
 """
 
 from __future__ import annotations

@@ -1650,6 +1650,141 @@ def run_fragment_bench() -> dict:
     }
 
 
+def run_snapshot_bench() -> dict:
+    """Snapshot-reads line: a pinned analytical GROUP BY repeated while an
+    OLTP write stream mutates the same table, against the two isolations
+    (writes alone, analytics alone with mvcc off).  The hard contract
+    gated by tools/bench_regress.py: ZERO lost writes, the pinned
+    aggregate stayed bit-identical across every repetition under live
+    inserts+updates, mvcc=0 replays the unpinned plan bit-identically on
+    quiesced data (the off-switch really is free), and the mixed-phase
+    write p99 stays within a documented multiple of write-only
+    isolation."""
+    from baikaldb_tpu.exec.session import Database, Session
+    import baikaldb_tpu.storage.mvcc  # noqa: F401 — registers the flags
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    n_writes = int(os.environ.get("BENCH_SNAPSHOT_WRITES", 240))
+    n_aggs = int(os.environ.get("BENCH_SNAPSHOT_AGGS", 12))
+    seed_rows = 256
+    agg_sql = ("SELECT g, COUNT(*) AS c, SUM(v) AS sv FROM t "
+               "GROUP BY g ORDER BY g")
+
+    def mk():
+        s = Session(Database())
+        s.execute("CREATE DATABASE sb")
+        s.execute("USE sb")
+        s.execute("CREATE TABLE t (k BIGINT, g BIGINT, v BIGINT)")
+        vals = ", ".join(f"({i}, {i % 8}, {i * 3})"
+                         for i in range(seed_rows))
+        s.execute(f"INSERT INTO t VALUES {vals}")
+        return s
+
+    def pq(lat: list, q: float) -> float:
+        srt = sorted(lat)
+        return round(srt[min(len(srt) - 1, int(q * (len(srt) - 1) + 0.5))],
+                     3)
+
+    mvcc0 = bool(FLAGS.mvcc)
+    try:
+        set_flag("mvcc", 1)
+
+        # write-only isolation: the same stream, no analytics running
+        s = mk()
+        issued = seed_rows
+        lat_iso: list[float] = []
+        for i in range(n_writes):
+            k = issued
+            issued += 1
+            w0 = time.perf_counter()
+            if i % 4 == 3:      # churn versions, not just append
+                s.execute(f"UPDATE t SET v = v + 1 WHERE k = {k % 64}")
+                issued -= 1
+            else:
+                s.execute(f"INSERT INTO t VALUES ({k}, {k % 8}, {k * 3})")
+            lat_iso.append((time.perf_counter() - w0) * 1e3)
+
+        # mixed phase, snapshot ON: pin once, interleave write bursts with
+        # the pinned aggregate; every repetition must be bit-identical
+        s = mk()
+        s.execute("SET SNAPSHOT = 'now'")
+        base = s.query(agg_sql)
+        issued = seed_rows
+        lat_mix: list[float] = []
+        agg_on_ms: list[float] = []
+        identical = 0
+        burst = max(1, n_writes // n_aggs)
+        for r in range(n_aggs):
+            for i in range(burst):
+                k = issued
+                issued += 1
+                w0 = time.perf_counter()
+                if i % 4 == 3:
+                    s.execute(f"UPDATE t SET v = v + 1 WHERE k = {k % 64}")
+                    issued -= 1
+                else:
+                    s.execute(
+                        f"INSERT INTO t VALUES ({k}, {k % 8}, {k * 3})")
+                lat_mix.append((time.perf_counter() - w0) * 1e3)
+            a0 = time.perf_counter()
+            got = s.query(agg_sql)
+            agg_on_ms.append((time.perf_counter() - a0) * 1e3)
+            identical += int(got == base)
+        s.execute("SET SNAPSHOT = 0")   # unpin BEFORE counting live rows
+        lost = issued - s.query("SELECT COUNT(*) AS c FROM t")[0]["c"]
+
+        # mixed phase, snapshot OFF: identical interleave, unpinned live
+        # reads (results drift by design — only the wall clock is kept)
+        set_flag("mvcc", 0)
+        s = mk()
+        issued = seed_rows
+        agg_off_ms: list[float] = []
+        for r in range(n_aggs):
+            for i in range(burst):
+                k = issued
+                issued += 1
+                if i % 4 == 3:
+                    s.execute(f"UPDATE t SET v = v + 1 WHERE k = {k % 64}")
+                    issued -= 1
+                else:
+                    s.execute(
+                        f"INSERT INTO t VALUES ({k}, {k % 8}, {k * 3})")
+            a0 = time.perf_counter()
+            s.query(agg_sql)
+            agg_off_ms.append((time.perf_counter() - a0) * 1e3)
+
+        # off-switch bit-identity on quiesced data: mvcc=0 and mvcc=1
+        # (unpinned, auto-pin at now) must agree to the bit
+        off_rows = s.query(agg_sql)
+        set_flag("mvcc", 1)
+        off_identical = s.query(agg_sql) == off_rows
+    finally:
+        set_flag("mvcc", int(mvcc0))
+
+    qps_on = n_aggs / (sum(agg_on_ms) / 1e3)
+    qps_off = n_aggs / (sum(agg_off_ms) / 1e3)
+    return {
+        "metric": f"snapshot reads: pinned GROUP BY under live "
+                  f"inserts+updates vs mvcc off ({n_writes} writes, "
+                  f"{n_aggs} repetitions)",
+        "value": round(qps_on, 1),
+        "unit": "queries/sec",
+        # <1 means the snapshot (versioned staging + sel-mask) costs
+        "vs_baseline": round(qps_on / qps_off, 3),
+        "analytics_snap_on_p50_ms": pq(agg_on_ms, 0.50),
+        "analytics_snap_off_p50_ms": pq(agg_off_ms, 0.50),
+        "write_p99_iso_ms": pq(lat_iso, 0.99),
+        "write_p99_mixed_ms": pq(lat_mix, 0.99),
+        "snap_rounds": n_aggs,
+        "snap_identical_rounds": identical,
+        "off_bit_identical": bool(off_identical),
+        "lost_writes": int(lost),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
 def _emit_fragment_line(skip_reason: str | None = None):
     """Pushed-fragment JSON line: store-side execution vs the frontend
     funnel, plus the dispatch counters bench_regress gates on.  Same
@@ -1670,6 +1805,29 @@ def _emit_fragment_line(skip_reason: str | None = None):
                             "rows/sec store-side vs frontend-pulled "
                             "(failed)",
                   "value": 0, "unit": "rows/sec", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
+def _emit_snapshot_line(skip_reason: str | None = None):
+    """Snapshot-reads JSON line: pinned analytics under live writes vs
+    mvcc off, plus the consistency counters bench_regress gates on.  Same
+    robustness contract: always prints a line, never raises."""
+    if os.environ.get("BENCH_SKIP_SNAPSHOT") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "snapshot reads: pinned GROUP BY under live "
+                      "inserts+updates vs mvcc off (skipped)",
+            "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+            "error": skip_reason}))
+        return
+    try:
+        result = run_snapshot_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "snapshot reads: pinned GROUP BY under live "
+                            "inserts+updates vs mvcc off (failed)",
+                  "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
                   "error": f"{type(e).__name__}: {e}"}
     print(json.dumps(result))
 
@@ -2022,6 +2180,8 @@ def main():
                                   "failed; stream phase skipped")
                 _emit_fragment_line(skip_reason="accelerator probe "
                                     "failed; fragment phase skipped")
+                _emit_snapshot_line(skip_reason="accelerator probe "
+                                    "failed; snapshot phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -2068,6 +2228,7 @@ def main():
             _emit_elastic_line()
             _emit_stream_line()
             _emit_fragment_line()
+            _emit_snapshot_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
@@ -2083,6 +2244,7 @@ def main():
     _emit_elastic_line()
     _emit_stream_line()
     _emit_fragment_line()
+    _emit_snapshot_line()
     return 0
 
 

@@ -527,6 +527,37 @@ def test_param_feed_fixture_hostsync_flagged(tmp_path):
     assert out == [("HOSTSYNC", 6)]
 
 
+# ---- the MVCC visibility mask stays jit-clean ------------------------------
+
+def test_visibility_mask_module_is_clean():
+    """storage/mvcc.py's visibility_mask is staged INSIDE jitted plans (the
+    snapshot sel-mask): the module must never grow a HOSTSYNC/RETRACE/
+    METRICINJIT violation.  Focused run so a suppression added for another
+    module cannot mask a regression here."""
+    cfg = LintConfig(suppression_file=os.path.join(
+        REPO, "tools", "tpulint_suppressions.txt"))
+    vs = run_lint([os.path.join(REPO, "baikaldb_tpu", "storage", "mvcc.py")],
+                  cfg, root=REPO)
+    assert vs == [], "mvcc violations:\n" + \
+        "\n".join(v.render() for v in vs)
+
+
+def test_visibility_mask_fixture_hostsync_flagged(tmp_path):
+    """Counterpart fixture: a visibility mask that materializes the row
+    count host-side (int() on the mask popcount) or counts versions via a
+    metric in traced scope IS flagged — the clean result above is
+    meaningful."""
+    out = lint_src(tmp_path, """\
+        import jax.numpy as jnp
+        from baikaldb_tpu.utils import metrics
+        def bad_mask(cts, dts, snap_ts):
+            vis = jnp.logical_and(cts <= snap_ts, dts > snap_ts)
+            metrics.REGISTRY.counter("mvcc.visible").add(1)
+            return vis, int(jnp.sum(vis))
+        """)
+    assert out == [("HOSTSYNC", 6), ("METRICINJIT", 5)]
+
+
 # ---- DONATED --------------------------------------------------------------
 
 def test_donated_read_after_fold(tmp_path):
@@ -770,6 +801,10 @@ _STATIC_TO_RUNTIME = {
         "store.table_lock",
     "baikaldb_tpu/storage/replicated.py:ReplicatedRowTier._mu":
         "replicated.tier_mu",
+    "baikaldb_tpu/storage/mvcc.py:SnapshotRegistry._mu":
+        "mvcc.registry_mu",
+    "baikaldb_tpu/storage/mvcc.py:TsoClient._mu":
+        "mvcc.tso_mu",
 }
 
 
@@ -811,6 +846,7 @@ def test_doc_rank_table_matches_registry():
     import baikaldb_tpu.obs.telemetry  # noqa: F401
     import baikaldb_tpu.obs.watchdog  # noqa: F401
     import baikaldb_tpu.storage.column_store  # noqa: F401
+    import baikaldb_tpu.storage.mvcc  # noqa: F401
     import baikaldb_tpu.storage.replicated  # noqa: F401
 
     rows: dict[str, int] = {}

@@ -1,0 +1,338 @@
+"""MVCC snapshot reads: visibility, SET SNAPSHOT, automatic analytical
+pins, GC watermarks, the off-switch, and the observability surface.
+
+The tentpole contract (docs + ISSUE): a pinned analytical query sees
+exactly the state committed at its snapshot timestamp while OLTP write
+traffic keeps flowing — resident path, under a live region split, and
+after GC sweeps — and ``mvcc=0`` reads bit-identically to the pre-MVCC
+engine.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from baikaldb_tpu.chaos.failpoint import clear_all, set_failpoint
+from baikaldb_tpu.exec.session import Database, Session
+from baikaldb_tpu.raft.core import raft_available
+from baikaldb_tpu.sql.lexer import SqlError
+from baikaldb_tpu.storage.mvcc import (MAX_TS, PENDING, MvccState,
+                                       SnapshotRegistry, visibility_mask)
+from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+needs_raft = pytest.mark.skipif(not raft_available(),
+                                reason="native raft core unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    clear_all()
+    set_flag("mvcc", True)
+    yield
+    clear_all()
+    set_flag("mvcc", True)
+    set_flag("snapshot_max_age_s", 300.0)
+
+
+def _session():
+    db = Database()
+    s = Session(db, "t")
+    s.execute("CREATE DATABASE t")
+    s.execute("CREATE TABLE r (id BIGINT, g BIGINT, v BIGINT, "
+              "PRIMARY KEY (id))")
+    for i in range(8):
+        s.execute(f"INSERT INTO r VALUES ({i}, {i % 2}, {i * 10})")
+    return db, s
+
+
+# ---- visibility primitive --------------------------------------------------
+
+def test_visibility_mask_interval_semantics():
+    cts = jnp.asarray(np.array([1, 5, 9, 0, 3], dtype=np.int64))
+    dts = jnp.asarray(np.array([4, MAX_TS, MAX_TS, MAX_TS, PENDING],
+                               dtype=np.int64))
+    m = np.asarray(visibility_mask(cts, dts, jnp.int64(5)))
+    # [cts <= 5 < dts]: closed at 4 -> dead; 5 visible; 9 future; 0 always
+    assert m.tolist() == [False, True, False, True, True]
+    # a PENDING delete_ts never hides the version from real snapshots
+    m0 = np.asarray(visibility_mask(cts, dts, jnp.int64(0)))
+    assert m0.tolist() == [False, False, False, True, False]
+
+
+def test_mvcc_state_restamp_and_rollback_capture():
+    st = MvccState()
+    st.stamp([1, 2], PENDING)
+    pre = st.capture()
+    st.record_dead([{"id": 3}], [3], PENDING)
+    assert st.restamp_pending(77) == 3
+    assert st.live_cts == {1: 77, 2: 77}
+    assert st.history == [({"id": 3}, 0, 77)]
+    st.restore(pre)
+    assert st.live_cts == {1: PENDING, 2: PENDING} and st.history == []
+
+
+# ---- pinned reads under writes --------------------------------------------
+
+def test_set_snapshot_pins_under_update_delete_insert():
+    db, s = _session()
+    s.execute("SET SNAPSHOT = 'now'")
+    base = s.query("SELECT id, v FROM r ORDER BY id")
+    agg = s.query("SELECT g, SUM(v) FROM r GROUP BY g ORDER BY g")
+    w = Session(db, "t")
+    w.execute("UPDATE r SET v = v + 1000 WHERE id < 4")
+    w.execute("DELETE FROM r WHERE id = 5")
+    w.execute("INSERT INTO r VALUES (100, 0, 1)")
+    assert s.query("SELECT id, v FROM r ORDER BY id") == base
+    assert s.query("SELECT g, SUM(v) FROM r GROUP BY g ORDER BY g") == agg
+    s.execute("SET SNAPSHOT = 0")
+    now = s.query("SELECT id, v FROM r ORDER BY id")
+    assert now != base
+    assert {r["id"] for r in now} == {0, 1, 2, 3, 4, 6, 7, 100}
+
+
+def test_set_snapshot_at_recorded_ts_replays():
+    db, s = _session()
+    s.execute("SET SNAPSHOT = 'now'")
+    ts = s._snapshot[1]
+    base = s.query("SELECT SUM(v), COUNT(*) FROM r")
+    w = Session(db, "t")
+    for i in range(8):
+        w.execute(f"UPDATE r SET v = v + 5 WHERE id = {i}")
+    s2 = Session(db, "t")
+    s2.execute(f"SET SNAPSHOT = {ts}")
+    assert s2.query("SELECT SUM(v), COUNT(*) FROM r") == base
+    s2.execute("SET SNAPSHOT = 0")
+    s.execute("SET SNAPSHOT = 0")
+
+
+def test_set_snapshot_validation():
+    db, s = _session()
+    with pytest.raises(SqlError):
+        s.execute("SET SNAPSHOT = 'tuesday'")
+    set_flag("mvcc", False)
+    with pytest.raises(SqlError):
+        s.execute("SET SNAPSHOT = 'now'")
+
+
+def test_auto_pin_analytical_consistency_point():
+    """An aggregate without an explicit pin draws ONE fresh ts: its pin
+    registers while it runs and releases after."""
+    db, s = _session()
+    reg = db.mvcc.snapshots
+    seen = []
+    orig = reg.pin
+
+    def spy(ts, query="", holder=""):
+        seen.append(query)
+        return orig(ts, query=query, holder=holder)
+
+    reg.pin = spy
+    try:
+        s.query("SELECT g, SUM(v) FROM r GROUP BY g ORDER BY g")
+    finally:
+        reg.pin = orig
+    assert seen == ["auto"]
+    assert reg.describe() == []         # released at query end
+    # non-analytical statements never pin
+    seen.clear()
+    reg.pin = spy
+    try:
+        s.query("SELECT id FROM r WHERE id = 3")
+    finally:
+        reg.pin = orig
+    assert seen == []
+
+
+def test_auto_pin_refusal_degrades_unpinned():
+    db, s = _session()
+    set_flag("chaos_seed", 1)
+    set_failpoint("snapshot.pin", "drop")
+    # automatic pins degrade silently; results still correct
+    assert s.query("SELECT SUM(v) AS sv FROM r")[0]["sv"] == sum(
+        i * 10 for i in range(8))
+    # explicit pins surface the refusal
+    with pytest.raises(SqlError):
+        s.execute("SET SNAPSHOT = 'now'")
+
+
+def test_off_switch_bit_identical():
+    db, s = _session()
+    on = s.query("SELECT g, SUM(v) FROM r GROUP BY g ORDER BY g")
+    rows_on = s.query("SELECT id, v FROM r ORDER BY id")
+    set_flag("mvcc", False)
+    assert s.query("SELECT g, SUM(v) FROM r GROUP BY g ORDER BY g") == on
+    assert s.query("SELECT id, v FROM r ORDER BY id") == rows_on
+
+
+# ---- transactions ----------------------------------------------------------
+
+def test_txn_commit_stamps_one_ts_rollback_restores():
+    db, s = _session()
+    store = db.stores["t.r"]
+    s.execute("SET SNAPSHOT = 'now'")
+    base = s.query("SELECT SUM(v) FROM r")
+    w = Session(db, "t")
+    w.execute("BEGIN")
+    w.execute("UPDATE r SET v = v + 100 WHERE id = 0")
+    w.execute("INSERT INTO r VALUES (50, 0, 7)")
+    # uncommitted rows carry PENDING: invisible to every real snapshot
+    assert PENDING in store._mvcc.live_cts.values()
+    w.execute("COMMIT")
+    stamps = {c for c in store._mvcc.live_cts.values() if c != PENDING}
+    assert PENDING not in store._mvcc.live_cts.values()
+    # the txn's two DMLs share ONE decide-time commit_ts
+    new_rows = [c for c in store._mvcc.live_cts.values()]
+    assert len(set(new_rows)) >= 1
+    assert s.query("SELECT SUM(v) FROM r") == base     # pin unaffected
+    # rollback: the MVCC preimage restores with the row preimage
+    w.execute("BEGIN")
+    w.execute("DELETE FROM r WHERE id = 1")
+    pre_hist = len(store._mvcc.history)
+    w.execute("ROLLBACK")
+    assert len(store._mvcc.history) < pre_hist or pre_hist == 0 or \
+        len(store._mvcc.history) == pre_hist - 1
+    s.execute("SET SNAPSHOT = 0")
+    assert {r["id"] for r in s.query("SELECT id FROM r")} >= {0, 1, 50}
+
+
+# ---- GC --------------------------------------------------------------------
+
+def test_gc_never_reclaims_at_or_above_oldest_pin():
+    db, s = _session()
+    s.execute("SET SNAPSHOT = 'now'")
+    ts = s._snapshot[1]
+    base = s.query("SELECT SUM(v) FROM r")
+    w = Session(db, "t")
+    for i in range(8):
+        w.execute(f"UPDATE r SET v = v + 3 WHERE id = {i}")
+    store = db.stores["t.r"]
+    assert store._mvcc.history          # versions exist
+    wm = db.mvcc.snapshots.watermark(db.mvcc.tso.last_ts())
+    assert wm <= ts
+    db.mvcc.gc(db.stores.values())
+    assert s.query("SELECT SUM(v) FROM r") == base
+    # release the pin: the watermark advances and the sweep reclaims
+    s.execute("SET SNAPSHOT = 0")
+    reclaimed = db.mvcc.gc(db.stores.values())
+    assert reclaimed >= 8
+    assert store._mvcc.history == []
+
+
+def test_expired_pin_stops_holding_watermark():
+    reg = SnapshotRegistry()
+    reg.pin(1000, query="q")
+    assert reg.watermark(5000) == 1000
+    set_flag("snapshot_max_age_s", 0.0)     # every pin is instantly stale
+    assert reg.watermark(5000) == 5000
+
+
+def test_wedged_gc_failpoint_skips_one_sweep():
+    db, s = _session()
+    w = Session(db, "t")
+    for i in range(8):
+        w.execute(f"UPDATE r SET v = v + 3 WHERE id = {i}")
+    store = db.stores["t.r"]
+    n = len(store._mvcc.history)
+    assert n >= 8
+    set_flag("chaos_seed", 1)
+    set_failpoint("mvcc.gc", "drop")
+    assert db.mvcc.gc(db.stores.values()) == 0      # wedged
+    assert len(store._mvcc.history) == n
+    clear_all()
+    assert db.mvcc.gc(db.stores.values()) >= n
+
+
+# ---- fleet: pinned snapshot survives a live split -------------------------
+
+@needs_raft
+def test_pinned_snapshot_survives_live_split():
+    from baikaldb_tpu.meta.service import MetaService
+    from baikaldb_tpu.raft.fleet import StoreFleet
+
+    fleet = StoreFleet(MetaService(peer_count=3),
+                       [f"c{i + 1}:1" for i in range(3)], seed=9)
+    db = Database(fleet=fleet)
+    s = Session(db, "t")
+    s.execute("CREATE DATABASE t")
+    s.execute("CREATE TABLE r (id BIGINT, v BIGINT, PRIMARY KEY (id))")
+    for i in range(12):
+        s.execute(f"INSERT INTO r VALUES ({i}, {i})")
+    s.execute("SET SNAPSHOT = 'now'")
+    base = s.query("SELECT SUM(v), COUNT(*) FROM r")
+    tier = fleet.row_tiers["t.r"]
+    parent = tier.metas[0].region_id
+    mid = []
+
+    def hook(phase):
+        # the pinned aggregate re-runs DURING the split, writes flowing
+        s.execute(f"INSERT INTO r VALUES ({100 + len(mid)}, 1)")
+        mid.append(s.query("SELECT SUM(v), COUNT(*) FROM r") == base)
+
+    tier.split_region_online(parent, chaos_hook=hook)
+    assert mid and all(mid), "pinned agg diverged mid-split"
+    assert s.query("SELECT SUM(v), COUNT(*) FROM r") == base
+    s.execute("SET SNAPSHOT = 0")
+    assert s.query("SELECT COUNT(*) AS c FROM r")[0]["c"] == \
+        12 + len(mid)
+
+
+@needs_raft
+def test_snapshot_chaos_scenario_deterministic():
+    from baikaldb_tpu.chaos.scenarios import run_scenario
+
+    a = run_scenario("snapshot_chaos", 5, writes=24)
+    assert a["ok"], a
+    b = run_scenario("snapshot_chaos", 5, writes=24)
+    assert b["ok"] and b["state_digest"] == a["state_digest"]
+    assert b["fault_schedule"] == a["fault_schedule"]
+
+
+# ---- observability ---------------------------------------------------------
+
+def test_information_schema_snapshots_and_query_log():
+    db, s = _session()
+    s.execute("SET SNAPSHOT = 'now'")
+    ts = s._snapshot[1]
+    rows = s.query("SELECT * FROM information_schema.snapshots")
+    assert len(rows) == 1
+    assert rows[0]["snapshot_ts"] == ts
+    assert rows[0]["query"] == "SET SNAPSHOT"
+    assert rows[0]["holder"] == "root"
+    assert rows[0]["age_ms"] >= 0
+    s.query("SELECT SUM(v) FROM r")
+    ql = s.query("SELECT query, snapshot_ts FROM "
+                 "information_schema.query_log")
+    pinned = [r for r in ql if r["query"] == "SELECT SUM(v) FROM r"]
+    assert pinned and pinned[-1]["snapshot_ts"] == ts
+    s.execute("SET SNAPSHOT = 0")
+    assert s.query("SELECT * FROM information_schema.snapshots") == []
+
+
+def test_show_status_tso_mvcc_rows():
+    db, s = _session()
+    rows = {r["Variable_name"]: r["Value"]
+            for r in s.query("SHOW STATUS")}
+    assert "tso.allocations.value" in rows
+    assert "tso.batch_refills.value" in rows
+    assert "mvcc.gc_reclaimed.value" in rows
+    assert "mvcc.live_versions.value" in rows
+    assert "mvcc.oldest_pin.value" in rows
+    assert int(rows["tso.allocations.value"]) > 0   # the inserts stamped
+
+
+def test_explain_analyze_snapshot_line():
+    db, s = _session()
+    s.execute("SET SNAPSHOT = 'now'")
+    w = Session(db, "t")
+    w.execute("UPDATE r SET v = v + 1 WHERE id = 0")    # creates a version
+    plan = "\n".join(
+        r["plan"] for r in s.query("EXPLAIN ANALYZE SELECT SUM(v) FROM r"))
+    line = next(l for l in plan.splitlines() if l.startswith("-- snapshot:"))
+    assert f"ts={s._snapshot[1]}" in line
+    assert "versions_scanned=1" in line
+    assert "gc_watermark=" in line
+    s.execute("SET SNAPSHOT = 0")
+    plan2 = "\n".join(
+        r["plan"] for r in s.query("EXPLAIN ANALYZE SELECT id FROM r "
+                                   "WHERE id = 3"))
+    assert "-- snapshot:" not in plan2

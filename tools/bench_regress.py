@@ -62,7 +62,7 @@ def load_capture(path: str) -> dict:
     Unknown/summary lines are ignored."""
     out: dict = {"header": None, "queries": {}, "coldstart": None,
                  "progress": None, "elastic": None, "stream": None,
-                 "fragments": None}
+                 "fragments": None, "snapshot": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -90,6 +90,8 @@ def load_capture(path: str) -> dict:
                 out["stream"] = row
             elif str(row.get("metric", "")).startswith("pushed fragments"):
                 out["fragments"] = row
+            elif str(row.get("metric", "")).startswith("snapshot reads"):
+                out["snapshot"] = row
     return out
 
 
@@ -245,6 +247,46 @@ def compare_fragments(cand: dict) -> list:
     return problems
 
 
+def compare_snapshot(cand: dict, p99_factor: float) -> list:
+    """Snapshot-reads contract on the candidate capture (skipped/failed
+    lines are ignored).  Hard gates are the deterministic consistency
+    bits: ZERO lost writes through the mixed phase, the pinned aggregate
+    bit-identical on EVERY repetition under live inserts+updates, and the
+    mvcc=0 off-switch replaying the unpinned plan bit-identically.  The
+    write-p99 gate is a documented GENEROUS multiple of the same
+    capture's write-only isolation p99 (``--snapshot-p99-x``, default 25;
+    0 disables): the mixed phase shares the process with the repeated
+    aggregate, so the multiplier only catches a write stalled behind the
+    snapshot machinery, not host-timing noise."""
+    c = cand.get("snapshot")
+    if c is None or c.get("error") or not c.get("value"):
+        return []
+    problems = []
+    if c.get("lost_writes", 0) != 0:
+        problems.append(f"snapshot: {c['lost_writes']} writes lost during "
+                        f"the mixed phase (must be 0)")
+    rounds = c.get("snap_rounds", 0)
+    if rounds < 1:
+        problems.append("snapshot: snap_rounds=0 — the pinned aggregate "
+                        "never actually ran")
+    elif c.get("snap_identical_rounds", 0) != rounds:
+        problems.append(
+            f"snapshot: pinned aggregate bit-identical on only "
+            f"{c.get('snap_identical_rounds', 0)}/{rounds} repetitions "
+            f"under live writes (must be all)")
+    if not c.get("off_bit_identical", False):
+        problems.append("snapshot: mvcc=0 no longer replays the unpinned "
+                        "plan bit-identically on quiesced data")
+    if p99_factor > 0 and c.get("write_p99_iso_ms"):
+        lim = c["write_p99_iso_ms"] * p99_factor
+        if c.get("write_p99_mixed_ms", 0.0) > lim:
+            problems.append(
+                f"snapshot: write p99 {c['write_p99_mixed_ms']}ms under "
+                f"the pinned aggregate > {p99_factor}x write-only "
+                f"isolation p99 ({c['write_p99_iso_ms']}ms)")
+    return problems
+
+
 def compare(base: dict, cand: dict, wall_clock_pct: float = 0.0) -> list:
     """-> list of human-readable regression strings (empty = clean)."""
     problems = []
@@ -302,12 +344,17 @@ def main(argv=None) -> int:
                     help="out-of-core stream prefetch-wait ceiling as a "
                          "multiple of the same capture's serial stage "
                          "time, +5ms slack (0 = counters only)")
+    ap.add_argument("--snapshot-p99-x", type=float, default=25.0,
+                    help="snapshot-reads mixed-phase write-p99 ceiling as "
+                         "a multiple of the same capture's write-only "
+                         "isolation p99 (0 = consistency bits only)")
     args = ap.parse_args(argv)
     base = load_capture(args.baseline)
     cand = load_capture(args.candidate)
     if not base["queries"] and base["coldstart"] is None \
             and cand["progress"] is None and cand["elastic"] is None \
-            and cand["stream"] is None and cand["fragments"] is None:
+            and cand["stream"] is None and cand["fragments"] is None \
+            and cand["snapshot"] is None:
         print(f"bench_regress: no query or cold-start rows in "
               f"{args.baseline}", file=sys.stderr)
         return 2
@@ -317,6 +364,7 @@ def main(argv=None) -> int:
     problems += compare_elastic(cand, args.elastic_p99_x)
     problems += compare_stream(cand, args.stream_wait_x)
     problems += compare_fragments(cand)
+    problems += compare_snapshot(cand, args.snapshot_p99_x)
     compared = []
     if base["queries"]:
         compared.append(f"{len(base['queries'])} queries")
@@ -330,6 +378,8 @@ def main(argv=None) -> int:
         compared.append("out-of-core stream line")
     if cand["fragments"] is not None:
         compared.append("pushed-fragments line")
+    if cand["snapshot"] is not None:
+        compared.append("snapshot-reads line")
     if problems:
         for p in problems:
             print(f"REGRESSION {p}")
