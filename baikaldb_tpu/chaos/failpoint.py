@@ -148,6 +148,18 @@ CATALOG: dict[str, str] = {
                     "refused — an automatic analytical pin degrades to "
                     "an unpinned read; explicit SET SNAPSHOT surfaces "
                     "the refusal to the client)",
+    "cdc.fetch": "subscription fetch, before events are read off the "
+                 "merged stream (drop: the fetch returns nothing this "
+                 "round — delivery deferred, never lost; delay: a slow "
+                 "consumer)",
+    "cdc.apply": "subscription ack after a delivered batch (drop: the "
+                 "ack is skipped — the batch redelivers; consumers "
+                 "dedupe by commit_ts, so exactly-once application "
+                 "must survive)",
+    "view.fold": "matview delta fold over a fetched batch (drop: the "
+                 "fold round is abandoned before any state change — "
+                 "events stay unacked and staleness grows, state stays "
+                 "consistent)",
 }
 
 _SPEC_RE = re.compile(

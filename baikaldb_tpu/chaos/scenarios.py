@@ -1073,6 +1073,171 @@ def snapshot_chaos(seed: int = 7, writes: int = 48) -> dict:
             "problems": problems}
 
 
+def cdc_chaos(seed: int = 9, writes: int = 60) -> dict:
+    """CDC change streams + matview maintenance under seeded faults (the
+    tentpole contract): INSERT/UPDATE/DELETE traffic flows while
+    ``cdc.fetch`` drops/delays defer delivery, ``cdc.apply`` drops lose
+    acks (forced redelivery), ``view.fold`` drops abandon maintenance
+    rounds, and one store daemon is killed and revived mid-stream.
+
+    Invariants checked:
+
+    - **exactly-once**: an audit subscription applies every event with a
+      commit_ts dedupe; replaying the applied row images reconstructs the
+      final table EXACTLY (no lost event, no double-apply) even though
+      lost acks redelivered batches (``redeliveries`` > 0 is the witness
+      that the fault actually fired and was absorbed);
+    - **view exactness at quiesce**: at failpoint-cleared checkpoints the
+      materialized-view answer is BIT-IDENTICAL to the recompute
+      (``matview_answer=0``) over the same data;
+    - fleet plane: the run digest is a pure function of the seed
+      (wall-clock commit_ts excluded by design)."""
+    from ..cdc.streams import CursorLagging
+    from ..utils.flags import FLAGS, set_flag
+
+    rng = random.Random((seed << 8) ^ 0x636463)
+    prev_seed = int(FLAGS.chaos_seed)
+    set_flag("chaos_seed", seed)
+    fleet, db, s = _fleet_session(seed)
+    s.execute("CREATE TABLE cv (k BIGINT, g BIGINT, v BIGINT, "
+              "PRIMARY KEY (k))")
+    s.execute("CREATE MATERIALIZED VIEW cv_mv AS SELECT g, COUNT(*), "
+              "SUM(v), MIN(v), MAX(v) FROM cv GROUP BY g")
+    audit = db.cdc.create("audit", table_key="chaos.cv")
+    AGG = ("SELECT g, COUNT(*), SUM(v), MIN(v), MAX(v) FROM cv "
+           "GROUP BY g ORDER BY g")
+    schedule: list[list] = []
+    problems: list[str] = []
+    applied: dict[int, bool] = {}       # commit_ts -> seen (the dedupe)
+    replica: dict[int, tuple] = {}      # k -> (g, v) rebuilt from events
+    redeliveries = 0
+    lost_ranges = 0
+    next_key = 0
+
+    def consume(drain: bool = False):
+        """The audit consumer: apply-then-ack with commit_ts dedupe."""
+        nonlocal redeliveries, lost_ranges
+        for _ in range(64 if drain else 2):
+            try:
+                evs = audit.fetch(32)
+            except CursorLagging:
+                lost_ranges += 1        # typed loss surfaced, never silent
+                continue
+            if not evs:
+                if drain:
+                    continue
+                return
+            for e in evs:
+                if e.commit_ts in applied:
+                    redeliveries += 1   # lost ack redelivered: absorbed
+                    continue
+                applied[e.commit_ts] = True
+                if not e.rows:
+                    problems.append(f"{e.event_type} event without row "
+                                    f"images (capture fell back)")
+                    continue
+                if e.event_type == "insert":
+                    for r in e.rows:
+                        replica[int(r["k"])] = (r["g"], r["v"])
+                elif e.event_type == "update":
+                    for pair in e.rows:
+                        n = pair["new"]
+                        replica[int(n["k"])] = (n["g"], n["v"])
+                elif e.event_type == "delete":
+                    for r in e.rows:
+                        replica.pop(int(r["k"]), None)
+            audit.ack(evs[-1].commit_ts)    # cdc.apply may drop this
+
+    def checkpoint(tag: str):
+        """Quiesced: faults off, maintenance drains, view == recompute."""
+        for n in ("cdc.fetch", "cdc.apply", "view.fold"):
+            failpoint.clear(n)
+        view = s.query(AGG)
+        set_flag("matview_answer", 0)
+        try:
+            base = s.query(AGG)
+        finally:
+            set_flag("matview_answer", 1)
+        if view != base:
+            problems.append(f"{tag}: view answer diverged from recompute")
+        schedule.append(["checkpoint", tag, view == base])
+        return view
+
+    tier = fleet.row_tiers["chaos.cv"]
+    g0 = tier.groups[0]
+    failpoint.set_failpoint("cdc.fetch", "25%drop")
+    failpoint.set_failpoint("cdc.apply", "25%drop")
+    failpoint.set_failpoint("view.fold", "20%drop")
+    killed = None
+    try:
+        for i in range(writes):
+            r = rng.random()
+            if r < 0.55 or next_key < 4:
+                s.execute(f"INSERT INTO cv VALUES ({next_key}, "
+                          f"{next_key % 3}, {next_key * next_key})")
+                next_key += 1
+            elif r < 0.8:
+                k = rng.randrange(next_key)
+                s.execute(f"UPDATE cv SET v = v + 11 WHERE k = {k}")
+                schedule.append(["update", k])
+            else:
+                k = rng.randrange(next_key)
+                s.execute(f"DELETE FROM cv WHERE k = {k}")
+                schedule.append(["delete", k])
+            consume()
+            if i % 5 == 4:
+                s.query(AGG)            # exercise fold under the faults
+            if killed is None and i == writes // 3:
+                killed = g0.leader()
+                g0.bus.kill(killed)
+                schedule.append([i, "kill_daemon", killed])
+            if killed is not None and i == (2 * writes) // 3:
+                g0.bus.revive(killed)
+                schedule.append([i, "revive", killed])
+                killed = None
+            if i == writes // 2:
+                # switch fetch faults from drops to seeded delays (slow
+                # consumer phase), then the checkpoint re-arms drops
+                checkpoint("mid_run")
+                failpoint.set_failpoint("cdc.fetch", "30%delay(1)")
+                failpoint.set_failpoint("cdc.apply", "25%drop")
+                failpoint.set_failpoint("view.fold", "20%drop")
+        if killed is not None:
+            g0.bus.revive(killed)
+            schedule.append([writes, "revive", killed])
+    finally:
+        for n in ("cdc.fetch", "cdc.apply", "view.fold"):
+            failpoint.clear(n)
+        set_flag("chaos_seed", prev_seed)
+    view = checkpoint("quiesce")
+    consume(drain=True)
+    rows = s.query("SELECT k, g, v FROM cv ORDER BY k")
+    got = {int(r["k"]): (r["g"], r["v"]) for r in rows}
+    if got != replica:
+        missing = sorted(set(got) - set(replica))
+        extra = sorted(set(replica) - set(got))
+        wrong = sorted(k for k in set(got) & set(replica)
+                       if got[k] != replica[k])
+        problems.append(f"audit replay diverged from the table (lost="
+                        f"{missing[:5]} extra={extra[:5]} "
+                        f"wrong={wrong[:5]})")
+    if redeliveries == 0:
+        problems.append("no redelivery observed: the cdc.apply fault "
+                        "never fired (chaos did not exercise the seam)")
+    mv = db.matviews.get("chaos", "cv_mv")
+    state = {"rows": rows, "view": view,
+             "groups": len(mv.state or {})}
+    return {"writes": writes, "fault_schedule": schedule,
+            "faults": len(schedule),
+            "events_applied": len(applied),
+            "redeliveries": redeliveries,
+            "lost_ranges": lost_ranges,
+            "deltas_folded": mv.deltas_folded,
+            "view_rescans": mv.rescans,
+            "state_digest": _digest({"schedule": schedule, "state": state}),
+            "problems": problems}
+
+
 SCENARIOS = {
     "kill_leader": kill_leader,
     "partition": partition,
@@ -1083,6 +1248,7 @@ SCENARIOS = {
     "stream_chaos": stream_chaos,
     "fragment_chaos": fragment_chaos,
     "snapshot_chaos": snapshot_chaos,
+    "cdc_chaos": cdc_chaos,
 }
 
 

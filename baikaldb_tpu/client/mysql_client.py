@@ -303,6 +303,59 @@ class PreparedStatement:
         return False
 
 
+@dataclass
+class ChangeEvent:
+    """One decoded CDC event from FETCH (cdc/streams.py over the wire)."""
+    commit_ts: int
+    event_type: str
+    table: str
+    rows: list
+    statement: str
+    affected: int
+
+
+class SubscriptionCursor:
+    """Client-side change-stream iterator (the baikal_capturer SDK analog):
+    ``CREATE SUBSCRIPTION`` once, then repeated ``FETCH`` batches decoded
+    into :class:`ChangeEvent`.  The server acks each delivered batch
+    durably, so a reconnecting client resumes exactly where the last FETCH
+    left off — the cursor is the server-side resume token, not client
+    state."""
+
+    def __init__(self, conn: Connection, name: str,
+                 table: Optional[str] = None, batch: int = 0):
+        self.conn = conn
+        self.name = name
+        self.batch = batch
+        on = f" ON {table}" if table else ""
+        conn.query(f"CREATE SUBSCRIPTION IF NOT EXISTS {name}{on}")
+
+    def fetch(self) -> list[ChangeEvent]:
+        """One FETCH batch (empty list = caught up)."""
+        import json
+
+        n = f"{self.batch} " if self.batch else ""
+        res = self.conn.query(f"FETCH {n}FROM {self.name}")
+        return [ChangeEvent(commit_ts=int(r[0]), event_type=str(r[1]),
+                            table=str(r[2]),
+                            rows=json.loads(r[3]) if r[3] else [],
+                            statement=str(r[4] or ""),
+                            affected=int(r[5] or 0))
+                for r in res.rows]
+
+    def __iter__(self):
+        """Drain until caught up (a tailing client calls fetch() in its
+        own poll loop; iteration is the catch-up read)."""
+        while True:
+            got = self.fetch()
+            if not got:
+                return
+            yield from got
+
+    def drop(self) -> None:
+        self.conn.query(f"DROP SUBSCRIPTION IF EXISTS {self.name}")
+
+
 class Pool:
     """Tiny connection pool (reference: baikal_client connection pools with
     health checks; health = ping-on-borrow here)."""

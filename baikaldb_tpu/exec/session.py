@@ -38,9 +38,11 @@ from ..sql.stmt import (AlterTableStmt, CreateDatabaseStmt, CreateTableStmt, Del
                         ExplainStmt, InsertStmt, SelectStmt, ShowStmt,
                         SetStmt, TruncateStmt, TxnStmt, UpdateStmt, UseStmt)
 from ..meta.privileges import READ, WRITE, AccessError, PrivilegeManager
-from ..sql.stmt import (CreateUserStmt, CreateViewStmt, DeallocateStmt,
-                        DropUserStmt,
-                        DropViewStmt, ExecuteStmt, GrantStmt, HandleStmt,
+from ..sql.stmt import (CreateMatViewStmt, CreateSubscriptionStmt,
+                        CreateUserStmt, CreateViewStmt, DeallocateStmt,
+                        DropMatViewStmt, DropSubscriptionStmt, DropUserStmt,
+                        DropViewStmt, ExecuteStmt, FetchStmt, GrantStmt,
+                        HandleStmt,
                         KillStmt, LoadDataStmt, PrepareStmt, RevokeStmt)
 from ..plan import paramize
 from ..storage.column_store import ROWID as ROWID_COL
@@ -404,15 +406,23 @@ class Database:
         self.read_replica = read_replica
         self.read_tag = read_tag
         self.read_max_lag = int(read_max_lag)
+        from ..cdc import ChangeStreams, MatViews
         if data_dir:
             import os
             os.makedirs(data_dir, exist_ok=True)
             # WAL-backed binlog: CDC events + capturer checkpoints survive
             # kill-9 with the rest of the durable tier (region_binlog analog)
             self.binlog = Binlog(path=os.path.join(data_dir, "binlog.wal"))
+            # change-stream + matview registries attach BEFORE recovery:
+            # _recover re-arms persisted subscriptions and views against
+            # the already-recovered binlog cursors
+            self.cdc = ChangeStreams(self)
+            self.matviews = MatViews(self)
             self._recover()
         else:
             self.binlog = Binlog()
+            self.cdc = ChangeStreams(self)
+            self.matviews = MatViews(self)
 
     def close(self) -> None:
         """Stop this Database's background machinery — today the fleet
@@ -627,6 +637,8 @@ class Database:
             {"database": k.split(".", 1)[0], "name": k.split(".", 1)[1], **v}
             for k, v in sorted(vsnap.items())
             if k.split(".", 1)[0] in dbs]
+        out["subscriptions"] = self.cdc.to_meta()
+        out["matviews"] = self.matviews.to_meta()
         tmp = os.path.join(self.data_dir, "catalog.json.tmp")
         with open(tmp, "w") as f:
             json.dump(out, f)
@@ -661,6 +673,10 @@ class Database:
         for v in saved.get("views", []):
             self.catalog.create_view(v["database"], v["name"], v["sql"],
                                      v.get("columns"), or_replace=True)
+        # durable CDC cursors were recovered with the binlog; these entries
+        # re-attach the subscription objects (and their GC holds) to them
+        self.cdc.recover(saved.get("subscriptions"))
+        self.matviews.recover(saved.get("matviews"))
         # resume interrupted backfills only AFTER every table is loaded:
         # the worker save_catalog()s at publish, and a snapshot taken
         # mid-recovery would persist a catalog missing later tables
@@ -808,8 +824,14 @@ class Session:
                 sub_dbs(e)
             return
         if isinstance(s, (CreateTableStmt, DropTableStmt, AlterTableStmt,
-                          CreateViewStmt, DropViewStmt)):
+                          CreateViewStmt, DropViewStmt, CreateMatViewStmt,
+                          DropMatViewStmt)):
             P.check(self.user, s.table.database or self.current_db, WRITE)
+            return
+        if isinstance(s, (CreateSubscriptionStmt, DropSubscriptionStmt)):
+            db = (s.table.database if getattr(s, "table", None) is not None
+                  else None) or self.current_db
+            P.check(self.user, db, READ)
             return
         if isinstance(s, CreateDatabaseStmt):
             P.check(self.user, s.name, WRITE)
@@ -1195,7 +1217,8 @@ class Session:
         # rolling back across a schema change is not supported
         if isinstance(s, (CreateTableStmt, DropTableStmt, CreateDatabaseStmt,
                           DropDatabaseStmt, TruncateStmt, AlterTableStmt,
-                          CreateViewStmt, DropViewStmt)):
+                          CreateViewStmt, DropViewStmt, CreateMatViewStmt,
+                          DropMatViewStmt)):
             self._commit_txn()
         if isinstance(s, PrepareStmt):
             return self._prepare_stmt(s)
@@ -1221,7 +1244,9 @@ class Session:
                 txt = self._render_pushdown(*cand)
                 return Result(columns=["plan"], plan_text=txt,
                               arrow=pa.table({"plan": txt.split("\n")}))
-            rw = self._try_rollup(stmt_x, refresh=False)
+            rw = self._try_matview(stmt_x, refresh=False)
+            if rw is None:
+                rw = self._try_rollup(stmt_x, refresh=False)
             if rw is not None:
                 stmt_x = rw
             plan = self._plan_select(stmt_x)
@@ -1272,6 +1297,48 @@ class Session:
             self._plan_cache.clear()
             self.db.save_catalog()
             return Result()
+        if isinstance(s, CreateMatViewStmt):
+            db = s.table.database or self.current_db
+            try:
+                self.db.matviews.create(self, db, s.table.name,
+                                        s.select_sql, s.if_not_exists)
+            except ValueError as e:
+                raise PlanError(str(e)) from None
+            self._plan_cache.clear()
+            return Result()
+        if isinstance(s, DropMatViewStmt):
+            db = s.table.database or self.current_db
+            self.db.matviews.drop(self, db, s.table.name, s.if_exists)
+            self._plan_cache.clear()
+            return Result()
+        if isinstance(s, CreateSubscriptionStmt):
+            table_key = None
+            if s.table is not None:
+                tdb = s.table.database or self.current_db
+                # surface unknown tables at CREATE, not at first FETCH
+                self.db.catalog.get_table(tdb, s.table.name)
+                table_key = f"{tdb}.{s.table.name}"
+            try:
+                self.db.cdc.create(s.name, table_key,
+                                   if_not_exists=s.if_not_exists)
+            except ValueError as e:
+                raise PlanError(str(e)) from None
+            self.db.save_catalog()
+            return Result()
+        if isinstance(s, DropSubscriptionStmt):
+            try:
+                sub = self.db.cdc.subs.get(s.name)
+                if sub is not None and sub.internal:
+                    raise PlanError(
+                        f"subscription {s.name!r} maintains a materialized "
+                        "view; drop the view instead")
+                self.db.cdc.drop(s.name, s.if_exists)
+            except KeyError as e:
+                raise PlanError(str(e.args[0])) from None
+            self.db.save_catalog()
+            return Result()
+        if isinstance(s, FetchStmt):
+            return self._fetch_stmt(s)
         if isinstance(s, AlterTableStmt):
             return self._alter_table(s)
         if isinstance(s, DropTableStmt):
@@ -1289,6 +1356,9 @@ class Session:
             st = self.db.stores.pop(f"{db}.{s.table.name}", None)
             self._drop_durable(f"{db}.{s.table.name}", st)
             self.db.discard_binlog_retry(f"{db}.{s.table.name}")
+            # matviews over the dropped base go with it (cascade), like
+            # rollups and global indexes below
+            self.db.matviews.drop_for_base(self, f"{db}.{s.table.name}")
             for rn in rollups:
                 rt = rollup_table_name(s.table.name, rn)
                 self.db.catalog.drop_table(db, rt, if_exists=True)
@@ -1306,6 +1376,8 @@ class Session:
             store.truncate()
             for _ix, bstore in self._coupled_global(store):
                 bstore.truncate()   # global-index entries go with the rows
+            self._log_binlog("truncate", s.table.database or self.current_db,
+                             s.table.name, statement="truncate")
             return Result()
         if isinstance(s, CreateDatabaseStmt):
             self.db.catalog.create_database(s.name, if_not_exists=s.if_not_exists)
@@ -1434,10 +1506,12 @@ class Session:
         def visible(db):
             # user-facing tables + views: rollup and global-index backing
             # tables are internal
+            from ..cdc.views import is_mv_table
             from ..index.globalindex import is_backing_table
             from ..index.rollup import is_rollup_table
             return ([n for n in cat.tables(db) if not is_rollup_table(n)
-                     and not is_backing_table(n)], list(cat.views(db)))
+                     and not is_backing_table(n) and not is_mv_table(n)],
+                    list(cat.views(db)))
 
         cat = self.db.catalog
         if s.what in ("profile", "profiles"):
@@ -2634,6 +2708,72 @@ class Session:
                 out.append(it.expr.name.split(".")[-1])
         return out
 
+    # -- CDC: FETCH + materialized views (cdc/) ----------------------------
+    def _fetch_stmt(self, s: FetchStmt) -> Result:
+        """FETCH [n] FROM sub: deliver the next ordered event batch, then
+        durably ack past it — deliver-then-ack, so a frontend crash after
+        the client read the batch never redelivers it, and a crash BEFORE
+        the reply redelivers the whole batch (at-least-once across crash,
+        exactly-once in steady state; consumers wanting strict
+        exactly-once under crashes dedupe on commit_ts)."""
+        import json as _json
+
+        try:
+            sub = self.db.cdc.get(s.name)
+        except KeyError as e:
+            raise PlanError(str(e.args[0])) from None
+        events = sub.fetch(s.limit)     # may raise CursorLagging (typed)
+        names = ["commit_ts", "event_type", "table_name", "rows",
+                 "statement", "affected"]
+        rows = [(e.commit_ts, e.event_type, f"{e.database}.{e.table}",
+                 _json.dumps(e.rows, default=str), e.statement, e.affected)
+                for e in events]
+        if events:
+            sub.ack(events[-1].commit_ts)
+        return self._host_rows_result(names, rows)
+
+    def _try_matview(self, stmt: SelectStmt, refresh: bool = True):
+        """If a registered materialized view covers this GROUP BY SELECT,
+        fold its pending change-stream deltas (matview_auto_maintain),
+        flush state into the hidden __mv_* table, and return the
+        rewritten statement.  ``refresh=False`` (EXPLAIN) only rewrites.
+        The same gates as _try_rollup: never inside a pinned snapshot or
+        an open transaction, never while a seed/rescan query runs."""
+        from ..index.rollup import try_rewrite
+
+        if not FLAGS.matview_answer:
+            return None
+        if getattr(self, "_in_mv_refresh", False) or \
+                getattr(self, "_in_rollup_refresh", False):
+            return None
+        if self._snap_ts or self._sql_txn is not None:
+            return None
+        if stmt.table is None or stmt.joins or stmt.ctes or stmt.union:
+            return None
+        db = stmt.table.database or self.current_db
+        for mv in self.db.matviews.for_base(f"{db}.{stmt.table.name}"):
+            rw = try_rewrite(stmt, stmt.table.name, mv.name, mv.keys,
+                             mv.measures, mv.database,
+                             target_table=mv.hidden)
+            if rw is None:
+                continue
+            if refresh:
+                if FLAGS.matview_auto_maintain:
+                    mv.maintain(self)
+                mv.materialize(self)
+                mv.answered += 1
+                metrics.view_answered_queries.add(1)
+                # zero-duration marker span: EXPLAIN ANALYZE renders it as
+                # the `-- view:` line; info-schema reads the same numbers
+                with trace.span("view", view=f"{mv.database}.{mv.name}",
+                                applied_ts=mv.applied_ts,
+                                staleness_ms=mv.staleness_ms(),
+                                deltas_folded=mv.deltas_folded,
+                                groups=len(mv.state or {})):
+                    pass
+            return rw
+        return None
+
     # -- rollup index (reference: I_ROLLUP, region_olap.cpp:530-651) -------
     def _try_rollup(self, stmt: SelectStmt, refresh: bool = True):
         """If a rollup covers this SELECT, refresh it (lazily, on base
@@ -3513,18 +3653,36 @@ class Session:
         else:
             mask_fn = self._host_mask(store, s.where)
         changed = [name for name, _ in assigns]
+        db_name = s.table.database or self.current_db
+        # row-image capture for CDC/matviews: old/new pairs let consumers
+        # fold the delta instead of rescanning; only on the non-coupled
+        # path (the global-index path dry-runs assign_fn, which would
+        # double-capture) and self-verified below against the affected
+        # count — any mismatch falls back to the statement image, which
+        # consumers treat as "rescan"
+        captured: list = []
         with store._lock:   # one critical section vs backfill publish
             coupled = self._coupled_global(store)
             if coupled:
                 n = self._update_with_global(store, coupled, mask_fn,
                                              assign_fn, changed)
             else:
-                n = store.update_where(mask_fn, assign_fn,
+                use_assign = assign_fn
+                if self.db.cdc.wants_rows(f"{db_name}.{s.table.name}"):
+                    def use_assign(t, mask, _inner=assign_fn):
+                        cond = pa.array(np.asarray(mask, bool))
+                        old = t.filter(cond).to_pylist()
+                        out = _inner(t, mask)
+                        new = out.filter(cond).to_pylist()
+                        captured.extend({"old": o, "new": w}
+                                        for o, w in zip(old, new))
+                        return out
+                n = store.update_where(mask_fn, use_assign,
                                        self._tctx(store),
                                        changed_cols=changed)
         if n:
-            self._log_binlog("update", s.table.database or self.current_db,
-                             s.table.name,
+            rows = captured if len(captured) == n else None
+            self._log_binlog("update", db_name, s.table.name, rows=rows,
                              statement=_stmt_image("update", s), affected=n)
         return Result(affected_rows=n)
 
@@ -3532,15 +3690,27 @@ class Session:
         store = self._store(s.table)
         mask_fn = self._point_write_mask(store, s.where) or \
             self._host_mask(store, s.where)
+        db_name = s.table.database or self.current_db
+        # row-image capture (see _update): outgoing rows let CDC consumers
+        # retract exactly; count-verified, statement-image fallback
+        captured: list = []
         with store._lock:   # one critical section vs backfill publish
             coupled = self._coupled_global(store)
             if coupled:
                 n = self._delete_with_global(store, coupled, mask_fn)
             else:
-                n = store.delete_where(mask_fn, self._tctx(store))
+                use_mask = mask_fn
+                if self.db.cdc.wants_rows(f"{db_name}.{s.table.name}"):
+                    def use_mask(t, _inner=mask_fn):
+                        m = np.asarray(_inner(t), bool)
+                        if m.any():
+                            captured.extend(
+                                t.filter(pa.array(m)).to_pylist())
+                        return m
+                n = store.delete_where(use_mask, self._tctx(store))
         if n:
-            self._log_binlog("delete", s.table.database or self.current_db,
-                             s.table.name,
+            rows = captured if len(captured) == n else None
+            self._log_binlog("delete", db_name, s.table.name, rows=rows,
                              statement=_stmt_image("delete", s), affected=n)
         return Result(affected_rows=n)
 
@@ -3779,6 +3949,10 @@ class Session:
             isinstance(it.expr, AggCall) for it in stmt.items)
         if not analytical:
             return None
+        if self._try_matview(stmt, refresh=False) is not None:
+            # a materialized view will answer this aggregate from folded
+            # state; pinning first would hide the maintenance writes
+            return None
         if self._try_rollup(stmt, refresh=False) is not None:
             # a rollup covers this aggregate: the version-gated refresh
             # already materializes ONE consistent cut of the base table,
@@ -3839,13 +4013,21 @@ class Session:
         point = None if snap_dirty else self._try_point_lookup(stmt)
         if point is not None:
             return point
-        rewritten = self._try_rollup(stmt)
+        rewritten = self._try_matview(stmt)
         if rewritten is not None:
-            # re-enter with the rollup statement; versions in the cache key
-            # come from the rollup store, which refresh just bumped
+            # answered from incrementally maintained view state: re-enter
+            # with the hidden-table statement (cdc/views.py)
             stmt = rewritten
             cache_key = None if cache_key is None else \
-                (cache_key[0] + " /*rollup*/", cache_key[1])
+                (cache_key[0] + " /*mv*/", cache_key[1])
+        else:
+            rewritten = self._try_rollup(stmt)
+            if rewritten is not None:
+                # re-enter with the rollup statement; versions in the cache
+                # key come from the rollup store, which refresh just bumped
+                stmt = rewritten
+                cache_key = None if cache_key is None else \
+                    (cache_key[0] + " /*rollup*/", cache_key[1])
 
         def _has_gc(e):
             if e is None:
@@ -4105,6 +4287,11 @@ class Session:
 
     def _explain_analyze_measure(self, stmt: SelectStmt) -> None:
         """Run + instrument; all output lands in the active trace."""
+        # materialized-view answering applies here exactly as in _select
+        # (the zero-duration `view` span renders the `-- view:` line)
+        rw = self._try_matview(stmt)
+        if rw is not None:
+            stmt = rw
         cand = self._pushdown_candidate(stmt)
         if cand is not None:
             # pushed-fragment execution: the dispatcher's `fragments`
@@ -4275,6 +4462,13 @@ class Session:
             a = s["attrs"]
             lines.append(f"-- batch: {a['table']} {a['kind']}="
                          f"{a['capacity']} live={a['live']}")
+        for s in find("view"):
+            a = s["attrs"]
+            lines.append(f"-- view: {a['view']} "
+                         f"applied_ts={a['applied_ts']} "
+                         f"staleness_ms={a['staleness_ms']} "
+                         f"deltas_folded={a['deltas_folded']} "
+                         f"groups={a['groups']}")
         snaps = find("snapshot")
         if snaps:
             # one line per query: the pinned ts is shared; versions sum
@@ -4753,6 +4947,42 @@ class Session:
                 "table_name": [r[1] for r in rows],
                 "view_definition": [r[2] for r in rows],
             }) if rows else _empty_info("views")
+        if name == "subscriptions":
+            rows = self.db.cdc.describe()
+            return pa.table({
+                "name": [r["name"] for r in rows],
+                "table_key": [r["table_key"] for r in rows],
+                "internal": ["YES" if r["internal"] else "NO"
+                             for r in rows],
+                "acked_ts": pa.array([r["acked_ts"] for r in rows],
+                                     pa.int64()),
+                "cursor_lag_ms": pa.array(
+                    [r["cursor_lag_ms"] for r in rows], pa.int64()),
+                "events_delivered": pa.array(
+                    [r["events_delivered"] for r in rows], pa.int64()),
+            }) if rows else _empty_info("subscriptions")
+        if name == "materialized_views":
+            rows = self.db.matviews.describe()
+            return pa.table({
+                "table_schema": [r["database"] for r in rows],
+                "view_name": [r["name"] for r in rows],
+                "base_table": [r["base_table"] for r in rows],
+                "definition": [r["definition"] for r in rows],
+                "applied_ts": pa.array([r["applied_ts"] for r in rows],
+                                       pa.int64()),
+                "staleness_ms": pa.array(
+                    [r["staleness_ms"] for r in rows], pa.int64()),
+                "cursor_lag_ms": pa.array(
+                    [r["cursor_lag_ms"] for r in rows], pa.int64()),
+                "deltas_folded": pa.array(
+                    [r["deltas_folded"] for r in rows], pa.int64()),
+                "rescans": pa.array([r["rescans"] for r in rows],
+                                    pa.int64()),
+                "answered_queries": pa.array(
+                    [r["answered_queries"] for r in rows], pa.int64()),
+                "groups": pa.array([r["groups"] for r in rows],
+                                   pa.int64()),
+            }) if rows else _empty_info("materialized_views")
         if name == "partitions":
             rows = []
             for db in cat.databases():

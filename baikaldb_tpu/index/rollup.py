@@ -137,8 +137,11 @@ def _rewrite_expr(e: Expr, keys: set, measures: set):
 
 def try_rewrite(stmt: SelectStmt, base_table: str, rollup_name: str,
                 keys: list[str], measures: list[str],
-                database: str) -> Optional[SelectStmt]:
-    """Rewrite ``stmt`` to read the rollup table, or None if not covered."""
+                database: str,
+                target_table: Optional[str] = None) -> Optional[SelectStmt]:
+    """Rewrite ``stmt`` to read the rollup table, or None if not covered.
+    ``target_table`` overrides the hidden-table name — materialized views
+    (cdc/views.py) share the partial layout but live under ``__mv_*``."""
     if (stmt.joins or stmt.ctes or stmt.union or stmt.distinct
             or stmt.table is None):
         return None
@@ -192,7 +195,8 @@ def try_rewrite(stmt: SelectStmt, base_table: str, rollup_name: str,
     return replace(
         stmt,
         items=new_items,
-        table=TableRef(database, rollup_table_name(base_table, rollup_name)),
+        table=TableRef(database, target_table if target_table is not None
+                       else rollup_table_name(base_table, rollup_name)),
         where=new_where, group_by=gb, having=new_having, order_by=new_order)
 
 

@@ -199,6 +199,27 @@ class Catalog:
                              Field("exec_ms", LType.FLOAT64),
                              Field("egress_ms", LType.FLOAT64),
                              Field("snapshot_ts", LType.INT64))),
+        # CDC subscriptions (cdc/streams.py): durable cursors, how far
+        # each ack stands behind the binlog high-water
+        "subscriptions": Schema((Field("name", LType.STRING),
+                                 Field("table_key", LType.STRING),
+                                 Field("internal", LType.STRING),
+                                 Field("acked_ts", LType.INT64),
+                                 Field("cursor_lag_ms", LType.INT64),
+                                 Field("events_delivered", LType.INT64))),
+        # incrementally maintained rollup views (cdc/views.py)
+        "materialized_views": Schema((
+            Field("table_schema", LType.STRING),
+            Field("view_name", LType.STRING),
+            Field("base_table", LType.STRING),
+            Field("definition", LType.STRING),
+            Field("applied_ts", LType.INT64),
+            Field("staleness_ms", LType.INT64),
+            Field("cursor_lag_ms", LType.INT64),
+            Field("deltas_folded", LType.INT64),
+            Field("rescans", LType.INT64),
+            Field("answered_queries", LType.INT64),
+            Field("groups", LType.INT64))),
         # live MVCC snapshot pins (SET SNAPSHOT + automatic analytical
         # pins): what holds the GC watermark right now
         "snapshots": Schema((Field("snapshot_ts", LType.INT64),

@@ -17,11 +17,13 @@ from ..expr.ast import (AggCall, Call, ColRef, Expr, Lit, Placeholder,
                         Subquery, WindowCall)
 from .lexer import SqlError, Token, tokenize
 from .stmt import (AlterTableStmt, ColumnDef, CreateDatabaseStmt,
+                   CreateMatViewStmt, CreateSubscriptionStmt,
                    CreateTableStmt, CreateUserStmt, CreateViewStmt,
                    DeallocateStmt, DeleteStmt, DescribeStmt,
-                   DropDatabaseStmt, DropTableStmt,
+                   DropDatabaseStmt, DropMatViewStmt, DropSubscriptionStmt,
+                   DropTableStmt,
                    DropUserStmt, DropViewStmt, ExecuteStmt, ExplainStmt,
-                   GrantStmt, HandleStmt, InsertStmt, JoinClause,
+                   FetchStmt, GrantStmt, HandleStmt, InsertStmt, JoinClause,
                    KillStmt, LoadDataStmt, OrderItem, PrepareStmt, RevokeStmt,
                    SelectItem,
                    SelectStmt, SetStmt, ShowStmt, TableRef, TruncateStmt, TxnStmt,
@@ -148,6 +150,14 @@ class Parser:
                 if p.lower() != "prepare":
                     raise SqlError(f"expected PREPARE, got {p!r}")
                 return DeallocateStmt(self.ident())
+            if w == "fetch":
+                # FETCH [n] FROM subscription
+                self.advance()
+                limit = 0
+                if self.peek().kind == "NUM":
+                    limit = int(self.advance().value)
+                self.expect_kw("from")
+                return FetchStmt(self.ident(), limit)
         if t.kind != "KW":
             raise SqlError(f"expected statement, got {t.value!r} at {t.pos}")
         if t.value in ("select", "with"):
@@ -605,6 +615,39 @@ class Parser:
             self.expect_kw("replace")
             or_replace = True
         if self.peek().kind == "IDENT" and \
+                self.peek().value.lower() == "materialized":
+            # CREATE MATERIALIZED VIEW [IF NOT EXISTS] name AS select
+            if or_replace:
+                raise SqlError("OR REPLACE does not apply to "
+                               "MATERIALIZED VIEW (DROP then CREATE)")
+            self.advance()
+            if not (self.peek().kind == "IDENT" and
+                    self.peek().value.lower() == "view"):
+                raise SqlError("expected VIEW after MATERIALIZED")
+            self.advance()
+            ine = self._if_not_exists()
+            table = self.table_name()
+            self.expect_kw("as")
+            start = self.peek().pos
+            sel = self.select_stmt()            # validates the body
+            end = self.peek().pos if not self.at_end() else len(self.sql)
+            body = self.sql[start:end].strip().rstrip(";").strip() \
+                if self.sql else ""
+            if not body:
+                raise SqlError("CREATE MATERIALIZED VIEW needs source text")
+            del sel     # registration re-parses + validates from text
+            return CreateMatViewStmt(table, body, ine)
+        if self.peek().kind == "IDENT" and \
+                self.peek().value.lower() == "subscription":
+            # CREATE SUBSCRIPTION [IF NOT EXISTS] name [ON table]
+            if or_replace:
+                raise SqlError("OR REPLACE does not apply to SUBSCRIPTION")
+            self.advance()
+            ine = self._if_not_exists()
+            name = self.ident()
+            table = self.table_name() if self.try_kw("on") else None
+            return CreateSubscriptionStmt(name, table, ine)
+        if self.peek().kind == "IDENT" and \
                 self.peek().value.lower() == "view":
             # CREATE [OR REPLACE] VIEW name [(col, ...)] AS select
             self.advance()
@@ -957,6 +1000,20 @@ class Parser:
             self.advance()
             ie = self._if_exists()
             return DropUserStmt(self._user_name(), ie)
+        if self.peek().kind == "IDENT" and \
+                self.peek().value.lower() == "materialized":
+            self.advance()
+            if not (self.peek().kind == "IDENT" and
+                    self.peek().value.lower() == "view"):
+                raise SqlError("expected VIEW after MATERIALIZED")
+            self.advance()
+            ie = self._if_exists()
+            return DropMatViewStmt(self.table_name(), ie)
+        if self.peek().kind == "IDENT" and \
+                self.peek().value.lower() == "subscription":
+            self.advance()
+            ie = self._if_exists()
+            return DropSubscriptionStmt(self.ident(), ie)
         if self.peek().kind == "IDENT" and \
                 self.peek().value.lower() == "view":
             self.advance()

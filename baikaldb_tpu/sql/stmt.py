@@ -144,6 +144,44 @@ class DropViewStmt:
 
 
 @dataclass
+class CreateMatViewStmt:
+    """CREATE MATERIALIZED VIEW name AS select — an incrementally
+    maintained GROUP BY rollup (cdc/views.py)."""
+    table: TableRef
+    select_sql: str              # the view body, stored as SQL text
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropMatViewStmt:
+    table: TableRef
+    if_exists: bool = False
+
+
+@dataclass
+class CreateSubscriptionStmt:
+    """CREATE SUBSCRIPTION name [ON table] — a durable named CDC cursor
+    (cdc/streams.py)."""
+    name: str
+    table: Optional[TableRef] = None
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSubscriptionStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class FetchStmt:
+    """FETCH [n] FROM subscription — deliver the next batch of change
+    events and durably advance the cursor past them."""
+    name: str
+    limit: int = 0               # 0 = cdc_fetch_batch flag default
+
+
+@dataclass
 class TruncateStmt:
     table: TableRef
 

@@ -38,12 +38,19 @@ import json
 import os
 import struct
 import threading
-from bisect import insort
+import time
+from bisect import bisect_right, insort
 from dataclasses import asdict, dataclass, field
 from typing import Iterator, Optional
 
 from ..chaos import failpoint
 from ..meta.service import Tso
+from ..utils.flags import FLAGS, define
+
+define("cdc_cursor_max_lag_s", 3600.0,
+       "a subscription cursor that has not acked for this many seconds "
+       "stops holding binlog GC; the next fetch on it raises CursorLagging "
+       "with the lost range instead of silently skipping events")
 
 _EVT = b"e"
 _CUR = b"c"
@@ -108,6 +115,14 @@ class Binlog:
         self._path = path
         self._cursors: dict[str, int] = {}
         self._trimmed_since_compact = 0
+        # subscription GC holds: holder name -> (acked commit_ts, wall time
+        # of the last ack).  Trim never drops an event a holder has not
+        # acked — unless the holder's ack is older than
+        # cdc_cursor_max_lag_s, in which case it is force-expired and the
+        # lost-from ts is parked in _gc_expired for the holder's next fetch
+        # to surface as a typed CursorLagging (never silent loss).
+        self._gc_holds: dict[str, tuple[int, float]] = {}
+        self._gc_expired: dict[str, int] = {}
         if path:
             from .rowstore import RowTable
 
@@ -207,19 +222,51 @@ class Binlog:
         with self._cv:
             insort(self._events, ev, key=lambda e: e.commit_ts)
             if len(self._events) > self.capacity:
-                drop = len(self._events) - self.capacity
-                self._oldest_ts = self._events[drop - 1].commit_ts
-                self._persist(
-                    [(1, _ekey(e.commit_ts), b"")
-                     for e in self._events[:drop]] +
-                    [(0, _GCW, struct.pack("<Q", self._oldest_ts))])
-                del self._events[:drop]
-                self._trimmed_since_compact += drop
-                if self._table is not None and \
-                        self._trimmed_since_compact >= self.capacity:
-                    self._compact_log_locked()
+                self._trim_locked()
             self._cv.notify_all()
             return ts
+
+    def _trim_locked(self):
+        """Trim the ring down to capacity, clamped at the oldest unacked
+        subscription cursor (reference: the capturer checkpoint holds the
+        binlog-region GC safepoint).  Caller holds _mu."""
+        from ..utils import metrics
+
+        want = len(self._events) - self.capacity
+        if want <= 0:
+            return
+        drop = want
+        if self._gc_holds:
+            now = time.monotonic()
+            max_lag = float(FLAGS.cdc_cursor_max_lag_s)
+            for name, (acked, last_ack) in list(self._gc_holds.items()):
+                if now - last_ack > max_lag:
+                    # force-expire: stop holding, remember where the hole
+                    # starts so the holder's next fetch raises CursorLagging
+                    self._gc_expired[name] = acked
+                    del self._gc_holds[name]
+                    metrics.cdc_cursors_expired.add(1)
+        if self._gc_holds:
+            min_hold = min(ts for ts, _ in self._gc_holds.values())
+            # every holder has acked events with commit_ts <= its hold ts;
+            # anything newer than the slowest hold is pinned
+            allowed = bisect_right(
+                [e.commit_ts for e in self._events], min_hold)
+            if allowed < want:
+                metrics.binlog_gc_held_by_cursor.add(want - allowed)
+            drop = min(want, allowed)
+        if drop <= 0:
+            return
+        self._oldest_ts = self._events[drop - 1].commit_ts
+        self._persist(
+            [(1, _ekey(e.commit_ts), b"")
+             for e in self._events[:drop]] +
+            [(0, _GCW, struct.pack("<Q", self._oldest_ts))])
+        del self._events[:drop]
+        self._trimmed_since_compact += drop
+        if self._table is not None and \
+                self._trimmed_since_compact >= self.capacity:
+            self._compact_log_locked()
 
     def current_ts(self) -> int:
         with self._mu:
@@ -250,6 +297,32 @@ class Binlog:
             self._cursors[name] = position
         self._persist([(0, _CUR + name.encode(),
                         struct.pack("<Q", position))])
+
+    # -- subscription GC holds --------------------------------------------
+    def hold_gc(self, name: str, acked_ts: int):
+        """Pin GC behind ``acked_ts`` for holder ``name`` (call on every
+        ack — the wall time of the newest call feeds force-expiry)."""
+        with self._mu:
+            self._gc_holds[name] = (acked_ts, time.monotonic())
+
+    def release_gc(self, name: str):
+        with self._mu:
+            self._gc_holds.pop(name, None)
+            self._gc_expired.pop(name, None)
+
+    def take_expired(self, name: str) -> Optional[int]:
+        """If ``name`` was force-expired past cdc_cursor_max_lag_s, return
+        the commit_ts its hold stood at (events after it may be gone) and
+        clear the mark; else None."""
+        with self._mu:
+            return self._gc_expired.pop(name, None)
+
+    def min_hold(self) -> Optional[int]:
+        """Oldest held commit_ts across active subscription cursors."""
+        with self._mu:
+            if not self._gc_holds:
+                return None
+            return min(ts for ts, _ in self._gc_holds.values())
 
 
 class Capturer:

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import json
 import time
+import weakref
 from typing import Optional
 
 from ..chaos import failpoint
@@ -49,6 +50,24 @@ define("binlog_prewrite_grace_s", 30.0,
        "outcome is expired (its writer died mid-2PC)")
 
 BINLOG_TABLE_KEY = "__binlog__.events"
+
+# subscription GC holds, per cluster: cursor name -> acked commit_ts.  gc()
+# never tombstones a committed event a registered cursor has not acked
+# (reference: the capturer checkpoint is the binlog-region GC safepoint).
+_GC_HOLDS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def register_gc_hold(cluster, name: str, acked_ts: int) -> None:
+    _GC_HOLDS.setdefault(cluster, {})[name] = int(acked_ts)
+
+
+def release_gc_hold(cluster, name: str) -> None:
+    _GC_HOLDS.get(cluster, {}).pop(name, None)
+
+
+def min_gc_hold(cluster) -> Optional[int]:
+    holds = _GC_HOLDS.get(cluster)
+    return min(holds.values()) if holds else None
 
 _FIELDS = (Field("ts", LType.INT64, False),
            Field("state", LType.INT64, False),      # 0 prewrite, 1 commit
@@ -319,8 +338,15 @@ class BinlogCapturer:
 
     def gc(self, before_ts: Optional[int] = None) -> int:
         """Tombstone emitted commit rows below ``before_ts`` (default: the
-        capturer checkpoint) — the binlog's bounded-retention story."""
+        capturer checkpoint) — the binlog's bounded-retention story.  The
+        limit is clamped at the oldest unacked subscription cursor
+        (register_gc_hold), so a slow subscriber never has events GC'd out
+        from under it silently."""
         limit = self.checkpoint if before_ts is None else int(before_ts)
+        hold = min_gc_hold(self.cluster)
+        if hold is not None and hold < limit:
+            metrics.binlog_gc_held_by_cursor.add(1)
+            limit = hold
         victims = [r for r in self.tier.scan_rows()
                    if not r.get("__del") and r["state"] == 1
                    and int(r["ts"]) <= limit]

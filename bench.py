@@ -1785,6 +1785,150 @@ def run_snapshot_bench() -> dict:
     }
 
 
+def run_cdc_bench() -> dict:
+    """CDC/rollup-view line: a GROUP BY dashboard read answered from an
+    incrementally maintained materialized view while an
+    insert/update/delete stream mutates the base table, vs the same read
+    recomputed from base rows.  The hard contract gated by
+    tools/bench_regress.py: ZERO lost change events (an audit
+    subscription replays the full stream and every write is accounted
+    for), a nonzero number of deltas actually folded (the view was
+    maintained incrementally, not rebuilt), and at quiesce the view
+    answer is BIT-IDENTICAL to the recompute — if it is not, this
+    function refuses to emit timings and reports the divergence
+    instead."""
+    from baikaldb_tpu.exec.session import Database, Session
+    import baikaldb_tpu.cdc.views  # noqa: F401 — registers the flags
+    from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+    n_writes = int(os.environ.get("BENCH_CDC_WRITES", 240))
+    n_reads = int(os.environ.get("BENCH_CDC_READS", 24))
+    seed_rows = int(os.environ.get("BENCH_CDC_SEED_ROWS", 4096))
+    agg_sql = ("SELECT g, COUNT(*) AS c, SUM(v) AS sv, MIN(v) AS mn, "
+               "MAX(v) AS mx FROM t GROUP BY g ORDER BY g")
+
+    def pq(lat: list, q: float) -> float:
+        srt = sorted(lat)
+        return round(srt[min(len(srt) - 1, int(q * (len(srt) - 1) + 0.5))],
+                     3)
+
+    def mk():
+        s = Session(Database())
+        s.execute("CREATE DATABASE cb")
+        s.execute("USE cb")
+        s.execute("CREATE TABLE t (k BIGINT, g BIGINT, v BIGINT, "
+                  "PRIMARY KEY (k))")
+        vals = ", ".join(f"({i}, {i % 8}, {i * 3})"
+                         for i in range(seed_rows))
+        s.execute(f"INSERT INTO t VALUES {vals}")
+        s.execute("CREATE MATERIALIZED VIEW mv AS SELECT g, COUNT(*), "
+                  "SUM(v), MIN(v), MAX(v) FROM t GROUP BY g")
+        s.query(agg_sql)    # untimed warmup: compile the read path once
+        return s
+
+    burst = max(1, n_writes // n_reads)
+
+    def drive(s) -> tuple[list[float], list[int], int]:
+        """The shared load: write bursts interleaved with timed GROUP BY
+        reads.  Returns (read latencies, staleness samples, rows
+        touched) — identical statement sequence for both phases, so the
+        read timings differ only by who answers them."""
+        mv = s.db.matviews.get("cb", "mv")
+        issued = seed_rows
+        applied = 0
+        lat: list[float] = []
+        stale: list[int] = []
+        for r in range(n_reads):
+            for i in range(burst):
+                k = issued
+                if i % 5 == 4:
+                    res = s.execute(
+                        f"UPDATE t SET v = v + 1 WHERE k = {k % 64}")
+                elif i % 5 == 3:
+                    res = s.execute(f"DELETE FROM t WHERE k = {k % 96}")
+                else:
+                    res = s.execute(
+                        f"INSERT INTO t VALUES ({k}, {k % 8}, {k * 3})")
+                    issued += 1
+                applied += int(res.affected_rows)
+            a0 = time.perf_counter()
+            s.query(agg_sql)
+            lat.append((time.perf_counter() - a0) * 1e3)
+            stale.append(int(mv.staleness_ms()))
+        return lat, stale, applied
+
+    answer0 = bool(FLAGS.matview_answer)
+    try:
+        # view phase: reads answered from the maintained rollup (each
+        # read folds the burst's pending deltas first — maintenance cost
+        # is IN the number, not hidden); an audit subscription replays
+        # the whole change stream afterwards to prove nothing was lost
+        set_flag("matview_answer", 1)
+        s = mk()
+        audit = s.db.cdc.create("bench_audit", table_key="cb.t")
+        view_ms, stale_ms, applied = drive(s)
+
+        # quiesce: the view answer must be bit-identical to the
+        # recompute of the same table — the emit gate
+        view_rows = s.query(agg_sql)
+        set_flag("matview_answer", 0)
+        base_rows = s.query(agg_sql)
+        agree = view_rows == base_rows
+
+        # audit replay: every row the write loop touched must appear in
+        # the stream (the subscription started at the live tail, so the
+        # seed INSERT is excluded; the view's backing-table traffic is
+        # excluded by the cb.t table filter)
+        seen = 0
+        while True:
+            got = audit.fetch(4096)
+            if not got:
+                break
+            seen += sum(int(e.affected) for e in got)
+            audit.ack(got[-1].commit_ts)
+        s.db.cdc.drop("bench_audit")
+        lost = applied - seen
+        d = s.db.matviews.get("cb", "mv").describe()
+
+        # recompute phase: the IDENTICAL interleave against a fresh
+        # session with the view switched off — reads scan+aggregate base
+        # rows under the same live write pressure
+        s = mk()
+        recompute_ms, _, _ = drive(s)
+    finally:
+        set_flag("matview_answer", int(answer0))
+
+    if not agree:
+        raise RuntimeError(
+            "view answer diverged from recompute at quiesce: "
+            f"view={view_rows[:4]!r}... base={base_rows[:4]!r}...")
+    qps_view = n_reads / (sum(view_ms) / 1e3)
+    qps_re = n_reads / (sum(recompute_ms) / 1e3)
+    return {
+        "metric": f"rollup views: GROUP BY answered from maintained view "
+                  f"vs recompute under live writes ({n_writes} writes, "
+                  f"{n_reads} reads)",
+        "value": round(qps_view, 1),
+        "unit": "queries/sec",
+        # >1 means the view read beats recomputing the aggregate
+        "vs_baseline": round(qps_view / qps_re, 3),
+        "view_read_p50_ms": pq(view_ms, 0.50),
+        "view_read_p99_ms": pq(view_ms, 0.99),
+        "recompute_p50_ms": pq(recompute_ms, 0.50),
+        "recompute_p99_ms": pq(recompute_ms, 0.99),
+        "staleness_p50_ms": pq([float(x) for x in stale_ms], 0.50),
+        "staleness_max_ms": int(max(stale_ms)),
+        "deltas_folded": int(d["deltas_folded"]),
+        "view_rescans": int(d["rescans"]),
+        "events_streamed": int(seen),
+        "lost_events": int(lost),
+        "quiesced_agree": bool(agree),
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": _git_head(),
+        **_hardware_context(),
+    }
+
+
 def _emit_fragment_line(skip_reason: str | None = None):
     """Pushed-fragment JSON line: store-side execution vs the frontend
     funnel, plus the dispatch counters bench_regress gates on.  Same
@@ -1827,6 +1971,33 @@ def _emit_snapshot_line(skip_reason: str | None = None):
     except Exception as e:                              # noqa: BLE001
         result = {"metric": "snapshot reads: pinned GROUP BY under live "
                             "inserts+updates vs mvcc off (failed)",
+                  "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
+
+
+def _emit_cdc_line(skip_reason: str | None = None):
+    """CDC/rollup-view JSON line: view-answered GROUP BY vs recompute
+    under live writes, plus the exactly-once counters bench_regress
+    gates on.  run_cdc_bench refuses to return timings unless the view
+    and the recompute agree bit-identically at quiesce — a divergence
+    surfaces here as an error line, never as a number.  Same robustness
+    contract: always prints a line, never raises."""
+    if os.environ.get("BENCH_SKIP_CDC") == "1":
+        return
+    if skip_reason is not None:
+        print(json.dumps({
+            "metric": "rollup views: GROUP BY answered from maintained "
+                      "view vs recompute under live writes (skipped)",
+            "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
+            "error": skip_reason}))
+        return
+    try:
+        result = run_cdc_bench()
+    except Exception as e:                              # noqa: BLE001
+        result = {"metric": "rollup views: GROUP BY answered from "
+                            "maintained view vs recompute under live "
+                            "writes (failed)",
                   "value": 0, "unit": "queries/sec", "vs_baseline": 0.0,
                   "error": f"{type(e).__name__}: {e}"}
     print(json.dumps(result))
@@ -2182,6 +2353,8 @@ def main():
                                     "failed; fragment phase skipped")
                 _emit_snapshot_line(skip_reason="accelerator probe "
                                     "failed; snapshot phase skipped")
+                _emit_cdc_line(skip_reason="accelerator probe "
+                               "failed; cdc phase skipped")
                 return 0
             if no_fallback:
                 # tpu_watch mode: a clean failure, not a multi-minute CPU
@@ -2229,6 +2402,7 @@ def main():
             _emit_stream_line()
             _emit_fragment_line()
             _emit_snapshot_line()
+            _emit_cdc_line()
             return 0
     print(json.dumps(result))
     _emit_mixed_line()
@@ -2245,6 +2419,7 @@ def main():
     _emit_stream_line()
     _emit_fragment_line()
     _emit_snapshot_line()
+    _emit_cdc_line()
     return 0
 
 

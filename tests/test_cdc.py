@@ -1,0 +1,294 @@
+"""CDC change streams + incrementally maintained rollup views.
+
+Contract under test (cdc/streams.py, cdc/views.py):
+
+- the k-way merge orders by commit_ts with a DETERMINISTIC tiebreak
+  (feed id, then arrival index) so equal-ts events replay identically,
+- subscription cursors are durable resume tokens: a restarted frontend
+  resumes exactly at the last acked commit_ts (no gap, no duplicate),
+- binlog GC clamps at the slowest unacked cursor; a cursor silent past
+  ``cdc_cursor_max_lag_s`` is force-expired and its next fetch raises the
+  typed CursorLagging with the lost range (never silent loss),
+- a materialized view answered from folded partial state is
+  BIT-IDENTICAL to recomputing from the base table, including string and
+  NULL group keys and COUNT/SUM/MIN/MAX/AVG measures, and the
+  ``matview_answer=0`` off-switch is exact by construction.
+"""
+
+import pytest
+
+from baikaldb_tpu.cdc.streams import CursorLagging, merge_by_commit_ts
+from baikaldb_tpu.exec.session import Database, PlanError, Session
+from baikaldb_tpu.storage.binlog import Binlog
+from baikaldb_tpu.utils import metrics
+from baikaldb_tpu.utils.flags import FLAGS, set_flag
+
+
+def _session(db=None):
+    s = Session(db or Database())
+    s.execute("CREATE DATABASE IF NOT EXISTS d")
+    s.execute("USE d")
+    return s
+
+
+# -- merge ----------------------------------------------------------------
+
+def test_merge_equal_ts_deterministic_tiebreak():
+    # two feeds with colliding commit_ts: feed id breaks the tie, then
+    # arrival order within a feed — identical on every replay
+    f0 = [{"commit_ts": 5, "tag": "a0"}, {"commit_ts": 7, "tag": "a1"}]
+    f1 = [{"commit_ts": 5, "tag": "b0"}, {"commit_ts": 5, "tag": "b1"}]
+    runs = [[e["tag"] for e in merge_by_commit_ts([(0, list(f0)),
+                                                   (1, list(f1))])]
+            for _ in range(3)]
+    assert runs[0] == ["a0", "b0", "b1", "a1"]
+    assert runs.count(runs[0]) == 3
+    # swapping feed ids swaps the interleave — the id IS the tiebreak
+    flipped = [e["tag"] for e in merge_by_commit_ts([(1, list(f0)),
+                                                     (0, list(f1))])]
+    assert flipped == ["b0", "b1", "a0", "a1"]
+
+
+# -- GC holds -------------------------------------------------------------
+
+def test_gc_clamps_at_oldest_unacked_cursor():
+    b = Binlog(capacity=4)
+    b.hold_gc("slow", 0)            # acked nothing yet
+    held0 = metrics.binlog_gc_held_by_cursor.value
+    ts = [b.append("insert", "d", "t", rows=[{"i": i}]) for i in range(9)]
+    # over capacity, but every event is pinned behind the hold
+    assert [e.commit_ts for e in b.read(0)] == ts
+    assert metrics.binlog_gc_held_by_cursor.value > held0
+    assert b.min_hold() == 0
+    # the cursor acks half way: the next append may trim THROUGH its ack
+    b.hold_gc("slow", ts[5])
+    b.append("insert", "d", "t", rows=[{"i": 9}])
+    assert b._oldest_ts <= ts[5]
+    assert b.read(ts[5])            # acked boundary still readable
+    with pytest.raises(ValueError):
+        b.read(0)
+    b.release_gc("slow")
+
+
+def test_cursor_lagging_on_force_expiry():
+    db = Database()
+    db.binlog = Binlog(capacity=4)
+    s = _session(db)
+    s.execute("CREATE TABLE t (id BIGINT, v BIGINT, PRIMARY KEY(id))")
+    sub = db.cdc.create("lagger", table_key="d.t")
+    prev = float(FLAGS.cdc_cursor_max_lag_s)
+    set_flag("cdc_cursor_max_lag_s", 0)
+    try:
+        for i in range(10):
+            s.execute(f"INSERT INTO t VALUES ({i}, {i})")
+        with pytest.raises(CursorLagging) as ei:
+            sub.fetch()
+        assert ei.value.subscription == "lagger"
+        assert ei.value.lost_to == db.binlog._oldest_ts
+        # typed loss raised ONCE; the cursor resumes from oldest retained
+        got = sub.fetch()
+        assert got and got[0].commit_ts > db.binlog._oldest_ts
+    finally:
+        set_flag("cdc_cursor_max_lag_s", prev)
+
+
+def test_subscription_pins_gc_until_acked():
+    db = Database()
+    db.binlog = Binlog(capacity=4)
+    s = _session(db)
+    s.execute("CREATE TABLE t (id BIGINT, v BIGINT, PRIMARY KEY(id))")
+    sub = db.cdc.create("audit", table_key="d.t")
+    for i in range(8):
+        s.execute(f"INSERT INTO t VALUES ({i}, {i})")
+    # capacity 4, but the unacked cursor pinned all 8 events: none lost
+    evs = sub.fetch(100)
+    assert [r["id"] for e in evs for r in e.rows] == list(range(8))
+    sub.ack(evs[-1].commit_ts)
+    # acked: the next append is free to trim down to capacity
+    s.execute("INSERT INTO t VALUES (8, 8)")
+    assert len(db.binlog._events) <= db.binlog.capacity
+    assert len(sub.fetch(100)) == 1     # resume at the GC boundary: no gap
+
+
+# -- durable cursors across restart --------------------------------------
+
+def test_cursor_replays_exactly_after_restart(tmp_path):
+    d = str(tmp_path / "data")
+    db = Database(data_dir=d)
+    s = Session(db)
+    s.execute("CREATE DATABASE d")
+    s.execute("USE d")
+    s.execute("CREATE TABLE t (id BIGINT, v BIGINT, PRIMARY KEY(id))")
+    s.execute("CREATE SUBSCRIPTION audit ON t")
+    s.execute("INSERT INTO t VALUES (1, 10)")
+    s.execute("INSERT INTO t VALUES (2, 20)")
+    first = s.execute("FETCH 1 FROM audit")
+    assert len(first.rows) == 1         # delivered AND durably acked
+    db2 = Database(data_dir=d)
+    s2 = Session(db2)
+    s2.execute("USE d")
+    rows = s2.execute("FETCH 10 FROM audit").rows
+    # exactly the unacked tail: event 2 once — no gap, no duplicate
+    assert len(rows) == 1
+    assert '"id": 2' in rows[0][3]
+    assert s2.execute("FETCH 10 FROM audit").rows == []
+
+
+# -- matview exactness ----------------------------------------------------
+
+AGG = ("SELECT k, COUNT(*), SUM(v), MIN(v), MAX(v), AVG(v), COUNT(v) "
+       "FROM t GROUP BY k ORDER BY k")
+
+
+def _mv_session():
+    s = _session()
+    s.execute("CREATE TABLE t (k VARCHAR(16), v BIGINT, id BIGINT, "
+              "PRIMARY KEY(id))")
+    s.execute("CREATE MATERIALIZED VIEW mv AS "
+              "SELECT k, COUNT(*), SUM(v), MIN(v), MAX(v), AVG(v), "
+              "COUNT(v) FROM t GROUP BY k")
+    return s
+
+
+def _both(s, sql):
+    """(view answer, recompute) for the same statement."""
+    on = s.query(sql)
+    set_flag("matview_answer", 0)
+    try:
+        off = s.query(sql)
+    finally:
+        set_flag("matview_answer", 1)
+    return on, off
+
+
+def test_view_bit_identical_with_string_and_null_keys():
+    s = _mv_session()
+    s.execute("INSERT INTO t VALUES ('a', 1, 1), ('a', 5, 2), "
+              "('b', 7, 3), (NULL, 2, 4), (NULL, NULL, 5)")
+    on, off = _both(s, AGG)
+    assert on == off
+    assert {r["k"] for r in on} == {"a", "b", None}
+    # NULL measure: COUNT(v) < COUNT(*), AVG over non-null only — exact
+    nrow = next(r for r in on if r["k"] is None)
+    assert nrow["count_star()"] == 2 and nrow["count(v)"] == 1
+
+
+def test_view_folds_updates_and_deletes_incrementally():
+    s = _mv_session()
+    mv = s.db.matviews.get("d", "mv")
+    s.execute("INSERT INTO t VALUES ('a', 1, 1), ('a', 5, 2), "
+              "('a', 3, 6), ('b', 7, 3)")
+    assert _both(s, AGG)[0] == _both(s, AGG)[1]
+    seeds = mv.rescans                  # the initial seed scan(s)
+    s.execute("UPDATE t SET v = 4 WHERE id = 6")    # not the min/max: folds
+    s.execute("INSERT INTO t VALUES ('b', 2, 4)")
+    on, off = _both(s, AGG)
+    assert on == off
+    assert mv.deltas_folded >= 2
+    assert mv.rescans == seeds          # pure folds, no rescan
+    # deleting the group max forces a targeted single-group rescan
+    s.execute("DELETE FROM t WHERE id = 3")
+    on, off = _both(s, AGG)
+    assert on == off
+    assert mv.rescans == seeds + 1
+    # deleting a group's last row removes the group entirely
+    s.execute("DELETE FROM t WHERE k = 'b'")
+    on, off = _both(s, AGG)
+    assert on == off and {r["k"] for r in on} == {"a"}
+
+
+def test_view_absorbs_statement_image_traffic():
+    # bulk INSERT..SELECT and REPLACE log statement images (no row
+    # images): the view must fall back to a full re-seed, staying exact
+    s = _mv_session()
+    s.execute("INSERT INTO t VALUES ('a', 1, 1), ('b', 2, 2)")
+    s.execute("CREATE TABLE src (k VARCHAR(16), v BIGINT, id BIGINT, "
+              "PRIMARY KEY(id))")
+    s.execute("INSERT INTO src VALUES ('c', 9, 7), ('a', 3, 8)")
+    s.execute("INSERT INTO t SELECT k, v, id FROM src")
+    on, off = _both(s, AGG)
+    assert on == off
+    s.execute("REPLACE INTO t VALUES ('a', 100, 1)")
+    on, off = _both(s, AGG)
+    assert on == off
+    s.execute("TRUNCATE TABLE t")
+    on, off = _both(s, AGG)
+    assert on == off == []
+
+
+def test_explain_analyze_view_line():
+    s = _mv_session()
+    s.execute("INSERT INTO t VALUES ('a', 1, 1), ('b', 2, 2)")
+    lines = [r[0] for r in s.execute(
+        "EXPLAIN ANALYZE SELECT k, COUNT(*) FROM t GROUP BY k").rows]
+    view_lines = [x for x in lines if x.startswith("-- view: d.mv")]
+    assert len(view_lines) == 1
+    assert "staleness_ms=" in view_lines[0]
+    assert "groups=2" in view_lines[0]
+    set_flag("matview_answer", 0)
+    try:
+        lines = [r[0] for r in s.execute(
+            "EXPLAIN ANALYZE SELECT k, COUNT(*) FROM t GROUP BY k").rows]
+        assert not any(x.startswith("-- view:") for x in lines)
+    finally:
+        set_flag("matview_answer", 1)
+
+
+def test_view_not_used_inside_txn_or_snapshot():
+    s = _mv_session()
+    s.execute("INSERT INTO t VALUES ('a', 1, 1)")
+    s.query(AGG)                        # seed + answer once
+    mv = s.db.matviews.get("d", "mv")
+    answered = mv.answered
+    s.execute("SET SNAPSHOT = 'now'")
+    s.query(AGG)                        # pinned read: base table, not view
+    s.execute("SET SNAPSHOT = 0")
+    assert mv.answered == answered
+
+
+def test_matview_validation_errors():
+    s = _session()
+    s.execute("CREATE TABLE t (k VARCHAR(16), f DOUBLE, v BIGINT, "
+              "id BIGINT, PRIMARY KEY(id))")
+    with pytest.raises(PlanError):      # no GROUP BY
+        s.execute("CREATE MATERIALIZED VIEW m1 AS SELECT COUNT(*) FROM t")
+    with pytest.raises(PlanError):      # float measure: folds inexact
+        s.execute("CREATE MATERIALIZED VIEW m2 AS "
+                  "SELECT k, SUM(f) FROM t GROUP BY k")
+    with pytest.raises(PlanError):      # float group key
+        s.execute("CREATE MATERIALIZED VIEW m3 AS "
+                  "SELECT f, COUNT(*) FROM t GROUP BY f")
+    with pytest.raises(PlanError):      # WHERE not supported
+        s.execute("CREATE MATERIALIZED VIEW m4 AS SELECT k, COUNT(*) "
+                  "FROM t WHERE v > 0 GROUP BY k")
+    assert s.execute(
+        "SELECT * FROM information_schema.materialized_views").rows == []
+
+
+def test_drop_table_cascades_to_views_and_fetch_sql():
+    s = _mv_session()
+    s.execute("INSERT INTO t VALUES ('a', 1, 1)")
+    s.query(AGG)
+    assert [r[1] for r in s.execute(
+        "SELECT table_schema, view_name FROM "
+        "information_schema.materialized_views").rows] == ["mv"]
+    subs = {r[0] for r in s.execute(
+        "SELECT name FROM information_schema.subscriptions").rows}
+    assert "__mv!d.mv" in subs          # internal cursor is visible
+    with pytest.raises(PlanError):      # but not droppable directly
+        s.execute("DROP SUBSCRIPTION `__mv!d.mv`")
+    s.execute("DROP TABLE t")
+    assert s.execute("SELECT * FROM "
+                     "information_schema.materialized_views").rows == []
+    assert s.execute("SELECT * FROM "
+                     "information_schema.subscriptions").rows == []
+    with pytest.raises(PlanError):
+        s.execute("FETCH FROM nosuch")
+
+
+def test_show_tables_hides_mv_backing_table():
+    s = _mv_session()
+    names = {r[0] for r in s.execute("SHOW TABLES").rows}
+    assert names == {"t"}
+    # the hidden store exists and is what answers rewritten queries
+    assert "d.__mv_mv" in s.db.stores

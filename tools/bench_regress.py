@@ -47,6 +47,15 @@ restarts — plus the overlap contract: fold-loop blocked-on-staging time
 within ``--stream-wait-x`` (default 1.05) times the serial staging cost
 +5ms.  bit-identity vs the resident path is asserted inside bench.py
 itself before the line is ever emitted.
+
+The rollup-views line (GROUP BY answered from a maintained materialized
+view vs recompute under live writes) is gated on its exactly-once
+counters — zero change events lost between the write stream and the
+audit subscription's replay, nonzero ``deltas_folded`` (the view was
+maintained incrementally) — plus quiesced view/recompute bit-identity
+(asserted inside bench.py before the line is emitted, re-checked here)
+and a view-read p99 within ``--cdc-view-p99-x`` (default 2) times the
+same capture's recompute p99.
 """
 
 from __future__ import annotations
@@ -62,7 +71,7 @@ def load_capture(path: str) -> dict:
     Unknown/summary lines are ignored."""
     out: dict = {"header": None, "queries": {}, "coldstart": None,
                  "progress": None, "elastic": None, "stream": None,
-                 "fragments": None, "snapshot": None}
+                 "fragments": None, "snapshot": None, "cdc": None}
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -92,6 +101,8 @@ def load_capture(path: str) -> dict:
                 out["fragments"] = row
             elif str(row.get("metric", "")).startswith("snapshot reads"):
                 out["snapshot"] = row
+            elif str(row.get("metric", "")).startswith("rollup views"):
+                out["cdc"] = row
     return out
 
 
@@ -287,6 +298,46 @@ def compare_snapshot(cand: dict, p99_factor: float) -> list:
     return problems
 
 
+def compare_cdc(cand: dict, p99_factor: float) -> list:
+    """CDC/rollup-view contract on the candidate capture (skipped/failed
+    lines are ignored).  Hard gates are the deterministic exactly-once
+    bits: ZERO change events lost between the write stream and the audit
+    subscription's replay, a NONZERO number of deltas actually folded
+    (a refactor that silently falls back to full rebuilds on every event
+    would otherwise pass on correctness alone), and the quiesced view
+    answer bit-identical to the recompute — bench.py refuses to emit
+    timings at all when that bit is false, so its absence here is also a
+    failure.  The latency gate bounds the view-read p99 by
+    ``--cdc-view-p99-x`` times the same capture's recompute p99 (default
+    2; 0 disables): the view read folds the pending write burst before
+    answering, so it may pay maintenance the recompute does not, but a
+    maintained rollup whose reads cost MULTIPLES of recomputing the
+    aggregate from scratch has lost its reason to exist."""
+    c = cand.get("cdc")
+    if c is None or c.get("error") or not c.get("value"):
+        return []
+    problems = []
+    if c.get("lost_events", 0) != 0:
+        problems.append(
+            f"cdc: {c['lost_events']} change events lost between the "
+            f"write stream and the audit replay (must be 0)")
+    if c.get("deltas_folded", 0) <= 0:
+        problems.append(
+            "cdc: deltas_folded=0 — the view was never maintained "
+            "incrementally (every event fell back to rebuild/rescan)")
+    if not c.get("quiesced_agree", False):
+        problems.append(
+            "cdc: quiesced view answer not bit-identical to recompute")
+    if p99_factor > 0 and c.get("recompute_p99_ms"):
+        lim = c["recompute_p99_ms"] * p99_factor
+        if c.get("view_read_p99_ms", 0.0) > lim:
+            problems.append(
+                f"cdc: view-read p99 {c['view_read_p99_ms']}ms > "
+                f"{p99_factor}x recompute p99 ({c['recompute_p99_ms']}ms) "
+                f"— the maintained rollup is slower than recomputing")
+    return problems
+
+
 def compare(base: dict, cand: dict, wall_clock_pct: float = 0.0) -> list:
     """-> list of human-readable regression strings (empty = clean)."""
     problems = []
@@ -348,13 +399,17 @@ def main(argv=None) -> int:
                     help="snapshot-reads mixed-phase write-p99 ceiling as "
                          "a multiple of the same capture's write-only "
                          "isolation p99 (0 = consistency bits only)")
+    ap.add_argument("--cdc-view-p99-x", type=float, default=2.0,
+                    help="rollup-view read-p99 ceiling as a multiple of "
+                         "the same capture's recompute p99 (0 = "
+                         "exactly-once counters only)")
     args = ap.parse_args(argv)
     base = load_capture(args.baseline)
     cand = load_capture(args.candidate)
     if not base["queries"] and base["coldstart"] is None \
             and cand["progress"] is None and cand["elastic"] is None \
             and cand["stream"] is None and cand["fragments"] is None \
-            and cand["snapshot"] is None:
+            and cand["snapshot"] is None and cand["cdc"] is None:
         print(f"bench_regress: no query or cold-start rows in "
               f"{args.baseline}", file=sys.stderr)
         return 2
@@ -365,6 +420,7 @@ def main(argv=None) -> int:
     problems += compare_stream(cand, args.stream_wait_x)
     problems += compare_fragments(cand)
     problems += compare_snapshot(cand, args.snapshot_p99_x)
+    problems += compare_cdc(cand, args.cdc_view_p99_x)
     compared = []
     if base["queries"]:
         compared.append(f"{len(base['queries'])} queries")
@@ -380,6 +436,8 @@ def main(argv=None) -> int:
         compared.append("pushed-fragments line")
     if cand["snapshot"] is not None:
         compared.append("snapshot-reads line")
+    if cand["cdc"] is not None:
+        compared.append("rollup-views line")
     if problems:
         for p in problems:
             print(f"REGRESSION {p}")
